@@ -1,0 +1,19 @@
+"""bng_tpu — a TPU-native Broadband Network Gateway framework.
+
+A from-scratch reimplementation of the capabilities of codelaboratoryltd/bng
+(Go + eBPF/XDP) designed for TPU hardware:
+
+- The eBPF/XDP fast path (bpf/dhcp_fastpath.c, bpf/nat44.c,
+  bpf/qos_ratelimit.c, bpf/antispoof.c) becomes a single fused JAX/Pallas
+  batched-packet pipeline (`bng_tpu.ops.pipeline`) operating on [B, 512]
+  uint8 packet batches in HBM/VMEM.
+- The eBPF maps (bpf/maps.h) become HBM-resident cuckoo hash tables
+  (`bng_tpu.ops.table`) with the host as single writer — mirroring the
+  reference's slow-path-populates-cache design (pkg/dhcp/server.go:1057).
+- The Go control plane (pkg/dhcp, pkg/allocator, pkg/radius, pkg/nexus,
+  pkg/ha, pkg/resilience, ...) becomes the `bng_tpu.control` package.
+- Scale-out is jax.sharding over a device Mesh with ICI collectives
+  (`bng_tpu.parallel`) instead of the reference's HTTP/SSE + libp2p mesh.
+"""
+
+__version__ = "0.1.0"

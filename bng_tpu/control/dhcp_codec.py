@@ -1,0 +1,179 @@
+"""DHCPv4 wire codec (RFC 2131/2132) — slow-path + test golden reference.
+
+The reference uses the insomniacslk/dhcp library for its Go slow path
+(pkg/dhcp/server.go); this is our from-scratch equivalent. Option-82
+sub-option parsing mirrors parseOption82 (pkg/dhcp/server.go:201-238).
+"""
+
+from __future__ import annotations
+
+import struct
+from dataclasses import dataclass, field
+
+DHCP_MAGIC = 0x63825363
+
+# Message types
+DISCOVER, OFFER, REQUEST, DECLINE, ACK, NAK, RELEASE, INFORM = range(1, 9)
+
+# Option codes (subset used by the BNG; bpf/maps.h:24-41)
+OPT_PAD = 0
+OPT_SUBNET_MASK = 1
+OPT_ROUTER = 3
+OPT_DNS = 6
+OPT_HOSTNAME = 12
+OPT_REQUESTED_IP = 50
+OPT_LEASE_TIME = 51
+OPT_MSG_TYPE = 53
+OPT_SERVER_ID = 54
+OPT_PARAM_REQ_LIST = 55
+OPT_RENEWAL_TIME = 58
+OPT_REBIND_TIME = 59
+OPT_VENDOR_CLASS = 60
+OPT_CLIENT_ID = 61
+OPT_RELAY_AGENT_INFO = 82
+OPT_END = 255
+
+OPT82_CIRCUIT_ID = 1
+OPT82_REMOTE_ID = 2
+
+
+@dataclass
+class DHCPPacket:
+    op: int = 1  # 1=BOOTREQUEST 2=BOOTREPLY
+    htype: int = 1
+    hlen: int = 6
+    hops: int = 0
+    xid: int = 0
+    secs: int = 0
+    flags: int = 0
+    ciaddr: int = 0
+    yiaddr: int = 0
+    siaddr: int = 0
+    giaddr: int = 0
+    chaddr: bytes = b"\x00" * 6  # client MAC (first hlen bytes)
+    sname: bytes = b""
+    file: bytes = b""
+    options: list[tuple[int, bytes]] = field(default_factory=list)
+
+    # -- option helpers --
+    def opt(self, code: int) -> bytes | None:
+        for c, v in self.options:
+            if c == code:
+                return v
+        return None
+
+    @property
+    def msg_type(self) -> int:
+        v = self.opt(OPT_MSG_TYPE)
+        return v[0] if v else 0
+
+    @property
+    def requested_ip(self) -> int:
+        v = self.opt(OPT_REQUESTED_IP)
+        return struct.unpack("!I", v)[0] if v and len(v) == 4 else 0
+
+    @property
+    def server_id(self) -> int:
+        v = self.opt(OPT_SERVER_ID)
+        return struct.unpack("!I", v)[0] if v and len(v) == 4 else 0
+
+    def option82(self) -> tuple[bytes, bytes]:
+        """Extract (circuit_id, remote_id) from Option 82 sub-options.
+
+        Parity: parseOption82, pkg/dhcp/server.go:201-238.
+        """
+        v = self.opt(OPT_RELAY_AGENT_INFO)
+        circuit, remote = b"", b""
+        if not v:
+            return circuit, remote
+        i = 0
+        while i + 2 <= len(v):
+            sub, slen = v[i], v[i + 1]
+            data = v[i + 2 : i + 2 + slen]
+            if sub == OPT82_CIRCUIT_ID:
+                circuit = data
+            elif sub == OPT82_REMOTE_ID:
+                remote = data
+            i += 2 + slen
+        return circuit, remote
+
+    def encode(self) -> bytes:
+        fixed = struct.pack(
+            "!BBBBIHHIIII",
+            self.op, self.htype, self.hlen, self.hops,
+            self.xid, self.secs, self.flags,
+            self.ciaddr, self.yiaddr, self.siaddr, self.giaddr,
+        )
+        chaddr = (self.chaddr + b"\x00" * 16)[:16]
+        sname = (self.sname + b"\x00" * 64)[:64]
+        bfile = (self.file + b"\x00" * 128)[:128]
+        opts = b""
+        for code, val in self.options:
+            if code == OPT_PAD:
+                opts += b"\x00"
+            else:
+                opts += bytes([code, len(val)]) + val
+        opts += bytes([OPT_END])
+        return fixed + chaddr + sname + bfile + struct.pack("!I", DHCP_MAGIC) + opts
+
+
+def decode(data: bytes) -> DHCPPacket:
+    if len(data) < 240:
+        raise ValueError(f"DHCP packet too short: {len(data)}")
+    p = DHCPPacket()
+    (p.op, p.htype, p.hlen, p.hops, p.xid, p.secs, p.flags,
+     p.ciaddr, p.yiaddr, p.siaddr, p.giaddr) = struct.unpack_from("!BBBBIHHIIII", data, 0)
+    p.chaddr = data[28 : 28 + max(p.hlen, 6)][:16]
+    p.sname = data[44:108].rstrip(b"\x00")
+    p.file = data[108:236].rstrip(b"\x00")
+    magic = struct.unpack_from("!I", data, 236)[0]
+    if magic != DHCP_MAGIC:
+        raise ValueError(f"bad DHCP magic: {magic:#x}")
+    i = 240
+    while i < len(data):
+        code = data[i]
+        if code == OPT_END:
+            break
+        if code == OPT_PAD:
+            i += 1
+            continue
+        if i + 1 >= len(data):
+            break
+        ln = data[i + 1]
+        p.options.append((code, data[i + 2 : i + 2 + ln]))
+        i += 2 + ln
+    return p
+
+
+def build_request(
+    mac: bytes,
+    msg_type: int,
+    xid: int = 0x12345678,
+    requested_ip: int = 0,
+    server_id: int = 0,
+    ciaddr: int = 0,
+    giaddr: int = 0,
+    broadcast: bool = False,
+    circuit_id: bytes = b"",
+    remote_id: bytes = b"",
+    extra_options: list[tuple[int, bytes]] | None = None,
+) -> DHCPPacket:
+    """Build a client DISCOVER/REQUEST/... packet."""
+    p = DHCPPacket(op=1, xid=xid, chaddr=mac, ciaddr=ciaddr, giaddr=giaddr)
+    if broadcast:
+        p.flags = 0x8000
+    p.options.append((OPT_MSG_TYPE, bytes([msg_type])))
+    if requested_ip:
+        p.options.append((OPT_REQUESTED_IP, struct.pack("!I", requested_ip)))
+    if server_id:
+        p.options.append((OPT_SERVER_ID, struct.pack("!I", server_id)))
+    if extra_options:
+        p.options.extend(extra_options)
+    if circuit_id or remote_id:
+        sub = b""
+        if circuit_id:
+            sub += bytes([OPT82_CIRCUIT_ID, len(circuit_id)]) + circuit_id
+        if remote_id:
+            sub += bytes([OPT82_REMOTE_ID, len(remote_id)]) + remote_id
+        p.options.append((OPT_RELAY_AGENT_INFO, sub))
+    return p

@@ -1,0 +1,178 @@
+"""Host-side packet construction/parsing (pure Python, wire-accurate).
+
+Used by the slow-path servers and by tests as the golden reference the
+device kernels are checked against. Covers Ethernet (+802.1Q/802.1ad),
+IPv4, UDP/TCP/ICMP — the protocol surface of the reference's fast path.
+"""
+
+from __future__ import annotations
+
+import struct
+from dataclasses import dataclass, field
+
+ETH_P_IP = 0x0800
+ETH_P_8021Q = 0x8100
+ETH_P_8021AD = 0x88A8
+
+
+def checksum16(data: bytes) -> int:
+    if len(data) % 2:
+        data += b"\x00"
+    s = sum(struct.unpack(f"!{len(data)//2}H", data))
+    s = (s & 0xFFFF) + (s >> 16)
+    s = (s & 0xFFFF) + (s >> 16)
+    return (~s) & 0xFFFF
+
+
+def eth_header(dst: bytes, src: bytes, ethertype: int, vlans: list[int] | None = None) -> bytes:
+    """L2 header; vlans = [outer_vid] or [outer_vid, inner_vid] (QinQ).
+
+    QinQ uses 802.1ad for the outer tag like the reference's parser expects
+    (bpf/dhcp_fastpath.c:373-387 accepts 0x8100 or 0x88a8 outer).
+    """
+    hdr = dst + src
+    if vlans:
+        if len(vlans) == 2:
+            hdr += struct.pack("!HH", ETH_P_8021AD, vlans[0])
+            hdr += struct.pack("!HH", ETH_P_8021Q, vlans[1])
+        else:
+            hdr += struct.pack("!HH", ETH_P_8021Q, vlans[0])
+    hdr += struct.pack("!H", ethertype)
+    return hdr
+
+
+def ipv4_header(
+    src_ip: int,
+    dst_ip: int,
+    payload_len: int,
+    proto: int,
+    ttl: int = 64,
+    ident: int = 0,
+    tos: int = 0,
+) -> bytes:
+    total = 20 + payload_len
+    hdr = struct.pack("!BBHHHBBH4s4s", 0x45, tos, total, ident, 0, ttl, proto, 0,
+                      struct.pack("!I", src_ip), struct.pack("!I", dst_ip))
+    csum = checksum16(hdr)
+    return hdr[:10] + struct.pack("!H", csum) + hdr[12:]
+
+
+def udp_header(src_port: int, dst_port: int, payload_len: int, csum: int = 0) -> bytes:
+    return struct.pack("!HHHH", src_port, dst_port, 8 + payload_len, csum)
+
+
+def udp_packet(
+    src_mac: bytes,
+    dst_mac: bytes,
+    src_ip: int,
+    dst_ip: int,
+    src_port: int,
+    dst_port: int,
+    payload: bytes,
+    vlans: list[int] | None = None,
+    ttl: int = 64,
+) -> bytes:
+    udp = udp_header(src_port, dst_port, len(payload)) + payload
+    ip = ipv4_header(src_ip, dst_ip, len(udp), 17, ttl=ttl)
+    return eth_header(dst_mac, src_mac, ETH_P_IP, vlans) + ip + udp
+
+
+def tcp_packet(
+    src_mac: bytes,
+    dst_mac: bytes,
+    src_ip: int,
+    dst_ip: int,
+    src_port: int,
+    dst_port: int,
+    payload: bytes = b"",
+    flags: int = 0x18,  # PSH|ACK
+    seq: int = 0,
+    ack: int = 0,
+    vlans: list[int] | None = None,
+) -> bytes:
+    tcp = struct.pack("!HHIIBBHHH", src_port, dst_port, seq, ack, 5 << 4, flags, 65535, 0, 0) + payload
+    # TCP checksum over pseudo header
+    pseudo = struct.pack("!IIBBH", src_ip, dst_ip, 0, 6, len(tcp))
+    csum = checksum16(pseudo + tcp)
+    tcp = tcp[:16] + struct.pack("!H", csum) + tcp[18:]
+    ip = ipv4_header(src_ip, dst_ip, len(tcp), 6)
+    return eth_header(dst_mac, src_mac, ETH_P_IP, vlans) + ip + tcp
+
+
+def icmp_echo_packet(
+    src_mac: bytes,
+    dst_mac: bytes,
+    src_ip: int,
+    dst_ip: int,
+    echo_id: int,
+    seq: int = 1,
+    payload: bytes = b"ping",
+    reply: bool = False,
+) -> bytes:
+    icmp = struct.pack("!BBHHH", 0 if reply else 8, 0, 0, echo_id, seq) + payload
+    csum = checksum16(icmp)
+    icmp = icmp[:2] + struct.pack("!H", csum) + icmp[4:]
+    ip = ipv4_header(src_ip, dst_ip, len(icmp), 1)
+    return eth_header(dst_mac, src_mac, ETH_P_IP) + ip + icmp
+
+
+@dataclass
+class DecodedPacket:
+    dst_mac: bytes = b""
+    src_mac: bytes = b""
+    vlans: list[int] = field(default_factory=list)
+    ethertype: int = 0
+    src_ip: int = 0
+    dst_ip: int = 0
+    ttl: int = 0
+    proto: int = 0
+    ip_total_len: int = 0
+    ip_checksum: int = 0
+    ip_checksum_ok: bool = False
+    src_port: int = 0
+    dst_port: int = 0
+    udp_len: int = 0
+    l4_checksum: int = 0
+    payload: bytes = b""
+    tcp_flags: int = 0
+    icmp_id: int = 0
+
+
+def decode(raw: bytes) -> DecodedPacket:
+    """Parse a raw frame back into fields (for asserting kernel output)."""
+    p = DecodedPacket()
+    p.dst_mac, p.src_mac = raw[0:6], raw[6:12]
+    off = 12
+    et = struct.unpack_from("!H", raw, off)[0]
+    off += 2
+    while et in (ETH_P_8021Q, ETH_P_8021AD):
+        tci = struct.unpack_from("!H", raw, off)[0]
+        p.vlans.append(tci & 0x0FFF)
+        et = struct.unpack_from("!H", raw, off + 2)[0]
+        off += 4
+    p.ethertype = et
+    if et != ETH_P_IP:
+        return p
+    ihl = (raw[off] & 0x0F) * 4
+    p.ip_total_len = struct.unpack_from("!H", raw, off + 2)[0]
+    p.ttl = raw[off + 8]
+    p.proto = raw[off + 9]
+    p.ip_checksum = struct.unpack_from("!H", raw, off + 10)[0]
+    p.src_ip = struct.unpack_from("!I", raw, off + 12)[0]
+    p.dst_ip = struct.unpack_from("!I", raw, off + 16)[0]
+    p.ip_checksum_ok = checksum16(raw[off : off + ihl]) == 0
+    l4 = off + ihl
+    if p.proto == 17:
+        p.src_port, p.dst_port, p.udp_len, p.l4_checksum = struct.unpack_from("!HHHH", raw, l4)
+        p.payload = raw[l4 + 8 : l4 + p.udp_len]
+    elif p.proto == 6:
+        p.src_port, p.dst_port = struct.unpack_from("!HH", raw, l4)
+        data_off = (raw[l4 + 12] >> 4) * 4
+        p.tcp_flags = raw[l4 + 13]
+        p.l4_checksum = struct.unpack_from("!H", raw, l4 + 16)[0]
+        p.payload = raw[l4 + data_off : off + p.ip_total_len]
+    elif p.proto == 1:
+        p.l4_checksum = struct.unpack_from("!H", raw, l4 + 2)[0]
+        p.icmp_id = struct.unpack_from("!H", raw, l4 + 4)[0]
+        p.payload = raw[l4 + 8 : off + p.ip_total_len]
+    return p

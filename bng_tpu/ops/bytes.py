@@ -1,0 +1,104 @@
+"""Vectorized byte extraction/composition over packet batches.
+
+Packets live as `[B, L]` uint8 arrays (L static, default 512 — covers DHCP's
+~350 bytes worst case, bpf/maps.h:22 caps option scans at 312). All helpers
+are branch-free gathers/selects so the whole parse lowers to a handful of
+fused XLA ops — the TPU equivalent of the reference's verifier-safe
+fixed-offset parsing style (bpf/dhcp_fastpath.c:216-250).
+
+Offsets may be per-lane (`[B]` int32) because VLAN tagging shifts L3 by
+0/4/8 bytes per packet (bpf/dhcp_fastpath.c:352-428).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+PKT_LEN = 512  # static packet slot size
+
+
+def _off(offs):
+    return offs.astype(jnp.int32)
+
+
+def u8_at(pkt, offs):
+    """Gather one byte per lane at per-lane offsets -> [B] uint32."""
+    idx = jnp.clip(_off(offs), 0, pkt.shape[1] - 1)
+    return jnp.take_along_axis(pkt, idx[:, None], axis=1)[:, 0].astype(jnp.uint32)
+
+
+def be16_at(pkt, offs):
+    return (u8_at(pkt, offs) << 8) | u8_at(pkt, offs + 1)
+
+
+def be32_at(pkt, offs):
+    return (be16_at(pkt, offs) << 16) | be16_at(pkt, offs + 2)
+
+
+def bytes_at(pkt, offs, n: int):
+    """Gather n consecutive bytes per lane -> [B, n] uint8 (n static)."""
+    idx = _off(offs)[:, None] + jnp.arange(n, dtype=jnp.int32)[None, :]
+    idx = jnp.clip(idx, 0, pkt.shape[1] - 1)
+    return jnp.take_along_axis(pkt, idx, axis=1)
+
+
+def set_u8(buf, col: int, val):
+    """Set a static column to per-lane byte values."""
+    return buf.at[:, col].set(val.astype(jnp.uint8))
+
+
+def set_const(buf, col: int, val: int):
+    return buf.at[:, col].set(jnp.uint8(val))
+
+
+def set_be16(buf, col: int, val):
+    buf = set_u8(buf, col, (val >> 8) & 0xFF)
+    return set_u8(buf, col + 1, val & 0xFF)
+
+
+def set_be32(buf, col: int, val):
+    buf = set_be16(buf, col, (val >> 16) & 0xFFFF)
+    return set_be16(buf, col + 2, val & 0xFFFF)
+
+
+def set_bytes(buf, col: int, vals):
+    """Set a static range of columns to [B, n] uint8 values."""
+    return buf.at[:, col : col + vals.shape[1]].set(vals.astype(jnp.uint8))
+
+
+def scatter_u8_at(pkt, offs, val):
+    """Write one byte per lane at per-lane offsets (in-place rewrite path).
+
+    Used by NAT44 where a few fields are rewritten at VLAN/IHL-dependent
+    offsets (bpf/nat44.c:752-801).
+    """
+    idx = jnp.clip(_off(offs), 0, pkt.shape[1] - 1)
+    rows = jnp.arange(pkt.shape[0], dtype=jnp.int32)
+    return pkt.at[rows, idx].set(val.astype(jnp.uint8))
+
+
+def scatter_be16_at(pkt, offs, val):
+    pkt = scatter_u8_at(pkt, offs, (val >> 8) & 0xFF)
+    return scatter_u8_at(pkt, offs + 1, val & 0xFF)
+
+
+def scatter_be32_at(pkt, offs, val):
+    pkt = scatter_be16_at(pkt, offs, (val >> 16) & 0xFFFF)
+    return scatter_be16_at(pkt, offs + 2, val & 0xFFFF)
+
+
+def scatter_u8_at_masked(pkt, offs, val, mask):
+    """Masked per-lane byte write: lanes with mask=False keep old bytes."""
+    old = u8_at(pkt, offs)
+    new = jnp.where(mask, val, old)
+    return scatter_u8_at(pkt, offs, new)
+
+
+def scatter_be16_at_masked(pkt, offs, val, mask):
+    pkt = scatter_u8_at_masked(pkt, offs, (val >> 8) & 0xFF, mask)
+    return scatter_u8_at_masked(pkt, offs + 1, val & 0xFF, mask)
+
+
+def scatter_be32_at_masked(pkt, offs, val, mask):
+    pkt = scatter_be16_at_masked(pkt, offs, (val >> 16) & 0xFFFF, mask)
+    return scatter_be16_at_masked(pkt, offs + 2, val & 0xFFFF, mask)

@@ -1,0 +1,59 @@
+"""Internet checksums, vectorized.
+
+Parity targets: ip_checksum (bpf/dhcp_fastpath.c:488-503) and the
+incremental update helpers update_csum/update_csum16/csum_fold
+(bpf/nat44.c:378-398), as [B]-wide uint32 lane math.
+
+Convention: 16-bit fields are held in uint32 lanes in *host order*; byte
+composition happens in bytes.py. One's-complement sums are byte-order
+agnostic as long as old/new values use consistent order, so host-order
+arithmetic gives byte-identical packets after composition.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def fold16(s):
+    """Fold a uint32 one's-complement accumulator to 16 bits."""
+    s = (s & 0xFFFF) + (s >> 16)
+    s = (s & 0xFFFF) + (s >> 16)
+    return s
+
+
+def csum_finish(s):
+    return (~fold16(s)) & 0xFFFF
+
+
+def ipv4_header_checksum(words):
+    """Checksum from a list of 16-bit field values ([B] uint32 each).
+
+    The checksum field itself must be passed as 0.
+    """
+    s = jnp.zeros_like(words[0])
+    for w in words:
+        s = s + (w & 0xFFFF)
+    return csum_finish(s)
+
+
+def csum_update32(csum, old32, new32):
+    """Incremental checksum update for a changed 32-bit value.
+
+    Parity: update_csum (bpf/nat44.c:384-391). csum/old/new are [B] uint32
+    (csum holds a 16-bit value).
+    """
+    s = (~csum) & 0xFFFF
+    s = s + ((~old32) & 0xFFFF)
+    s = s + ((~(old32 >> 16)) & 0xFFFF)
+    s = s + (new32 & 0xFFFF)
+    s = s + (new32 >> 16)
+    return (~fold16(s)) & 0xFFFF
+
+
+def csum_update16(csum, old16, new16):
+    """Parity: update_csum16 (bpf/nat44.c:393-398)."""
+    s = (~csum) & 0xFFFF
+    s = s + ((~old16) & 0xFFFF)
+    s = s + (new16 & 0xFFFF)
+    return (~fold16(s)) & 0xFFFF

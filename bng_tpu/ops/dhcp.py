@@ -1,0 +1,403 @@
+"""DHCP fast-path kernel: batched in-device OFFER/ACK generation.
+
+TPU re-expression of the XDP program dhcp_fastpath_prog
+(bpf/dhcp_fastpath.c:619-813). One XDP invocation = one lane of a [B, L]
+batch; `return XDP_PASS/XDP_TX` becomes per-lane verdict masks; the three
+eBPF map lookups become cuckoo-table gathers; the in-place packet rewrite +
+bpf_xdp_adjust_tail becomes a canonical-reply compose with per-lane VLAN
+reinsertion (a single gather — TPUs shift bytes with index arithmetic, not
+memmove).
+
+Parity notes (cited against /root/reference):
+- msg-type extraction at fixed offsets {0,1,3,4,5,6}: dhcp_fastpath.c:216-250
+- circuit-ID extraction at fixed positions {3, 12..19}: dhcp_fastpath.c:267-323
+- lookup cascade VLAN -> circuit-ID -> MAC: dhcp_fastpath.c:653-681
+- lease expiry check: dhcp_fastpath.c:690-695
+- relay (giaddr!=0) vs broadcast reply: dhcp_fastpath.c:721-756
+- option build order 53,54,51,1,3,[6],58,59,255: dhcp_fastpath.c:519-602
+- stats enum: dhcp_fastpath.c:117-128
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from bng_tpu.ops import bytes as B_
+from bng_tpu.ops.checksum import ipv4_header_checksum
+from bng_tpu.ops.parse import Parsed
+from bng_tpu.ops.table import TableState, device_lookup
+
+# ---- DHCP constants ----
+DHCP_SERVER_PORT = 67
+DHCP_CLIENT_PORT = 68
+DHCP_MAGIC = 0x63825363
+BOOTREQUEST, BOOTREPLY = 1, 2
+DISCOVER, OFFER, REQUEST, ACK = 1, 2, 3, 5
+FLAG_BROADCAST = 0x8000
+
+# pool_assignment value-word layout (parity: bpf/maps.h:89-97)
+AV_POOL_ID, AV_IP, AV_VLAN, AV_CLASS, AV_LEASE_EXP, AV_FLAGS = range(6)
+ASSIGN_WORDS = 8
+
+# ip_pool row layout (parity: bpf/maps.h:135-144); dense array, pool_id index
+PV_NETWORK, PV_PREFIX, PV_GATEWAY, PV_DNS1, PV_DNS2, PV_LEASE_T, PV_VALID = range(7)
+POOL_WORDS = 8
+
+# server_config layout (parity: bpf/maps.h:153-159)
+SC_MAC_HI, SC_MAC_LO, SC_IP = range(3)
+SERVER_WORDS = 4
+
+# stats indices (parity: enum stat_counter, dhcp_fastpath.c:117-128)
+(ST_TOTAL, ST_HIT, ST_MISS, ST_ERROR, ST_EXPIRED,
+ ST_OPT82_PRESENT, ST_OPT82_ABSENT, ST_BCAST, ST_UCAST, ST_VLAN) = range(10)
+NSTATS = 10
+
+CID_KEY_LEN = 32  # bpf/maps.h:216
+CID_WORDS = 8
+
+# canonical (untagged) reply geometry
+_ETH, _IP, _UDP, _BOOTP = 14, 20, 8, 240
+_OPT_HEAD = 27  # 53(3) + 54(6) + 51(6) + 1(6) + 3(6)
+_OPT_DNS_MAX = 10
+_OPT_TAIL = 13  # 58(6) + 59(6) + 255(1)
+_OPT_MAX = _OPT_HEAD + _OPT_DNS_MAX + _OPT_TAIL
+CANON_LEN = _ETH + _IP + _UDP + _BOOTP + _OPT_MAX  # 332
+
+
+class DHCPTables(NamedTuple):
+    """Device-side state for the DHCP fast path (pytree)."""
+
+    sub: TableState  # key [mac_hi, mac_lo] -> assignment (subscriber_pools)
+    vlan: TableState  # key [s_tag<<16|c_tag] -> assignment (vlan_subscriber_pools)
+    cid: TableState  # key 8 words (32B circuit-id) -> assignment (circuit_id_subscribers)
+    pools: jax.Array  # [P, POOL_WORDS] dense (ip_pools; pool_id is a small int)
+    server: jax.Array  # [SERVER_WORDS] (server_config)
+
+
+class DHCPGeom(NamedTuple):
+    """Static table geometry (python ints, part of the jit closure)."""
+
+    sub_nbuckets: int
+    vlan_nbuckets: int
+    cid_nbuckets: int
+    stash: int
+
+
+class DHCPResult(NamedTuple):
+    is_reply: jax.Array  # [B] bool — lane answered on device (XDP_TX)
+    is_dhcp: jax.Array  # [B] bool — lane is a DHCP request (reply or slow path)
+    out_pkt: jax.Array  # [B, L] uint8 — reply bytes (valid where is_reply)
+    out_len: jax.Array  # [B] uint32
+    stats: jax.Array  # [NSTATS] uint32 batch deltas
+
+
+def _extract_msg_type(pkt, opts_off, opts_in_bounds):
+    """Fixed-offset option-53 scan. Parity: get_dhcp_msg_type."""
+    found = jnp.zeros_like(opts_in_bounds)
+    mtype = jnp.zeros(pkt.shape[0], dtype=jnp.uint32)
+    for o in (0, 1, 3, 4, 5, 6):  # same offsets, same order as the reference
+        ok = (B_.u8_at(pkt, opts_off + o) == 53) & (B_.u8_at(pkt, opts_off + o + 1) == 1)
+        take = ok & ~found & opts_in_bounds
+        mtype = jnp.where(take, B_.u8_at(pkt, opts_off + o + 2), mtype)
+        found = found | take
+    return jnp.where(opts_in_bounds, mtype, 0)
+
+
+def _extract_circuit_id(pkt, opts_off, length):
+    """Fixed-position Option-82 circuit-ID extraction.
+
+    Parity: extract_circuit_id_fixed (dhcp_fastpath.c:267-323).
+    Returns (found [B] bool, cid [B, 32] uint8 zero-padded).
+    """
+    Bsz = pkt.shape[0]
+    scan_ok = (opts_off.astype(jnp.uint32) + 64) <= length
+
+    found = jnp.zeros((Bsz,), dtype=bool)
+    cid = jnp.zeros((Bsz, CID_KEY_LEN), dtype=jnp.uint8)
+
+    def try_pos(found, cid, tag_off, len_off, sub_off, cidlen_off, cid_off, extra_ok):
+        tag = B_.u8_at(pkt, opts_off + tag_off)
+        o82len = B_.u8_at(pkt, opts_off + len_off)
+        sub1 = B_.u8_at(pkt, opts_off + sub_off)
+        cl = B_.u8_at(pkt, opts_off + cidlen_off)
+        in_b = (opts_off.astype(jnp.uint32) + cid_off + cl) <= length
+        ok = (
+            scan_ok & extra_ok & (tag == 82) & (o82len >= 4) & (sub1 == 1)
+            & (cl > 0) & (cl <= CID_KEY_LEN) & in_b & ~found
+        )
+        raw = B_.bytes_at(pkt, opts_off + cid_off, CID_KEY_LEN)  # [B, 32]
+        mask = jnp.arange(CID_KEY_LEN)[None, :] < cl[:, None]
+        cand = jnp.where(mask, raw, 0)
+        cid = jnp.where(ok[:, None], cand, cid)
+        return found | ok, cid
+
+    # Position A: [53][1][x][82][len][sub=1][cl][cid...] (tag at opts+3)
+    o82len_a = B_.u8_at(pkt, opts_off + 4)
+    a_extra = (opts_off.astype(jnp.uint32) + 5 + o82len_a) <= length
+    found, cid = try_pos(found, cid, 3, 4, 5, 6, 7, a_extra)
+    # Positions 12..19
+    for p in range(12, 20):
+        p_extra = (opts_off.astype(jnp.uint32) + p + 8) <= length
+        found, cid = try_pos(found, cid, p, p + 1, p + 2, p + 3, p + 4, p_extra)
+    return found, cid
+
+
+def pack_cid_words(cid_bytes):
+    """[B, 32] uint8 -> [B, 8] uint32 big-endian words (table key form)."""
+    b = cid_bytes.astype(jnp.uint32).reshape(cid_bytes.shape[0], CID_WORDS, 4)
+    return (b[:, :, 0] << 24) | (b[:, :, 1] << 16) | (b[:, :, 2] << 8) | b[:, :, 3]
+
+
+def _prefix_to_mask(plen):
+    """CIDR prefix -> netmask. Parity: prefix_to_mask (dhcp_fastpath.c:510).
+
+    Shift in two halves to dodge the undefined shift-by-32 (plen=0).
+    """
+    full = jnp.full_like(plen.astype(jnp.uint32), 0xFFFFFFFF)
+    sh = jnp.clip(32 - plen.astype(jnp.int32), 0, 32)
+    sh1 = jnp.minimum(sh, 16)
+    sh2 = sh - sh1
+    return (full << sh1) << sh2
+
+
+def dhcp_fastpath(
+    pkt: jax.Array,
+    length: jax.Array,
+    parsed: Parsed,
+    tables: DHCPTables,
+    geom: DHCPGeom,
+    now_s: jax.Array,
+) -> DHCPResult:
+    Bsz, L = pkt.shape
+    length = length.astype(jnp.uint32)
+    stats = jnp.zeros((NSTATS,), dtype=jnp.uint32)
+
+    def count(m):
+        return jnp.sum(m, dtype=jnp.uint32)
+
+    # --- eligibility (parity: parse + op + magic checks, :624-633) ---
+    dhcp_off = parsed.l4_off + _UDP
+    is_dhcp_port = parsed.is_udp & (parsed.dst_port == DHCP_SERVER_PORT)
+    hdr_in_bounds = (dhcp_off.astype(jnp.uint32) + _BOOTP) <= length
+    base = is_dhcp_port & hdr_in_bounds
+    op = B_.u8_at(pkt, dhcp_off)
+    magic = B_.be32_at(pkt, dhcp_off + 236)
+    base = base & (op == BOOTREQUEST) & (magic == DHCP_MAGIC)
+
+    # vlan_packets counts every tagged frame the hook sees, not just DHCP
+    # (the reference increments it mid-parse, dhcp_fastpath.c:384, before
+    # the IPv4/UDP/port-67 filters)
+    stats = stats.at[ST_VLAN].add(count(parsed.is_vlan & (length > 0)))
+    stats = stats.at[ST_TOTAL].add(count(base))
+
+    # --- message type (parity :639-645) ---
+    opts_off = dhcp_off + 240
+    opts_in_bounds = (opts_off.astype(jnp.uint32) + 12) <= length
+    mtype = _extract_msg_type(pkt, opts_off, opts_in_bounds & base)
+    is_fast_type = (mtype == DISCOVER) | (mtype == REQUEST)
+    wrong_type = base & ~is_fast_type
+    stats = stats.at[ST_MISS].add(count(wrong_type))
+    elig = base & is_fast_type
+
+    # --- lookup cascade (parity :653-681) ---
+    # 1) VLAN key
+    vlan_key = ((parsed.s_tag << 16) | parsed.c_tag)[:, None].astype(jnp.uint32)
+    vlan_res = device_lookup(tables.vlan, vlan_key, geom.vlan_nbuckets, geom.stash)
+    vlan_hit = vlan_res.found & parsed.is_vlan & elig
+
+    # 2) circuit-ID
+    cid_found, cid_bytes = _extract_circuit_id(pkt, opts_off, length)
+    cid_res = device_lookup(tables.cid, pack_cid_words(cid_bytes), geom.cid_nbuckets, geom.stash)
+    cid_hit = cid_res.found & cid_found & elig & ~vlan_hit
+
+    # 3) MAC (chaddr at dhcp_off+28)
+    mac_hi = B_.be16_at(pkt, dhcp_off + 28)
+    mac_lo = B_.be32_at(pkt, dhcp_off + 30)
+    mac_key = jnp.stack([mac_hi, mac_lo], axis=1)
+    mac_res = device_lookup(tables.sub, mac_key, geom.sub_nbuckets, geom.stash)
+    mac_hit = mac_res.found & elig & ~vlan_hit & ~cid_hit
+
+    stats = stats.at[ST_OPT82_PRESENT].add(count(cid_hit))
+
+    hit = vlan_hit | cid_hit | mac_hit
+    assign = jnp.where(
+        vlan_hit[:, None], vlan_res.vals,
+        jnp.where(cid_hit[:, None], cid_res.vals, mac_res.vals),
+    )
+    stats = stats.at[ST_MISS].add(count(elig & ~hit))
+
+    # --- lease expiry (parity :690-695) ---
+    lease_exp = assign[:, AV_LEASE_EXP]
+    expired = hit & (now_s > lease_exp)
+    stats = stats.at[ST_EXPIRED].add(count(expired))
+    live = hit & ~expired
+
+    # --- pool + server config (parity :698-713) ---
+    P = tables.pools.shape[0]
+    pool_id = assign[:, AV_POOL_ID]
+    pool_ok_idx = pool_id < P
+    pool_row = tables.pools[jnp.minimum(pool_id, P - 1).astype(jnp.int32)]  # [B, POOL_WORDS]
+    pool_valid = pool_ok_idx & (pool_row[:, PV_VALID] != 0)
+    pool_err = live & ~pool_valid
+    stats = stats.at[ST_ERROR].add(count(pool_err))
+    reply = live & pool_valid
+    stats = stats.at[ST_HIT].add(count(reply))
+
+    # --- reply field computation ---
+    server_mac_hi = tables.server[SC_MAC_HI]
+    server_mac_lo = tables.server[SC_MAC_LO]
+    cfg_server_ip = tables.server[SC_IP]
+    gateway = pool_row[:, PV_GATEWAY]
+    server_ip = jnp.where(cfg_server_ip != 0, cfg_server_ip, gateway)  # :724
+
+    reply_type = jnp.where(mtype == DISCOVER, OFFER, ACK)
+
+    xid_b = B_.bytes_at(pkt, dhcp_off + 4, 4)
+    secs_b = B_.bytes_at(pkt, dhcp_off + 8, 2)
+    flags = B_.be16_at(pkt, dhcp_off + 10)
+    ciaddr = B_.be32_at(pkt, dhcp_off + 12)
+    giaddr = B_.be32_at(pkt, dhcp_off + 24)
+    chaddr_b = B_.bytes_at(pkt, dhcp_off + 28, 16)
+    giaddr_b = B_.bytes_at(pkt, dhcp_off + 24, 4)
+
+    relayed = giaddr != 0
+    # broadcast decision (parity: setup_reply_l2_headers :436-462 — every
+    # non-relay case with ciaddr==0 broadcasts; ciaddr!=0 without the
+    # broadcast flag unicasts to chaddr)
+    use_bcast = (~relayed) & (((flags & FLAG_BROADCAST) != 0) | (ciaddr == 0))
+    stats = stats.at[ST_BCAST].add(count(reply & use_bcast))
+    stats = stats.at[ST_UCAST].add(count(reply & ~use_bcast))  # covers relay :743
+
+    # L2 dest: relay -> requester's src MAC; bcast -> ff:..; else chaddr
+    req_src = B_.bytes_at(pkt, jnp.zeros_like(dhcp_off) + 6, 6)
+    bcast_mac = jnp.full((Bsz, 6), 0xFF, dtype=jnp.uint8)
+    dst_mac = jnp.where(
+        relayed[:, None], req_src, jnp.where(use_bcast[:, None], bcast_mac, chaddr_b[:, :6])
+    )
+
+    ip_dst = jnp.where(relayed, giaddr, jnp.uint32(0xFFFFFFFF))  # :734 / :749
+    udp_dst = jnp.where(relayed, DHCP_SERVER_PORT, DHCP_CLIENT_PORT)  # :740 / :754
+
+    # --- options geometry ---
+    dns1 = pool_row[:, PV_DNS1]
+    dns2 = pool_row[:, PV_DNS2]
+    dns_sz = jnp.where(dns1 == 0, 0, jnp.where(dns2 == 0, 6, 10)).astype(jnp.int32)
+    opt_len = _OPT_HEAD + dns_sz + _OPT_TAIL
+    lease_t = pool_row[:, PV_LEASE_T]
+    t1 = lease_t // 2  # :585
+    t2 = (lease_t * 7) // 8  # :593
+    mask32 = _prefix_to_mask(pool_row[:, PV_PREFIX])
+
+    dhcp_len = (_BOOTP + opt_len).astype(jnp.uint32)
+    udp_len = 8 + dhcp_len
+    ip_len = 20 + udp_len
+    canon_total = 14 + ip_len
+    out_len = canon_total + parsed.vlan_offset.astype(jnp.uint32)
+
+    # --- canonical reply compose (static offsets) ---
+    canon = jnp.zeros((Bsz, CANON_LEN), dtype=jnp.uint8)
+    canon = B_.set_bytes(canon, 0, dst_mac)
+    canon = B_.set_be16(canon, 6, server_mac_hi * jnp.ones_like(flags))
+    canon = B_.set_be32(canon, 8, server_mac_lo * jnp.ones_like(flags))
+    canon = B_.set_be16(canon, 12, jnp.full((Bsz,), 0x0800, dtype=jnp.uint32))
+    # IPv4
+    ip0 = _ETH
+    canon = B_.set_const(canon, ip0 + 0, 0x45)
+    canon = B_.set_be16(canon, ip0 + 2, ip_len)
+    canon = B_.set_const(canon, ip0 + 8, 64)  # TTL :735/:750
+    canon = B_.set_const(canon, ip0 + 9, 17)
+    ip_csum = ipv4_header_checksum([
+        jnp.full((Bsz,), 0x4500, dtype=jnp.uint32), ip_len,
+        jnp.zeros((Bsz,), dtype=jnp.uint32), jnp.zeros((Bsz,), dtype=jnp.uint32),
+        jnp.full((Bsz,), (64 << 8) | 17, dtype=jnp.uint32), jnp.zeros((Bsz,), dtype=jnp.uint32),
+        server_ip >> 16, server_ip & 0xFFFF, ip_dst >> 16, ip_dst & 0xFFFF,
+    ])
+    canon = B_.set_be16(canon, ip0 + 10, ip_csum)
+    canon = B_.set_be32(canon, ip0 + 12, server_ip)
+    canon = B_.set_be32(canon, ip0 + 16, ip_dst)
+    # UDP (checksum 0: legal for IPv4, matches :741/:755)
+    u0 = ip0 + _IP
+    canon = B_.set_be16(canon, u0 + 0, jnp.full((Bsz,), DHCP_SERVER_PORT, dtype=jnp.uint32))
+    canon = B_.set_be16(canon, u0 + 2, udp_dst.astype(jnp.uint32))
+    canon = B_.set_be16(canon, u0 + 4, udp_len)
+    # BOOTP fixed
+    d0 = u0 + _UDP
+    canon = B_.set_const(canon, d0 + 0, BOOTREPLY)  # :759
+    canon = B_.set_const(canon, d0 + 1, 1)
+    canon = B_.set_const(canon, d0 + 2, 6)
+    # hops=0 (:760)
+    canon = B_.set_bytes(canon, d0 + 4, xid_b)
+    canon = B_.set_bytes(canon, d0 + 8, secs_b)
+    canon = B_.set_be16(canon, d0 + 10, flags)
+    canon = B_.set_be32(canon, d0 + 12, ciaddr)
+    canon = B_.set_be32(canon, d0 + 16, assign[:, AV_IP])  # yiaddr :761
+    canon = B_.set_be32(canon, d0 + 20, server_ip)  # siaddr :762
+    canon = B_.set_bytes(canon, d0 + 24, giaddr_b)
+    canon = B_.set_bytes(canon, d0 + 28, chaddr_b)
+    # sname/file zeroed by construction (:765-766)
+    canon = B_.set_be32(canon, d0 + 236, jnp.full((Bsz,), DHCP_MAGIC, dtype=jnp.uint32))
+
+    # options: head segment [B, 27]
+    head = jnp.zeros((Bsz, _OPT_HEAD), dtype=jnp.uint8)
+    head = B_.set_const(head, 0, 53); head = B_.set_const(head, 1, 1)
+    head = B_.set_u8(head, 2, reply_type)
+    head = B_.set_const(head, 3, 54); head = B_.set_const(head, 4, 4)
+    head = B_.set_be32(head, 5, server_ip)
+    head = B_.set_const(head, 9, 51); head = B_.set_const(head, 10, 4)
+    head = B_.set_be32(head, 11, lease_t)
+    head = B_.set_const(head, 15, 1); head = B_.set_const(head, 16, 4)
+    head = B_.set_be32(head, 17, mask32)
+    head = B_.set_const(head, 21, 3); head = B_.set_const(head, 22, 4)
+    head = B_.set_be32(head, 23, gateway)
+    # dns segment [B, 10]
+    dns = jnp.zeros((Bsz, _OPT_DNS_MAX), dtype=jnp.uint8)
+    dns = B_.set_const(dns, 0, 6)
+    dns = B_.set_u8(dns, 1, jnp.where(dns2 == 0, 4, 8))
+    dns = B_.set_be32(dns, 2, dns1)
+    dns = B_.set_be32(dns, 6, dns2)
+    # tail segment [B, 13]
+    tail = jnp.zeros((Bsz, _OPT_TAIL), dtype=jnp.uint8)
+    tail = B_.set_const(tail, 0, 58); tail = B_.set_const(tail, 1, 4)
+    tail = B_.set_be32(tail, 2, t1)
+    tail = B_.set_const(tail, 6, 59); tail = B_.set_const(tail, 7, 4)
+    tail = B_.set_be32(tail, 8, t2)
+    tail = B_.set_const(tail, 12, 255)
+
+    # compose options area [B, _OPT_MAX]: head is fixed-offset; dns and tail
+    # shift with dns_sz, handled by two index-arithmetic gathers
+    oj = jnp.arange(_OPT_MAX, dtype=jnp.int32)[None, :]
+    head_p = jnp.zeros((Bsz, _OPT_MAX), dtype=jnp.uint8).at[:, :_OPT_HEAD].set(head)
+    dns_idx = jnp.broadcast_to(jnp.clip(oj - _OPT_HEAD, 0, _OPT_DNS_MAX - 1), (Bsz, _OPT_MAX))
+    tail_idx = jnp.clip(oj - _OPT_HEAD - dns_sz[:, None], 0, _OPT_TAIL - 1)
+    dns_g = jnp.take_along_axis(dns, dns_idx, axis=1)
+    tail_g = jnp.take_along_axis(tail, tail_idx, axis=1)
+    opt_area = jnp.where(
+        oj < _OPT_HEAD,
+        head_p,
+        jnp.where(
+            oj < (_OPT_HEAD + dns_sz[:, None]),
+            dns_g,
+            jnp.where(oj < opt_len[:, None], tail_g, 0),
+        ),
+    )
+    canon = canon.at[:, d0 + 240 : d0 + 240 + _OPT_MAX].set(opt_area.astype(jnp.uint8))
+
+    # --- final compose with VLAN reinsertion ---
+    canon_L = jnp.zeros((Bsz, L), dtype=jnp.uint8).at[:, :CANON_LEN].set(canon)
+    jj = jnp.arange(L, dtype=jnp.int32)[None, :]
+    vo = parsed.vlan_offset[:, None]
+    shift_idx = jnp.clip(jj - vo, 0, L - 1)
+    canon_shift = jnp.take_along_axis(canon_L, shift_idx, axis=1)
+    out = jnp.where(jj < 12, canon_L, jnp.where(jj < 14 + vo, pkt, canon_shift))
+    out = jnp.where(jj < out_len[:, None].astype(jnp.int32), out, 0)
+
+    return DHCPResult(
+        is_reply=reply,
+        is_dhcp=base,
+        out_pkt=out,
+        out_len=jnp.where(reply, out_len, 0),
+        stats=stats,
+    )

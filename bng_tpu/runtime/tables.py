@@ -1,0 +1,199 @@
+"""Host-side fast-path table management — the pkg/ebpf/loader.go role.
+
+The reference's Loader owns typed Go mirrors of every eBPF map and all CRUD
+(pkg/ebpf/loader.go:74-661: AddSubscriber, AddPool, SetServerConfig,
+circuit-ID ops). Here the same surface manages numpy mirrors of the HBM
+cuckoo tables plus the dense pool/server-config arrays, and emits bounded
+TableUpdate batches that the jitted device step scatters into HBM — the
+replacement for bpf_map_update_elem syscalls.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from bng_tpu.ops.dhcp import (
+    ASSIGN_WORDS,
+    POOL_WORDS,
+    SERVER_WORDS,
+    AV_POOL_ID,
+    AV_IP,
+    AV_VLAN,
+    AV_CLASS,
+    AV_LEASE_EXP,
+    AV_FLAGS,
+    PV_NETWORK,
+    PV_PREFIX,
+    PV_GATEWAY,
+    PV_DNS1,
+    PV_DNS2,
+    PV_LEASE_T,
+    PV_VALID,
+    SC_MAC_HI,
+    SC_MAC_LO,
+    SC_IP,
+    CID_KEY_LEN,
+    DHCPGeom,
+    DHCPTables,
+)
+from bng_tpu.ops.table import HostTable, TableUpdate, apply_update
+from bng_tpu.utils.net import mac_to_u64, split_u64
+
+
+def pack_cid_host(circuit_id: bytes) -> np.ndarray:
+    """32-byte (padded/truncated) circuit-id -> 8 big-endian uint32 words.
+
+    Must match ops.dhcp.pack_cid_words; parity with the fixed 32-byte key of
+    bpf/maps.h:216-220 (truncate long, zero-pad short).
+    """
+    buf = (circuit_id[:CID_KEY_LEN] + b"\x00" * CID_KEY_LEN)[:CID_KEY_LEN]
+    return np.frombuffer(buf, dtype=">u4").astype(np.uint32)
+
+
+class FastPathUpdates(NamedTuple):
+    """Per-step bounded update batch for all DHCP-path tables (pytree)."""
+
+    sub: TableUpdate
+    vlan: TableUpdate
+    cid: TableUpdate
+    pools: jax.Array  # [P, POOL_WORDS] full (tiny) refresh
+    server: jax.Array  # [SERVER_WORDS]
+
+
+def apply_fastpath_updates(tables: DHCPTables, upd: FastPathUpdates) -> DHCPTables:
+    """Jit-side application of one update batch."""
+    return DHCPTables(
+        sub=apply_update(tables.sub, upd.sub),
+        vlan=apply_update(tables.vlan, upd.vlan),
+        cid=apply_update(tables.cid, upd.cid),
+        pools=upd.pools,
+        server=upd.server,
+    )
+
+
+class FastPathTables:
+    """Host authority for subscriber/VLAN/circuit-ID/pool/server tables."""
+
+    def __init__(
+        self,
+        sub_nbuckets: int = 1 << 15,
+        vlan_nbuckets: int = 1 << 12,
+        cid_nbuckets: int = 1 << 12,
+        max_pools: int = 256,
+        stash: int = 64,
+        update_slots: int = 256,
+    ):
+        self.sub = HostTable(sub_nbuckets, key_words=2, val_words=ASSIGN_WORDS, stash=stash, name="subscriber_pools")
+        self.vlan = HostTable(vlan_nbuckets, key_words=1, val_words=ASSIGN_WORDS, stash=stash, name="vlan_subscriber_pools")
+        self.cid = HostTable(cid_nbuckets, key_words=8, val_words=ASSIGN_WORDS, stash=stash, name="circuit_id_subscribers")
+        self.pools = np.zeros((max_pools, POOL_WORDS), dtype=np.uint32)
+        self.server = np.zeros((SERVER_WORDS,), dtype=np.uint32)
+        self.update_slots = update_slots
+        self.geom = DHCPGeom(
+            sub_nbuckets=sub_nbuckets,
+            vlan_nbuckets=vlan_nbuckets,
+            cid_nbuckets=cid_nbuckets,
+            stash=stash,
+        )
+
+    # -- CRUD (parity: pkg/ebpf/loader.go AddSubscriber :352, AddPool :402,
+    #    SetServerConfig :444, AddVLANSubscriber :470, circuit-ID ops :556+) --
+    @staticmethod
+    def _assignment(pool_id, ip, lease_expiry, vlan_id, client_class, flags):
+        v = np.zeros((ASSIGN_WORDS,), dtype=np.uint32)
+        v[AV_POOL_ID] = pool_id
+        v[AV_IP] = ip
+        v[AV_VLAN] = vlan_id
+        v[AV_CLASS] = client_class
+        v[AV_LEASE_EXP] = lease_expiry
+        v[AV_FLAGS] = flags
+        return v
+
+    def add_subscriber(self, mac, pool_id: int, ip: int, lease_expiry: int,
+                       vlan_id: int = 0, client_class: int = 0, flags: int = 0) -> None:
+        key = mac_to_u64(mac) if not isinstance(mac, int) else mac
+        lo, hi = split_u64(key)
+        self.sub.insert([hi, lo], self._assignment(pool_id, ip, lease_expiry, vlan_id, client_class, flags))
+
+    def remove_subscriber(self, mac) -> bool:
+        key = mac_to_u64(mac) if not isinstance(mac, int) else mac
+        lo, hi = split_u64(key)
+        return self.sub.delete([hi, lo])
+
+    def get_subscriber(self, mac):
+        key = mac_to_u64(mac) if not isinstance(mac, int) else mac
+        lo, hi = split_u64(key)
+        return self.sub.lookup([hi, lo])
+
+    def add_vlan_subscriber(self, s_tag: int, c_tag: int, pool_id: int, ip: int,
+                            lease_expiry: int, client_class: int = 0, flags: int = 0) -> None:
+        self.vlan.insert([(s_tag << 16) | c_tag],
+                         self._assignment(pool_id, ip, lease_expiry, 0, client_class, flags))
+
+    def remove_vlan_subscriber(self, s_tag: int, c_tag: int) -> bool:
+        return self.vlan.delete([(s_tag << 16) | c_tag])
+
+    def add_circuit_id_subscriber(self, circuit_id: bytes, pool_id: int, ip: int,
+                                  lease_expiry: int, client_class: int = 0, flags: int = 0) -> None:
+        self.cid.insert(pack_cid_host(circuit_id),
+                        self._assignment(pool_id, ip, lease_expiry, 0, client_class, flags))
+
+    def remove_circuit_id_subscriber(self, circuit_id: bytes) -> bool:
+        return self.cid.delete(pack_cid_host(circuit_id))
+
+    def add_pool(self, pool_id: int, network: int, prefix_len: int, gateway: int,
+                 dns_primary: int = 0, dns_secondary: int = 0, lease_time: int = 3600) -> None:
+        if pool_id >= len(self.pools):
+            raise ValueError(f"pool_id {pool_id} >= max_pools {len(self.pools)}")
+        row = self.pools[pool_id]
+        row[PV_NETWORK] = network
+        row[PV_PREFIX] = prefix_len
+        row[PV_GATEWAY] = gateway
+        row[PV_DNS1] = dns_primary
+        row[PV_DNS2] = dns_secondary
+        row[PV_LEASE_T] = lease_time
+        row[PV_VALID] = 1
+
+    def remove_pool(self, pool_id: int) -> None:
+        self.pools[pool_id] = 0
+
+    def set_server_config(self, mac, ip: int) -> None:
+        key = mac_to_u64(mac) if not isinstance(mac, int) else mac
+        lo, hi = split_u64(key)
+        self.server[SC_MAC_HI] = hi
+        self.server[SC_MAC_LO] = lo
+        self.server[SC_IP] = ip
+
+    def touch_lease(self, mac, lease_expiry: int) -> bool:
+        """Refresh a subscriber's lease expiry in place."""
+        key = mac_to_u64(mac) if not isinstance(mac, int) else mac
+        lo, hi = split_u64(key)
+        return self.sub.update_val_words([hi, lo], AV_LEASE_EXP, [lease_expiry])
+
+    # -- device sync --
+    def device_tables(self) -> DHCPTables:
+        """Full upload (startup)."""
+        return DHCPTables(
+            sub=self.sub.device_state(),
+            vlan=self.vlan.device_state(),
+            cid=self.cid.device_state(),
+            pools=jnp.asarray(self.pools),
+            server=jnp.asarray(self.server),
+        )
+
+    def make_updates(self) -> FastPathUpdates:
+        """Drain dirty slots into one bounded per-step update batch."""
+        return FastPathUpdates(
+            sub=self.sub.make_update(self.update_slots),
+            vlan=self.vlan.make_update(self.update_slots),
+            cid=self.cid.make_update(self.update_slots),
+            pools=jnp.asarray(self.pools),
+            server=jnp.asarray(self.server),
+        )
+
+    def dirty_count(self) -> int:
+        return self.sub.dirty_count() + self.vlan.dirty_count() + self.cid.dirty_count()

@@ -1,0 +1,10 @@
+from bng_tpu.utils.net import (  # noqa: F401
+    mac_to_u64,
+    u64_to_mac,
+    ip_to_u32,
+    u32_to_ip,
+    prefix_to_mask,
+    fnv1a32,
+    split_u64,
+    join_u64,
+)

@@ -1,0 +1,89 @@
+"""Network address helpers shared by host control plane and device kernels.
+
+Behavioral parity with the reference's conversion utilities:
+- MAC-as-u64 keys: pkg/ebpf/loader.go:666-701 and bpf/dhcp_fastpath.c:175-182
+  (big-endian byte order: mac[0] is the most significant byte).
+- FNV-1a hashing: pkg/ebpf/loader.go:546-553 (circuit-ID hashing) and
+  pkg/pool/peer.go:777-790 (rendezvous hash combine).
+- prefix_to_mask: bpf/dhcp_fastpath.c:510-516.
+
+All integer math here is plain Python int / numpy; device-side equivalents
+live in bng_tpu.ops.
+"""
+
+from __future__ import annotations
+
+FNV1A32_OFFSET = 0x811C9DC5
+FNV1A32_PRIME = 0x01000193
+FNV1A64_OFFSET = 0xCBF29CE484222325
+FNV1A64_PRIME = 0x100000001B3
+
+_U32 = 0xFFFFFFFF
+_U64 = 0xFFFFFFFFFFFFFFFF
+
+
+def mac_to_u64(mac: bytes | str) -> int:
+    """Convert a 6-byte MAC to a u64 key (big-endian, like the reference)."""
+    if isinstance(mac, str):
+        mac = bytes(int(b, 16) for b in mac.split(":"))
+    if len(mac) != 6:
+        raise ValueError(f"MAC must be 6 bytes, got {len(mac)}")
+    out = 0
+    for b in mac:
+        out = (out << 8) | b
+    return out
+
+
+def u64_to_mac(key: int) -> bytes:
+    return bytes((key >> (8 * (5 - i))) & 0xFF for i in range(6))
+
+
+def ip_to_u32(ip: str | bytes) -> int:
+    """Dotted-quad (or 4 raw bytes) to host-order u32 (10.0.0.1 -> 0x0A000001)."""
+    if isinstance(ip, bytes):
+        if len(ip) != 4:
+            raise ValueError("need 4 bytes")
+        parts = list(ip)
+    else:
+        parts = [int(p) for p in ip.split(".")]
+    if len(parts) != 4 or any(p < 0 or p > 255 for p in parts):
+        raise ValueError(f"bad IPv4 address: {ip!r}")
+    return (parts[0] << 24) | (parts[1] << 16) | (parts[2] << 8) | parts[3]
+
+
+def u32_to_ip(v: int) -> str:
+    return f"{(v >> 24) & 0xFF}.{(v >> 16) & 0xFF}.{(v >> 8) & 0xFF}.{v & 0xFF}"
+
+
+def prefix_to_mask(prefix_len: int) -> int:
+    """CIDR prefix length to host-order netmask u32."""
+    if prefix_len <= 0:
+        return 0
+    if prefix_len >= 32:
+        return _U32
+    return (_U32 << (32 - prefix_len)) & _U32
+
+
+def fnv1a32(data: bytes, seed: int = FNV1A32_OFFSET) -> int:
+    h = seed
+    for b in data:
+        h ^= b
+        h = (h * FNV1A32_PRIME) & _U32
+    return h
+
+
+def fnv1a64(data: bytes, seed: int = FNV1A64_OFFSET) -> int:
+    h = seed
+    for b in data:
+        h ^= b
+        h = (h * FNV1A64_PRIME) & _U64
+    return h
+
+
+def split_u64(v: int) -> tuple[int, int]:
+    """u64 -> (lo32, hi32) for storage in uint32 table key words."""
+    return v & _U32, (v >> 32) & _U32
+
+
+def join_u64(lo: int, hi: int) -> int:
+    return (hi << 32) | lo
