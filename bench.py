@@ -1,0 +1,184 @@
+"""Benchmark: sustained DHCP+NAT44 fast-path throughput on one chip.
+
+Steady-state mix (the BASELINE.json headline): cached DHCP DISCOVER lanes
+answered on device + established NAT44 flows SNAT'd on device, through the
+full fused pipeline (parse -> antispoof -> DHCP -> NAT44 -> QoS) with the
+tables at realistic scale.
+
+Prints ONE JSON line:
+  {"metric": "Mpps/chip DHCP+NAT44 fast path", "value": X, "unit": "Mpps",
+   "vs_baseline": X / 12.5, ...}
+vs_baseline: the north star is >=100 Mpps on a v5e-8 (BASELINE.md) =
+12.5 Mpps/chip; >1.0 beats the target share for one chip.
+
+Env knobs: BNG_BENCH_BATCH, BNG_BENCH_STEPS, BNG_BENCH_SUBS, BNG_BENCH_FLOWS.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def _mark(msg: str) -> None:
+    print(f"[bench {time.strftime('%H:%M:%S')}] {msg}", file=sys.stderr, flush=True)
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from bng_tpu.control import dhcp_codec, packets
+    from bng_tpu.control.nat import NATManager
+    from bng_tpu.ops.pipeline import PipelineGeom, PipelineTables, pipeline_step
+    from bng_tpu.runtime.engine import AntispoofTables, QoSTables
+    from bng_tpu.runtime.tables import FastPathTables
+    from bng_tpu.utils.net import ip_to_u32
+
+    _mark("jax imported; initializing device...")
+    dev = jax.devices()[0]
+    _mark(f"device: {dev}")
+    on_tpu = dev.platform not in ("cpu",)
+    B = int(os.environ.get("BNG_BENCH_BATCH", 8192 if on_tpu else 512))
+    STEPS = int(os.environ.get("BNG_BENCH_STEPS", 200 if on_tpu else 10))
+    N_SUBS = int(os.environ.get("BNG_BENCH_SUBS", 100_000 if on_tpu else 2_000))
+    N_FLOWS = int(os.environ.get("BNG_BENCH_FLOWS", 100_000 if on_tpu else 2_000))
+    L = 512
+    now = 1_753_000_000
+
+    t_setup = time.time()
+    # ---- tables at scale ----
+    sub_nb = 1 << max(10, (N_SUBS * 2 // 4).bit_length())  # ~50% load, 4-way
+    fp = FastPathTables(sub_nbuckets=sub_nb, vlan_nbuckets=1 << 10,
+                        cid_nbuckets=1 << 10, max_pools=64, stash=256)
+    fp.set_server_config(bytes.fromhex("02aabbccdd01"), ip_to_u32("10.0.0.1"))
+    # /16 pools to hold N_SUBS addresses
+    n_pools = max(1, (N_SUBS >> 16) + 1)
+    for pid in range(n_pools):
+        fp.add_pool(pid + 1, ip_to_u32(f"10.{pid}.0.0") & 0xFFFF0000, 16,
+                    ip_to_u32("10.0.0.1"), ip_to_u32("1.1.1.1"),
+                    ip_to_u32("8.8.8.8"), 86400)
+
+    macs = np.arange(N_SUBS, dtype=np.uint64) + 0x02AA00000000
+    _mark(f"inserting {N_SUBS} subscribers...")
+    for i in range(N_SUBS):
+        ip = (10 << 24) | (i + 2)
+        fp.add_subscriber(int(macs[i]), pool_id=(i >> 16) + 1, ip=ip,
+                          lease_expiry=now + 86400)
+
+    sess_nb = 1 << max(10, (N_FLOWS * 2 // 4).bit_length())
+    nat = NATManager(public_ips=[ip_to_u32("203.0.113.1") + i for i in range(64)],
+                     ports_per_subscriber=64,
+                     sessions_nbuckets=sess_nb, sub_nat_nbuckets=sub_nb, stash=256)
+    n_nat_subs = min(N_SUBS, max(1, N_FLOWS // 4))  # ~4 flows per subscriber
+    _mark(f"creating {N_FLOWS} NAT flows...")
+    flows = []
+    for i in range(N_FLOWS):
+        sub_i = i % n_nat_subs
+        src_ip = (10 << 24) | (sub_i + 2)
+        if sub_i == i:  # first flow of this subscriber
+            nat.allocate_nat(src_ip, now)
+        dst_ip = ip_to_u32("93.184.0.0") + (i // n_nat_subs)
+        sport = 20000 + (i // n_nat_subs)
+        got = nat.handle_new_flow(src_ip, dst_ip, sport, 443, 17, 100, now)
+        if got is not None:
+            flows.append((src_ip, dst_ip, sport))
+    qos = QoSTables(nbuckets=1 << 10)
+    spoof = AntispoofTables(nbuckets=1 << 10)
+
+    _mark("uploading tables to device...")
+    geom = PipelineGeom(dhcp=fp.geom, nat=nat.geom, qos=qos.geom, spoof=spoof.geom)
+    tables = PipelineTables(
+        dhcp=fp.device_tables(), nat=nat.device_tables(),
+        qos_up=qos.up.device_state(), qos_down=qos.down.device_state(),
+        spoof=spoof.bindings.device_state(),
+        spoof_ranges=jnp.asarray(spoof.ranges),
+        spoof_config=jnp.asarray(spoof.config),
+    )
+
+    # ---- steady-state batch: 20% cached DISCOVER, 80% established flows ----
+    pkt = np.zeros((B, L), dtype=np.uint8)
+    length = np.zeros((B,), dtype=np.uint32)
+    rng = np.random.default_rng(42)
+    n_dhcp = B // 5
+    for row in range(B):
+        if row < n_dhcp:
+            i = int(rng.integers(N_SUBS))
+            mac = int(macs[i]).to_bytes(8, "big")[2:]
+            p = dhcp_codec.build_request(mac, dhcp_codec.DISCOVER,
+                                         xid=0x1000 + row)
+            p.options.append((dhcp_codec.OPT_PARAM_REQ_LIST, bytes([1, 3, 6, 51, 54])))
+            f = packets.udp_packet(mac, b"\xff" * 6, 0, 0xFFFFFFFF, 68, 67,
+                                   p.encode().ljust(300, b"\x00"))
+        else:
+            src_ip, dst_ip, sport = flows[int(rng.integers(len(flows)))]
+            f = packets.udp_packet(b"\x02" * 6, b"\x04" * 6, src_ip, dst_ip,
+                                   sport, 443, b"x" * 180)
+        pkt[row, : len(f)] = np.frombuffer(f, dtype=np.uint8)
+        length[row] = len(f)
+
+    pkt_d = jax.device_put(jnp.asarray(pkt))
+    len_d = jax.device_put(jnp.asarray(length))
+    fa_d = jax.device_put(jnp.ones((B,), dtype=bool))
+
+    @jax.jit
+    def step(tables, pkt, ln, fa, now_s, now_us):
+        res = pipeline_step(tables, pkt, ln, fa, geom, now_s, now_us)
+        return res.tables, res.verdict, res.dhcp_stats, res.nat_stats
+
+    setup_s = time.time() - t_setup
+    _mark(f"setup done in {setup_s:.1f}s; compiling fused pipeline (B={B})...")
+
+    # ---- warmup / compile ----
+    t_compile = time.time()
+    tables, verdict, ds, ns = step(tables, pkt_d, len_d, fa_d,
+                                   jnp.uint32(now), jnp.uint32(0))
+    verdict.block_until_ready()
+    compile_s = time.time() - t_compile
+    _mark(f"compile+first step {compile_s:.1f}s; timing {STEPS} steps...")
+
+    v = np.asarray(verdict)
+    n_tx = int((v == 2).sum())
+    n_fwd = int((v == 3).sum())
+    hit_rate = (n_tx + n_fwd) / B
+
+    # ---- timed sustained loop (per-step latency measured too) ----
+    lat = []
+    t0 = time.time()
+    for k in range(STEPS):
+        t1 = time.perf_counter()
+        tables, verdict, ds, ns = step(tables, pkt_d, len_d, fa_d,
+                                       jnp.uint32(now + 1 + k), jnp.uint32(k * 100))
+        verdict.block_until_ready()
+        lat.append(time.perf_counter() - t1)
+    elapsed = time.time() - t0
+
+    pps = STEPS * B / elapsed
+    mpps = pps / 1e6
+    lat_us = np.array(lat) * 1e6
+    p50, p99 = float(np.percentile(lat_us, 50)), float(np.percentile(lat_us, 99))
+
+    print(json.dumps({
+        "metric": "Mpps/chip DHCP+NAT44 fast path",
+        "value": round(mpps, 3),
+        "unit": "Mpps",
+        "vs_baseline": round(mpps / 12.5, 4),
+        "batch": B,
+        "steps": STEPS,
+        "subscribers": N_SUBS,
+        "flows": len(flows),
+        "fastpath_hit_rate": round(hit_rate, 4),
+        "batch_latency_p50_us": round(p50, 1),
+        "batch_latency_p99_us": round(p99, 1),
+        "device": str(dev),
+        "compile_s": round(compile_s, 1),
+        "setup_s": round(setup_s, 1),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,13 @@
+from bng_tpu.control.allocator.bitmap import IPAllocator  # noqa: F401
+from bng_tpu.control.allocator.epoch_bitmap import EpochBitmapAllocator  # noqa: F401
+from bng_tpu.control.allocator.store import (  # noqa: F401
+    AllocationRecord,
+    AllocationStore,
+    MemoryAllocationStore,
+)
+from bng_tpu.control.allocator.distributed import DistributedAllocator  # noqa: F401
+from bng_tpu.control.allocator.modes import (  # noqa: F401
+    Allocator,
+    HybridAllocator,
+    LocalAllocator,
+)

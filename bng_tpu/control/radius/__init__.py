@@ -1,0 +1,5 @@
+from bng_tpu.control.radius.packet import RadiusPacket  # noqa: F401
+from bng_tpu.control.radius.client import RadiusClient, RadiusServerConfig  # noqa: F401
+from bng_tpu.control.radius.policy import PolicyManager, QoSPolicy, DEFAULT_POLICIES  # noqa: F401
+from bng_tpu.control.radius.accounting import AccountingManager  # noqa: F401
+from bng_tpu.control.radius.coa import CoAProcessor, CoAServer  # noqa: F401

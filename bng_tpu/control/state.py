@@ -1,0 +1,198 @@
+"""In-memory indexed state store — the embedded single-node alternative to
+Nexus.
+
+Parity: pkg/state/store.go — Store (:15) with subscriber/lease/pool/
+session/NAT-binding records, by-MAC/by-IP/by-NTE indexes (:148-856),
+FindPoolForSubscriber class matching (:356), TTL cleanup sweeps
+(:858-1024, explicit tick here). Types: pkg/state/types.go:9-330.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+
+
+@dataclass
+class Subscriber:
+    id: str
+    mac: str = ""
+    circuit_id: str = ""
+    nte_id: str = ""
+    client_class: int = 0
+    isp_id: str = ""
+    enabled: bool = True
+    meta: dict = field(default_factory=dict)
+
+
+@dataclass
+class LeaseRecord:
+    ip: str
+    subscriber_id: str
+    mac: str
+    expires_at: float
+    pool_id: str = ""
+
+
+@dataclass
+class PoolRecord:
+    id: str
+    cidr: str
+    client_class: int = 0
+    isp_id: str = ""
+    enabled: bool = True
+
+
+@dataclass
+class SessionRecord:
+    id: str
+    subscriber_id: str
+    ip: str = ""
+    mac: str = ""
+    started_at: float = 0.0
+    last_seen: float = 0.0
+    kind: str = "ipoe"  # ipoe | pppoe | wifi
+    state: str = "active"
+
+
+@dataclass
+class NATBinding:
+    private_ip: str
+    public_ip: str
+    port_start: int
+    port_end: int
+    subscriber_id: str = ""
+
+
+class Store:
+    def __init__(self, clock=time.time):
+        self.clock = clock
+        self.subscribers: dict[str, Subscriber] = {}
+        self.leases: dict[str, LeaseRecord] = {}  # by ip
+        self.pools: dict[str, PoolRecord] = {}
+        self.sessions: dict[str, SessionRecord] = {}
+        self.nat_bindings: dict[str, NATBinding] = {}  # by private ip
+        # indexes
+        self._sub_by_mac: dict[str, str] = {}
+        self._sub_by_cid: dict[str, str] = {}
+        self._sub_by_nte: dict[str, set[str]] = {}
+        self._sess_by_sub: dict[str, set[str]] = {}
+        self._lease_by_mac: dict[str, str] = {}
+
+    # -- subscribers --
+    def put_subscriber(self, s: Subscriber) -> None:
+        old = self.subscribers.get(s.id)
+        if old:
+            self._sub_by_mac.pop(old.mac, None)
+            self._sub_by_cid.pop(old.circuit_id, None)
+            if old.nte_id:
+                self._sub_by_nte.get(old.nte_id, set()).discard(s.id)
+        self.subscribers[s.id] = s
+        if s.mac:
+            self._sub_by_mac[s.mac.lower()] = s.id
+        if s.circuit_id:
+            self._sub_by_cid[s.circuit_id] = s.id
+        if s.nte_id:
+            self._sub_by_nte.setdefault(s.nte_id, set()).add(s.id)
+
+    def get_subscriber(self, sub_id: str) -> Subscriber | None:
+        return self.subscribers.get(sub_id)
+
+    def subscriber_by_mac(self, mac: str) -> Subscriber | None:
+        sid = self._sub_by_mac.get(mac.lower())
+        return self.subscribers.get(sid) if sid else None
+
+    def subscriber_by_circuit_id(self, cid: str) -> Subscriber | None:
+        sid = self._sub_by_cid.get(cid)
+        return self.subscribers.get(sid) if sid else None
+
+    def subscribers_by_nte(self, nte_id: str) -> list[Subscriber]:
+        return [self.subscribers[s] for s in self._sub_by_nte.get(nte_id, ())]
+
+    def delete_subscriber(self, sub_id: str) -> bool:
+        s = self.subscribers.pop(sub_id, None)
+        if s is None:
+            return False
+        self._sub_by_mac.pop(s.mac.lower(), None)
+        self._sub_by_cid.pop(s.circuit_id, None)
+        if s.nte_id:
+            self._sub_by_nte.get(s.nte_id, set()).discard(sub_id)
+        return True
+
+    # -- leases --
+    def put_lease(self, l: LeaseRecord) -> None:
+        self.leases[l.ip] = l
+        self._lease_by_mac[l.mac.lower()] = l.ip
+
+    def lease_by_ip(self, ip: str) -> LeaseRecord | None:
+        return self.leases.get(ip)
+
+    def lease_by_mac(self, mac: str) -> LeaseRecord | None:
+        ip = self._lease_by_mac.get(mac.lower())
+        return self.leases.get(ip) if ip else None
+
+    def delete_lease(self, ip: str) -> bool:
+        l = self.leases.pop(ip, None)
+        if l is None:
+            return False
+        if self._lease_by_mac.get(l.mac.lower()) == ip:
+            del self._lease_by_mac[l.mac.lower()]
+        return True
+
+    # -- pools --
+    def put_pool(self, p: PoolRecord) -> None:
+        self.pools[p.id] = p
+
+    def find_pool_for_subscriber(self, sub: Subscriber) -> PoolRecord | None:
+        """Class/ISP matching (parity: FindPoolForSubscriber, store.go:356):
+        exact class+isp > class > isp > any-enabled."""
+        best, best_score = None, -1
+        for p in self.pools.values():
+            if not p.enabled:
+                continue
+            score = 0
+            if p.client_class and p.client_class != sub.client_class:
+                continue
+            if p.isp_id and p.isp_id != sub.isp_id:
+                continue
+            score = (2 if p.client_class else 0) + (1 if p.isp_id else 0)
+            if score > best_score:
+                best, best_score = p, score
+        return best
+
+    # -- sessions --
+    def put_session(self, s: SessionRecord) -> None:
+        self.sessions[s.id] = s
+        self._sess_by_sub.setdefault(s.subscriber_id, set()).add(s.id)
+
+    def sessions_for(self, subscriber_id: str) -> list[SessionRecord]:
+        return [self.sessions[i] for i in self._sess_by_sub.get(subscriber_id, ())]
+
+    def delete_session(self, session_id: str) -> bool:
+        s = self.sessions.pop(session_id, None)
+        if s is None:
+            return False
+        self._sess_by_sub.get(s.subscriber_id, set()).discard(session_id)
+        return True
+
+    # -- NAT bindings --
+    def put_nat_binding(self, b: NATBinding) -> None:
+        self.nat_bindings[b.private_ip] = b
+
+    def nat_binding(self, private_ip: str) -> NATBinding | None:
+        return self.nat_bindings.get(private_ip)
+
+    # -- cleanup sweeps (parity: store.go:858-1024) --
+    def cleanup_expired_leases(self, now: float | None = None) -> int:
+        now = now if now is not None else self.clock()
+        dead = [ip for ip, l in self.leases.items() if l.expires_at < now]
+        for ip in dead:
+            self.delete_lease(ip)
+        return len(dead)
+
+    def cleanup_idle_sessions(self, idle_s: float, now: float | None = None) -> int:
+        now = now if now is not None else self.clock()
+        dead = [i for i, s in self.sessions.items() if now - s.last_seen > idle_s]
+        for i in dead:
+            self.delete_session(i)
+        return len(dead)

@@ -24,7 +24,7 @@ import jax.numpy as jnp
 
 from bng_tpu.ops import bytes as B_
 from bng_tpu.ops.parse import Parsed
-from bng_tpu.ops.table import TableState, device_lookup
+from bng_tpu.ops.table import TableGeom, TableState, lookup
 
 # modes (antispoof.c:30-33)
 MODE_DISABLED, MODE_STRICT, MODE_LOOSE, MODE_LOG_ONLY = range(4)
@@ -39,9 +39,7 @@ VALID_V4, VALID_V6 = 0x01, 0x02
 ANTISPOOF_NSTATS = 6
 
 
-class AntispoofGeom(NamedTuple):
-    nbuckets: int
-    stash: int
+AntispoofGeom = TableGeom
 
 
 class AntispoofResult(NamedTuple):
@@ -62,7 +60,7 @@ def antispoof_kernel(
     default_mode = config[0]
 
     mac_key = jnp.stack([parsed.src_mac_hi, parsed.src_mac_lo], axis=1)
-    res = device_lookup(bindings, mac_key, geom.nbuckets, geom.stash)
+    res = lookup(bindings, mac_key, geom)
     has_binding = res.found
     mode = jnp.where(has_binding, res.vals[:, AB_MODE], default_mode)
 

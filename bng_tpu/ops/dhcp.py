@@ -28,7 +28,7 @@ import jax.numpy as jnp
 from bng_tpu.ops import bytes as B_
 from bng_tpu.ops.checksum import ipv4_header_checksum
 from bng_tpu.ops.parse import Parsed
-from bng_tpu.ops.table import TableState, device_lookup
+from bng_tpu.ops.table import TableGeom, TableState, lookup
 
 # ---- DHCP constants ----
 DHCP_SERVER_PORT = 67
@@ -78,12 +78,11 @@ class DHCPTables(NamedTuple):
 
 
 class DHCPGeom(NamedTuple):
-    """Static table geometry (python ints, part of the jit closure)."""
+    """Static table geometry (part of the jit closure / static args)."""
 
-    sub_nbuckets: int
-    vlan_nbuckets: int
-    cid_nbuckets: int
-    stash: int
+    sub: TableGeom
+    vlan: TableGeom
+    cid: TableGeom
 
 
 class DHCPResult(NamedTuple):
@@ -205,19 +204,19 @@ def dhcp_fastpath(
     # --- lookup cascade (parity :653-681) ---
     # 1) VLAN key
     vlan_key = ((parsed.s_tag << 16) | parsed.c_tag)[:, None].astype(jnp.uint32)
-    vlan_res = device_lookup(tables.vlan, vlan_key, geom.vlan_nbuckets, geom.stash)
+    vlan_res = lookup(tables.vlan, vlan_key, geom.vlan)
     vlan_hit = vlan_res.found & parsed.is_vlan & elig
 
     # 2) circuit-ID
     cid_found, cid_bytes = _extract_circuit_id(pkt, opts_off, length)
-    cid_res = device_lookup(tables.cid, pack_cid_words(cid_bytes), geom.cid_nbuckets, geom.stash)
+    cid_res = lookup(tables.cid, pack_cid_words(cid_bytes), geom.cid)
     cid_hit = cid_res.found & cid_found & elig & ~vlan_hit
 
     # 3) MAC (chaddr at dhcp_off+28)
     mac_hi = B_.be16_at(pkt, dhcp_off + 28)
     mac_lo = B_.be32_at(pkt, dhcp_off + 30)
     mac_key = jnp.stack([mac_hi, mac_lo], axis=1)
-    mac_res = device_lookup(tables.sub, mac_key, geom.sub_nbuckets, geom.stash)
+    mac_res = lookup(tables.sub, mac_key, geom.sub)
     mac_hit = mac_res.found & elig & ~vlan_hit & ~cid_hit
 
     stats = stats.at[ST_OPT82_PRESENT].add(count(cid_hit))

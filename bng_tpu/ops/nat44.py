@@ -38,7 +38,7 @@ import jax.numpy as jnp
 from bng_tpu.ops import bytes as B_
 from bng_tpu.ops.checksum import csum_update16, csum_update32
 from bng_tpu.ops.parse import PROTO_ICMP, PROTO_TCP, PROTO_UDP, Parsed
-from bng_tpu.ops.table import TableState, device_lookup
+from bng_tpu.ops.table import TableGeom, TableState, lookup
 
 # session value-word layout (parity: struct nat_session, nat44.c:123-141)
 (SV_NAT_IP, SV_NAT_PORT, SV_ORIG_IP, SV_ORIG_PORT, SV_DEST_IP, SV_DEST_PORT,
@@ -74,10 +74,9 @@ class NATTables(NamedTuple):
 
 
 class NATGeom(NamedTuple):
-    sessions_nbuckets: int
-    reverse_nbuckets: int
-    sub_nat_nbuckets: int
-    stash: int
+    sessions: TableGeom
+    reverse: TableGeom
+    sub_nat: TableGeom
 
 
 class NATResult(NamedTuple):
@@ -187,7 +186,7 @@ def nat44_kernel(
     ingress = eligible & ~is_private_ip(parsed.src_ip)
 
     # ---- egress: subscriber allocation gate (nat44.c:589-596) ----
-    sub_res = device_lookup(tables.sub_nat, parsed.src_ip[:, None], geom.sub_nat_nbuckets, geom.stash)
+    sub_res = lookup(tables.sub_nat, parsed.src_ip[:, None], geom.sub_nat)
     has_alloc = sub_res.found & egress
     no_alloc = egress & ~sub_res.found
     stats = stats.at[NST_PASSED].add(count(no_alloc))
@@ -208,7 +207,7 @@ def nat44_kernel(
     # (nat44.c:643-649), ingress matches (0, echo_id) (nat44.c:846-851).
     e_dst_port = jnp.where(parsed.is_icmp, 0, parsed.dst_port)
     ekey = _session_key(parsed.src_ip, parsed.dst_ip, parsed.src_port, e_dst_port, parsed.proto)
-    esess = device_lookup(tables.sessions, ekey, geom.sessions_nbuckets, geom.stash)
+    esess = lookup(tables.sessions, ekey, geom.sessions)
     egress_active = has_alloc & ~alg_hit
     egress_hit = egress_active & esess.found
     egress_miss = egress_active & ~esess.found  # new flow -> punt to host
@@ -217,10 +216,10 @@ def nat44_kernel(
     # ---- ingress reverse lookup (nat44.c:860-876) ----
     i_src_port = jnp.where(parsed.is_icmp, 0, parsed.src_port)
     rkey = _session_key(parsed.src_ip, parsed.dst_ip, i_src_port, parsed.dst_port, parsed.proto)
-    rres = device_lookup(tables.reverse, rkey, geom.reverse_nbuckets, geom.stash)
+    rres = lookup(tables.reverse, rkey, geom.reverse)
     ingress_rhit = ingress & rres.found
     stats = stats.at[NST_PASSED].add(count(ingress & ~rres.found))
-    isess = device_lookup(tables.sessions, rres.vals[:, :4], geom.sessions_nbuckets, geom.stash)
+    isess = lookup(tables.sessions, rres.vals[:, :4], geom.sessions)
     ingress_hit = ingress_rhit & isess.found
     ingress_orphan = ingress_rhit & ~isess.found  # reverse without session
     stats = stats.at[NST_EXPIRED].add(count(ingress_orphan))

@@ -1,0 +1,151 @@
+"""The fused BNG packet pipeline — one jitted program per batch.
+
+The reference runs four separate eBPF programs on different hooks (XDP
+DHCP, TC antispoof/qos/NAT, SURVEY.md §1). On TPU, dispatch overhead
+dominates small kernels, so the whole chain is ONE fused XLA program over a
+[B, L] batch:
+
+    parse -> antispoof -> DHCP responder -> NAT44 (SNAT/DNAT) -> QoS
+
+Hook-order parity: XDP runs before TC in the kernel, so a DHCP fast-path
+reply (XDP_TX) never traverses antispoof/QoS — here TX lanes are exempt
+from the drop masks the same way. Slow-path DHCP requests (is_dhcp &
+~is_reply) are likewise exempt from antispoof (DISCOVER's 0.0.0.0 source
+must reach the DHCP server; the reference achieves this by attaching
+antispoof only to data VLANs).
+
+Direction is per-lane via `from_access` (True = subscriber-side ingress,
+the uplink; False = core-side, the downlink) — the role of the two
+interfaces in pkg/nat/tc_linux.go.
+
+Verdicts (the XDP_TX/XDP_PASS/TC_ACT_SHOT model, per lane):
+    PASS=0 (slow path / untouched), DROP=1, TX=2 (device-generated reply),
+    FWD=3 (rewritten, forward).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from bng_tpu.ops.antispoof import (
+    ANTISPOOF_NSTATS,
+    AntispoofGeom,
+    antispoof_kernel,
+)
+from bng_tpu.ops import bytes as B_
+from bng_tpu.ops.dhcp import DHCPGeom, DHCPTables, NSTATS as DHCP_NSTATS, dhcp_fastpath
+from bng_tpu.ops.nat44 import NATGeom, NATTables, NAT_NSTATS, nat44_kernel
+from bng_tpu.ops.parse import parse_batch
+from bng_tpu.ops.qos import QOS_NSTATS, QoSGeom, qos_kernel
+from bng_tpu.ops.table import TableState
+
+VERDICT_PASS, VERDICT_DROP, VERDICT_TX, VERDICT_FWD = 0, 1, 2, 3
+
+
+class PipelineTables(NamedTuple):
+    """All device-resident state for the fused pipeline (a pytree)."""
+
+    dhcp: DHCPTables
+    nat: NATTables
+    qos_up: TableState  # keyed by src ip (upload; qos_ingress map role)
+    qos_down: TableState  # keyed by dst ip (download; qos_egress map role)
+    spoof: TableState
+    spoof_ranges: jax.Array  # [R, 2]
+    spoof_config: jax.Array  # [2]
+
+
+class PipelineGeom(NamedTuple):
+    dhcp: DHCPGeom
+    nat: NATGeom
+    qos: QoSGeom
+    spoof: AntispoofGeom
+
+
+class PipelineResult(NamedTuple):
+    verdict: jax.Array  # [B] int32
+    out_pkt: jax.Array  # [B, L] uint8
+    out_len: jax.Array  # [B] uint32
+    tables: PipelineTables  # updated device state (counters/tokens)
+    dhcp_stats: jax.Array  # [DHCP_NSTATS]
+    nat_stats: jax.Array  # [NAT_NSTATS]
+    qos_stats: jax.Array  # [QOS_NSTATS] (up + down combined)
+    spoof_stats: jax.Array  # [ANTISPOOF_NSTATS]
+    priority: jax.Array  # [B] uint32 (QoS class)
+    nat_punt: jax.Array  # [B] bool — new flow, host must create session
+    spoof_violation: jax.Array  # [B] bool — host audit log
+
+
+def pipeline_step(
+    tables: PipelineTables,
+    pkt: jax.Array,
+    length: jax.Array,
+    from_access: jax.Array,
+    geom: PipelineGeom,
+    now_s: jax.Array,
+    now_us: jax.Array,
+) -> PipelineResult:
+    parsed = parse_batch(pkt, length)
+
+    # --- antispoof (TC ingress on access side; antispoof.c:188-293) ---
+    spoof = antispoof_kernel(pkt, parsed, tables.spoof, geom.spoof,
+                             tables.spoof_ranges, tables.spoof_config)
+    spoof_drop = spoof.dropped & from_access
+
+    # --- DHCP fast path (XDP; dhcp_fastpath.c:619-813) ---
+    dhcp = dhcp_fastpath(pkt, length, parsed, tables.dhcp, geom.dhcp, now_s)
+    dhcp_tx = dhcp.is_reply & from_access
+    dhcp_slow = dhcp.is_dhcp & from_access & ~dhcp_tx
+    # DHCP traffic bypasses antispoof (XDP-before-TC for TX; DISCOVER src
+    # 0.0.0.0 must reach the slow path)
+    spoof_drop = spoof_drop & ~dhcp.is_dhcp
+
+    # --- NAT44 (TC; nat44.c:565-948) — not for DHCP lanes ---
+    nat = nat44_kernel(pkt, length, parsed, tables.nat, geom.nat, now_s)
+    natable = ~dhcp.is_dhcp & ~spoof_drop
+    nat_fwd = nat.translated & natable
+    nat_punt = nat.punted & natable
+
+    # --- QoS (TC; qos_ratelimit.c:126-222) ---
+    # upload: access-side lanes keyed by src ip (qos_ingress_prog :178)
+    up = qos_kernel(parsed.src_ip, length, from_access & parsed.is_ipv4 & ~dhcp.is_dhcp,
+                    tables.qos_up, geom.qos, now_us)
+    # download: core-side lanes keyed by POST-DNAT dst ip (the subscriber
+    # address — after DNAT the dst is the private ip, qos_egress_prog :126).
+    # Read it from the rewritten bytes: covers translated and untouched lanes.
+    dnat_dst = B_.be32_at(nat.out_pkt, parsed.l3_off + 16)
+    down = qos_kernel(dnat_dst, length, ~from_access & parsed.is_ipv4,
+                      tables.qos_down, geom.qos, now_us)
+    qos_drop = (up.dropped & from_access) | (down.dropped & ~from_access)
+
+    # --- verdict combination (precedence: TX > DROP > FWD > PASS) ---
+    drop = (spoof_drop | qos_drop) & ~dhcp_tx
+    verdict = jnp.where(
+        dhcp_tx, VERDICT_TX,
+        jnp.where(drop, VERDICT_DROP,
+                  jnp.where(nat_fwd, VERDICT_FWD, VERDICT_PASS)),
+    ).astype(jnp.int32)
+
+    out_pkt = jnp.where(dhcp_tx[:, None], dhcp.out_pkt, nat.out_pkt)
+    out_len = jnp.where(dhcp_tx, dhcp.out_len, length)
+
+    new_tables = tables._replace(
+        nat=tables.nat._replace(sessions=nat.sessions),
+        qos_up=up.table,
+        qos_down=down.table,
+    )
+    return PipelineResult(
+        verdict=verdict,
+        out_pkt=out_pkt,
+        out_len=out_len,
+        tables=new_tables,
+        dhcp_stats=dhcp.stats,
+        nat_stats=nat.stats,
+        qos_stats=up.stats + down.stats,
+        spoof_stats=spoof.stats,
+        priority=jnp.maximum(up.priority, down.priority),
+        nat_punt=nat_punt,
+        spoof_violation=spoof.violation,
+    )

@@ -4,10 +4,11 @@ TPU re-expression of bpf/qos_ratelimit.c. The eBPF program does a
 read-modify-write of one token bucket per packet (qos_ratelimit.c:70-104);
 on TPU a batch may contain many packets for the same subscriber, so the
 sequential "consume if tokens suffice" semantics are recovered with a
-**segment prefix sum computed on the MXU**: an equality matrix
-(same-bucket lanes) masked lower-triangular, matmul'd against packet
-lengths. B=2048 lanes -> a [B,B]@[B] f32 matmul — exactly what the
-systolic array is for; no sorting, no scatter conflicts.
+**stable-sort segment prefix sum**: lanes sorted by bucket slot (stable,
+preserving arrival order), per-segment cumulative byte counts via cumsum +
+cummax head-carry, admission decided against the bucket's available
+tokens, then results unsorted. O(B log B) time, O(B) memory — scales to
+the 8k+ lane batches the throughput target needs.
 
 Admission rule: lane i passes iff (sum of lengths of same-bucket lanes
 j<=i) <= available tokens at batch start. This is the reference's TBF with
@@ -28,7 +29,7 @@ import jax
 import jax.numpy as jnp
 
 from bng_tpu.ops.parse import Parsed
-from bng_tpu.ops.table import TableState, device_lookup
+from bng_tpu.ops.table import TableGeom, TableState, lookup
 
 # token_bucket value words (parity: qos_ratelimit.c:24-31)
 (QV_RATE_BPS_LO, QV_RATE_BPS_HI, QV_BURST, QV_TOKENS, QV_LAST_US, QV_PRIORITY) = range(6)
@@ -39,9 +40,8 @@ QOS_WORDS = 8
 QOS_NSTATS = 4
 
 
-class QoSGeom(NamedTuple):
-    nbuckets: int
-    stash: int
+# QoS has a single table per direction; its geometry IS a TableGeom
+QoSGeom = TableGeom
 
 
 class QoSResult(NamedTuple):
@@ -57,11 +57,18 @@ def qos_kernel(
     pkt_len: jax.Array,  # [B] uint32
     active: jax.Array,  # [B] bool — lanes subject to this QoS direction
     table: TableState,
-    geom: QoSGeom,
+    geom: TableGeom,
     now_us: jax.Array,  # uint32 scalar, wraps
 ) -> QoSResult:
+    # qos is the only device-side *writer* of its table: the token/timestamp
+    # writeback below scatters into the LOCAL table at res.slot, which under
+    # a sharded geometry would be an owner-local slot — silent corruption.
+    # QoS tables are chip-local by design (subscriber traffic affinity).
+    if geom.axis is not None and geom.n_shards > 1:
+        raise ValueError("qos_kernel requires a chip-local table (geom.axis=None); "
+                         "QoS state is placed by subscriber affinity, not hash-sharding")
     Bsz = ip_key.shape[0]
-    res = device_lookup(table, ip_key[:, None], geom.nbuckets, geom.stash)
+    res = lookup(table, ip_key[:, None], geom)
     has_policy = res.found & active
     rate_lo = res.vals[:, QV_RATE_BPS_LO]
     rate_hi = res.vals[:, QV_RATE_BPS_HI]
@@ -79,24 +86,47 @@ def qos_kernel(
     refill = elapsed_us * (rate_bps / 8.0) * jnp.float32(1e-6)
     avail = jnp.minimum(tokens.astype(jnp.float32) + refill, burst.astype(jnp.float32))
 
-    # --- MXU segment prefix sum over same-slot lanes ---
-    slot = jnp.where(limited, res.slot, -1 - jnp.arange(Bsz, dtype=jnp.int32))  # unique per inactive lane
-    same = (slot[:, None] == slot[None, :]).astype(jnp.float32)  # [B, B]
-    tri_incl = jnp.tril(jnp.ones((Bsz, Bsz), dtype=jnp.float32))  # j <= i
-    lens = pkt_len.astype(jnp.float32)
-    cum_incl = (same * tri_incl) @ lens  # [B] bytes attempted up to & incl me
-    allowed = ~limited | (cum_incl <= avail)
+    # --- sort-based segment prefix sum over same-slot lanes ---
+    # O(B log B) and O(B) memory (an equality-matrix/MXU variant is O(B^2)
+    # bytes — 268MB at B=8192 — which swamps HBM bandwidth). A stable sort
+    # groups same-bucket lanes while preserving lane order, so the
+    # sequential TBF admission order survives.
+    # integer byte accounting: an f32 cumsum loses integer exactness past
+    # 2^24 total batch bytes (8k jumbo-frame lanes), flipping boundary
+    # admissions — uint32 is exact to 4GB per batch
+    lens_u = pkt_len.astype(jnp.uint32)
+    slot_eff = jnp.where(limited, res.slot, -1 - jnp.arange(Bsz, dtype=jnp.int32))
+    order = jnp.argsort(slot_eff, stable=True)
+    s_sorted = slot_eff[order]
+    lens_sorted = lens_u[order]
+    avail_sorted = avail[order]
+    limited_sorted = limited[order]
+
+    csum = jnp.cumsum(lens_sorted)
+    is_head = jnp.concatenate([jnp.ones((1,), dtype=bool), s_sorted[1:] != s_sorted[:-1]])
+    seg_id = jnp.cumsum(is_head.astype(jnp.int32)) - 1  # dense segment rank
+    # bytes consumed before each segment starts: carry the head's base forward
+    seg_base = jax.lax.cummax(jnp.where(is_head, csum - lens_sorted, 0))
+    cum_incl_sorted = csum - seg_base  # attempted bytes up to & incl me, in my bucket
+    # floor(avail) in uint32 keeps the admission compare fully integral
+    avail_int = jnp.clip(avail_sorted, 0.0, 4.0e9).astype(jnp.uint32)
+    allowed_sorted = ~limited_sorted | (cum_incl_sorted <= avail_int)
+
+    # per-bucket admitted-byte totals -> token writeback
+    admitted_sorted = jnp.where(allowed_sorted & limited_sorted, lens_sorted, 0)
+    seg_totals = jax.ops.segment_sum(admitted_sorted, seg_id, num_segments=Bsz)
+    consumed_sorted = seg_totals[seg_id]
+    new_tokens_sorted = jnp.clip(avail_sorted - consumed_sorted.astype(jnp.float32), 0.0,
+                                 burst[order].astype(jnp.float32))
+
+    # unsort lane-wise results
+    inv = jnp.zeros((Bsz,), dtype=jnp.int32).at[order].set(jnp.arange(Bsz, dtype=jnp.int32))
+    allowed = allowed_sorted[inv]
     dropped = limited & ~allowed
+    new_tokens = new_tokens_sorted[inv]
 
-    # consumed per bucket = sum of admitted lanes' bytes (full row sum)
-    admitted_lens = jnp.where(allowed & limited, lens, 0.0)
-    consumed = same @ admitted_lens  # same total for every lane of the bucket
-    new_tokens = jnp.clip(avail - consumed, 0.0, burst.astype(jnp.float32))
-
-    # first lane of each bucket writes the new state (no scatter conflicts)
-    tri_strict = jnp.tril(jnp.ones((Bsz, Bsz), dtype=jnp.float32), k=-1)
-    prior_same = (same * tri_strict) @ jnp.ones((Bsz,), dtype=jnp.float32)
-    first = limited & (prior_same == 0)
+    # the head lane of each bucket writes the new state (no conflicts)
+    first = (is_head & limited_sorted)[inv] & limited
     S = table.vals.shape[0]
     wslot = jnp.where(first, res.slot, S).astype(jnp.int32)
     vals = table.vals.at[wslot, QV_TOKENS].set(new_tokens.astype(jnp.uint32), mode="drop")

@@ -65,8 +65,37 @@ class TableUpdate(NamedTuple):
 
 class LookupResult(NamedTuple):
     found: jax.Array  # [B] bool
-    slot: jax.Array  # [B] int32 (valid only where found)
+    slot: jax.Array  # [B] int32 (valid only where found; owner-local if sharded)
     vals: jax.Array  # [B, V] uint32 (zeros where not found)
+
+
+class TableGeom(NamedTuple):
+    """Static geometry of one table, plus optional ICI sharding.
+
+    axis=None: the table is chip-local (or replicated) — plain 2-gather
+    lookup. axis="x": the table is hash-sharded across `n_shards` devices
+    on mesh axis "x"; lookups ride an all-to-all key/result exchange
+    (see sharded_lookup). This is the TPU re-expression of the reference's
+    hash-partitioned tables across nodes (SURVEY.md §2.3: Nexus hashring /
+    rendezvous placement).
+    """
+
+    nbuckets: int
+    stash: int
+    axis: str | None = None
+    n_shards: int = 1
+
+
+# shard-owner hash seed — distinct from the cuckoo bucket seeds so shard
+# routing and in-table placement are independent
+SEED_SHARD = np.uint32(0xC2B2AE35)
+
+
+def shard_owner(query_words, n_shards: int):
+    """Owner shard of each key: mix(key) % n_shards. Host (numpy) and
+    device (jnp) both call this — routing must agree bit-for-bit."""
+    h = hash_words(query_words, SEED_SHARD)
+    return h % np.uint32(n_shards)
 
 
 def apply_update(state: TableState, upd: TableUpdate) -> TableState:
@@ -75,6 +104,69 @@ def apply_update(state: TableState, upd: TableUpdate) -> TableState:
         keys=state.keys.at[upd.idx].set(upd.keys, mode="drop"),
         vals=state.vals.at[upd.idx].set(upd.vals, mode="drop"),
         used=state.used.at[upd.idx].set(upd.used, mode="drop"),
+    )
+
+
+def lookup(state: TableState, query: jax.Array, g: TableGeom) -> LookupResult:
+    """Geometry-dispatched lookup: local 2-gather probe, or sharded
+    all-to-all exchange when g.axis names a mesh axis."""
+    if g.axis is None or g.n_shards == 1:
+        return device_lookup(state, query, g.nbuckets, g.stash)
+    return sharded_lookup(state, query, g)
+
+
+def sharded_lookup(state: TableState, query: jax.Array, g: TableGeom) -> LookupResult:
+    """Cross-chip lookup via MoE-style dispatch over ICI.
+
+    Must run inside shard_map over mesh axis g.axis. Each chip holds one
+    hash-shard of the table (an independent cuckoo table) and a local
+    [b, K] query batch. Only keys and result rows ride the interconnect —
+    packets never move:
+
+      1. owner = shard_owner(key) for each lane
+      2. keys are packed into a [N, C, K] per-destination buffer
+         (C = b: worst case every lane targets one shard — no overflow,
+         no dropped lookups)
+      3. lax.all_to_all exchanges request buffers (one ICI shuffle)
+      4. each chip probes its local shard for all received keys
+      5. a second all_to_all returns results; lane i reads its
+         (owner, position) cell
+
+    The reference does this routing with HTTP forwards to the hashring
+    owner (pkg/nexus/client.go:487-577, pkg/pool/peer.go:230-368); here
+    it is two ICI collectives per batch.
+    """
+    b, K = query.shape
+    N = g.n_shards
+    C = b  # per-destination capacity (worst case, exact)
+    words = [query[:, k] for k in range(K)]
+    owner = shard_owner(words, N).astype(jnp.int32)  # [b]
+
+    onehot = (owner[:, None] == jnp.arange(N, dtype=jnp.int32)[None, :]).astype(jnp.int32)
+    pos = jnp.take_along_axis(jnp.cumsum(onehot, axis=0) - 1, owner[:, None], axis=1)[:, 0]
+    flat = owner * C + pos  # [b] position in the request buffer
+
+    req = jnp.zeros((N * C, K), dtype=jnp.uint32).at[flat].set(query)
+    req = req.reshape(N, C, K)
+    req_recv = jax.lax.all_to_all(req, g.axis, split_axis=0, concat_axis=0, tiled=True)
+
+    local = device_lookup(state, req_recv.reshape(N * C, K), g.nbuckets, g.stash)
+    # pack found/slot/vals into ONE response buffer -> one return collective
+    # (three separate all_to_alls would triple the response latency)
+    V = local.vals.shape[1]
+    packed = jnp.concatenate(
+        [local.vals,
+         local.found.astype(jnp.uint32)[:, None],
+         local.slot.astype(jnp.uint32)[:, None]],
+        axis=1,
+    ).reshape(N, C, V + 2)
+    resp = jax.lax.all_to_all(packed, g.axis, split_axis=0, concat_axis=0, tiled=True)
+
+    cell = resp[owner, pos]  # [b, V+2]
+    return LookupResult(
+        found=cell[:, V] != 0,
+        slot=cell[:, V + 1].astype(jnp.int32),
+        vals=cell[:, :V],
     )
 
 

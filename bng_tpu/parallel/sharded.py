@@ -1,0 +1,239 @@
+"""Multi-chip BNG: the fused pipeline under shard_map over a device Mesh.
+
+Scale-out design (replacing the reference's HTTP/SSE + hashring node mesh,
+SURVEY.md §2.3, with ICI collectives):
+
+- **Packets are data-parallel**: the ring steers each subscriber's traffic
+  to a consistent chip (rendezvous hashing at the host ring — the
+  pkg/pool/peer.go owner-routing role), so each chip's batch is its own
+  subscribers' traffic.
+- **Flow state is chip-local**: NAT sessions / QoS buckets / antispoof
+  bindings live on the chip that owns the subscriber — no cross-chip
+  traffic for the hot NAT path (mirrors the reference where each node owns
+  its subscribers' conntrack outright).
+- **DHCP subscriber tables are hash-sharded across chips** with all-to-all
+  key/result exchange (ops.table.sharded_lookup): DISCOVER/REQUEST can
+  arrive on any chip (broadcasts, relays), and the 1M-entry table sharded
+  over 8 chips is the capacity headline. Only 8-byte keys and 32-byte
+  results ride ICI, never packets.
+- **Stats are psum-reduced** over the mesh (the per-CPU-map -> global
+  counter role, bpf maps PERCPU_ARRAY).
+
+Host side: ShardedCluster owns one host-table stack per shard, routes
+control-plane writes to the owner shard (DHCP tables by key hash; NAT/QoS/
+spoof by the subscriber-affinity shard), and stacks the per-shard device
+arrays with a leading mesh dimension.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from bng_tpu.control.nat import NATManager
+from bng_tpu.ops.pipeline import PipelineGeom, PipelineTables, pipeline_step
+from bng_tpu.ops.table import TableGeom, shard_owner
+from bng_tpu.runtime.engine import AntispoofTables, QoSTables
+from bng_tpu.runtime.tables import FastPathTables
+from bng_tpu.utils.net import mac_to_u64, split_u64
+
+AXIS = "shard"
+
+
+def make_mesh(n_devices: int) -> Mesh:
+    devs = jax.devices()[:n_devices]
+    if len(devs) < n_devices:
+        raise RuntimeError(f"need {n_devices} devices, have {len(jax.devices())}")
+    return Mesh(np.array(devs), (AXIS,))
+
+
+def _sharded_geom(geom: PipelineGeom, n: int) -> PipelineGeom:
+    """Mark the DHCP lookup tables as hash-sharded over the mesh axis."""
+    dhcp = geom.dhcp._replace(
+        sub=geom.dhcp.sub._replace(axis=AXIS, n_shards=n),
+        vlan=geom.dhcp.vlan._replace(axis=AXIS, n_shards=n),
+        cid=geom.dhcp.cid._replace(axis=AXIS, n_shards=n),
+    )
+    return geom._replace(dhcp=dhcp)
+
+
+@functools.lru_cache(maxsize=4)
+def _sharded_step_jit(mesh: Mesh, geom: PipelineGeom, n: int):
+    geom_sh = _sharded_geom(geom, n)
+
+    def local_step(tables1, pkt, length, fa, now_s, now_us):
+        # shard_map hands each chip a leading dim of 1: drop it
+        tables = jax.tree.map(lambda x: x[0], tables1)
+        res = pipeline_step(tables, pkt, length, fa, geom_sh, now_s, now_us)
+        new_tables1 = jax.tree.map(lambda x: x[None], res.tables)
+        # global stats over ICI (per-CPU map -> one counter)
+        dhcp_stats = jax.lax.psum(res.dhcp_stats, AXIS)
+        nat_stats = jax.lax.psum(res.nat_stats, AXIS)
+        qos_stats = jax.lax.psum(res.qos_stats, AXIS)
+        spoof_stats = jax.lax.psum(res.spoof_stats, AXIS)
+        return (res.verdict, res.out_pkt, res.out_len, new_tables1,
+                dhcp_stats, nat_stats, qos_stats, spoof_stats,
+                res.nat_punt, res.spoof_violation)
+
+    sharded = jax.shard_map(
+        local_step,
+        mesh=mesh,
+        in_specs=(P(AXIS), P(AXIS), P(AXIS), P(AXIS), P(), P()),
+        out_specs=(P(AXIS), P(AXIS), P(AXIS), P(AXIS), P(), P(), P(), P(),
+                   P(AXIS), P(AXIS)),
+        check_vma=False,
+    )
+    return jax.jit(sharded, donate_argnums=(0,))
+
+
+class ShardedCluster:
+    """N-shard BNG over a 1D mesh. Control-plane writes route to owners."""
+
+    def __init__(
+        self,
+        n_shards: int,
+        mesh: Mesh | None = None,
+        batch_per_shard: int = 64,
+        sub_nbuckets: int = 256,
+        vlan_nbuckets: int = 64,
+        cid_nbuckets: int = 64,
+        max_pools: int = 16,
+        nat_sessions_nbuckets: int = 256,
+        qos_nbuckets: int = 256,
+        spoof_nbuckets: int = 256,
+        public_ips: list[int] | None = None,
+    ):
+        self.n = n_shards
+        self.mesh = mesh if mesh is not None else make_mesh(n_shards)
+        self.b = batch_per_shard
+        self.fastpath = [
+            FastPathTables(sub_nbuckets=sub_nbuckets, vlan_nbuckets=vlan_nbuckets,
+                           cid_nbuckets=cid_nbuckets, max_pools=max_pools)
+            for _ in range(n_shards)
+        ]
+        base_pub = public_ips or [0xCB007100 + i for i in range(n_shards)]
+        self.nat = [
+            NATManager(public_ips=[base_pub[i % len(base_pub)]],
+                       sessions_nbuckets=nat_sessions_nbuckets,
+                       sub_nat_nbuckets=256)
+            for i in range(n_shards)
+        ]
+        self.qos = [QoSTables(nbuckets=qos_nbuckets) for _ in range(n_shards)]
+        self.spoof = [AntispoofTables(nbuckets=spoof_nbuckets) for _ in range(n_shards)]
+        self.geom = PipelineGeom(
+            dhcp=self.fastpath[0].geom,
+            nat=self.nat[0].geom,
+            qos=self.qos[0].geom,
+            spoof=self.spoof[0].geom,
+        )
+        self._step = _sharded_step_jit(self.mesh, self.geom, self.n)
+        self.tables = None  # lazily built on first step / sync()
+
+    # ---- owner routing (must match device shard_owner) ----
+    def dhcp_sub_shard(self, mac) -> int:
+        key = mac_to_u64(mac) if not isinstance(mac, int) else mac
+        lo, hi = split_u64(key)
+        words = [np.array([hi], dtype=np.uint32), np.array([lo], dtype=np.uint32)]
+        return int(shard_owner(words, self.n)[0])
+
+    def dhcp_vlan_shard(self, s_tag: int, c_tag: int) -> int:
+        words = [np.array([(s_tag << 16) | c_tag], dtype=np.uint32)]
+        return int(shard_owner(words, self.n)[0])
+
+    def dhcp_cid_shard(self, circuit_id: bytes) -> int:
+        from bng_tpu.runtime.tables import pack_cid_host
+
+        w = pack_cid_host(circuit_id)
+        words = [w[i : i + 1] for i in range(8)]
+        return int(shard_owner(words, self.n)[0])
+
+    def affinity_shard(self, subscriber_key: str) -> int:
+        """Traffic-placement shard for a subscriber (rendezvous over chips)."""
+        from bng_tpu.parallel.hashring import rendezvous_owner
+
+        nodes = [str(i) for i in range(self.n)]
+        return int(rendezvous_owner(nodes, subscriber_key))
+
+    # ---- control-plane writes ----
+    def add_pool_all(self, pool_id: int, network: int, prefix_len: int, gateway: int,
+                     dns1: int = 0, dns2: int = 0, lease_time: int = 3600) -> None:
+        for fp in self.fastpath:
+            fp.add_pool(pool_id, network, prefix_len, gateway, dns1, dns2, lease_time)
+
+    def set_server_config_all(self, mac, ip: int) -> None:
+        for fp in self.fastpath:
+            fp.set_server_config(mac, ip)
+
+    def add_subscriber(self, mac, **kw) -> int:
+        o = self.dhcp_sub_shard(mac)
+        self.fastpath[o].add_subscriber(mac, **kw)
+        return o
+
+    def add_vlan_subscriber(self, s_tag: int, c_tag: int, **kw) -> int:
+        o = self.dhcp_vlan_shard(s_tag, c_tag)
+        self.fastpath[o].add_vlan_subscriber(s_tag, c_tag, **kw)
+        return o
+
+    def add_circuit_id_subscriber(self, circuit_id: bytes, **kw) -> int:
+        o = self.dhcp_cid_shard(circuit_id)
+        self.fastpath[o].add_circuit_id_subscriber(circuit_id, **kw)
+        return o
+
+    # ---- device sync ----
+    def _stack(self, arrs, spec):
+        stacked = np.stack([np.asarray(a) for a in arrs])
+        return jax.device_put(stacked, NamedSharding(self.mesh, spec))
+
+    def sync_tables(self) -> None:
+        """Full upload of every shard's tables, stacked on the mesh axis."""
+        per_shard = []
+        for i in range(self.n):
+            t = PipelineTables(
+                dhcp=self.fastpath[i].device_tables(),
+                nat=self.nat[i].device_tables(),
+                qos_up=self.qos[i].up.device_state(),
+                qos_down=self.qos[i].down.device_state(),
+                spoof=self.spoof[i].bindings.device_state(),
+                spoof_ranges=jnp.asarray(self.spoof[i].ranges),
+                spoof_config=jnp.asarray(self.spoof[i].config),
+            )
+            per_shard.append(t)
+        self.tables = jax.tree.map(
+            lambda *xs: self._stack(xs, P(AXIS)), *per_shard
+        )
+
+    def step(self, pkt: np.ndarray, length: np.ndarray, from_access: np.ndarray,
+             now_s: int, now_us: int):
+        """One sharded pipeline step.
+
+        pkt: [N*b, L] uint8 (shard i's lanes at rows i*b..(i+1)*b).
+        Returns (verdict, out_pkt, out_len, stats tuple...) — batch-sharded
+        outputs are fetched to host.
+        """
+        if self.tables is None:
+            self.sync_tables()
+        sh = NamedSharding(self.mesh, P(AXIS))
+        pkt_d = jax.device_put(pkt, sh)
+        len_d = jax.device_put(length.astype(np.uint32), sh)
+        fa_d = jax.device_put(from_access, sh)
+        out = self._step(self.tables, pkt_d, len_d, fa_d,
+                         jnp.uint32(now_s), jnp.uint32(now_us))
+        (verdict, out_pkt, out_len, new_tables, dhcp_stats, nat_stats,
+         qos_stats, spoof_stats, nat_punt, viol) = out
+        self.tables = new_tables
+        return {
+            "verdict": np.asarray(verdict),
+            "out_pkt": out_pkt,
+            "out_len": np.asarray(out_len),
+            "dhcp_stats": np.asarray(dhcp_stats),
+            "nat_stats": np.asarray(nat_stats),
+            "qos_stats": np.asarray(qos_stats),
+            "spoof_stats": np.asarray(spoof_stats),
+            "nat_punt": np.asarray(nat_punt),
+            "violation": np.asarray(viol),
+        }

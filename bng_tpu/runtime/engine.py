@@ -1,0 +1,316 @@
+"""Host runtime engine: the packet ring <-> device pipeline glue.
+
+This is the role pkg/ebpf plays in the reference (SURVEY.md §1 L1), turned
+inside out for TPU: instead of loading programs into the kernel and writing
+maps via syscalls, the engine
+
+1. assembles frames into fixed [B, L] uint8 batches (the AF_XDP RX ring
+   consumer; a C++ ring feeds this in production, synthetic sources in
+   tests/bench),
+2. drains bounded table-update batches from the host managers (the
+   bpf_map_update_elem replacement),
+3. invokes ONE donated jitted step: updates -> fused pipeline -> verdicts,
+4. applies verdicts: TX/FWD frames out, DROP counted, PASS lanes handed to
+   the slow-path handlers (DHCP server, NAT new-flow manager) exactly like
+   XDP_PASS delivers to the Go servers,
+5. accumulates device stats into host counters (u64 in Python ints,
+   mirroring pkg/metrics' 5s scrapes of the stats maps).
+
+Single-chip engine; the sharded multi-chip variant lives in
+bng_tpu.parallel.
+"""
+
+from __future__ import annotations
+
+import functools
+import time
+from dataclasses import dataclass, field
+from typing import Callable
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from bng_tpu.control.nat import NATManager, apply_nat_updates
+from bng_tpu.ops.antispoof import ANTISPOOF_NSTATS, AntispoofGeom
+from bng_tpu.ops.dhcp import NSTATS as DHCP_NSTATS
+from bng_tpu.ops.nat44 import NAT_NSTATS
+from bng_tpu.ops.pipeline import (
+    PipelineGeom,
+    PipelineResult,
+    PipelineTables,
+    VERDICT_DROP,
+    VERDICT_FWD,
+    VERDICT_PASS,
+    VERDICT_TX,
+    pipeline_step,
+)
+from bng_tpu.ops.qos import QOS_NSTATS, QOS_WORDS, make_bucket_row
+from bng_tpu.ops.antispoof import ANTISPOOF_WORDS
+from bng_tpu.ops.table import HostTable, TableGeom, apply_update
+from bng_tpu.runtime.tables import FastPathTables, apply_fastpath_updates
+
+PKT_SLOT = 512
+
+
+def _apply_all_updates(tables: PipelineTables, upd) -> PipelineTables:
+    fp_upd, nat_upd, qup, qdown, sp_upd, sp_ranges, sp_config = upd
+    return PipelineTables(
+        dhcp=apply_fastpath_updates(tables.dhcp, fp_upd),
+        nat=apply_nat_updates(tables.nat, nat_upd),
+        qos_up=apply_update(tables.qos_up, qup),
+        qos_down=apply_update(tables.qos_down, qdown),
+        spoof=apply_update(tables.spoof, sp_upd),
+        spoof_ranges=sp_ranges,
+        spoof_config=sp_config,
+    )
+
+
+@functools.lru_cache(maxsize=8)
+def _pipeline_jit(geom: PipelineGeom):
+    def step(tables, upd, pkt, length, from_access, now_s, now_us):
+        tables = _apply_all_updates(tables, upd)
+        return pipeline_step(tables, pkt, length, from_access, geom, now_s, now_us)
+
+    # donate the device tables: updates + counter writes are in-place
+    return jax.jit(step, donate_argnums=(0,))
+
+
+@dataclass
+class EngineStats:
+    dhcp: np.ndarray = field(default_factory=lambda: np.zeros(DHCP_NSTATS, dtype=np.uint64))
+    nat: np.ndarray = field(default_factory=lambda: np.zeros(NAT_NSTATS, dtype=np.uint64))
+    qos: np.ndarray = field(default_factory=lambda: np.zeros(QOS_NSTATS, dtype=np.uint64))
+    spoof: np.ndarray = field(default_factory=lambda: np.zeros(ANTISPOOF_NSTATS, dtype=np.uint64))
+    batches: int = 0
+    tx: int = 0
+    fwd: int = 0
+    dropped: int = 0
+    passed: int = 0
+
+
+class QoSTables:
+    """Host side of the two QoS maps (pkg/qos/manager.go:167-246 role)."""
+
+    def __init__(self, nbuckets: int = 1 << 12, stash: int = 64, update_slots: int = 128):
+        self.up = HostTable(nbuckets, 1, QOS_WORDS, stash=stash, name="qos_ingress")
+        self.down = HostTable(nbuckets, 1, QOS_WORDS, stash=stash, name="qos_egress")
+        self.geom = TableGeom(nbuckets, stash)
+        self.update_slots = update_slots
+
+    def set_subscriber(self, ip: int, down_bps: int, up_bps: int,
+                       down_burst: int | None = None, up_burst: int | None = None,
+                       priority: int = 0) -> None:
+        # burst default: 1.25s at rate /8 -> bytes (manager.go burst calc role)
+        down_burst = down_burst if down_burst is not None else max(int(down_bps / 8 * 1.25), 1500)
+        up_burst = up_burst if up_burst is not None else max(int(up_bps / 8 * 1.25), 1500)
+        self.down.insert([ip], make_bucket_row(down_bps, down_burst, priority))
+        self.up.insert([ip], make_bucket_row(up_bps, up_burst, priority))
+
+    def remove_subscriber(self, ip: int) -> None:
+        self.down.delete([ip])
+        self.up.delete([ip])
+
+
+class AntispoofTables:
+    """Host side of antispoof (pkg/antispoof/manager.go role)."""
+
+    def __init__(self, nbuckets: int = 1 << 12, stash: int = 64, update_slots: int = 128):
+        from bng_tpu.ops.antispoof import MODE_DISABLED
+
+        self.bindings = HostTable(nbuckets, 2, ANTISPOOF_WORDS, stash=stash, name="subscriber_bindings")
+        self.ranges = np.zeros((256, 2), dtype=np.uint32)
+        self.config = np.array([MODE_DISABLED, 0], dtype=np.uint32)
+        self.geom = TableGeom(nbuckets, stash)
+        self.update_slots = update_slots
+
+    def set_config(self, default_mode: int, log_violations: bool) -> None:
+        self.config[0] = default_mode
+        self.config[1] = 1 if log_violations else 0
+
+    def add_binding(self, mac, ipv4: int, mode: int) -> None:
+        from bng_tpu.ops.antispoof import AB_IPV4, AB_MODE, AB_VALIDS, VALID_V4
+        from bng_tpu.utils.net import mac_to_u64, split_u64
+
+        key = mac_to_u64(mac) if not isinstance(mac, int) else mac
+        lo, hi = split_u64(key)
+        row = np.zeros((ANTISPOOF_WORDS,), dtype=np.uint32)
+        row[AB_IPV4] = ipv4
+        row[AB_VALIDS] = VALID_V4
+        row[AB_MODE] = mode
+        self.bindings.insert([hi, lo], row)
+
+    def add_binding_v6(self, mac, ipv6_words: list[int], mode: int) -> None:
+        from bng_tpu.ops.antispoof import AB_MODE, AB_V6_0, AB_VALIDS, VALID_V6
+        from bng_tpu.utils.net import mac_to_u64, split_u64
+
+        key = mac_to_u64(mac) if not isinstance(mac, int) else mac
+        lo, hi = split_u64(key)
+        existing = self.bindings.lookup([hi, lo])
+        row = existing if existing is not None else np.zeros((ANTISPOOF_WORDS,), dtype=np.uint32)
+        row[AB_V6_0 : AB_V6_0 + 4] = np.asarray(ipv6_words, dtype=np.uint32)
+        row[AB_VALIDS] |= VALID_V6
+        row[AB_MODE] = mode
+        self.bindings.insert([hi, lo], row)
+
+    def remove_binding(self, mac) -> bool:
+        from bng_tpu.utils.net import mac_to_u64, split_u64
+
+        key = mac_to_u64(mac) if not isinstance(mac, int) else mac
+        lo, hi = split_u64(key)
+        return self.bindings.delete([hi, lo])
+
+    def add_allowed_range(self, network: int, prefix_len: int) -> None:
+        free = np.nonzero(self.ranges[:, 0] == 0)[0]
+        if len(free) == 0:
+            raise RuntimeError("allowed-ranges table full")
+        self.ranges[free[0]] = (prefix_len, network)
+
+
+class Engine:
+    def __init__(
+        self,
+        fastpath: FastPathTables,
+        nat: NATManager,
+        qos: QoSTables | None = None,
+        antispoof: AntispoofTables | None = None,
+        batch_size: int = 256,
+        slow_path: Callable[[bytes], bytes | None] | None = None,
+        violation_sink: Callable[[int, bytes], None] | None = None,
+        clock: Callable[[], float] = time.time,
+    ):
+        self.fastpath = fastpath
+        self.nat = nat
+        self.qos = qos or QoSTables()
+        self.antispoof = antispoof or AntispoofTables()
+        self.B = batch_size
+        self.slow_path = slow_path
+        self.violation_sink = violation_sink
+        self.clock = clock
+        self.stats = EngineStats()
+
+        self.geom = PipelineGeom(
+            dhcp=fastpath.geom, nat=nat.geom, qos=self.qos.geom, spoof=self.antispoof.geom
+        )
+        self.tables: PipelineTables = PipelineTables(
+            dhcp=fastpath.device_tables(),
+            nat=nat.device_tables(),
+            qos_up=self.qos.up.device_state(),
+            qos_down=self.qos.down.device_state(),
+            spoof=self.antispoof.bindings.device_state(),
+            spoof_ranges=jnp.asarray(self.antispoof.ranges),
+            spoof_config=jnp.asarray(self.antispoof.config),
+        )
+        # jit cache is keyed on geometry so Engine instances with identical
+        # table shapes share one compile (tests build many engines)
+        self._step = _pipeline_jit(self.geom)
+
+    def _drain_updates(self):
+        return (
+            self.fastpath.make_updates(),
+            self.nat.make_updates(),
+            self.qos.up.make_update(self.qos.update_slots),
+            self.qos.down.make_update(self.qos.update_slots),
+            self.antispoof.bindings.make_update(self.antispoof.update_slots),
+            jnp.asarray(self.antispoof.ranges),
+            jnp.asarray(self.antispoof.config),
+        )
+
+    def process(
+        self,
+        frames: list[bytes],
+        from_access: list[bool] | bool = True,
+        now: float | None = None,
+    ) -> dict:
+        """Run one batch through the device pipeline and apply verdicts.
+
+        Returns {"tx": [(lane, frame)], "fwd": [...], "dropped": [lanes],
+        "slow": [(lane, reply_frame|None)]}.
+        """
+        if len(frames) > self.B:
+            raise ValueError(f"batch of {len(frames)} exceeds engine batch size {self.B}")
+        now = now if now is not None else self.clock()
+        now_s = np.uint32(int(now))
+        now_us = np.uint32(int(now * 1e6) & 0xFFFFFFFF)
+
+        pkt = np.zeros((self.B, PKT_SLOT), dtype=np.uint8)
+        length = np.zeros((self.B,), dtype=np.uint32)
+        for i, f in enumerate(frames):
+            n = min(len(f), PKT_SLOT)
+            pkt[i, :n] = np.frombuffer(f[:n], dtype=np.uint8)
+            length[i] = n
+        if isinstance(from_access, bool):
+            fa = np.full((self.B,), from_access, dtype=bool)
+        else:
+            fa = np.zeros((self.B,), dtype=bool)
+            fa[: len(from_access)] = from_access
+
+        res: PipelineResult = self._step(
+            self.tables, self._drain_updates(), jnp.asarray(pkt), jnp.asarray(length),
+            jnp.asarray(fa), now_s, now_us,
+        )
+        self.tables = res.tables
+
+        verdict = np.asarray(res.verdict)[: len(frames)]
+        out_len = np.asarray(res.out_len)
+        out_pkt = res.out_pkt  # fetch rows lazily
+        punt = np.asarray(res.nat_punt)[: len(frames)]
+        viol = np.asarray(res.spoof_violation)[: len(frames)]
+
+        self.stats.batches += 1
+        self.stats.dhcp += np.asarray(res.dhcp_stats, dtype=np.uint64)
+        self.stats.nat += np.asarray(res.nat_stats, dtype=np.uint64)
+        self.stats.qos += np.asarray(res.qos_stats, dtype=np.uint64)
+        self.stats.spoof += np.asarray(res.spoof_stats, dtype=np.uint64)
+
+        out = {"tx": [], "fwd": [], "dropped": [], "slow": []}
+        out_rows = None
+        for i, v in enumerate(verdict):
+            if v == VERDICT_TX:
+                if out_rows is None:
+                    out_rows = np.asarray(out_pkt)
+                out["tx"].append((i, bytes(out_rows[i, : int(out_len[i])])))
+                self.stats.tx += 1
+            elif v == VERDICT_FWD:
+                if out_rows is None:
+                    out_rows = np.asarray(out_pkt)
+                out["fwd"].append((i, bytes(out_rows[i, : int(out_len[i])])))
+                self.stats.fwd += 1
+            elif v == VERDICT_DROP:
+                out["dropped"].append(i)
+                self.stats.dropped += 1
+            else:
+                self.stats.passed += 1
+                reply = None
+                if punt[i]:
+                    self._punt_new_flow(frames[i], int(now))
+                elif self.slow_path is not None:
+                    reply = self.slow_path(frames[i])
+                out["slow"].append((i, reply))
+            if viol[i] and self.violation_sink is not None:
+                self.violation_sink(i, frames[i])
+        return out
+
+    def _punt_new_flow(self, frame: bytes, now: int) -> None:
+        """Device egress-miss: create the session host-side (packet 1 of a
+        new flow; parity with the conntrack-hybrid slow path)."""
+        from bng_tpu.control import packets as P
+
+        try:
+            d = P.decode(frame)
+        except Exception:
+            return
+        if d.ethertype != 0x0800:
+            return
+        src_port = d.icmp_id if d.proto == 1 else d.src_port
+        dst_port = 0 if d.proto == 1 else d.dst_port
+        self.nat.handle_new_flow(d.src_ip, d.dst_ip, src_port, dst_port,
+                                 d.proto, len(frame), now)
+
+    def fetch_session_vals(self) -> np.ndarray:
+        """Device-authoritative session counters for accounting/expiry."""
+        return np.asarray(self.tables.nat.sessions.vals)
+
+    def expire(self, now: int | None = None) -> int:
+        now = int(now if now is not None else self.clock())
+        return self.nat.expire_sessions(now, device_vals=self.fetch_session_vals())

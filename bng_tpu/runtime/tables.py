@@ -40,7 +40,7 @@ from bng_tpu.ops.dhcp import (
     DHCPGeom,
     DHCPTables,
 )
-from bng_tpu.ops.table import HostTable, TableUpdate, apply_update
+from bng_tpu.ops.table import HostTable, TableGeom, TableUpdate, apply_update
 from bng_tpu.utils.net import mac_to_u64, split_u64
 
 
@@ -94,10 +94,9 @@ class FastPathTables:
         self.server = np.zeros((SERVER_WORDS,), dtype=np.uint32)
         self.update_slots = update_slots
         self.geom = DHCPGeom(
-            sub_nbuckets=sub_nbuckets,
-            vlan_nbuckets=vlan_nbuckets,
-            cid_nbuckets=cid_nbuckets,
-            stash=stash,
+            sub=TableGeom(sub_nbuckets, stash),
+            vlan=TableGeom(vlan_nbuckets, stash),
+            cid=TableGeom(cid_nbuckets, stash),
         )
 
     # -- CRUD (parity: pkg/ebpf/loader.go AddSubscriber :352, AddPool :402,

@@ -1,0 +1,177 @@
+"""Allocator subsystem tests (pkg/allocator parity)."""
+
+import json
+
+import pytest
+
+from bng_tpu.control.allocator import (
+    AllocationRecord,
+    DistributedAllocator,
+    EpochBitmapAllocator,
+    HybridAllocator,
+    IPAllocator,
+    LocalAllocator,
+    MemoryAllocationStore,
+)
+from bng_tpu.control.allocator.bitmap import BitmapExhaustedError
+
+
+class TestBitmap:
+    def test_allocate_release_cycle(self):
+        a = IPAllocator("192.168.1.0/29")  # 8 addrs, net+bcast reserved
+        ips = [str(a.allocate(f"s{i}")) for i in range(6)]
+        assert len(set(ips)) == 6
+        assert "192.168.1.0" not in ips and "192.168.1.7" not in ips
+        with pytest.raises(BitmapExhaustedError):
+            a.allocate("s7")
+        assert a.release("192.168.1.3")
+        assert str(a.allocate("s8")) == "192.168.1.3"
+
+    def test_specific_and_owner(self):
+        a = IPAllocator("10.1.0.0/24")
+        assert a.allocate_specific("10.1.0.50", "alice")
+        assert not a.allocate_specific("10.1.0.50", "bob")
+        assert a.allocate_specific("10.1.0.50", "alice")  # idempotent for owner
+        assert a.owner_of("10.1.0.50") == "alice"
+
+    def test_ipv6_prefix(self):
+        a = IPAllocator("2001:db8::/120")
+        ip = a.allocate("v6sub")
+        assert str(ip).startswith("2001:db8::")
+        assert a.release(ip)
+
+    def test_json_roundtrip(self):
+        a = IPAllocator("10.2.0.0/24")
+        a.allocate("x")
+        a.allocate("y")
+        b = IPAllocator.from_json(a.to_json())
+        assert b.allocated_count == a.allocated_count
+        assert b.owners == a.owners
+
+    def test_out_of_range_rejected(self):
+        a = IPAllocator("10.3.0.0/24")
+        with pytest.raises(ValueError):
+            a.offset_of("10.4.0.1")
+
+
+class TestEpochBitmap:
+    def test_epoch_expiry_o1(self):
+        a = EpochBitmapAllocator("10.5.0.0/28")
+        ip1 = a.allocate("s1")
+        assert a.owner_of(ip1) == "s1"
+        a.advance_epoch()  # s1 now one epoch old - still live
+        assert a.owner_of(ip1) == "s1"
+        a.advance_epoch()  # two epochs -> expired, lazily
+        assert a.owner_of(ip1) is None
+
+    def test_touch_keeps_alive(self):
+        a = EpochBitmapAllocator("10.5.1.0/28")
+        ip = a.allocate("s1")
+        for _ in range(5):
+            a.advance_epoch()
+            assert a.touch(ip), "renewed lease must stay live"
+        assert a.owner_of(ip) == "s1"
+
+    def test_expired_slots_reclaimed(self):
+        a = EpochBitmapAllocator("10.5.2.0/30")  # 4 slots
+        for i in range(4):
+            a.allocate(f"s{i}")
+        with pytest.raises(RuntimeError):
+            a.allocate("overflow")
+        a.advance_epoch()
+        a.advance_epoch()  # all expired
+        ip = a.allocate("fresh")  # lazy reclaim works
+        assert a.owner_of(ip) == "fresh"
+        assert a.live_count() == 1
+
+    def test_snapshot_roundtrip(self):
+        a = EpochBitmapAllocator("10.5.3.0/28")
+        ip = a.allocate("s1")
+        a.advance_epoch()
+        b = EpochBitmapAllocator.from_json(a.to_json())
+        assert b.owner_of(ip) == "s1"
+        assert b.epoch == a.epoch
+
+
+class TestDistributed:
+    def test_same_subscriber_same_ip_across_nodes(self):
+        """Hashring determinism: no coordination needed for agreement."""
+        store = MemoryAllocationStore()
+        n1 = DistributedAllocator("10.6.0.0/24", store, node_id="n1")
+        n2 = DistributedAllocator("10.6.0.0/24", store, node_id="n2")
+        ip1 = n1.allocate("sub-42")
+        ip2 = n2.allocate("sub-42")
+        assert ip1 == ip2
+
+    def test_conflict_probes_forward(self):
+        store = MemoryAllocationStore()
+        a = DistributedAllocator("10.6.1.0/24", store)
+        ip1 = a.allocate("sub-A")
+        # sub-B hashing to the same first candidate must probe onward
+        taken = {ip1}
+        for i in range(50):
+            ip = a.allocate(f"sub-B{i}")
+            assert ip not in taken
+            taken.add(ip)
+
+    def test_expiry_reclaims(self):
+        t = [1000.0]
+        store = MemoryAllocationStore()
+        a = DistributedAllocator("10.6.2.0/29", store, lease_seconds=60,
+                                 clock=lambda: t[0])
+        ips = [a.allocate(f"s{i}") for i in range(6)]
+        assert all(ips)
+        assert a.allocate("s-late") is None  # full
+        t[0] += 3600  # all leases expired
+        assert a.allocate("s-late") is not None
+
+    def test_sync_from_store(self):
+        store = MemoryAllocationStore()
+        a = DistributedAllocator("10.6.3.0/24", store)
+        a.allocate("s1")
+        b = DistributedAllocator("10.6.3.0/24", store, node_id="n2")
+        assert b.sync_from_store() == 1
+
+
+class FlakyPrimary:
+    """Test double: a primary allocator with a controllable health switch
+    (the reference's controllable health-checker pattern, SURVEY.md §4.6)."""
+
+    def __init__(self):
+        self.healthy = True
+        self.inner = LocalAllocator("10.7.0.0/24")
+
+    def allocate(self, sid):
+        if not self.healthy:
+            raise ConnectionError("nexus unreachable")
+        return self.inner.allocate(sid)
+
+    def release(self, sid):
+        if not self.healthy:
+            raise ConnectionError("nexus unreachable")
+        return self.inner.release(sid)
+
+
+class TestHybrid:
+    def test_partition_fallback_and_reconcile(self):
+        primary = FlakyPrimary()
+        h = HybridAllocator(primary, "100.64.0.0/24", failure_threshold=2)
+        ip = h.allocate("s1")
+        assert ip.startswith("10.7.0.")
+        assert not h.is_partition_active()
+
+        primary.healthy = False
+        assert h.allocate("s2") is None  # failure 1
+        ip3 = h.allocate("s3")  # failure 2 -> partition -> fallback
+        assert h.is_partition_active()
+        assert ip3.startswith("100.64.0.")
+        assert len(h.fallback_allocations) == 1
+
+        primary.healthy = True
+        migrated, renumbered = h.reconcile()
+        assert migrated == 1
+        # disjoint fallback range -> the subscriber gets a primary address
+        assert len(renumbered) == 1
+        fb, new_ip = renumbered[0]
+        assert fb.subscriber_id == "s3" and new_ip.startswith("10.7.0.")
+        assert not h.is_partition_active()

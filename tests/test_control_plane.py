@@ -1,0 +1,203 @@
+"""Control-plane unit tests: state store, subscriber lifecycle, nexus."""
+
+import pytest
+
+from bng_tpu.control.nexus import (
+    ErrNoAllocation,
+    HTTPAllocator,
+    IPPoolEntity,
+    MemoryStore,
+    NexusClient,
+    SubscriberEntity,
+    TypedStore,
+    VLANAllocator,
+)
+from bng_tpu.control.state import (
+    LeaseRecord,
+    PoolRecord,
+    SessionRecord,
+    Store,
+    Subscriber,
+)
+from bng_tpu.control.subscriber import SessionKind, SessionState, SubscriberManager
+
+
+class TestStateStore:
+    def test_subscriber_indexes(self):
+        st = Store()
+        st.put_subscriber(Subscriber(id="s1", mac="02:AA:BB:CC:DD:01",
+                                     circuit_id="olt1/1/1", nte_id="nte-1"))
+        assert st.subscriber_by_mac("02:aa:bb:cc:dd:01").id == "s1"
+        assert st.subscriber_by_circuit_id("olt1/1/1").id == "s1"
+        assert [s.id for s in st.subscribers_by_nte("nte-1")] == ["s1"]
+        assert st.delete_subscriber("s1")
+        assert st.subscriber_by_mac("02:aa:bb:cc:dd:01") is None
+
+    def test_pool_matching_specificity(self):
+        st = Store()
+        st.put_pool(PoolRecord(id="any", cidr="10.0.0.0/24"))
+        st.put_pool(PoolRecord(id="biz", cidr="10.1.0.0/24", client_class=2))
+        st.put_pool(PoolRecord(id="biz-ispA", cidr="10.2.0.0/24", client_class=2, isp_id="A"))
+        biz_sub = Subscriber(id="b", client_class=2, isp_id="A")
+        assert st.find_pool_for_subscriber(biz_sub).id == "biz-ispA"
+        res_sub = Subscriber(id="r", client_class=0)
+        assert st.find_pool_for_subscriber(res_sub).id == "any"
+
+    def test_lease_expiry_sweep(self):
+        t = [1000.0]
+        st = Store(clock=lambda: t[0])
+        st.put_lease(LeaseRecord(ip="10.0.0.5", subscriber_id="s1",
+                                 mac="02:aa", expires_at=1100))
+        assert st.cleanup_expired_leases() == 0
+        t[0] = 1200
+        assert st.cleanup_expired_leases() == 1
+        assert st.lease_by_mac("02:aa") is None
+
+    def test_session_idle_sweep(self):
+        t = [1000.0]
+        st = Store(clock=lambda: t[0])
+        st.put_session(SessionRecord(id="x", subscriber_id="s1", last_seen=1000))
+        t[0] = 5000
+        assert st.cleanup_idle_sessions(idle_s=3600) == 1
+
+
+class TestSubscriberManager:
+    def test_full_lifecycle(self):
+        events = []
+        alloc = type("A", (), {
+            "allocate": lambda self, sid: "10.0.0.9",
+            "release": lambda self, sid: True,
+        })()
+        m = SubscriberManager(
+            authenticator=lambda s: {"subscriber_id": "sub-1"},
+            allocator=alloc,
+            event_sink=lambda e: events.append(e.event),
+        )
+        s = m.create_session(SessionKind.IPOE, mac="02:AA:BB:00:00:01")
+        assert m.authenticate(s.id)
+        assert m.assign_address(s.id) == "10.0.0.9"
+        m.activate(s.id)
+        assert m.sessions[s.id].state == SessionState.ACTIVE
+        assert m.by_mac("02:aa:bb:00:00:01").id == s.id
+        assert m.terminate(s.id)
+        assert events == ["created", "authenticated", "address_assigned",
+                          "active", "terminated"]
+
+    def test_auth_failure_goes_walled(self):
+        garden = []
+        wg = type("W", (), {
+            "add": lambda self, s: garden.append(s.id),
+            "remove": lambda self, s: garden.remove(s.id),
+        })()
+        m = SubscriberManager(authenticator=lambda s: None, walled_garden=wg)
+        s = m.create_session(SessionKind.WIFI, mac="02:BB:00:00:00:01")
+        assert not m.authenticate(s.id)
+        assert m.sessions[s.id].state == SessionState.WALLED_GARDEN
+        assert garden == [s.id]
+        m.activate(s.id)  # portal auth succeeded later
+        assert garden == []
+
+    def test_idle_cleanup(self):
+        t = [1000.0]
+        m = SubscriberManager(idle_timeout_s=100, clock=lambda: t[0])
+        s = m.create_session(SessionKind.IPOE, mac="02:CC:00:00:00:01")
+        t[0] = 1050
+        assert m.cleanup_idle() == 0
+        t[0] = 1200
+        assert m.cleanup_idle() == 1
+        assert s.id not in m.sessions
+
+
+class TestNexus:
+    def test_typed_store_and_watch(self):
+        store = MemoryStore()
+        subs = TypedStore(store, "subscribers", SubscriberEntity)
+        changes = []
+        subs.watch(lambda id_, obj: changes.append((id_, obj)))
+        subs.put("s1", SubscriberEntity(id="s1", mac="02:aa"))
+        got = subs.get("s1")
+        assert got.mac == "02:aa"
+        subs.delete("s1")
+        assert changes[0][0] == "s1" and changes[0][1].mac == "02:aa"
+        assert changes[1] == ("s1", None)
+
+    def test_hashring_allocation_deterministic(self):
+        c1 = NexusClient(MemoryStore())
+        c1.pools.put("p1", IPPoolEntity(id="p1", cidr="10.10.0.0/24"))
+        ip_a = c1.allocate_ip("sub-A", "p1")
+        assert ip_a and ip_a.startswith("10.10.0.")
+        # idempotent for the same subscriber
+        assert c1.allocate_ip("sub-A", "p1") == ip_a
+        # a different client over the SAME store agrees without coordination
+        c2 = NexusClient(c1.store, node_id="bng1")
+        c2.pools = c1.pools
+        assert c2.allocate_ip("sub-A", "p1") == ip_a
+        assert c1.release_ip("sub-A", "p1")
+        assert c1.store.get("allocations/p1/by-ip/" + ip_a) is None
+
+    def test_subscriber_lookup_by_mac(self):
+        c = NexusClient()
+        c.subscribers.put("s1", SubscriberEntity(id="s1", mac="02:AA:BB:CC:DD:EE"))
+        assert c.get_subscriber_by_mac("02:aa:bb:cc:dd:ee").id == "s1"
+        assert c.get_subscriber_by_mac("02:00:00:00:00:00") is None
+
+
+class FakeNexusHTTP:
+    """In-memory Nexus REST endpoint (httpmock role)."""
+
+    def __init__(self):
+        self.allocs = {}
+        self.next = 10
+        self.healthy = True
+
+    def __call__(self, method, path, body):
+        if not self.healthy:
+            return 503, {}
+        if path == "/health":
+            return 200, {}
+        if method == "POST" and path == "/api/v1/allocate":
+            sid = body["subscriber_id"]
+            if sid not in self.allocs:
+                self.allocs[sid] = f"100.64.0.{self.next}"
+                self.next += 1
+            return 200, {"ip": self.allocs[sid]}
+        if method == "GET" and path.startswith("/api/v1/allocations/"):
+            sid = path.rsplit("/", 1)[1]
+            return (200, {"ip": self.allocs[sid]}) if sid in self.allocs else (404, {})
+        if method == "DELETE" and path.startswith("/api/v1/allocations/"):
+            sid = path.rsplit("/", 1)[1]
+            return (204, {}) if self.allocs.pop(sid, None) else (404, {})
+        if path == "/api/v1/pools":
+            return 200, {"pools": [{"id": "p1", "used": len(self.allocs)}]}
+        return 404, {}
+
+
+class TestHTTPAllocator:
+    def test_allocate_lookup_release(self):
+        server = FakeNexusHTTP()
+        a = HTTPAllocator("http://nexus", server)
+        ip = a.allocate("sub-1")
+        assert ip == "100.64.0.10"
+        assert a.lookup("sub-1") == ip
+        assert a.release("sub-1")
+        assert a.lookup("sub-1") is None
+        assert a.health_check()
+
+    def test_server_error_raises(self):
+        server = FakeNexusHTTP()
+        server.healthy = False
+        a = HTTPAllocator("http://nexus", server)
+        with pytest.raises(ConnectionError):
+            a.allocate("sub-1")
+        assert not a.health_check()
+
+
+class TestVLANAllocator:
+    def test_allocate_unique_pairs(self):
+        v = VLANAllocator(s_tag_range=(100, 101), c_tag_range=(1, 3))
+        pairs = [v.allocate(f"s{i}") for i in range(6)]
+        assert len(set(pairs)) == 6
+        assert v.allocate("overflow") is None
+        assert v.allocate("s0") == pairs[0]  # sticky
+        assert v.release("s0")
+        assert v.allocate("s-new") is not None

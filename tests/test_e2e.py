@@ -1,0 +1,282 @@
+"""End-to-end slice: engine + fused pipeline + slow-path control plane.
+
+The SURVEY.md §7 milestone: one DORA cycle where DISCOVER #1 misses to the
+slow path and DISCOVER #2 is answered on-device, plus NAT conntrack-hybrid
+(first packet punts, second fast-paths), QoS shaping and antispoof drops —
+all through the public Engine surface.
+"""
+
+import numpy as np
+import pytest
+
+from bng_tpu.control import dhcp_codec, packets
+from bng_tpu.control.dhcp_server import DHCPServer
+from bng_tpu.control.nat import NATManager
+from bng_tpu.control.pool import Pool, PoolManager
+from bng_tpu.ops.antispoof import MODE_STRICT
+from bng_tpu.runtime.engine import AntispoofTables, Engine, QoSTables
+from bng_tpu.runtime.tables import FastPathTables
+from bng_tpu.utils.net import ip_to_u32, u32_to_ip
+
+SERVER_MAC = bytes.fromhex("02aabbccdd01")
+SERVER_IP = ip_to_u32("10.0.0.1")
+T0 = 1_753_000_000
+
+
+class FakeClock:
+    def __init__(self, t=T0):
+        self.t = float(t)
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+@pytest.fixture
+def stack():
+    clock = FakeClock()
+    fastpath = FastPathTables(sub_nbuckets=512, vlan_nbuckets=64, cid_nbuckets=64, max_pools=16)
+    fastpath.set_server_config(SERVER_MAC, SERVER_IP)
+    pools = PoolManager(fastpath)
+    pools.add_pool(Pool(pool_id=1, network=ip_to_u32("10.0.0.0"), prefix_len=24,
+                        gateway=SERVER_IP, dns_primary=ip_to_u32("1.1.1.1"),
+                        lease_time=3600))
+    nat = NATManager(public_ips=[ip_to_u32("203.0.113.1")],
+                     sessions_nbuckets=256, sub_nat_nbuckets=64)
+    qos = QoSTables(nbuckets=256)
+    spoof = AntispoofTables(nbuckets=256)
+    server = DHCPServer(SERVER_MAC, SERVER_IP, pools, fastpath_tables=fastpath,
+                        nat_hook=lambda ip, now: nat.allocate_nat(ip, now), clock=clock)
+    engine = Engine(fastpath, nat, qos, spoof, batch_size=8,
+                    slow_path=server.handle_frame, clock=clock)
+    return engine, server, nat, qos, spoof, clock
+
+
+def client_frame(mac, msg_type, **kw):
+    src_ip = kw.pop("src_ip", 0)
+    pkt = dhcp_codec.build_request(mac, msg_type, **kw)
+    pkt.options.append((dhcp_codec.OPT_PARAM_REQ_LIST, bytes([1, 3, 6, 51, 54])))
+    return packets.udp_packet(mac, b"\xff" * 6, src_ip, 0xFFFFFFFF, 68, 67,
+                              pkt.encode().ljust(320, b"\x00"))
+
+
+def data_frame(src_mac, src_ip, dst_ip, sport, dport, payload=b"data", proto="udp"):
+    if proto == "udp":
+        return packets.udp_packet(src_mac, SERVER_MAC, src_ip, dst_ip, sport, dport, payload)
+    return packets.tcp_packet(src_mac, SERVER_MAC, src_ip, dst_ip, sport, dport, payload)
+
+
+class TestDORA:
+    def test_full_dora_then_fastpath(self, stack):
+        engine, server, nat, qos, spoof, clock = stack
+        mac = bytes.fromhex("02c0ffee0001")
+
+        # DISCOVER #1 -> slow path -> OFFER from server
+        r1 = engine.process([client_frame(mac, dhcp_codec.DISCOVER)])
+        assert r1["tx"] == [] and len(r1["slow"]) == 1
+        lane, offer_frame = r1["slow"][0]
+        assert offer_frame is not None
+        offer = dhcp_codec.decode(packets.decode(offer_frame).payload)
+        assert offer.msg_type == dhcp_codec.OFFER
+        ip = offer.yiaddr
+        assert u32_to_ip(ip).startswith("10.0.0.")
+
+        # REQUEST -> slow path -> ACK + fast-path cache populated
+        r2 = engine.process([client_frame(mac, dhcp_codec.REQUEST, requested_ip=ip,
+                                          server_id=SERVER_IP)])
+        _, ack_frame = r2["slow"][0]
+        ack = dhcp_codec.decode(packets.decode(ack_frame).payload)
+        assert ack.msg_type == dhcp_codec.ACK
+        assert ack.yiaddr == ip
+        assert server.stats.ack == 1
+
+        # DISCOVER #2 -> answered ON DEVICE (the fast-path milestone)
+        r3 = engine.process([client_frame(mac, dhcp_codec.DISCOVER)])
+        assert len(r3["tx"]) == 1
+        _, dev_frame = r3["tx"][0]
+        dev_offer = dhcp_codec.decode(packets.decode(dev_frame).payload)
+        assert dev_offer.msg_type == dhcp_codec.OFFER
+        assert dev_offer.yiaddr == ip
+
+        # renewal REQUEST also on device
+        r4 = engine.process([client_frame(mac, dhcp_codec.REQUEST, requested_ip=ip,
+                                          server_id=SERVER_IP)])
+        assert len(r4["tx"]) == 1
+
+    def test_release_invalidates_fastpath(self, stack):
+        engine, server, nat, qos, spoof, clock = stack
+        mac = bytes.fromhex("02c0ffee0002")
+        engine.process([client_frame(mac, dhcp_codec.DISCOVER)])
+        r = engine.process([client_frame(mac, dhcp_codec.REQUEST,
+                                         requested_ip=0, server_id=SERVER_IP)])
+        ack = dhcp_codec.decode(packets.decode(r["slow"][0][1]).payload)
+        ip = ack.yiaddr
+        # fast path now answers
+        r = engine.process([client_frame(mac, dhcp_codec.DISCOVER)])
+        assert len(r["tx"]) == 1
+        # RELEASE tears down lease + cache
+        engine.process([client_frame(mac, dhcp_codec.RELEASE, ciaddr=ip)])
+        r = engine.process([client_frame(mac, dhcp_codec.DISCOVER)])
+        assert r["tx"] == []  # back to slow path
+        assert server.stats.release == 1
+
+    def test_lease_expiry_goes_slow_path(self, stack):
+        engine, server, nat, qos, spoof, clock = stack
+        mac = bytes.fromhex("02c0ffee0003")
+        engine.process([client_frame(mac, dhcp_codec.DISCOVER)])
+        engine.process([client_frame(mac, dhcp_codec.REQUEST, server_id=SERVER_IP)])
+        r = engine.process([client_frame(mac, dhcp_codec.DISCOVER)])
+        assert len(r["tx"]) == 1
+        clock.advance(4000)  # beyond 3600s lease
+        r = engine.process([client_frame(mac, dhcp_codec.DISCOVER)])
+        assert r["tx"] == []  # expired -> slow path (renews)
+
+
+class TestNATFlow:
+    def test_conntrack_hybrid(self, stack):
+        engine, server, nat, qos, spoof, clock = stack
+        sub_mac = bytes.fromhex("02c0ffee0010")
+        sub_ip = ip_to_u32("10.0.0.55")
+        remote = ip_to_u32("93.184.216.34")
+        nat.allocate_nat(sub_ip, T0)
+
+        f = data_frame(sub_mac, sub_ip, remote, 40000, 443)
+        # packet 1: new flow -> punt, host creates session
+        r1 = engine.process([f])
+        assert r1["fwd"] == [] and len(r1["slow"]) == 1
+        assert nat.sessions.count == 1
+
+        # packet 2: device SNAT
+        r2 = engine.process([f])
+        assert len(r2["fwd"]) == 1
+        _, out = r2["fwd"][0]
+        d = packets.decode(out)
+        assert d.src_ip == ip_to_u32("203.0.113.1")
+        assert 1024 <= d.src_port <= 65535
+        assert d.dst_ip == remote
+        nat_port = d.src_port
+
+        # reply from the internet: device DNAT back to subscriber
+        reply = packets.udp_packet(SERVER_MAC, sub_mac, remote,
+                                   ip_to_u32("203.0.113.1"), 443, nat_port, b"resp")
+        r3 = engine.process([reply], from_access=False)
+        assert len(r3["fwd"]) == 1
+        _, back = r3["fwd"][0]
+        db = packets.decode(back)
+        assert db.dst_ip == sub_ip
+        assert db.dst_port == 40000
+        assert db.src_ip == remote
+
+    def test_no_allocation_passes_unnatted(self, stack):
+        engine, server, nat, qos, spoof, clock = stack
+        f = data_frame(b"\x02" * 6, ip_to_u32("10.0.0.99"), ip_to_u32("8.8.8.8"), 1234, 53)
+        r = engine.process([f])
+        assert r["fwd"] == [] and len(r["slow"]) == 1
+        assert nat.sessions.count == 0  # no port block -> no session
+
+    def test_eim_stable_mapping(self, stack):
+        """RFC 4787: same internal ip:port -> same external mapping."""
+        engine, server, nat, qos, spoof, clock = stack
+        sub_ip = ip_to_u32("10.0.0.56")
+        nat.allocate_nat(sub_ip, T0)
+        mac = bytes.fromhex("02c0ffee0011")
+        ports = set()
+        for dst in ("1.1.1.1", "2.2.2.2", "3.3.3.3"):
+            f = data_frame(mac, sub_ip, ip_to_u32(dst), 50000, 443)
+            engine.process([f])  # punt -> create
+            r = engine.process([f])  # fast path
+            d = packets.decode(r["fwd"][0][1])
+            ports.add((d.src_ip, d.src_port))
+        assert len(ports) == 1  # endpoint-independent
+
+
+class TestQoS:
+    def test_rate_limit_drops(self, stack):
+        engine, server, nat, qos, spoof, clock = stack
+        sub_ip = ip_to_u32("10.0.0.60")
+        # 8 kbps => 1000 bytes/s; burst 1500
+        qos.set_subscriber(sub_ip, down_bps=8000, up_bps=8000, up_burst=1500, down_burst=1500)
+        mac = bytes.fromhex("02c0ffee0020")
+        big = data_frame(mac, sub_ip, ip_to_u32("8.8.8.8"), 1111, 9999, b"x" * 400)
+        frames = [big] * 8
+        r = engine.process(frames)
+        # 1500-byte bucket / ~442-byte frames -> 3 pass, rest dropped
+        assert len(r["dropped"]) >= 4
+        assert engine.stats.qos[1] >= 4  # QST_PKTS_DROPPED
+
+    def test_refill_after_time(self, stack):
+        engine, server, nat, qos, spoof, clock = stack
+        sub_ip = ip_to_u32("10.0.0.61")
+        qos.set_subscriber(sub_ip, down_bps=80000, up_bps=80000, up_burst=1000, down_burst=1000)
+        mac = bytes.fromhex("02c0ffee0021")
+        f = data_frame(mac, sub_ip, ip_to_u32("8.8.8.8"), 1111, 9999, b"x" * 800)
+        r = engine.process([f])
+        assert r["dropped"] == []
+        r = engine.process([f])  # bucket nearly empty
+        assert len(r["dropped"]) == 1
+        clock.advance(1.0)  # 10kB/s refill
+        r = engine.process([f])
+        assert r["dropped"] == []
+
+    def test_unlimited_rate_passes(self, stack):
+        engine, server, nat, qos, spoof, clock = stack
+        sub_ip = ip_to_u32("10.0.0.62")
+        qos.set_subscriber(sub_ip, down_bps=0, up_bps=0)
+        mac = bytes.fromhex("02c0ffee0022")
+        f = data_frame(mac, sub_ip, ip_to_u32("8.8.8.8"), 1111, 9999, b"x" * 1000)
+        for _ in range(3):
+            r = engine.process([f])
+            assert r["dropped"] == []
+
+
+class TestAntispoof:
+    def test_strict_mode_drops_spoofed(self, stack):
+        engine, server, nat, qos, spoof, clock = stack
+        mac = bytes.fromhex("02c0ffee0030")
+        good_ip = ip_to_u32("10.0.0.70")
+        spoof.add_binding(mac, good_ip, MODE_STRICT)
+        violations = []
+        engine.violation_sink = lambda lane, frame: violations.append(lane)
+
+        ok = data_frame(mac, good_ip, ip_to_u32("8.8.8.8"), 1000, 53)
+        bad = data_frame(mac, ip_to_u32("10.0.0.71"), ip_to_u32("8.8.8.8"), 1000, 53)
+        engine.antispoof.set_config(0, log_violations=True)
+        r = engine.process([ok, bad])
+        assert r["dropped"] == [1]
+        assert violations == [1]
+
+    def test_dhcp_exempt_from_antispoof(self, stack):
+        """DISCOVER src 0.0.0.0 must reach the slow path despite strict mode."""
+        engine, server, nat, qos, spoof, clock = stack
+        mac = bytes.fromhex("02c0ffee0031")
+        spoof.add_binding(mac, ip_to_u32("10.0.0.72"), MODE_STRICT)
+        r = engine.process([client_frame(mac, dhcp_codec.DISCOVER)])
+        assert r["dropped"] == []
+        assert r["slow"][0][1] is not None  # got an OFFER
+
+
+class TestStatsAndExpiry:
+    def test_session_counters_and_expiry(self, stack):
+        engine, server, nat, qos, spoof, clock = stack
+        sub_ip = ip_to_u32("10.0.0.80")
+        nat.allocate_nat(sub_ip, T0)
+        mac = bytes.fromhex("02c0ffee0040")
+        f = data_frame(mac, sub_ip, ip_to_u32("9.9.9.9"), 1234, 443)
+        engine.process([f])  # create
+        for _ in range(3):
+            engine.process([f])  # 3 fast-path packets
+        vals = engine.fetch_session_vals()
+        from bng_tpu.ops.nat44 import SV_PKTS_OUT
+
+        slots = np.nonzero(np.asarray(nat.sessions.used))[0]
+        assert len(slots) == 1
+        # 1 seeded by the host on create (nat44.c:722 parity) + 3 on device
+        assert vals[slots[0], SV_PKTS_OUT] == 4
+
+        # idle expiry (UDP timeout 120s)
+        clock.advance(200)
+        n = engine.expire()
+        assert n == 1
+        assert nat.sessions.count == 0 and nat.reverse.count == 0

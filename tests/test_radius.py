@@ -1,0 +1,285 @@
+"""RADIUS subsystem tests: codec crypto, client failover, accounting
+spool/recovery, CoA processing (pkg/radius parity)."""
+
+import hashlib
+import struct
+
+import pytest
+
+from bng_tpu.control.radius import packet as rp
+from bng_tpu.control.radius.accounting import AccountingManager
+from bng_tpu.control.radius.client import AuthResult, RadiusClient, RadiusServerConfig
+from bng_tpu.control.radius.coa import CoAProcessor, CoAServer
+from bng_tpu.control.radius.packet import (
+    RadiusPacket,
+    decrypt_password,
+    encrypt_password,
+    new_request_authenticator,
+)
+from bng_tpu.control.radius.policy import DEFAULT_POLICIES, PolicyManager
+
+SECRET = b"s3cr3t"
+
+
+class FakeRadiusServer:
+    """Wire-accurate in-memory RADIUS server (the httpmock role)."""
+
+    def __init__(self, secret=SECRET, users=None, drop_first=0):
+        self.secret = secret
+        self.users = users or {}
+        self.drop_first = drop_first
+        self.requests = []
+
+    def __call__(self, data, host, port, timeout):
+        if self.drop_first > 0:
+            self.drop_first -= 1
+            return None
+        req = RadiusPacket.decode(data)
+        self.requests.append((host, port, req))
+        if req.code == rp.ACCESS_REQUEST:
+            user = req.get_str(rp.USER_NAME)
+            pw = decrypt_password(req.get(rp.USER_PASSWORD), self.secret,
+                                  req.authenticator).decode()
+            entry = self.users.get(user)
+            if entry and entry["password"] == pw:
+                resp = RadiusPacket(rp.ACCESS_ACCEPT, req.id)
+                for t, v in entry.get("attrs", []):
+                    resp.add(t, v)
+            else:
+                resp = RadiusPacket(rp.ACCESS_REJECT, req.id)
+                resp.add(rp.REPLY_MESSAGE, "bad credentials")
+        elif req.code == rp.ACCOUNTING_REQUEST:
+            resp = RadiusPacket(rp.ACCOUNTING_RESPONSE, req.id)
+        else:
+            return None
+        return resp.encode(self.secret, request_auth=req.authenticator)
+
+
+def make_client(server, **kw):
+    return RadiusClient(
+        [RadiusServerConfig("10.0.0.5", secret=SECRET, timeout_s=0.1, retries=2)],
+        transport=server, **kw,
+    )
+
+
+class TestCodec:
+    def test_password_roundtrip(self):
+        auth = new_request_authenticator()
+        for pw in (b"short", b"exactly16bytes!!", b"a much longer password than one block"):
+            enc = encrypt_password(pw, SECRET, auth)
+            assert len(enc) % 16 == 0
+            assert decrypt_password(enc, SECRET, auth) == pw
+
+    def test_packet_roundtrip(self):
+        p = RadiusPacket(rp.ACCESS_REQUEST, 42, new_request_authenticator())
+        p.add(rp.USER_NAME, "alice")
+        p.add(rp.NAS_PORT, 7)
+        raw = p.encode(SECRET)
+        q = RadiusPacket.decode(raw)
+        assert q.code == rp.ACCESS_REQUEST and q.id == 42
+        assert q.get_str(rp.USER_NAME) == "alice"
+        assert q.get_int(rp.NAS_PORT) == 7
+
+    def test_accounting_request_authenticator(self):
+        p = RadiusPacket(rp.ACCOUNTING_REQUEST, 9)
+        p.add(rp.ACCT_SESSION_ID, "sess-1")
+        raw = p.encode(SECRET)
+        q = RadiusPacket.decode(raw)
+        assert q.verify_request(SECRET, raw)
+        # tampered packet fails
+        bad = bytearray(raw)
+        bad[-1] ^= 0xFF
+        q2 = RadiusPacket.decode(bytes(bad))
+        assert not q2.verify_request(SECRET, bytes(bad))
+
+    def test_message_authenticator_present(self):
+        p = RadiusPacket(rp.ACCESS_REQUEST, 1, new_request_authenticator())
+        p.add(rp.USER_NAME, "bob")
+        raw = p.encode(SECRET, sign_message_authenticator=True)
+        q = RadiusPacket.decode(raw)
+        ma = q.get(rp.MESSAGE_AUTHENTICATOR)
+        assert ma is not None and len(ma) == 16 and ma != b"\x00" * 16
+
+
+class TestClient:
+    def test_authenticate_accept_with_attributes(self):
+        server = FakeRadiusServer(users={"alice": {
+            "password": "pw123",
+            "attrs": [(rp.FRAMED_IP_ADDRESS, 0x0A000042),
+                      (rp.SESSION_TIMEOUT, 3600),
+                      (rp.FILTER_ID, "residential-100mbps")],
+        }})
+        c = make_client(server)
+        r = c.authenticate("alice", "pw123", mac=bytes.fromhex("02deadbeef01"))
+        assert r is not None and r.success
+        assert r.framed_ip == 0x0A000042
+        assert r.session_timeout == 3600
+        assert r.policy_name == "residential-100mbps"
+        assert c.stats["auth_ok"] == 1
+        # calling-station-id formatting
+        _, _, req = server.requests[0]
+        assert req.get_str(rp.CALLING_STATION_ID) == "02-DE-AD-BE-EF-01"
+
+    def test_reject(self):
+        server = FakeRadiusServer(users={"alice": {"password": "right"}})
+        c = make_client(server)
+        r = c.authenticate("alice", "wrong")
+        assert r is not None and not r.success
+        assert c.stats["auth_reject"] == 1
+
+    def test_timeout_returns_none(self):
+        c = make_client(lambda *a: None)
+        assert c.authenticate("alice", "pw") is None
+        assert c.stats["auth_timeout"] == 1
+
+    def test_retry_then_success(self):
+        server = FakeRadiusServer(users={"a": {"password": "p"}}, drop_first=1)
+        c = make_client(server)
+        r = c.authenticate("a", "p")
+        assert r is not None and r.success
+
+    def test_failover_to_second_server(self):
+        calls = []
+
+        def transport(data, host, port, timeout):
+            calls.append(host)
+            if host == "10.0.0.5":
+                return None  # primary dead
+            return FakeRadiusServer(users={"a": {"password": "p"}})(data, host, port, timeout)
+
+        c = RadiusClient([
+            RadiusServerConfig("10.0.0.5", secret=SECRET, timeout_s=0.01, retries=2),
+            RadiusServerConfig("10.0.0.6", secret=SECRET, timeout_s=0.01, retries=2),
+        ], transport=transport)
+        r = c.authenticate("a", "p")
+        assert r is not None and r.success
+        assert c.stats["failovers"] == 1
+        assert "10.0.0.6" in calls
+
+    def test_accounting_start_stop(self):
+        server = FakeRadiusServer()
+        c = make_client(server)
+        assert c.send_accounting("sess-1", rp.ACCT_START, username="a", framed_ip=1)
+        assert c.send_accounting("sess-1", rp.ACCT_STOP, session_time=10,
+                                 input_octets=1000, output_octets=2000,
+                                 terminate_cause=rp.TERM_USER_REQUEST)
+        acct = [r for _, _, r in server.requests if r.code == rp.ACCOUNTING_REQUEST]
+        assert len(acct) == 2
+        assert acct[0].get_int(rp.ACCT_STATUS_TYPE) == rp.ACCT_START
+        assert acct[1].get_int(rp.ACCT_SESSION_TIME) == 10
+
+
+class TestAccountingManager:
+    def test_interim_and_stop(self):
+        t = [1000.0]
+        server = FakeRadiusServer()
+        c = make_client(server, clock=lambda: t[0])
+        m = AccountingManager(c, interim_interval_s=300, clock=lambda: t[0])
+        m.start("s1", "alice", 0x0A000001)
+        assert m.interim_tick() == 0  # not due yet
+        t[0] += 301
+        m.update_counters("s1", 111, 222)
+        assert m.interim_tick() == 1
+        t[0] += 100
+        assert m.stop("s1")
+        types = [r.get_int(rp.ACCT_STATUS_TYPE) for _, _, r in server.requests]
+        assert types == [rp.ACCT_START, rp.ACCT_INTERIM, rp.ACCT_STOP]
+
+    def test_offline_queue_and_retry(self):
+        server_up = [False]
+        real = FakeRadiusServer()
+
+        def transport(*a):
+            return real(*a) if server_up[0] else None
+
+        c = RadiusClient([RadiusServerConfig("h", secret=SECRET, timeout_s=0.01, retries=1)],
+                         transport=transport)
+        m = AccountingManager(c)
+        m.start("s1", "a", 1)
+        m.stop("s1")
+        assert len(m.pending) == 2  # start + stop both queued
+        server_up[0] = True
+        assert m.retry_tick() == 2
+        assert m.pending == []
+
+    def test_orphan_recovery_from_spool(self, tmp_path):
+        spool = str(tmp_path / "acct.json")
+        server = FakeRadiusServer()
+        c = make_client(server)
+        m = AccountingManager(c, spool_path=spool)
+        m.start("s1", "alice", 5)
+        # simulate crash: new manager over same spool
+        m2 = AccountingManager(make_client(server), spool_path=spool)
+        stops = [p for p in m2.pending if p.status == rp.ACCT_STOP]
+        assert len(stops) == 1
+        assert stops[0].payload["terminate_cause"] == rp.TERM_LOST_CARRIER
+        assert m2.retry_tick() == 1
+
+
+class TestCoA:
+    def _processor(self):
+        sessions = {"sess-1": type("S", (), {"ip": 0x0A000001, "mac": "02-AA"})()}
+        applied = []
+        disconnected = []
+        proc = CoAProcessor(
+            find_by_session_id=sessions.get,
+            find_by_ip=lambda ip: next((s for s in sessions.values() if s.ip == ip), None),
+            qos_update=lambda ip, pol: applied.append((ip, pol)) or True,
+            disconnect=lambda s: disconnected.append(s) or True,
+            policy_manager=PolicyManager(),
+        )
+        return proc, applied, disconnected
+
+    def test_coa_policy_change(self):
+        proc, applied, _ = self._processor()
+        srv = CoAServer(SECRET, proc)
+        req = RadiusPacket(rp.COA_REQUEST, 5)
+        req.add(rp.ACCT_SESSION_ID, "sess-1")
+        req.add(rp.FILTER_ID, "business-100mbps")
+        raw = req.encode(SECRET)
+        resp_raw = srv.handle_raw(raw)
+        resp = RadiusPacket.decode(resp_raw)
+        assert resp.code == rp.COA_ACK
+        assert applied == [(0x0A000001, "business-100mbps")]
+
+    def test_coa_unknown_policy_naks(self):
+        proc, applied, _ = self._processor()
+        srv = CoAServer(SECRET, proc)
+        req = RadiusPacket(rp.COA_REQUEST, 6)
+        req.add(rp.ACCT_SESSION_ID, "sess-1")
+        req.add(rp.FILTER_ID, "no-such-policy")
+        resp = RadiusPacket.decode(srv.handle_raw(req.encode(SECRET)))
+        assert resp.code == rp.COA_NAK
+        assert applied == []
+
+    def test_disconnect(self):
+        proc, _, disconnected = self._processor()
+        srv = CoAServer(SECRET, proc)
+        req = RadiusPacket(rp.DISCONNECT_REQUEST, 7)
+        req.add(rp.ACCT_SESSION_ID, "sess-1")
+        resp = RadiusPacket.decode(srv.handle_raw(req.encode(SECRET)))
+        assert resp.code == rp.DISCONNECT_ACK
+        assert len(disconnected) == 1
+
+    def test_bad_authenticator_dropped(self):
+        proc, _, _ = self._processor()
+        srv = CoAServer(SECRET, proc)
+        req = RadiusPacket(rp.COA_REQUEST, 8)
+        req.add(rp.ACCT_SESSION_ID, "sess-1")
+        raw = bytearray(req.encode(b"wrong-secret"))
+        assert srv.handle_raw(bytes(raw)) is None
+        assert srv.stats["bad_auth"] == 1
+
+
+class TestPolicies:
+    def test_defaults_present(self):
+        pm = PolicyManager()
+        p = pm.get("residential-100mbps")
+        assert p and p.download_bps == 100_000_000 and p.upload_bps == 20_000_000
+
+    def test_radius_attr_resolution(self):
+        pm = PolicyManager()
+        assert pm.from_radius_attributes(filter_id="business-1gbps").priority == 2
+        adhoc = pm.from_radius_attributes(vendor_rate_down=5_000_000, vendor_rate_up=1_000_000)
+        assert adhoc.download_bps == 5_000_000
+        assert pm.from_radius_attributes(filter_id="nope") is None
