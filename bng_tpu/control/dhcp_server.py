@@ -240,14 +240,23 @@ class DHCPServer:
         if self.lease_time_cap:
             lease_time = min(lease_time, self.lease_time_cap)
         cid, rid = req.option82()
-        self._session_seq += 1
-        lease = Lease(
-            mac=mac, ip=ip, pool_id=pool_id, expiry=now + lease_time,
-            circuit_id=cid, remote_id=rid,
-            s_tag=profile.get("s_tag", 0), c_tag=profile.get("c_tag", 0),
-            session_id=f"bng-{now:x}-{self._session_seq:06x}",
-            username=profile.get("username", ""),
-        )
+        existing = self.leases.get(mk)
+        is_renewal = existing is not None and existing.ip == ip
+        if is_renewal:
+            # RFC 2131 renewal: extend the session, don't create a new one
+            # (a fresh session per REQUEST would leak accounting sessions)
+            lease = existing
+            lease.expiry = now + lease_time
+            lease.circuit_id, lease.remote_id = cid, rid
+        else:
+            self._session_seq += 1
+            lease = Lease(
+                mac=mac, ip=ip, pool_id=pool_id, expiry=now + lease_time,
+                circuit_id=cid, remote_id=rid,
+                s_tag=profile.get("s_tag", 0), c_tag=profile.get("c_tag", 0),
+                session_id=f"bng-{now:x}-{self._session_seq:06x}",
+                username=profile.get("username", ""),
+            )
         self.leases[mk] = lease
         if cid:
             self.leases_by_cid[cid] = mk
@@ -256,13 +265,14 @@ class DHCPServer:
         # fast-path cache population (server.go:708, 1057-1097)
         self._update_fastpath(lease, pool)
 
-        # QoS + NAT wiring (server.go:774-814)
-        if self.qos_hook is not None:
-            self.qos_hook(ip, profile.get("qos_policy", ""))
-        if self.nat_hook is not None:
-            self.nat_hook(ip, now)
-        if self.accounting_hook is not None:
-            self.accounting_hook("start", lease, lease.session_id)
+        # QoS + NAT wiring (server.go:774-814) — new sessions only
+        if not is_renewal:
+            if self.qos_hook is not None:
+                self.qos_hook(ip, profile.get("qos_policy", ""))
+            if self.nat_hook is not None:
+                self.nat_hook(ip, now)
+            if self.accounting_hook is not None:
+                self.accounting_hook("start", lease, lease.session_id)
 
         self.stats.ack += 1
         return self._build_reply(req, ACK, ip, pool, lease_time=lease_time)
@@ -353,8 +363,14 @@ class DHCPServer:
                 self.tables.remove_subscriber(lease.mac)
                 if lease.circuit_id:
                     self.tables.remove_circuit_id_subscriber(lease.circuit_id)
+                if lease.s_tag or lease.c_tag:
+                    self.tables.remove_vlan_subscriber(lease.s_tag, lease.c_tag)
+            if self.allocator is not None:
+                self.allocator.release(lease.mac.hex())
             if self.release_hook is not None:
                 self.release_hook(lease)
+            if self.accounting_hook is not None:
+                self.accounting_hook("stop", lease, lease.session_id)
             self.stats.expired_cleaned += 1
         return len(dead)
 

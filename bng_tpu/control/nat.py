@@ -128,8 +128,10 @@ class NATManager:
             reverse=TableGeom(sessions_nbuckets, stash),
             sub_nat=TableGeom(sub_nat_nbuckets, stash),
         )
-        # block carving state: per public IP, next block start
+        # block carving state: per public IP, next block start + free list of
+        # released block starts (blocks are uniform size, so reuse is exact)
         self._next_block: dict[int, int] = {ip: port_range[0] for ip in self.public_ips}
+        self._free_blocks: dict[int, list[int]] = {ip: [] for ip in self.public_ips}
         self._ip_round_robin = 0
         # EIM host authority: (int_ip, int_port, proto) -> [ext_ip, ext_port, refcount]
         self.eim: dict[tuple[int, int, int], list[int]] = {}
@@ -155,31 +157,35 @@ class NATManager:
         n = self.ports_per_subscriber
         for _ in range(len(self.public_ips)):
             pub_ip = self.public_ips[self._ip_round_robin % len(self.public_ips)]
-            start = self._next_block[pub_ip]
-            if start + n - 1 <= self.port_range[1]:
+            if self._free_blocks[pub_ip]:
+                start = self._free_blocks[pub_ip].pop()
+            else:
+                start = self._next_block[pub_ip]
+                if start + n - 1 > self.port_range[1]:
+                    self._ip_round_robin += 1
+                    continue
                 self._next_block[pub_ip] = start + n
-                sub_id = self._sub_id_seq
-                self._sub_id_seq += 1
-                block = {
-                    "public_ip": pub_ip,
-                    "port_start": start,
-                    "port_end": start + n - 1,
-                    "next_port": start,
-                    "subscriber_id": sub_id,
-                    "private_ip": private_ip,
-                }
-                self.blocks[private_ip] = block
-                row = np.zeros((SUBNAT_WORDS,), dtype=np.uint32)
-                row[BV_PUBLIC_IP] = pub_ip
-                row[BV_PORT_START] = start
-                row[BV_PORT_END] = start + n - 1
-                row[BV_NEXT_PORT] = start
-                row[BV_SUB_ID] = sub_id
-                self.sub_nat.insert([private_ip], row)
-                self._log(LOG_PORT_BLOCK_ASSIGN, sub_id, private_ip, pub_ip,
-                          0, start, 0, start + n - 1, 0, now)
-                return block
-            self._ip_round_robin += 1
+            sub_id = self._sub_id_seq
+            self._sub_id_seq += 1
+            block = {
+                "public_ip": pub_ip,
+                "port_start": start,
+                "port_end": start + n - 1,
+                "next_port": start,
+                "subscriber_id": sub_id,
+                "private_ip": private_ip,
+            }
+            self.blocks[private_ip] = block
+            row = np.zeros((SUBNAT_WORDS,), dtype=np.uint32)
+            row[BV_PUBLIC_IP] = pub_ip
+            row[BV_PORT_START] = start
+            row[BV_PORT_END] = start + n - 1
+            row[BV_NEXT_PORT] = start
+            row[BV_SUB_ID] = sub_id
+            self.sub_nat.insert([private_ip], row)
+            self._log(LOG_PORT_BLOCK_ASSIGN, sub_id, private_ip, pub_ip,
+                      0, start, 0, start + n - 1, 0, now)
+            return block
         return None  # pool exhausted
 
     def release_nat(self, private_ip: int, now: int = 0) -> bool:
@@ -191,6 +197,9 @@ class NATManager:
         for key in [k for k in self.eim if k[0] == private_ip]:
             ext_ip, ext_port, _ = self.eim.pop(key)
             self._ext_ports.pop((ext_ip, ext_port, key[2]), None)
+        # return the port block for reuse (RFC 6431 block recycling)
+        self._free_blocks.setdefault(block["public_ip"], []).append(
+            block["port_start"])
         self._log(LOG_PORT_BLOCK_RELEASE, block["subscriber_id"], private_ip,
                   block["public_ip"], 0, block["port_start"], 0, block["port_end"], 0, now)
         return True

@@ -92,9 +92,21 @@ class RadiusClient:
         self._last_req = now
         return True
 
-    def _exchange(self, pkt: RadiusPacket, port_of, secret_needed: bool = True) -> tuple[RadiusPacket, RadiusServerConfig] | None:
-        """Send with per-server retry then failover (client.go:157-248)."""
+    def _exchange(self, pkt: RadiusPacket, port_of,
+                  password: bytes | None = None) -> tuple[RadiusPacket, RadiusServerConfig] | None:
+        """Send with per-server retry then failover (client.go:157-248).
+
+        `password` is the plaintext PAP password: User-Password ciphering
+        is per-secret (RFC 2865 §5.2), so it must be re-encrypted for
+        each failover server rather than reusing servers[0]'s cipher.
+        """
         for si, srv in enumerate(self.servers):
+            if password is not None:
+                pkt.attributes = [(t, v) for (t, v) in pkt.attributes
+                                  if t != rp.USER_PASSWORD]
+                pkt.add(rp.USER_PASSWORD,
+                        rp.encrypt_password(password, srv.secret,
+                                            pkt.authenticator))
             raw = pkt.encode(srv.secret, sign_message_authenticator=(pkt.code == rp.ACCESS_REQUEST))
             for _ in range(srv.retries):
                 resp_raw = self.transport(raw, srv.host, port_of(srv), srv.timeout_s)
@@ -124,9 +136,6 @@ class RadiusClient:
         pkt = RadiusPacket(rp.ACCESS_REQUEST, self._next_id(),
                            rp.new_request_authenticator())
         pkt.add(rp.USER_NAME, username)
-        srv0 = self.servers[0]
-        pkt.add(rp.USER_PASSWORD, rp.encrypt_password(password.encode(), srv0.secret,
-                                                      pkt.authenticator))
         pkt.add(rp.NAS_IDENTIFIER, self.nas_identifier)
         if self.nas_ip:
             pkt.add(rp.NAS_IP_ADDRESS, self.nas_ip)
@@ -137,7 +146,8 @@ class RadiusClient:
         if circuit_id:
             pkt.add(rp.CALLED_STATION_ID, circuit_id)
 
-        got = self._exchange(pkt, lambda s: s.auth_port)
+        got = self._exchange(pkt, lambda s: s.auth_port,
+                             password=password.encode())
         if got is None:
             self.stats["auth_timeout"] += 1
             return None

@@ -83,7 +83,7 @@ class Store:
     def put_subscriber(self, s: Subscriber) -> None:
         old = self.subscribers.get(s.id)
         if old:
-            self._sub_by_mac.pop(old.mac, None)
+            self._sub_by_mac.pop(old.mac.lower(), None)
             self._sub_by_cid.pop(old.circuit_id, None)
             if old.nte_id:
                 self._sub_by_nte.get(old.nte_id, set()).discard(s.id)

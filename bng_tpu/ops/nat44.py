@@ -84,9 +84,16 @@ class NATResult(NamedTuple):
     punted: jax.Array  # [B] bool — new flow / ALG: needs slow path
     dropped: jax.Array  # [B] bool
     out_pkt: jax.Array  # [B, L] uint8 rewritten packets
-    sessions: TableState  # updated (counters/state) session table
     stats: jax.Array  # [NAT_NSTATS] uint32
     is_hairpin: jax.Array  # [B] bool
+    # per-lane hit info for the deferred accounting pass
+    # (update_sessions applies counters only for lanes the pipeline
+    # actually forwards, so QoS/antispoof drops are never billed)
+    egress_hit: jax.Array  # [B] bool
+    ingress_hit: jax.Array  # [B] bool
+    e_slot: jax.Array  # [B] int32 session row for egress hits
+    i_slot: jax.Array  # [B] int32 session row for ingress hits
+    i_state: jax.Array  # [B] uint32 current TCP state (ingress rows)
 
 
 def is_private_ip(ip):
@@ -225,42 +232,7 @@ def nat44_kernel(
     stats = stats.at[NST_EXPIRED].add(count(ingress_orphan))
     stats = stats.at[NST_DNAT].add(count(ingress_hit))
 
-    # ---- session table updates (counters, last_seen, TCP state) ----
     hit_any = egress_hit | ingress_hit
-    slot = jnp.where(egress_hit, esess.slot, isess.slot)
-    # out-of-bounds slot for non-hit lanes -> dropped by scatter
-    S = tables.sessions.vals.shape[0]
-    upd_slot = jnp.where(hit_any, slot, S).astype(jnp.int32)
-    plen = length.astype(jnp.uint32)
-    vals = tables.sessions.vals
-    zeros = jnp.zeros((Bsz,), dtype=jnp.uint32)
-    ones = jnp.ones((Bsz,), dtype=jnp.uint32)
-    add_block = jnp.stack(
-        [
-            jnp.where(egress_hit, ones, zeros),  # SV_PKTS_OUT
-            jnp.where(ingress_hit, ones, zeros),  # SV_PKTS_IN
-            jnp.where(egress_hit, plen, zeros),  # SV_BYTES_OUT
-            jnp.where(ingress_hit, plen, zeros),  # SV_BYTES_IN
-        ],
-        axis=1,
-    )
-    vals = vals.at[upd_slot, SV_PKTS_OUT : SV_BYTES_IN + 1].add(add_block, mode="drop")
-    vals = vals.at[upd_slot, SV_LAST_SEEN].set(jnp.broadcast_to(now_s, (Bsz,)).astype(jnp.uint32), mode="drop")
-
-    # TCP state machine on ingress (nat44.c:885-895). Scatter-max keeps
-    # duplicate-slot batches deterministic: states are ordered
-    # NEW < ESTABLISHED < FIN_WAIT < CLOSING, so a FIN/RST lane always
-    # wins over a same-batch ACK lane regardless of scatter order.
-    fin_or_rst = (parsed.tcp_flags & 0x05) != 0  # FIN|RST
-    ack = (parsed.tcp_flags & 0x10) != 0
-    cur_state = isess.vals[:, SV_STATE]
-    new_state = jnp.where(
-        fin_or_rst, NAT_STATE_CLOSING,
-        jnp.where((cur_state == NAT_STATE_NEW) & ack, NAT_STATE_ESTABLISHED, cur_state),
-    ).astype(jnp.uint32)
-    state_slot = jnp.where(ingress_hit & parsed.is_tcp, isess.slot, S).astype(jnp.int32)
-    vals = vals.at[state_slot, SV_STATE].max(new_state, mode="drop")
-    new_sessions = tables.sessions._replace(vals=vals)
 
     # ---- packet rewrite ----
     nat_ip = esess.vals[:, SV_NAT_IP]
@@ -276,7 +248,67 @@ def nat44_kernel(
         punted=punted,
         dropped=jnp.zeros((Bsz,), dtype=bool),
         out_pkt=pkt,
-        sessions=new_sessions,
         stats=stats,
         is_hairpin=is_hairpin,
+        egress_hit=egress_hit,
+        ingress_hit=ingress_hit,
+        e_slot=esess.slot.astype(jnp.int32),
+        i_slot=isess.slot.astype(jnp.int32),
+        i_state=isess.vals[:, SV_STATE],
     )
+
+
+def nat44_update_sessions(
+    sessions: TableState,
+    res: NATResult,
+    parsed: Parsed,
+    length: jax.Array,
+    keep: jax.Array,
+    now_s: jax.Array,
+) -> TableState:
+    """Apply session counters/last_seen/TCP-state for forwarded lanes only.
+
+    `keep` is the pipeline's final forward decision: packets dropped by
+    QoS/antispoof after translation must not be billed to the subscriber
+    (the kernel hooks get this for free from hook ordering; here the
+    accounting pass is explicitly gated).
+    """
+    Bsz = length.shape[0]
+    egress_hit = res.egress_hit & keep
+    ingress_hit = res.ingress_hit & keep
+    hit_any = egress_hit | ingress_hit
+    slot = jnp.where(egress_hit, res.e_slot, res.i_slot)
+    # out-of-bounds slot for non-hit lanes -> dropped by scatter
+    S = sessions.vals.shape[0]
+    upd_slot = jnp.where(hit_any, slot, S).astype(jnp.int32)
+    plen = length.astype(jnp.uint32)
+    vals = sessions.vals
+    zeros = jnp.zeros((Bsz,), dtype=jnp.uint32)
+    ones = jnp.ones((Bsz,), dtype=jnp.uint32)
+    add_block = jnp.stack(
+        [
+            jnp.where(egress_hit, ones, zeros),  # SV_PKTS_OUT
+            jnp.where(ingress_hit, ones, zeros),  # SV_PKTS_IN
+            jnp.where(egress_hit, plen, zeros),  # SV_BYTES_OUT
+            jnp.where(ingress_hit, plen, zeros),  # SV_BYTES_IN
+        ],
+        axis=1,
+    )
+    vals = vals.at[upd_slot, SV_PKTS_OUT : SV_BYTES_IN + 1].add(add_block, mode="drop")
+    vals = vals.at[upd_slot, SV_LAST_SEEN].set(
+        jnp.broadcast_to(now_s, (Bsz,)).astype(jnp.uint32), mode="drop")
+
+    # TCP state machine on ingress (nat44.c:885-895). Scatter-max keeps
+    # duplicate-slot batches deterministic: states are ordered
+    # NEW < ESTABLISHED < FIN_WAIT < CLOSING, so a FIN/RST lane always
+    # wins over a same-batch ACK lane regardless of scatter order.
+    fin_or_rst = (parsed.tcp_flags & 0x05) != 0  # FIN|RST
+    ack = (parsed.tcp_flags & 0x10) != 0
+    cur_state = res.i_state
+    new_state = jnp.where(
+        fin_or_rst, NAT_STATE_CLOSING,
+        jnp.where((cur_state == NAT_STATE_NEW) & ack, NAT_STATE_ESTABLISHED, cur_state),
+    ).astype(jnp.uint32)
+    state_slot = jnp.where(ingress_hit & parsed.is_tcp, res.i_slot, S).astype(jnp.int32)
+    vals = vals.at[state_slot, SV_STATE].max(new_state, mode="drop")
+    return sessions._replace(vals=vals)

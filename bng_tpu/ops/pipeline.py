@@ -37,7 +37,13 @@ from bng_tpu.ops.antispoof import (
 )
 from bng_tpu.ops import bytes as B_
 from bng_tpu.ops.dhcp import DHCPGeom, DHCPTables, NSTATS as DHCP_NSTATS, dhcp_fastpath
-from bng_tpu.ops.nat44 import NATGeom, NATTables, NAT_NSTATS, nat44_kernel
+from bng_tpu.ops.nat44 import (
+    NATGeom,
+    NATTables,
+    NAT_NSTATS,
+    nat44_kernel,
+    nat44_update_sessions,
+)
 from bng_tpu.ops.parse import parse_batch
 from bng_tpu.ops.qos import QOS_NSTATS, QoSGeom, qos_kernel
 from bng_tpu.ops.table import TableState
@@ -131,8 +137,13 @@ def pipeline_step(
     out_pkt = jnp.where(dhcp_tx[:, None], dhcp.out_pkt, nat.out_pkt)
     out_len = jnp.where(dhcp_tx, dhcp.out_len, length)
 
+    # NAT accounting only for lanes that actually forward: a packet the
+    # pipeline drops (QoS/antispoof) must not advance session counters
+    new_sessions = nat44_update_sessions(
+        tables.nat.sessions, nat, parsed, length,
+        keep=nat_fwd & ~drop, now_s=now_s)
     new_tables = tables._replace(
-        nat=tables.nat._replace(sessions=nat.sessions),
+        nat=tables.nat._replace(sessions=new_sessions),
         qos_up=up.table,
         qos_down=down.table,
     )

@@ -38,7 +38,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from bng_tpu.control.nat import NATManager
 from bng_tpu.ops.pipeline import PipelineGeom, PipelineTables, pipeline_step
 from bng_tpu.ops.table import TableGeom, shard_owner
-from bng_tpu.runtime.engine import AntispoofTables, QoSTables
+from bng_tpu.runtime.engine import AntispoofTables, QoSTables, _apply_all_updates
 from bng_tpu.runtime.tables import FastPathTables
 from bng_tpu.utils.net import mac_to_u64, split_u64
 
@@ -66,9 +66,13 @@ def _sharded_geom(geom: PipelineGeom, n: int) -> PipelineGeom:
 def _sharded_step_jit(mesh: Mesh, geom: PipelineGeom, n: int):
     geom_sh = _sharded_geom(geom, n)
 
-    def local_step(tables1, pkt, length, fa, now_s, now_us):
+    def local_step(tables1, upd1, pkt, length, fa, now_s, now_us):
         # shard_map hands each chip a leading dim of 1: drop it
         tables = jax.tree.map(lambda x: x[0], tables1)
+        upd = jax.tree.map(lambda x: x[0], upd1)
+        # host table deltas land here, inside the donated step — the
+        # bpf_map_update_elem replacement, same as the single-chip Engine
+        tables = _apply_all_updates(tables, upd)
         res = pipeline_step(tables, pkt, length, fa, geom_sh, now_s, now_us)
         new_tables1 = jax.tree.map(lambda x: x[None], res.tables)
         # global stats over ICI (per-CPU map -> one counter)
@@ -83,7 +87,7 @@ def _sharded_step_jit(mesh: Mesh, geom: PipelineGeom, n: int):
     sharded = jax.shard_map(
         local_step,
         mesh=mesh,
-        in_specs=(P(AXIS), P(AXIS), P(AXIS), P(AXIS), P(), P()),
+        in_specs=(P(AXIS), P(AXIS), P(AXIS), P(AXIS), P(AXIS), P(), P()),
         out_specs=(P(AXIS), P(AXIS), P(AXIS), P(AXIS), P(), P(), P(), P(),
                    P(AXIS), P(AXIS)),
         check_vma=False,
@@ -189,8 +193,38 @@ class ShardedCluster:
         stacked = np.stack([np.asarray(a) for a in arrs])
         return jax.device_put(stacked, NamedSharding(self.mesh, spec))
 
+    def _drain_updates(self):
+        """Per-shard bounded update batches, stacked on the mesh axis.
+
+        Same mechanism as Engine._drain_updates: host writes since the
+        last step ride into the donated jitted step as fixed-size deltas,
+        so device-authoritative state (NAT session counters, QoS tokens)
+        is never clobbered by a full re-upload.
+        """
+        per_shard = [
+            (
+                self.fastpath[i].make_updates(),
+                self.nat[i].make_updates(),
+                self.qos[i].up.make_update(self.qos[i].update_slots),
+                self.qos[i].down.make_update(self.qos[i].update_slots),
+                self.antispoof_upd(i),
+                jnp.asarray(self.spoof[i].ranges),
+                jnp.asarray(self.spoof[i].config),
+            )
+            for i in range(self.n)
+        ]
+        return jax.tree.map(lambda *xs: self._stack(xs, P(AXIS)), *per_shard)
+
+    def antispoof_upd(self, i: int):
+        return self.spoof[i].bindings.make_update(self.spoof[i].update_slots)
+
     def sync_tables(self) -> None:
-        """Full upload of every shard's tables, stacked on the mesh axis."""
+        """Full upload of every shard's tables, stacked on the mesh axis.
+
+        Initial upload only: after the first step(), incremental writes
+        flow through _drain_updates — re-syncing would reset
+        device-authoritative counters/tokens.
+        """
         per_shard = []
         for i in range(self.n):
             t = PipelineTables(
@@ -221,7 +255,7 @@ class ShardedCluster:
         pkt_d = jax.device_put(pkt, sh)
         len_d = jax.device_put(length.astype(np.uint32), sh)
         fa_d = jax.device_put(from_access, sh)
-        out = self._step(self.tables, pkt_d, len_d, fa_d,
+        out = self._step(self.tables, self._drain_updates(), pkt_d, len_d, fa_d,
                          jnp.uint32(now_s), jnp.uint32(now_us))
         (verdict, out_pkt, out_len, new_tables, dhcp_stats, nat_stats,
          qos_stats, spoof_stats, nat_punt, viol) = out

@@ -185,3 +185,36 @@ class TestShardedCluster:
         from bng_tpu.ops.dhcp import ST_MISS
 
         assert out["dhcp_stats"][ST_MISS] == 1
+
+    def test_subscriber_added_after_first_step_reaches_device(self):
+        """Control-plane writes after the first step flow through the
+        per-step update drain (regression: they used to stay host-only)."""
+        cl = ShardedCluster(N, batch_per_shard=8)
+        cl.set_server_config_all(self.SERVER_MAC, self.SERVER_IP)
+        cl.add_pool_all(1, ip_to_u32("10.0.0.0"), 24, self.SERVER_IP)
+        B = N * cl.b
+        pkt = np.zeros((B, 512), dtype=np.uint8)
+        length = np.zeros((B,), dtype=np.uint32)
+        fa = np.ones((B,), dtype=bool)
+        mac = bytes.fromhex("02c0ffee9999")
+        f = self._discover_frame(mac)
+        pkt[0, : len(f)] = np.frombuffer(f, dtype=np.uint8)
+        length[0] = len(f)
+
+        # step 1: unknown -> slow path
+        out = cl.step(pkt, length, fa, self.T0, 0)
+        assert (out["verdict"] == 2).sum() == 0
+
+        # slow path installs the lease AFTER the cluster is live
+        cl.add_subscriber(mac, pool_id=1, ip=ip_to_u32("10.0.0.99"),
+                          lease_expiry=self.T0 + 600)
+
+        # step 2: answered on-device
+        out = cl.step(pkt, length, fa, self.T0 + 1, 0)
+        tx_rows = np.nonzero(out["verdict"] == 2)[0]
+        assert len(tx_rows) == 1
+        row = int(tx_rows[0])
+        raw = bytes(np.asarray(out["out_pkt"])[row, : int(out["out_len"][row])])
+        d = dhcp_codec.decode(packets.decode(raw).payload)
+        assert d.msg_type == dhcp_codec.OFFER
+        assert d.yiaddr == ip_to_u32("10.0.0.99")

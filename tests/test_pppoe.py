@@ -1,0 +1,388 @@
+"""PPPoE server tests: discovery, LCP, PAP/CHAP, IPCP, keepalive, teardown.
+
+Mirrors the reference's pkg/pppoe/*_test.go strategy: drive the server
+with synthetic client frames end-to-end, no sockets (SURVEY.md §4.6).
+"""
+
+import struct
+
+import pytest
+
+from bng_tpu.control.pppoe import codec
+from bng_tpu.control.pppoe.auth import LocalVerifier, chap_md5
+from bng_tpu.control.pppoe.codec import (
+    CODE_PADI,
+    CODE_PADO,
+    CODE_PADR,
+    CODE_PADS,
+    CODE_PADT,
+    CODE_SESSION,
+    CP_CONF_ACK,
+    CP_CONF_NAK,
+    CP_CONF_REQ,
+    CP_ECHO_REP,
+    CP_ECHO_REQ,
+    ETH_PPPOE_DISCOVERY,
+    ETH_PPPOE_SESSION,
+    PROTO_CHAP,
+    PROTO_IPCP,
+    PROTO_LCP,
+    PROTO_PAP,
+    CPOption,
+    CPPacket,
+    PPPoEPacket,
+    Tag,
+    eth_frame,
+    find_tag,
+    parse_eth,
+    parse_ppp,
+    parse_tags,
+    serialize_tags,
+)
+from bng_tpu.control.pppoe.ipcp import OPT_IP_ADDRESS, OPT_PRIMARY_DNS
+from bng_tpu.control.pppoe.lcp import OPT_MAGIC, OPT_MRU
+from bng_tpu.control.pppoe.server import PPPoEServer, PPPoEServerConfig
+from bng_tpu.control.pppoe.session import Phase, TerminateCause
+
+CLIENT_MAC = b"\x02\xcc\x00\x00\x00\x07"
+
+
+def mkserver(auth=PROTO_CHAP, **kw):
+    cfg = PPPoEServerConfig(auth_proto=auth, our_ip=0x0A000001,
+                            dns_primary=0x01010101, echo_interval_s=30.0,
+                            **kw)
+    verifier = LocalVerifier({"alice": b"secret123"})
+    allocs = {}
+
+    def allocate_ip(username, mac):
+        ip = 0x0A000064 + len(allocs)
+        allocs[mac] = ip
+        return ip
+
+    events = {"open": [], "close": []}
+    srv = PPPoEServer(cfg, verifier, allocate_ip,
+                      on_open=lambda s: events["open"].append(s),
+                      on_close=lambda e: events["close"].append(e),
+                      magic_source=lambda: 0xDEADBEEF,
+                      challenge_source=lambda: b"C" * 16)
+    return srv, events
+
+
+class SimClient:
+    """Minimal client side of PPPoE+PPP for driving the server."""
+
+    def __init__(self, srv, mac=CLIENT_MAC):
+        self.srv = srv
+        self.mac = mac
+        self.session_id = 0
+        self.magic = 0x12345678
+        self.lcp_acked = False  # server acked our conf-req
+        self.lcp_ack_sent = False  # we acked the server's conf-req
+        self.ip = 0
+        self.dns = 0
+        self.ipcp_done = False
+        self.rx_discovery = []
+        self.username = "alice"
+        self.password = b"secret123"
+
+    def _pump(self, frames, now):
+        """Feed frames to the server, process replies until quiescent."""
+        pending = list(frames)
+        while pending:
+            frame = pending.pop(0)
+            for out in self.srv.handle_frame(frame, now):
+                pending.extend(self._react(out, now))
+
+    def _react(self, frame, now) -> list[bytes]:
+        dst, src, etype, payload = parse_eth(frame)
+        pkt = PPPoEPacket.decode(payload)
+        if etype == ETH_PPPOE_DISCOVERY:
+            self.rx_discovery.append(pkt)
+            if pkt.code == CODE_PADO:
+                tags = parse_tags(pkt.payload)
+                cookie = find_tag(tags, codec.TAG_AC_COOKIE)
+                out = [Tag(codec.TAG_SERVICE_NAME, b"")]
+                if cookie:
+                    out.append(cookie)
+                padr = PPPoEPacket(CODE_PADR, 0, serialize_tags(out))
+                return [eth_frame(src, self.mac, ETH_PPPOE_DISCOVERY,
+                                  padr.encode())]
+            if pkt.code == CODE_PADS and pkt.session_id:
+                self.session_id = pkt.session_id
+                # kick off our LCP conf-req
+                req = CPPacket(CP_CONF_REQ, 1, options=[
+                    CPOption(OPT_MRU, struct.pack(">H", 1492)),
+                    CPOption(OPT_MAGIC, struct.pack(">I", self.magic))])
+                return [self._ppp(PROTO_LCP, req.encode())]
+            return []
+        # session frames
+        proto, body = parse_ppp(pkt.payload)
+        if proto == PROTO_LCP:
+            return self._lcp(body, now)
+        if proto == PROTO_CHAP:
+            return self._chap(body)
+        if proto == PROTO_PAP:
+            return []  # ack/nak — nothing to send
+        if proto == PROTO_IPCP:
+            return self._ipcp(body)
+        return []
+
+    def _ppp(self, proto, body) -> bytes:
+        pkt = PPPoEPacket(CODE_SESSION, self.session_id,
+                          codec.ppp_frame(proto, body))
+        return eth_frame(self.srv.config.server_mac, self.mac,
+                         ETH_PPPOE_SESSION, pkt.encode())
+
+    def _lcp(self, body, now) -> list[bytes]:
+        cp = CPPacket.decode(body)
+        if cp.code == CP_CONF_REQ:
+            self.lcp_ack_sent = True
+            return [self._ppp(PROTO_LCP,
+                              CPPacket(CP_CONF_ACK, cp.identifier,
+                                       options=cp.options).encode())]
+        if cp.code == CP_CONF_ACK:
+            self.lcp_acked = True
+            return []
+        if cp.code == CP_ECHO_REQ:
+            return [self._ppp(PROTO_LCP,
+                              CPPacket(CP_ECHO_REP, cp.identifier,
+                                       data=struct.pack(">I", self.magic)).encode())]
+        return []
+
+    def _chap(self, body) -> list[bytes]:
+        code, ident = body[0], body[1]
+        if code != 1:  # not a challenge
+            return []
+        length = struct.unpack(">H", body[2:4])[0]
+        p = body[4:length]
+        vlen = p[0]
+        challenge = p[1 : 1 + vlen]
+        resp = chap_md5(ident, self.password, challenge)
+        out = bytes([len(resp)]) + resp + self.username.encode()
+        pkt = struct.pack(">BBH", 2, ident, 4 + len(out)) + out
+        return [self._ppp(PROTO_CHAP, pkt)]
+
+    def pap_request(self) -> bytes:
+        u, pw = self.username.encode(), self.password
+        p = bytes([len(u)]) + u + bytes([len(pw)]) + pw
+        pkt = struct.pack(">BBH", 1, 7, 4 + len(p)) + p
+        return self._ppp(PROTO_PAP, pkt)
+
+    def _ipcp(self, body) -> list[bytes]:
+        cp = CPPacket.decode(body)
+        if cp.code == CP_CONF_REQ:
+            # ack the server's address
+            return [self._ppp(PROTO_IPCP,
+                              CPPacket(CP_CONF_ACK, cp.identifier,
+                                       options=cp.options).encode())]
+        if cp.code == CP_CONF_NAK:
+            for o in cp.options:
+                if o.type == OPT_IP_ADDRESS:
+                    self.ip = struct.unpack(">I", o.data)[0]
+                if o.type == OPT_PRIMARY_DNS:
+                    self.dns = struct.unpack(">I", o.data)[0]
+            opts = [CPOption(OPT_IP_ADDRESS, struct.pack(">I", self.ip))]
+            if self.dns:
+                opts.append(CPOption(OPT_PRIMARY_DNS, struct.pack(">I", self.dns)))
+            return [self._ppp(PROTO_IPCP,
+                              CPPacket(CP_CONF_REQ, 2, options=opts).encode())]
+        if cp.code == CP_CONF_ACK:
+            self.ipcp_done = True
+            return []
+        return []
+
+    def connect(self, now=1000.0):
+        padi = PPPoEPacket(CODE_PADI, 0, serialize_tags(
+            [Tag(codec.TAG_SERVICE_NAME, b""),
+             Tag(codec.TAG_HOST_UNIQ, b"HU01")]))
+        self._pump([eth_frame(b"\xff" * 6, self.mac, ETH_PPPOE_DISCOVERY,
+                              padi.encode())], now)
+        # IPCP with 0.0.0.0 → expect NAK with assigned address
+        if self.session_id and not self.ipcp_done:
+            opts = [CPOption(OPT_IP_ADDRESS, b"\x00" * 4),
+                    CPOption(OPT_PRIMARY_DNS, b"\x00" * 4)]
+            self._pump([self._ppp(PROTO_IPCP,
+                                  CPPacket(CP_CONF_REQ, 1, options=opts).encode())],
+                       now)
+
+
+def test_full_chap_session():
+    srv, events = mkserver(auth=PROTO_CHAP)
+    cli = SimClient(srv)
+    cli.connect()
+    assert cli.session_id != 0
+    assert cli.lcp_acked and cli.lcp_ack_sent
+    assert cli.ipcp_done
+    assert cli.ip == 0x0A000064
+    assert cli.dns == 0x01010101
+    assert len(events["open"]) == 1
+    sess = events["open"][0]
+    assert sess.username == "alice"
+    assert sess.assigned_ip == 0x0A000064
+    assert sess.phase == Phase.OPEN
+    assert srv.stats.auth_success == 1
+
+
+def test_full_pap_session():
+    srv, events = mkserver(auth=codec.PROTO_PAP)
+    cli = SimClient(srv)
+    cli.connect()
+    assert cli.session_id != 0
+    # PAP: client sends auth-request itself after LCP
+    cli._pump([cli.pap_request()], 1001.0)
+    opts = [CPOption(OPT_IP_ADDRESS, b"\x00" * 4)]
+    cli._pump([cli._ppp(PROTO_IPCP,
+                        CPPacket(CP_CONF_REQ, 1, options=opts).encode())], 1001.0)
+    assert cli.ipcp_done
+    assert len(events["open"]) == 1
+    assert srv.stats.auth_success == 1
+
+
+def test_chap_bad_password_terminates():
+    srv, events = mkserver(auth=PROTO_CHAP)
+    cli = SimClient(srv)
+    cli.password = b"wrong"
+    cli.connect()
+    assert srv.stats.auth_failure == 1
+    assert len(events["open"]) == 0
+    # session got torn down
+    assert len(srv.sessions) == 0
+
+
+def test_bad_cookie_rejected():
+    srv, _ = mkserver()
+    padr = PPPoEPacket(CODE_PADR, 0, serialize_tags(
+        [Tag(codec.TAG_AC_COOKIE, b"X" * 16)]))
+    out = srv.handle_frame(eth_frame(srv.config.server_mac, CLIENT_MAC,
+                                     ETH_PPPOE_DISCOVERY, padr.encode()), 0.0)
+    assert len(out) == 1
+    pkt = PPPoEPacket.decode(parse_eth(out[0])[3])
+    assert pkt.code == CODE_PADS and pkt.session_id == 0
+    tags = parse_tags(pkt.payload)
+    assert find_tag(tags, codec.TAG_GENERIC_ERR) is not None
+
+
+def test_keepalive_and_carrier_loss():
+    srv, events = mkserver()
+    cli = SimClient(srv)
+    cli.connect(now=1000.0)
+    assert len(events["open"]) == 1
+    # tick past echo interval: server emits echo-request
+    frames = srv.tick(1031.0)
+    echo = []
+    for f in frames:
+        if parse_eth(f)[2] != ETH_PPPOE_SESSION:
+            continue
+        proto, body = parse_ppp(PPPoEPacket.decode(parse_eth(f)[3]).payload)
+        if proto == PROTO_LCP and body[0] == CP_ECHO_REQ:
+            echo.append(f)
+    assert len(echo) == 1
+    # client never answers: after max_missed echoes the session dies
+    for i in range(2, 6):
+        srv.tick(1000.0 + 31.0 * i)
+    assert len(events["close"]) == 1
+    assert events["close"][0].cause == TerminateCause.LOST_CARRIER
+
+
+def test_echo_reply_keeps_session():
+    srv, events = mkserver()
+    cli = SimClient(srv)
+    cli.connect(now=1000.0)
+    for i in range(1, 10):
+        now = 1000.0 + 31.0 * i
+        for f in srv.tick(now):
+            _, _, etype, payload = parse_eth(f)
+            if etype == ETH_PPPOE_SESSION:
+                cli._pump([], now)  # noop
+                proto, body = parse_ppp(PPPoEPacket.decode(payload).payload)
+                if proto == PROTO_LCP and body[0] == CP_ECHO_REQ:
+                    cli._pump(cli._lcp(body, now), now)
+    assert len(events["close"]) == 0
+    assert len(srv.sessions) == 1
+
+
+def test_padt_teardown_releases_ip():
+    released = []
+    srv, events = mkserver()
+    srv.release_ip = lambda ip, mac: released.append((ip, mac))
+    cli = SimClient(srv)
+    cli.connect()
+    padt = PPPoEPacket(CODE_PADT, cli.session_id, b"")
+    srv.handle_frame(eth_frame(srv.config.server_mac, CLIENT_MAC,
+                               ETH_PPPOE_DISCOVERY, padt.encode()), 2000.0)
+    assert len(events["close"]) == 1
+    ev = events["close"][0]
+    assert ev.cause == TerminateCause.USER_REQUEST
+    assert ev.session_time_s == pytest.approx(1000.0)
+    assert released == [(0x0A000064, CLIENT_MAC)]
+
+
+def test_admin_terminate():
+    srv, events = mkserver()
+    cli = SimClient(srv)
+    cli.connect()
+    frames = srv.terminate(cli.session_id, TerminateCause.ADMIN_RESET, 1500.0)
+    # LCP Term-Req + PADT
+    codes = []
+    for f in frames:
+        _, _, etype, payload = parse_eth(f)
+        pkt = PPPoEPacket.decode(payload)
+        if etype == ETH_PPPOE_DISCOVERY:
+            codes.append(pkt.code)
+    assert CODE_PADT in codes
+    assert events["close"][0].cause == TerminateCause.ADMIN_RESET
+    assert len(srv.sessions) == 0
+
+
+def test_session_limit():
+    srv, _ = mkserver(max_sessions=2)
+    for i in range(3):
+        mac = bytes([2, 0, 0, 0, 0, 10 + i])
+        cli = SimClient(srv, mac=mac)
+        cli.connect()
+    assert len(srv.sessions) == 2
+
+
+def test_rate_limit_on_auth():
+    srv, _ = mkserver(auth=PROTO_CHAP)
+    # same MAC hammering bad passwords
+    for i in range(7):
+        cli = SimClient(srv)
+        cli.password = b"wrong"
+        cli.connect(now=1000.0 + i)
+    assert srv.stats.auth_failure >= 6
+    # 6th+ attempts hit the limiter (5/min) — reason is rate limited, still a failure
+    # now a correct attempt inside the window also fails (limiter)
+    cli = SimClient(srv)
+    cli.connect(now=1005.0)
+    assert len(srv.sessions) == 0
+
+
+def test_unknown_session_gets_padt():
+    srv, _ = mkserver()
+    pkt = PPPoEPacket(CODE_SESSION, 999, codec.ppp_frame(PROTO_LCP, b"\x09\x01\x00\x04"))
+    out = srv.handle_frame(eth_frame(srv.config.server_mac, CLIENT_MAC,
+                                     ETH_PPPOE_SESSION, pkt.encode()), 0.0)
+    assert len(out) == 1
+    reply = PPPoEPacket.decode(parse_eth(out[0])[3])
+    assert reply.code == CODE_PADT
+
+
+def test_codec_roundtrip():
+    tags = [Tag(codec.TAG_SERVICE_NAME, b"svc"), Tag(codec.TAG_HOST_UNIQ, b"\x01\x02")]
+    data = serialize_tags(tags)
+    back = parse_tags(data)
+    assert [(t.type, t.value) for t in back] == [(t.type, t.value) for t in tags]
+    cp = CPPacket(CP_CONF_REQ, 7, options=[CPOption(1, b"\x05\xd4"),
+                                           CPOption(5, b"\x11\x22\x33\x44")])
+    back = CPPacket.decode(cp.encode())
+    assert back.code == CP_CONF_REQ and back.identifier == 7
+    assert [(o.type, o.data) for o in back.options] == \
+        [(o.type, o.data) for o in cp.options]
+
+
+def test_cp_packet_bad_length():
+    with pytest.raises(ValueError):
+        CPPacket.decode(b"\x01\x01\x00\x02")  # length < 4
+    with pytest.raises(ValueError):
+        PPPoEPacket.decode(b"\x11\x09\x00\x00\x00\xff")  # length > frame
