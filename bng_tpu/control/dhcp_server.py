@@ -247,8 +247,24 @@ class DHCPServer:
             # (a fresh session per REQUEST would leak accounting sessions)
             lease = existing
             lease.expiry = now + lease_time
+            if lease.circuit_id and lease.circuit_id != cid:
+                # subscriber moved access ports: drop the stale circuit-id
+                # index + fast-path row or a future port user inherits it
+                self.leases_by_cid.pop(lease.circuit_id, None)
+                if self.tables is not None:
+                    self.tables.remove_circuit_id_subscriber(lease.circuit_id)
             lease.circuit_id, lease.remote_id = cid, rid
         else:
+            if existing is not None:
+                # same MAC granted a different IP: the old lease's address
+                # and accounting session must be torn down, not orphaned
+                old_pool = self.pools.pools.get(existing.pool_id)
+                if old_pool is not None:
+                    old_pool.release(existing.ip)
+                if existing.circuit_id:
+                    self.leases_by_cid.pop(existing.circuit_id, None)
+                if self.accounting_hook is not None:
+                    self.accounting_hook("stop", existing, existing.session_id)
             self._session_seq += 1
             lease = Lease(
                 mac=mac, ip=ip, pool_id=pool_id, expiry=now + lease_time,

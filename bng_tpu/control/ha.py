@@ -1,0 +1,353 @@
+"""Active/standby HA: session replication, health monitoring, failover.
+
+Parity: pkg/ha — HASyncer (sync.go:77; active serves /sessions full sync
++ /sessions/stream SSE deltas :231-454, standby full-sync + reconnect
+with backoff :482-770), SessionState (protocol.go:76-113), SessionStore
+(protocol.go:162, store.go:10-62), HealthMonitor (health_monitor.go:79,
+:232-415), FailoverController with Normal/FailoverPending/FailedOver/
+FailbackPending states and auto-failback (failover.go:137, :305-600).
+
+TPU-build differences: transport is injectable (tests wire two syncers
+directly; production uses DCN/HTTP), and all loops are tick(now)-driven.
+The role of the standby pod-slice mirroring session tables (SURVEY §2.3).
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field, asdict
+from typing import Callable
+
+
+@dataclass
+class SessionState:
+    """Full subscriber session record (parity: protocol.go:76-113)."""
+
+    session_id: str
+    mac: str = ""
+    ip: int = 0
+    pool_id: int = 0
+    circuit_id: str = ""
+    username: str = ""
+    lease_expiry: float = 0.0
+    s_tag: int = 0
+    c_tag: int = 0
+    nat_public_ip: int = 0
+    nat_port_start: int = 0
+    nat_port_end: int = 0
+    qos_policy: str = ""
+    session_kind: str = "ipoe"  # ipoe | pppoe | wifi
+    updated_at: float = 0.0
+
+    def to_dict(self) -> dict:
+        return asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "SessionState":
+        return cls(**{k: v for k, v in d.items() if k in cls.__dataclass_fields__})
+
+
+class InMemorySessionStore:
+    """Parity: ha/store.go:10-62."""
+
+    def __init__(self):
+        self._sessions: dict[str, SessionState] = {}
+
+    def put(self, s: SessionState) -> None:
+        self._sessions[s.session_id] = s
+
+    def get(self, session_id: str) -> SessionState | None:
+        return self._sessions.get(session_id)
+
+    def delete(self, session_id: str) -> bool:
+        return self._sessions.pop(session_id, None) is not None
+
+    def all(self) -> list[SessionState]:
+        return list(self._sessions.values())
+
+    def __len__(self) -> int:
+        return len(self._sessions)
+
+
+@dataclass
+class HAChange:
+    """One replication event (the SSE event payload role)."""
+
+    op: str  # "put" | "delete"
+    session: SessionState | None = None
+    session_id: str = ""
+    seq: int = 0
+
+
+class ActiveSyncer:
+    """Active side: records changes, serves full syncs + deltas.
+
+    Parity: the active half of HASyncer (sync.go:231-454). Standbys
+    subscribe with a callback (the SSE connection role); a bounded replay
+    buffer covers reconnect gaps before forcing a full resync.
+    """
+
+    def __init__(self, store: InMemorySessionStore, replay_buffer: int = 1024):
+        self.store = store
+        self._seq = 0
+        self._replay: list[HAChange] = []
+        self._replay_cap = replay_buffer
+        self._subscribers: list[Callable[[HAChange], None]] = []
+        self.stats = {"changes": 0, "full_syncs": 0}
+
+    def push_change(self, session: SessionState | None, session_id: str = "") -> None:
+        """Parity: HASyncer.PushChange (sync.go:456)."""
+        self._seq += 1
+        if session is not None:
+            self.store.put(session)
+            ch = HAChange("put", session=session, seq=self._seq)
+        else:
+            self.store.delete(session_id)
+            ch = HAChange("delete", session_id=session_id, seq=self._seq)
+        self._replay.append(ch)
+        if len(self._replay) > self._replay_cap:
+            self._replay.pop(0)
+        self.stats["changes"] += 1
+        for cb in list(self._subscribers):
+            cb(ch)
+
+    def full_sync(self) -> tuple[list[SessionState], int]:
+        """GET /sessions role: snapshot + high-water seq."""
+        self.stats["full_syncs"] += 1
+        return self.store.all(), self._seq
+
+    def replay_since(self, seq: int) -> list[HAChange] | None:
+        """Deltas after `seq`, or None if the gap fell out of the buffer."""
+        if seq == self._seq:
+            return []
+        missing = [c for c in self._replay if c.seq > seq]
+        if not missing or missing[0].seq != seq + 1:
+            return None  # gap: standby must full-sync
+        return missing
+
+    def subscribe(self, cb: Callable[[HAChange], None]) -> Callable[[], None]:
+        self._subscribers.append(cb)
+
+        def cancel():
+            if cb in self._subscribers:
+                self._subscribers.remove(cb)
+
+        return cancel
+
+
+class StandbySyncer:
+    """Standby side: full sync then live deltas, reconnect with backoff.
+
+    Parity: standbyLoop (sync.go:495), performFullSync (:538),
+    connectToStream (:596). The `transport` returns the active's
+    ActiveSyncer-shaped API or raises ConnectionError.
+    """
+
+    def __init__(self, store: InMemorySessionStore,
+                 transport: Callable[[], ActiveSyncer],
+                 backoff_initial_s: float = 1.0, backoff_max_s: float = 30.0):
+        self.store = store
+        self.transport = transport
+        self.connected = False
+        self.last_seq = 0
+        self._cancel = None
+        self._backoff = backoff_initial_s
+        self._backoff_initial = backoff_initial_s
+        self._backoff_max = backoff_max_s
+        self._next_attempt = 0.0
+        self.stats = {"full_syncs": 0, "deltas": 0, "reconnects": 0}
+
+    def _on_change(self, ch: HAChange) -> None:
+        if ch.op == "put":
+            self.store.put(ch.session)
+        else:
+            self.store.delete(ch.session_id)
+        self.last_seq = ch.seq
+        self.stats["deltas"] += 1
+
+    def _connect(self) -> None:
+        active = self.transport()  # raises ConnectionError when active is down
+        replay = active.replay_since(self.last_seq) if self.last_seq else None
+        if replay is None:
+            sessions, seq = active.full_sync()
+            self.store._sessions = {s.session_id: s for s in sessions}
+            self.last_seq = seq
+            self.stats["full_syncs"] += 1
+        else:
+            for ch in replay:
+                self._on_change(ch)
+        self._cancel = active.subscribe(self._on_change)
+        self.connected = True
+        self._backoff = self._backoff_initial
+
+    def disconnect(self) -> None:
+        if self._cancel:
+            self._cancel()
+            self._cancel = None
+        self.connected = False
+
+    def tick(self, now: float) -> None:
+        if self.connected:
+            return
+        if now < self._next_attempt:
+            return
+        try:
+            self._connect()
+            self.stats["reconnects"] += 1
+        except ConnectionError:
+            self._next_attempt = now + self._backoff
+            self._backoff = min(self._backoff * 2, self._backoff_max)
+
+
+# ---------------------------------------------------------------------------
+class HealthState(str, enum.Enum):
+    HEALTHY = "healthy"
+    DEGRADED = "degraded"
+    FAILED = "failed"
+
+
+@dataclass
+class HealthEvent:
+    state: HealthState
+    at: float
+    consecutive_failures: int = 0
+
+
+class HealthMonitor:
+    """Probe the peer with failure/recovery thresholds.
+
+    Parity: health_monitor.go:79,232-415 — 1s HTTP probes, N consecutive
+    failures -> FAILED, M consecutive successes -> HEALTHY.
+    """
+
+    def __init__(self, probe: Callable[[], bool], interval_s: float = 1.0,
+                 failure_threshold: int = 3, recovery_threshold: int = 2,
+                 on_event: Callable[[HealthEvent], None] | None = None):
+        self.probe = probe
+        self.interval_s = interval_s
+        self.failure_threshold = failure_threshold
+        self.recovery_threshold = recovery_threshold
+        self.on_event = on_event
+        self.state = HealthState.HEALTHY
+        self._fails = 0
+        self._oks = 0
+        self._last_check = 0.0
+
+    def tick(self, now: float) -> HealthState:
+        if now - self._last_check < self.interval_s:
+            return self.state
+        self._last_check = now
+        ok = False
+        try:
+            ok = bool(self.probe())
+        except Exception:
+            ok = False
+        if ok:
+            self._oks += 1
+            self._fails = 0
+            if self.state == HealthState.FAILED:
+                if self._oks >= self.recovery_threshold:
+                    self._emit(HealthState.HEALTHY, now)
+            elif self.state == HealthState.DEGRADED:
+                self.state = HealthState.HEALTHY
+        else:
+            self._fails += 1
+            self._oks = 0
+            if self.state != HealthState.FAILED:
+                if self._fails >= self.failure_threshold:
+                    self._emit(HealthState.FAILED, now)
+                else:
+                    self.state = HealthState.DEGRADED
+        return self.state
+
+    def _emit(self, state: HealthState, now: float) -> None:
+        self.state = state
+        if self.on_event:
+            self.on_event(HealthEvent(state, now, self._fails))
+
+
+class FailoverState(str, enum.Enum):
+    """Parity: failover.go:137 states."""
+
+    NORMAL = "normal"
+    FAILOVER_PENDING = "failover_pending"
+    FAILED_OVER = "failed_over"
+    FAILBACK_PENDING = "failback_pending"
+
+
+class Role(str, enum.Enum):
+    ACTIVE = "active"
+    STANDBY = "standby"
+
+
+class FailoverController:
+    """Standby-side promote/failback state machine.
+
+    Parity: failover.go:305-600 — health events drive NORMAL ->
+    FAILOVER_PENDING (grace delay) -> FAILED_OVER (promote, role-change
+    callback); peer recovery + auto-failback drives FAILED_OVER ->
+    FAILBACK_PENDING (stability window) -> NORMAL (demote).
+    """
+
+    def __init__(self, role: Role = Role.STANDBY,
+                 failover_delay_s: float = 5.0,
+                 failback_delay_s: float = 30.0,
+                 auto_failback: bool = True,
+                 on_role_change: Callable[[Role], None] | None = None):
+        self.role = role
+        self.state = FailoverState.NORMAL
+        self.failover_delay_s = failover_delay_s
+        self.failback_delay_s = failback_delay_s
+        self.auto_failback = auto_failback
+        self.on_role_change = on_role_change
+        self._pending_since = 0.0
+        self.stats = {"failovers": 0, "failbacks": 0}
+
+    def handle_health_event(self, ev: HealthEvent) -> None:
+        """Parity: handleHealthEvent (failover.go:322)."""
+        if self.role != Role.STANDBY and self.state not in (
+                FailoverState.FAILED_OVER, FailoverState.FAILBACK_PENDING):
+            return
+        if ev.state == HealthState.FAILED and self.state == FailoverState.NORMAL:
+            self.state = FailoverState.FAILOVER_PENDING
+            self._pending_since = ev.at
+        elif ev.state == HealthState.FAILED and \
+                self.state == FailoverState.FAILBACK_PENDING:
+            # peer died again before failback completed: stay active
+            self.state = FailoverState.FAILED_OVER
+        elif ev.state == HealthState.HEALTHY:
+            if self.state == FailoverState.FAILOVER_PENDING:
+                self.state = FailoverState.NORMAL  # peer came back in time
+            elif self.state == FailoverState.FAILED_OVER and self.auto_failback:
+                self.state = FailoverState.FAILBACK_PENDING
+                self._pending_since = ev.at
+
+    def tick(self, now: float) -> None:
+        if self.state == FailoverState.FAILOVER_PENDING and \
+                now - self._pending_since >= self.failover_delay_s:
+            self._promote()
+        elif self.state == FailoverState.FAILBACK_PENDING and \
+                now - self._pending_since >= self.failback_delay_s:
+            self._demote()
+
+    def _promote(self) -> None:
+        """executeFailover (failover.go:400-500)."""
+        self.state = FailoverState.FAILED_OVER
+        self.role = Role.ACTIVE
+        self.stats["failovers"] += 1
+        if self.on_role_change:
+            self.on_role_change(Role.ACTIVE)
+
+    def _demote(self) -> None:
+        self.state = FailoverState.NORMAL
+        self.role = Role.STANDBY
+        self.stats["failbacks"] += 1
+        if self.on_role_change:
+            self.on_role_change(Role.STANDBY)
+
+    def force_failover(self) -> None:
+        """Operator-initiated (failover.go manual path)."""
+        self._promote()
+
+    def force_failback(self) -> None:
+        self._demote()

@@ -197,6 +197,19 @@ class NATManager:
         for key in [k for k in self.eim if k[0] == private_ip]:
             ext_ip, ext_port, _ = self.eim.pop(key)
             self._ext_ports.pop((ext_ip, ext_port, key[2]), None)
+        # purge live session + reverse rows before the block can be
+        # recycled: a stale reverse row on a reused port would DNAT the
+        # new subscriber's inbound traffic to the old private IP
+        for s in np.nonzero(self.sessions.used)[0]:
+            key = self.sessions.keys[s]
+            if int(key[0]) != private_ip:
+                continue
+            v = self.sessions.vals[s]
+            dst_ip, ports, proto_k = int(key[1]), int(key[2]), int(key[3])
+            r_src_port = 0 if proto_k == PROTO_ICMP else ports & 0xFFFF
+            nat_ip, nat_port = int(v[SV_NAT_IP]), int(v[SV_NAT_PORT])
+            self.sessions.delete(key.copy())
+            self.reverse.delete(self._key(dst_ip, nat_ip, r_src_port, nat_port, proto_k))
         # return the port block for reuse (RFC 6431 block recycling)
         self._free_blocks.setdefault(block["public_ip"], []).append(
             block["port_start"])

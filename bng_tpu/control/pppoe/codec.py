@@ -183,11 +183,37 @@ def parse_ppp(payload: bytes) -> tuple[int, bytes]:
     return struct.unpack(">H", payload[:2])[0], payload[2:]
 
 
-def eth_frame(dst: bytes, src: bytes, ethertype: int, payload: bytes) -> bytes:
-    return dst + src + struct.pack(">H", ethertype) + payload
+ETH_P_8021Q = 0x8100
+ETH_P_8021AD = 0x88A8
+
+
+def eth_frame(dst: bytes, src: bytes, ethertype: int, payload: bytes,
+              vlans: list[int] | None = None) -> bytes:
+    """L2 frame; vlans mirror bng_tpu.control.packets.eth_header (QinQ)."""
+    hdr = dst + src
+    if vlans:
+        if len(vlans) == 2:
+            hdr += struct.pack(">HH", ETH_P_8021AD, vlans[0])
+            hdr += struct.pack(">HH", ETH_P_8021Q, vlans[1])
+        else:
+            hdr += struct.pack(">HH", ETH_P_8021Q, vlans[0])
+    return hdr + struct.pack(">H", ethertype) + payload
 
 
 def parse_eth(frame: bytes) -> tuple[bytes, bytes, int, bytes]:
     if len(frame) < 14:
         raise ValueError("ethernet frame truncated")
     return frame[0:6], frame[6:12], struct.unpack(">H", frame[12:14])[0], frame[14:]
+
+
+def parse_eth_vlan(frame: bytes) -> tuple[bytes, bytes, int, bytes, list[int]]:
+    """parse_eth that strips 802.1Q/802.1ad tags (subscriber frames are
+    typically S/C-tagged; parity with parse_packet_headers'
+    VLAN/QinQ handling in the DHCP fast path)."""
+    dst, src, etype, payload = parse_eth(frame)
+    vlans: list[int] = []
+    while etype in (ETH_P_8021Q, ETH_P_8021AD) and len(payload) >= 4:
+        tci, etype = struct.unpack(">HH", payload[:4])
+        vlans.append(tci & 0x0FFF)
+        payload = payload[4:]
+    return dst, src, etype, payload, vlans

@@ -50,7 +50,7 @@ from bng_tpu.control.pppoe.codec import (
     Tag,
     eth_frame,
     find_tag,
-    parse_eth,
+    parse_eth_vlan,
     parse_ppp,
     parse_tags,
     ppp_frame,
@@ -82,6 +82,9 @@ class PPPoEServerConfig:
     echo_max_missed: int = 3
     idle_timeout_s: float = 0.0  # 0 = disabled
     session_timeout_s: float = 0.0
+    # half-open sessions (PADR done but never reached OPEN) are reclaimed
+    # after this long, else stuck LCP/AUTH floods exhaust the table
+    setup_timeout_s: float = 60.0
     cookie_secret: bytes = field(default_factory=lambda: os.urandom(16))
 
 
@@ -124,15 +127,20 @@ class PPPoEServer:
         self.chap = CHAPHandler(verifier, ac_name=config.ac_name,
                                 challenge_source=challenge_source,
                                 limiter=limiter)
+        self._limiter = limiter
         self._acct_counter = 0
+        self._cur_vlans: list[int] = []
 
     # ---- frame entry point ----
 
     def handle_frame(self, frame: bytes, now: float) -> list[bytes]:
         try:
-            dst, src, etype, payload = parse_eth(frame)
+            dst, src, etype, payload, vlans = parse_eth_vlan(frame)
         except ValueError:
             return []
+        # replies mirror the request's VLAN stack (single-threaded server;
+        # _cur_vlans is valid for the duration of this frame)
+        self._cur_vlans = vlans
         if etype == ETH_PPPOE_DISCOVERY:
             return self._handle_discovery(src, payload, now)
         if etype == ETH_PPPOE_SESSION:
@@ -145,11 +153,12 @@ class PPPoEServer:
         return hmac.new(self.config.cookie_secret, mac, hashlib.sha256).digest()[:16]
 
     def _discovery_reply(self, code: int, dst: bytes, session_id: int,
-                         tags: list[Tag]) -> bytes:
+                         tags: list[Tag], vlans: list[int] | None = None) -> bytes:
         pkt = PPPoEPacket(code=code, session_id=session_id,
                           payload=serialize_tags(tags))
         return eth_frame(dst, self.config.server_mac, ETH_PPPOE_DISCOVERY,
-                         pkt.encode())
+                         pkt.encode(),
+                         vlans=vlans if vlans is not None else self._cur_vlans)
 
     def _handle_discovery(self, src: bytes, payload: bytes, now: float
                           ) -> list[bytes]:
@@ -181,6 +190,12 @@ class PPPoEServer:
                     cookie.value, self._cookie_for(src)):
                 err = [Tag(codec.TAG_GENERIC_ERR, b"bad AC-Cookie")]
                 return [self._discovery_reply(CODE_PADS, src, 0, err)]
+            # re-dial from a MAC with a live session: tear the old one
+            # down properly (IP release + accounting stop) before replacing
+            old = self.sessions.by_mac(src)
+            if old is not None:
+                self._close_session(old, TerminateCause.LOST_CARRIER, now,
+                                    send_padt=False)
             sess = self.sessions.allocate(src, now)
             if sess is None:
                 err = [Tag(codec.TAG_AC_SYSTEM_ERR, b"session table full")]
@@ -189,6 +204,7 @@ class PPPoEServer:
             sess.acct_session_id = f"pppoe-{sess.session_id:04x}-{self._acct_counter}"
             sess.lcp = LCP(magic=self._magic(), auth_proto=self.config.auth_proto)
             sess.phase = Phase.LCP
+            sess.vlans = list(self._cur_vlans)
             out = [Tag(codec.TAG_AC_NAME, self.config.ac_name.encode()),
                    Tag(codec.TAG_SERVICE_NAME, b"")]
             hu = find_tag(tags, codec.TAG_HOST_UNIQ)
@@ -214,7 +230,7 @@ class PPPoEServer:
         pkt = PPPoEPacket(code=CODE_SESSION, session_id=sess.session_id,
                           payload=ppp_frame(proto, body))
         return eth_frame(sess.client_mac, self.config.server_mac,
-                         ETH_PPPOE_SESSION, pkt.encode())
+                         ETH_PPPOE_SESSION, pkt.encode(), vlans=sess.vlans)
 
     def _drain_cp(self, sess: PPPoESession, fsm) -> list[bytes]:
         frames = []
@@ -291,7 +307,7 @@ class PPPoEServer:
             frames += self._start_auth(sess, now)
         elif was_open and sess.lcp.state == "closed":
             self._close_session(sess, TerminateCause.USER_REQUEST, now,
-                                send_padt=True, send_term=False)
+                                send_padt=True)
         return frames
 
     def _start_auth(self, sess: PPPoESession, now: float) -> list[bytes]:
@@ -311,16 +327,19 @@ class PPPoEServer:
             self.stats.auth_failure += 1
             return self._terminate_frames(sess, TerminateCause.USER_ERROR, now)
         self.stats.auth_success += 1
+        # a successful auth clears the attempt budget so legitimately
+        # flapping clients are not locked out (limiter counts failures)
+        self._limiter.reset(sess.client_mac.hex())
         return self._start_network(sess, res.username, res, now)
 
     def _handle_pap(self, sess: PPPoESession, body: bytes, now: float
                     ) -> list[bytes]:
         key = sess.client_mac.hex()
         reply, res = self.pap.handle(body, key, now)
-        frames = []
-        if reply is not None:
-            frames.append(self._session_frame(sess, PROTO_PAP, reply))
-        return frames + self._auth_done(sess, res, now)
+        if reply is None:
+            return []  # malformed frame: ignore, client will retransmit
+        return [self._session_frame(sess, PROTO_PAP, reply)] + \
+            self._auth_done(sess, res, now)
 
     def _handle_chap(self, sess: PPPoESession, body: bytes, now: float
                      ) -> list[bytes]:
@@ -329,10 +348,10 @@ class PPPoEServer:
         key = sess.client_mac.hex()
         reply, res = self.chap.handle_response(body, sess.chap_challenge,
                                                key, now)
-        frames = []
-        if reply is not None:
-            frames.append(self._session_frame(sess, PROTO_CHAP, reply))
-        return frames + self._auth_done(sess, res, now)
+        if reply is None:
+            return []  # malformed frame: ignore, client will retransmit
+        return [self._session_frame(sess, PROTO_CHAP, reply)] + \
+            self._auth_done(sess, res, now)
 
     def _start_network(self, sess: PPPoESession, username: str,
                        res: AuthResult, now: float) -> list[bytes]:
@@ -373,13 +392,11 @@ class PPPoEServer:
         if sess.lcp is not None and sess.lcp.state == "opened":
             sess.lcp.close(now)
             frames += self._drain_cp(sess, sess.lcp)
-        frames += self._close_session(sess, cause, now, send_padt=True,
-                                      send_term=False)
+        frames += self._close_session(sess, cause, now, send_padt=True)
         return frames
 
     def _close_session(self, sess: PPPoESession, cause: TerminateCause,
-                       now: float, send_padt: bool, send_term: bool = False
-                       ) -> list[bytes]:
+                       now: float, send_padt: bool) -> list[bytes]:
         frames: list[bytes] = []
         if send_padt:
             self.stats.padt_tx += 1
@@ -388,12 +405,15 @@ class PPPoEServer:
         removed = self.sessions.remove(sess.session_id)
         if removed is None:
             return frames
+        was_open = sess.phase == Phase.OPEN
         sess.terminate_cause = cause
         sess.phase = Phase.CLOSED
         self.stats.sessions_closed += 1
         if sess.assigned_ip and self.release_ip:
             self.release_ip(sess.assigned_ip, sess.client_mac)
-        if self.on_close:
+        if self.on_close and was_open:
+            # accounting/teardown hooks only for sessions that opened:
+            # half-open reclaims have no accounting session to stop
             self.on_close(TeardownEvent(session=sess, cause=cause, at=now))
         return frames
 
@@ -414,6 +434,17 @@ class PPPoEServer:
                 if fsm is not None:
                     fsm.tick(now)
                     frames += self._drain_cp(sess, fsm)
+            # reclaim half-open sessions: PADR done but LCP/AUTH/IPCP never
+            # completed (or LCP retried out into CLOSED). Without this, a
+            # PADI/PADR flood from distinct MACs pins the session table.
+            if sess.phase != Phase.OPEN:
+                lcp_dead = sess.lcp is not None and sess.lcp.state == "closed" \
+                    and sess.phase in (Phase.LCP, Phase.AUTH)
+                if lcp_dead or (self.config.setup_timeout_s and
+                                now - sess.created_at >= self.config.setup_timeout_s):
+                    frames += self._close_session(
+                        sess, TerminateCause.LOST_SERVICE, now, send_padt=True)
+                continue
             if sess.phase == Phase.OPEN and sess.lcp is not None:
                 cfg = self.config
                 if cfg.session_timeout_s and \

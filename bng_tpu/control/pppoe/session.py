@@ -70,6 +70,7 @@ class PPPoESession:
     terminate_cause: TerminateCause | None = None
     acct_session_id: str = ""
     radius_attributes: dict = field(default_factory=dict)
+    vlans: list[int] = field(default_factory=list)  # S/C tags of the access line
 
     def touch(self, now: float) -> None:
         self.last_activity = now

@@ -280,3 +280,28 @@ class TestStatsAndExpiry:
         n = engine.expire()
         assert n == 1
         assert nat.sessions.count == 0 and nat.reverse.count == 0
+
+
+def test_nat_release_purges_sessions_before_block_reuse():
+    """Recycled port blocks must not resurrect the old subscriber's
+    reverse-table rows (cross-subscriber traffic leakage)."""
+    from bng_tpu.control.nat import NATManager
+
+    nat = NATManager(public_ips=[0xCB007101], ports_per_subscriber=64,
+                     sessions_nbuckets=256, sub_nat_nbuckets=64)
+    a, b = 0x0A000005, 0x0A000006
+    nat.allocate_nat(a, now=100)
+    got = nat.handle_new_flow(a, 0x5DB8D822, 40000, 443, 17, 100, now=100)
+    assert got is not None
+    nat_ip, nat_port = got
+    # A's reverse row exists
+    rkey = [0x5DB8D822, nat_ip, 443, nat_port, 17]
+    key = [rkey[0], rkey[1], ((rkey[2] & 0xFFFF) << 16) | (rkey[3] & 0xFFFF), rkey[4]]
+    assert nat.reverse.lookup(key) is not None
+    nat.release_nat(a, now=200)
+    # stale rows are gone
+    assert nat.reverse.lookup(key) is None
+    assert nat.sessions.used.sum() == 0
+    # B gets the recycled block
+    blk = nat.allocate_nat(b, now=300)
+    assert blk["port_start"] == 1024  # reused A's block

@@ -1,0 +1,296 @@
+"""HA pair + resilience tests.
+
+Mirrors the reference strategy: HA active+standby in one process
+(pkg/ha/sync_test.go), controllable health checkers driving the
+partition state machine (pkg/resilience/partition_test.go:15-50).
+"""
+
+from bng_tpu.control.ha import (
+    ActiveSyncer,
+    FailoverController,
+    FailoverState,
+    HealthMonitor,
+    HealthState,
+    InMemorySessionStore,
+    Role,
+    SessionState,
+    StandbySyncer,
+)
+from bng_tpu.control.resilience import (
+    CachedProfile,
+    ConflictDetector,
+    DegradedRADIUSHandler,
+    PartitionState,
+    PoolLevel,
+    PoolMonitor,
+    RequestQueue,
+    ResilienceManager,
+)
+
+
+def sess(i, at=0.0):
+    return SessionState(session_id=f"s{i}", mac=f"02:00:00:00:00:{i:02x}",
+                        ip=0x0A000000 + i, updated_at=at)
+
+
+class TestHASync:
+    def _pair(self):
+        active = ActiveSyncer(InMemorySessionStore())
+        up = {"ok": True}
+
+        def transport():
+            if not up["ok"]:
+                raise ConnectionError("active down")
+            return active
+
+        standby = StandbySyncer(InMemorySessionStore(), transport)
+        return active, standby, up
+
+    def test_full_sync_then_deltas(self):
+        active, standby, _ = self._pair()
+        for i in range(5):
+            active.push_change(sess(i))
+        standby.tick(0.0)
+        assert standby.connected
+        assert len(standby.store) == 5
+        assert standby.stats["full_syncs"] == 1
+        # live delta
+        active.push_change(sess(9))
+        assert standby.store.get("s9") is not None
+        active.push_change(None, session_id="s0")
+        assert standby.store.get("s0") is None
+        assert len(standby.store) == 5
+
+    def test_reconnect_with_backoff_and_replay(self):
+        active, standby, up = self._pair()
+        active.push_change(sess(1))
+        standby.tick(0.0)
+        assert standby.connected
+
+        # active "dies": standby disconnects, retries with backoff
+        up["ok"] = False
+        standby.disconnect()
+        standby.tick(1.0)
+        assert not standby.connected
+        # backoff: next attempt not before 1+1s
+        up["ok"] = True
+        active.push_change(sess(2))  # happens while disconnected
+        standby.tick(1.5)
+        assert not standby.connected  # still backing off
+        standby.tick(2.5)
+        assert standby.connected
+        # missed change arrived via replay, not full resync
+        assert standby.store.get("s2") is not None
+        assert standby.stats["full_syncs"] == 1
+
+    def test_replay_gap_forces_full_sync(self):
+        active, standby, up = self._pair()
+        active.push_change(sess(1))
+        standby.tick(0.0)
+        standby.disconnect()
+        # overflow the replay buffer while disconnected
+        active._replay_cap = 4
+        for i in range(10, 20):
+            active.push_change(sess(i))
+        standby.tick(10.0)
+        assert standby.connected
+        assert standby.stats["full_syncs"] == 2
+        assert len(standby.store) == 11
+
+
+class TestHealthFailover:
+    def test_threshold_and_recovery(self):
+        ok = {"v": True}
+        events = []
+        hm = HealthMonitor(lambda: ok["v"], interval_s=1.0,
+                           failure_threshold=3, recovery_threshold=2,
+                           on_event=events.append)
+        for t in range(3):
+            assert hm.tick(float(t)) == HealthState.HEALTHY
+        ok["v"] = False
+        hm.tick(3.0)
+        hm.tick(4.0)
+        assert hm.state == HealthState.DEGRADED
+        hm.tick(5.0)
+        assert hm.state == HealthState.FAILED
+        assert events[-1].state == HealthState.FAILED
+        ok["v"] = True
+        hm.tick(6.0)
+        assert hm.state == HealthState.FAILED  # 1 ok < recovery threshold
+        hm.tick(7.0)
+        assert hm.state == HealthState.HEALTHY
+
+    def test_failover_and_auto_failback(self):
+        roles = []
+        fc = FailoverController(failover_delay_s=5.0, failback_delay_s=10.0,
+                                on_role_change=roles.append)
+        ok = {"v": True}
+        hm = HealthMonitor(lambda: ok["v"], interval_s=1.0,
+                           failure_threshold=2, on_event=fc.handle_health_event)
+        ok["v"] = False
+        hm.tick(1.0)
+        hm.tick(2.0)  # -> FAILED event
+        assert fc.state == FailoverState.FAILOVER_PENDING
+        fc.tick(4.0)
+        assert fc.role == Role.STANDBY  # grace not elapsed
+        fc.tick(7.5)
+        assert fc.role == Role.ACTIVE
+        assert fc.state == FailoverState.FAILED_OVER
+        assert roles == [Role.ACTIVE]
+        # peer recovers -> failback after stability window
+        ok["v"] = True
+        hm.tick(8.0)
+        hm.tick(9.0)
+        assert fc.state == FailoverState.FAILBACK_PENDING
+        fc.tick(18.0)
+        assert fc.role == Role.ACTIVE  # window not elapsed
+        fc.tick(19.5)
+        assert fc.role == Role.STANDBY
+        assert roles == [Role.ACTIVE, Role.STANDBY]
+
+    def test_flap_cancels_pending_failover(self):
+        fc = FailoverController(failover_delay_s=5.0)
+        ok = {"v": False}
+        hm = HealthMonitor(lambda: ok["v"], interval_s=1.0,
+                           failure_threshold=2, recovery_threshold=1,
+                           on_event=fc.handle_health_event)
+        hm.tick(1.0)
+        hm.tick(2.0)
+        assert fc.state == FailoverState.FAILOVER_PENDING
+        ok["v"] = True
+        hm.tick(3.0)
+        assert fc.state == FailoverState.NORMAL
+        fc.tick(100.0)
+        assert fc.role == Role.STANDBY
+
+
+class TestResilience:
+    def test_partition_lifecycle_with_conflicts(self):
+        healthy = {"v": True}
+        central = {}  # ip -> (subscriber, at)
+        renumbered = []
+        states = []
+        m = ResilienceManager(
+            nexus_healthy=lambda: healthy["v"],
+            check_interval_s=1.0, failure_threshold=2,
+            central_lookup=central.get,
+            renumber=lambda sub: renumbered.append(sub) or True,
+            on_state_change=states.append,
+        )
+        assert m.tick(1.0) == PartitionState.NORMAL
+        healthy["v"] = False
+        m.tick(2.0)
+        assert m.state == PartitionState.NORMAL  # 1 fail < threshold
+        m.tick(3.0)
+        assert m.state == PartitionState.PARTITIONED
+        # local allocations during partition
+        m.record_allocation("sub-local", 0x0A000005, at=100.0)
+        m.record_allocation("sub-free", 0x0A000006, at=101.0)
+        # central store meanwhile gave .5 to someone else EARLIER
+        central[0x0A000005] = ("sub-remote", 50.0)
+        healthy["v"] = True
+        m.tick(4.0)
+        assert m.state == PartitionState.NORMAL
+        # remote allocation was earlier -> local loses, gets renumbered
+        assert renumbered == ["sub-local"]
+        assert m.events.conflicts_found == 1
+        assert m.events.renumbered == 1
+        assert states == [PartitionState.PARTITIONED, PartitionState.RECOVERING,
+                          PartitionState.NORMAL]
+
+    def test_conflict_winner_by_timestamp(self):
+        cd = ConflictDetector()
+        cd.record("local", 1, at=10.0)
+        out = cd.detect(lambda ip: ("remote", 20.0) if ip == 1 else None)
+        assert out[0].winner == "local" and out[0].loser == "remote"
+        cd2 = ConflictDetector()
+        cd2.record("local", 1, at=30.0)
+        out2 = cd2.detect(lambda ip: ("remote", 20.0))
+        assert out2[0].winner == "remote" and out2[0].loser == "local"
+
+    def test_pool_monitor_short_lease(self):
+        util = {"v": 0.5}
+        levels = []
+        pm = PoolMonitor(lambda: util["v"], on_level_change=levels.append)
+        assert pm.tick() == PoolLevel.NORMAL
+        util["v"] = 0.85
+        assert pm.tick() == PoolLevel.WARNING
+        assert not pm.short_lease_active
+        util["v"] = 0.96
+        assert pm.tick() == PoolLevel.CRITICAL
+        assert pm.short_lease_active
+        util["v"] = 1.0
+        assert pm.tick() == PoolLevel.EXHAUSTED
+        util["v"] = 0.3
+        assert pm.tick() == PoolLevel.NORMAL
+        assert levels == [PoolLevel.WARNING, PoolLevel.CRITICAL,
+                          PoolLevel.EXHAUSTED, PoolLevel.NORMAL]
+
+    def test_degraded_auth_and_replay(self):
+        h = DegradedRADIUSHandler(cache_ttl_s=100.0)
+        h.cache_profile(CachedProfile("alice", "gold", cached_at=0.0))
+        assert h.degraded_auth("alice", 50.0) is not None
+        assert h.degraded_auth("alice", 200.0) is None  # TTL expired
+        assert h.degraded_auth("bob", 1.0) is None
+        assert h.reauth_queue == ["alice"]
+        h.buffer_accounting({"session": "s1"})
+        h.buffer_accounting({"session": "s2"})
+        sent_ok = []
+        fail_first = {"v": True}
+
+        def send(rec):
+            if fail_first["v"]:
+                fail_first["v"] = False
+                return False
+            sent_ok.append(rec)
+            return True
+
+        sent, reauthed = h.replay(send, reauth=lambda u: True)
+        assert sent == 1 and reauthed == 1
+        assert len(h.acct_buffer) == 1  # failed record stays
+        assert h.reauth_queue == []
+
+    def test_request_queue_bounded(self):
+        q = RequestQueue(max_size=2)
+        assert q.enqueue("put", {"a": 1})
+        assert q.enqueue("put", {"a": 2})
+        assert not q.enqueue("put", {"a": 3})
+        assert q.dropped == 1
+        done = q.drain(lambda kind, p: p["a"] == 1)
+        assert done == 1 and len(q) == 1
+
+
+def test_failback_cancelled_when_peer_dies_again():
+    """FAILBACK_PENDING + peer fails again -> stay active (no dual-dead)."""
+    from bng_tpu.control.ha import HealthEvent
+
+    fc = FailoverController(failover_delay_s=1.0, failback_delay_s=10.0)
+    fc.force_failover()
+    assert fc.role == Role.ACTIVE
+    fc.handle_health_event(HealthEvent(HealthState.HEALTHY, 100.0))
+    assert fc.state == FailoverState.FAILBACK_PENDING
+    fc.handle_health_event(HealthEvent(HealthState.FAILED, 105.0))
+    assert fc.state == FailoverState.FAILED_OVER
+    fc.tick(200.0)
+    assert fc.role == Role.ACTIVE  # never demoted
+
+
+def test_radius_only_outage_activates_degraded_auth():
+    radius_ok = {"v": True}
+    sent = []
+    m = ResilienceManager(nexus_healthy=lambda: True,
+                          radius_healthy=lambda: radius_ok["v"],
+                          check_interval_s=1.0, failure_threshold=2)
+    m.radius_handler.cache_profile(CachedProfile("alice", "gold", cached_at=0.0))
+    m.tick(1.0)
+    assert not m.degraded_auth_active
+    radius_ok["v"] = False
+    m.tick(2.0)
+    m.tick(3.0)
+    assert m.radius_down and m.degraded_auth_active
+    assert m.state == PartitionState.NORMAL  # nexus fine: not partitioned
+    m.radius_handler.buffer_accounting({"s": 1})
+    radius_ok["v"] = True
+    m.tick(4.0, acct_send=lambda r: sent.append(r) or True)
+    assert not m.degraded_auth_active
+    assert len(sent) == 1  # buffered accounting replayed on recovery

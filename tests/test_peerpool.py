@@ -1,0 +1,128 @@
+"""PeerPool tests: owner routing, forwarding, failover, reconcile.
+
+Mirrors the reference's multi-node-in-one-process strategy
+(pkg/pool tests; SURVEY §4.6).
+"""
+
+import pytest
+
+from bng_tpu.control.peerpool import PeerPool, PeerPoolError, PoolRange
+
+
+def make_cluster(n=3, size=100):
+    nodes = [f"node{i}" for i in range(n)]
+    pools: dict[str, PeerPool] = {}
+    down: set[str] = set()
+
+    def transport(node_id):
+        if node_id in down:
+            raise ConnectionError(f"{node_id} down")
+        return pools[node_id]
+
+    for nid in nodes:
+        pools[nid] = PeerPool(nid, nodes, PoolRange(0x0A000000, size),
+                              transport=transport)
+    return pools, down
+
+
+class TestPeerPool:
+    def test_owner_allocates_locally(self):
+        pools, _ = make_cluster()
+        p0 = pools["node0"]
+        owner = p0.owner_ranked("sub-A")[0]
+        ip = pools[owner].allocate("sub-A")
+        assert pools[owner].stats["local_allocs"] == 1
+        assert pools[owner].stats["forwarded"] == 0
+        assert pools[owner].by_subscriber["sub-A"] == ip
+
+    def test_non_owner_forwards(self):
+        pools, _ = make_cluster()
+        sub = "sub-B"
+        owner = pools["node0"].owner_ranked(sub)[0]
+        non_owner = next(n for n in pools if n != owner)
+        ip = pools[non_owner].allocate(sub)
+        assert pools[non_owner].stats["forwarded"] == 1
+        assert pools[owner].by_subscriber[sub] == ip
+        # idempotent: same subscriber -> same ip from any node
+        assert pools[owner].allocate(sub) == ip
+        for n in pools:
+            assert pools[n].get(sub) == ip
+
+    def test_failover_to_next_ranked(self):
+        pools, down = make_cluster()
+        sub = "sub-C"
+        ranked = pools["node0"].owner_ranked(sub)
+        owner = ranked[0]
+        caller = next(n for n in pools if n != owner)
+        down.add(owner)
+        ip = pools[caller].allocate(sub)
+        assert ip is not None
+        # allocated on the next healthy ranked node (or caller itself)
+        holder = next(n for n in pools if sub in pools[n].by_subscriber)
+        assert holder != owner
+        assert pools[caller].stats["failovers"] >= 1
+
+    def test_owner_failure_marks_unhealthy_then_recovers(self):
+        pools, down = make_cluster()
+        sub = "sub-D"
+        owner = pools["node0"].owner_ranked(sub)[0]
+        caller = next(n for n in pools if n != owner)
+        down.add(owner)
+        for _ in range(3):
+            pools[caller].allocate(sub)  # each call retries the dead owner
+        # after threshold failures the owner is excluded from ranking
+        if owner in pools[caller].peers:
+            assert not pools[caller].peers[owner].healthy
+            assert owner not in pools[caller]._healthy_nodes()
+        down.discard(owner)
+        pools[caller].health_check(now=100.0)
+        assert pools[caller].peers[owner].healthy
+
+    def test_deterministic_cross_node_allocation(self):
+        pools, _ = make_cluster()
+        # same subscriber from different entry nodes -> same ip
+        ip1 = pools["node0"].allocate("sub-E")
+        ip2 = pools["node1"].allocate("sub-E")
+        assert ip1 == ip2
+
+    def test_release(self):
+        pools, _ = make_cluster()
+        ip = pools["node0"].allocate("sub-F")
+        assert pools["node1"].release("sub-F")
+        assert pools["node0"].get("sub-F") is None
+        # address is reusable
+        ip2 = pools["node2"].allocate("sub-F")
+        assert ip2 == ip  # deterministic: same candidate free again
+
+    def test_exhaustion(self):
+        pools, _ = make_cluster(n=1, size=3)
+        p = pools["node0"]
+        got = set()
+        for i in range(3):
+            got.add(p.allocate(f"s{i}"))
+        assert len(got) == 3
+        with pytest.raises(PeerPoolError):
+            p.allocate("s-overflow")
+
+    def test_reconcile_drops_double_allocation(self):
+        pools, down = make_cluster(n=2, size=50)
+        # simulate a partition double-allocation: both nodes own ip X
+        pools["node0"].allocations[0x0A000005] = "sub-X"
+        pools["node0"].by_subscriber["sub-X"] = 0x0A000005
+        pools["node1"].allocations[0x0A000005] = "sub-Y"
+        pools["node1"].by_subscriber["sub-Y"] = 0x0A000005
+        conflicts = pools["node0"].reconcile()
+        assert conflicts == 1
+        holders = [n for n in pools
+                   if 0x0A000005 in pools[n].allocations]
+        assert len(holders) >= 1
+        # only one subscriber keeps the address
+        subs = {pools[n].allocations.get(0x0A000005) for n in holders}
+        assert len(subs) == 1
+
+    def test_status(self):
+        pools, _ = make_cluster()
+        pools["node0"].allocate("sub-G")
+        st = pools["node0"].status()
+        assert st["pool_size"] == 100
+        assert st["healthy_peers"] == 2

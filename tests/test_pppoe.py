@@ -386,3 +386,109 @@ def test_cp_packet_bad_length():
         CPPacket.decode(b"\x01\x01\x00\x02")  # length < 4
     with pytest.raises(ValueError):
         PPPoEPacket.decode(b"\x11\x09\x00\x00\x00\xff")  # length > frame
+
+
+def test_vlan_tagged_discovery_mirrored():
+    """Tagged PADI gets a tagged PADO back (QinQ access lines)."""
+    import struct as _s
+
+    srv, events = mkserver()
+    padi = PPPoEPacket(CODE_PADI, 0, serialize_tags(
+        [Tag(codec.TAG_SERVICE_NAME, b"")]))
+    # S-tag 100 (802.1ad) + C-tag 42 (802.1Q)
+    frame = (b"\xff" * 6 + CLIENT_MAC + _s.pack(">HH", 0x88A8, 100)
+             + _s.pack(">HH", 0x8100, 42)
+             + _s.pack(">H", ETH_PPPOE_DISCOVERY) + padi.encode())
+    out = srv.handle_frame(frame, 0.0)
+    assert len(out) == 1
+    reply = out[0]
+    assert _s.unpack(">H", reply[12:14])[0] == 0x88A8
+    assert _s.unpack(">H", reply[14:16])[0] == 100
+    assert _s.unpack(">H", reply[16:18])[0] == 0x8100
+    assert _s.unpack(">H", reply[18:20])[0] == 42
+    assert _s.unpack(">H", reply[20:22])[0] == ETH_PPPOE_DISCOVERY
+
+
+def test_half_open_sessions_reclaimed():
+    """PADR floods that never finish LCP can't pin the session table."""
+    srv, events = mkserver()
+    for i in range(5):
+        mac = bytes([2, 0, 0, 0, 1, i])
+        cli = SimClient(srv, mac=mac)
+        # only do discovery: PADI->PADO->PADR->PADS, then go silent.
+        padi = PPPoEPacket(CODE_PADI, 0, serialize_tags(
+            [Tag(codec.TAG_SERVICE_NAME, b"")]))
+        frames = srv.handle_frame(
+            eth_frame(b"\xff" * 6, mac, ETH_PPPOE_DISCOVERY, padi.encode()), 0.0)
+        pado = PPPoEPacket.decode(parse_eth(frames[0])[3])
+        cookie = find_tag(parse_tags(pado.payload), codec.TAG_AC_COOKIE)
+        padr = PPPoEPacket(CODE_PADR, 0, serialize_tags([cookie]))
+        srv.handle_frame(
+            eth_frame(srv.config.server_mac, mac, ETH_PPPOE_DISCOVERY,
+                      padr.encode()), 0.0)
+    assert len(srv.sessions) == 5
+    # past setup timeout: all reclaimed, no accounting events fired
+    srv.tick(61.0)
+    assert len(srv.sessions) == 0
+    assert events["close"] == []  # never opened -> no teardown events
+
+
+def test_redial_releases_old_session():
+    """A client re-dialing PADI/PADR tears down its old open session."""
+    released = []
+    srv, events = mkserver()
+    srv.release_ip = lambda ip, mac: released.append(ip)
+    cli1 = SimClient(srv)
+    cli1.connect()
+    assert len(events["open"]) == 1
+    old_sid = cli1.session_id
+    # same MAC dials again
+    cli2 = SimClient(srv)
+    cli2.connect()
+    assert len(events["close"]) == 1
+    assert events["close"][0].session.session_id == old_sid
+    assert released == [0x0A000064]
+    assert len(srv.sessions) == 1
+
+
+def test_malformed_auth_frame_does_not_kill_session():
+    srv, events = mkserver(auth=PROTO_CHAP)
+    cli = SimClient(srv)
+    # drive up to AUTH phase but intercept before responding to challenge
+    padi = PPPoEPacket(CODE_PADI, 0, serialize_tags(
+        [Tag(codec.TAG_SERVICE_NAME, b"")]))
+    frames = srv.handle_frame(
+        eth_frame(b"\xff" * 6, CLIENT_MAC, ETH_PPPOE_DISCOVERY, padi.encode()), 0.0)
+    pado = PPPoEPacket.decode(parse_eth(frames[0])[3])
+    cookie = find_tag(parse_tags(pado.payload), codec.TAG_AC_COOKIE)
+    padr = PPPoEPacket(CODE_PADR, 0, serialize_tags([cookie]))
+    frames = srv.handle_frame(
+        eth_frame(srv.config.server_mac, CLIENT_MAC, ETH_PPPOE_DISCOVERY,
+                  padr.encode()), 0.0)
+    sess = srv.sessions.by_mac(CLIENT_MAC)
+    cli.session_id = sess.session_id
+    # complete LCP so we are in AUTH
+    for f in frames:
+        _, _, etype, payload = parse_eth(f)
+        if etype == ETH_PPPOE_SESSION:
+            proto, body = parse_ppp(PPPoEPacket.decode(payload).payload)
+            if proto == PROTO_LCP:
+                for rf in cli._lcp(body, 0.0):
+                    srv.handle_frame(rf, 0.0)
+    req = CPPacket(CP_CONF_REQ, 1, options=[])
+    srv.handle_frame(cli._ppp(PROTO_LCP, req.encode()), 0.0)
+    assert sess.phase == Phase.AUTH
+    # garbage CHAP response: truncated
+    srv.handle_frame(cli._ppp(codec.PROTO_CHAP, b"\x02\x01\x00\x04"), 1.0)
+    assert srv.sessions.by_mac(CLIENT_MAC) is not None  # session survives
+    assert srv.stats.auth_failure == 0
+
+
+def test_successful_auth_resets_rate_limiter():
+    srv, events = mkserver(auth=PROTO_CHAP)
+    # 6 successful reconnects in one window: all must succeed
+    for i in range(6):
+        cli = SimClient(srv)
+        cli.connect(now=1000.0 + i * 2)
+    assert srv.stats.auth_success == 6
+    assert srv.stats.auth_failure == 0

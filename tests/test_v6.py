@@ -1,0 +1,369 @@
+"""DHCPv6 + SLAAC tests (mirrors pkg/dhcpv6 + pkg/slaac test strategy)."""
+
+import struct
+
+import pytest
+
+from bng_tpu.control.dhcpv6 import protocol as p6
+from bng_tpu.control.dhcpv6.protocol import (
+    DHCPv6Message,
+    DUID,
+    IAAddress,
+    IANA,
+    IAPD,
+    generate_duid_ll,
+)
+from bng_tpu.control.dhcpv6.server import (
+    AddressPool6,
+    DHCPv6Server,
+    DHCPv6ServerConfig,
+    PrefixPool6,
+)
+from bng_tpu.control.slaac import (
+    SLAACConfig,
+    SLAACServer,
+    PrefixConfig,
+    eui64_iid,
+    link_local,
+    stable_privacy_iid,
+    _icmp6_checksum,
+)
+
+CLIENT_MAC = b"\x02\xcc\x00\x00\x00\x42"
+CLIENT_DUID = generate_duid_ll(CLIENT_MAC).encode()
+
+
+def mkserver(**kw):
+    cfg = DHCPv6ServerConfig(
+        dns_servers=[bytes.fromhex("20010db8000000000000000000000053")],
+        domain_list=["isp.example"], **kw)
+    return DHCPv6Server(
+        cfg,
+        address_pool=AddressPool6("2001:db8:100::/64", 3600, 7200),
+        prefix_pool=PrefixPool6("2001:db8:f000::/40", delegated_len=56),
+        clock=lambda: 1000.0,
+    )
+
+
+def solicit(iaid=1, pd=False, rapid=False):
+    m = DHCPv6Message(p6.SOLICIT, 0x123456)
+    m.add(p6.OPT_CLIENTID, CLIENT_DUID)
+    m.add_ia_na(IANA(iaid))
+    if pd:
+        m.add_ia_pd(IAPD(iaid))
+    if rapid:
+        m.add(p6.OPT_RAPID_COMMIT, b"")
+    return m
+
+
+class TestCodec:
+    def test_message_roundtrip(self):
+        m = solicit(pd=True)
+        back = DHCPv6Message.decode(m.encode())
+        assert back.msg_type == p6.SOLICIT
+        assert back.transaction_id == 0x123456
+        assert back.client_duid == CLIENT_DUID
+        assert len(back.ia_nas()) == 1 and len(back.ia_pds()) == 1
+
+    def test_iana_roundtrip(self):
+        ia = IANA(7, 100, 200)
+        ia.addresses.append(IAAddress(b"\x20\x01" + b"\x00" * 14, 300, 400))
+        back = IANA.decode(ia.encode())
+        assert back.iaid == 7 and back.t1 == 100 and back.t2 == 200
+        assert back.addresses[0].preferred == 300
+        assert back.addresses[0].valid == 400
+
+    def test_duid_ll(self):
+        d = generate_duid_ll(CLIENT_MAC)
+        assert d.duid_type == p6.DUID_LL
+        back = DUID.decode(d.encode())
+        assert back.data == struct.pack(">H", 1) + CLIENT_MAC
+
+    def test_truncated_rejected(self):
+        with pytest.raises(ValueError):
+            DHCPv6Message.decode(b"\x01\x02")
+        srv = mkserver()
+        assert srv.handle_message(b"\x01") is None
+
+
+class TestServer:
+    def test_solicit_advertise_request_reply(self):
+        srv = mkserver()
+        adv_raw = srv.handle_message(solicit(pd=True).encode())
+        adv = DHCPv6Message.decode(adv_raw)
+        assert adv.msg_type == p6.ADVERTISE
+        assert adv.server_duid == srv.duid.encode()
+        ia = adv.ia_nas()[0]
+        addr = ia.addresses[0].address
+        assert addr.startswith(bytes.fromhex("20010db80100"))
+        pd = adv.ia_pds()[0]
+        assert pd.prefixes[0].prefix_len == 56
+        # advertise does not commit
+        assert len(srv.leases) == 0
+
+        req = DHCPv6Message(p6.REQUEST, 0x654321)
+        req.add(p6.OPT_CLIENTID, CLIENT_DUID)
+        req.add(p6.OPT_SERVERID, srv.duid.encode())
+        req.add_ia_na(IANA(1))
+        req.add_ia_pd(IAPD(1))
+        rep = DHCPv6Message.decode(srv.handle_message(req.encode()))
+        assert rep.msg_type == p6.REPLY
+        assert len(srv.leases) == 2
+        assert rep.ia_nas()[0].t1 == 3600  # 0.5 * valid
+        assert rep.ia_nas()[0].t2 == 5760  # 0.8 * valid
+        # dns + domain options present
+        assert rep.get(p6.OPT_DNS_SERVERS) is not None
+        assert b"isp" in rep.get(p6.OPT_DOMAIN_LIST)
+
+    def test_rapid_commit(self):
+        srv = mkserver()
+        rep = DHCPv6Message.decode(srv.handle_message(solicit(rapid=True).encode()))
+        assert rep.msg_type == p6.REPLY
+        assert rep.get(p6.OPT_RAPID_COMMIT) is not None
+        assert len(srv.leases) == 1
+
+    def test_renew_extends_rebind_recreates(self):
+        srv = mkserver()
+        srv.handle_message(solicit(rapid=True).encode())
+        lease = next(iter(srv.leases.values()))
+        addr0 = lease.address
+
+        renew = DHCPv6Message(p6.RENEW, 1)
+        renew.add(p6.OPT_CLIENTID, CLIENT_DUID)
+        renew.add(p6.OPT_SERVERID, srv.duid.encode())
+        renew.add_ia_na(IANA(1))
+        rep = DHCPv6Message.decode(srv.handle_message(renew.encode()))
+        assert rep.ia_nas()[0].addresses[0].address == addr0
+
+        # renew for unknown IAID -> NoBinding
+        renew2 = DHCPv6Message(p6.RENEW, 2)
+        renew2.add(p6.OPT_CLIENTID, CLIENT_DUID)
+        renew2.add(p6.OPT_SERVERID, srv.duid.encode())
+        renew2.add_ia_na(IANA(99))
+        rep2 = DHCPv6Message.decode(srv.handle_message(renew2.encode()))
+        assert rep2.ia_nas()[0].status[0] == p6.STATUS_NO_BINDING
+
+        # rebind for unknown IAID recreates
+        rebind = DHCPv6Message(p6.REBIND, 3)
+        rebind.add(p6.OPT_CLIENTID, CLIENT_DUID)
+        rebind.add_ia_na(IANA(99))
+        rep3 = DHCPv6Message.decode(srv.handle_message(rebind.encode()))
+        assert rep3.ia_nas()[0].status is None
+        assert len(rep3.ia_nas()[0].addresses) == 1
+
+    def test_release_returns_to_pool(self):
+        srv = mkserver()
+        srv.handle_message(solicit(rapid=True).encode())
+        addr = next(iter(srv.leases.values())).address
+        rel = DHCPv6Message(p6.RELEASE, 5)
+        rel.add(p6.OPT_CLIENTID, CLIENT_DUID)
+        rel.add(p6.OPT_SERVERID, srv.duid.encode())
+        rel.add_ia_na(IANA(1))
+        rep = DHCPv6Message.decode(srv.handle_message(rel.encode()))
+        assert rep.msg_type == p6.REPLY
+        assert len(srv.leases) == 0
+        # the address is reusable
+        assert srv.addr_pool.allocate() == addr
+
+    def test_decline_quarantines(self):
+        srv = mkserver()
+        srv.handle_message(solicit(rapid=True).encode())
+        addr = next(iter(srv.leases.values())).address
+        dec = DHCPv6Message(p6.DECLINE, 6)
+        dec.add(p6.OPT_CLIENTID, CLIENT_DUID)
+        dec.add(p6.OPT_SERVERID, srv.duid.encode())
+        dec.add_ia_na(IANA(1))
+        srv.handle_message(dec.encode())
+        assert len(srv.leases) == 0
+        # declined address is NOT handed out again
+        assert srv.addr_pool.allocate() != addr
+
+    def test_confirm_on_link(self):
+        srv = mkserver()
+        conf = DHCPv6Message(p6.CONFIRM, 7)
+        conf.add(p6.OPT_CLIENTID, CLIENT_DUID)
+        ia = IANA(1)
+        ia.addresses.append(IAAddress(
+            int(srv.addr_pool.net.network_address + 5).to_bytes(16, "big")))
+        conf.add_ia_na(ia)
+        rep = DHCPv6Message.decode(srv.handle_message(conf.encode()))
+        code = struct.unpack(">H", rep.get(p6.OPT_STATUS_CODE)[:2])[0]
+        assert code == p6.STATUS_SUCCESS
+
+        conf2 = DHCPv6Message(p6.CONFIRM, 8)
+        conf2.add(p6.OPT_CLIENTID, CLIENT_DUID)
+        ia2 = IANA(1)
+        ia2.addresses.append(IAAddress(bytes.fromhex("20010db8deadbeef") + b"\x00" * 8))
+        conf2.add_ia_na(ia2)
+        rep2 = DHCPv6Message.decode(srv.handle_message(conf2.encode()))
+        code2 = struct.unpack(">H", rep2.get(p6.OPT_STATUS_CODE)[:2])[0]
+        assert code2 == p6.STATUS_NOT_ON_LINK
+
+    def test_info_request(self):
+        srv = mkserver()
+        m = DHCPv6Message(p6.INFORMATION_REQUEST, 9)
+        rep = DHCPv6Message.decode(srv.handle_message(m.encode()))
+        assert rep.msg_type == p6.REPLY
+        assert rep.get(p6.OPT_DNS_SERVERS) is not None
+        assert len(rep.ia_nas()) == 0
+
+    def test_pd_prefixes_distinct(self):
+        srv = mkserver()
+        seen = set()
+        for i in range(4):
+            duid = generate_duid_ll(bytes([2, 0, 0, 0, 0, i])).encode()
+            m = DHCPv6Message(p6.REQUEST, i)
+            m.add(p6.OPT_CLIENTID, duid)
+            m.add(p6.OPT_SERVERID, srv.duid.encode())
+            m.add_ia_pd(IAPD(1))
+            rep = DHCPv6Message.decode(srv.handle_message(m.encode()))
+            pfx = rep.ia_pds()[0].prefixes[0]
+            assert pfx.prefix_len == 56
+            seen.add(pfx.prefix)
+        assert len(seen) == 4
+
+    def test_pool_exhaustion_status(self):
+        srv = DHCPv6Server(DHCPv6ServerConfig(),
+                           address_pool=AddressPool6("2001:db8::/126"),
+                           clock=lambda: 0.0)
+        codes = []
+        for i in range(5):
+            duid = generate_duid_ll(bytes([2, 0, 0, 0, 1, i])).encode()
+            m = DHCPv6Message(p6.REQUEST, i)
+            m.add(p6.OPT_CLIENTID, duid)
+            m.add(p6.OPT_SERVERID, srv.duid.encode())
+            m.add_ia_na(IANA(1))
+            rep = DHCPv6Message.decode(srv.handle_message(m.encode()))
+            ia = rep.ia_nas()[0]
+            codes.append(ia.status[0] if ia.status else None)
+        assert p6.STATUS_NO_ADDRS_AVAIL in codes
+        assert codes.count(None) >= 1  # some succeeded
+
+    def test_expiry_cleanup(self):
+        t = [1000.0]
+        srv = DHCPv6Server(DHCPv6ServerConfig(),
+                           address_pool=AddressPool6("2001:db8::/64", 10, 20),
+                           clock=lambda: t[0])
+        srv.handle_message(solicit(rapid=True).encode())
+        assert len(srv.leases) == 1
+        t[0] = 1021.0
+        assert srv.cleanup_expired() == 1
+        assert len(srv.leases) == 0
+
+
+class TestSLAAC:
+    def mkserver(self, **kw):
+        return SLAACServer(SLAACConfig(
+            prefixes=[PrefixConfig(prefix=bytes.fromhex("20010db801000000") + b"\x00" * 8)],
+            rdnss=[bytes.fromhex("20010db8000000000000000000000053")],
+            dnssl=["isp.example"],
+            mtu=1500, **kw))
+
+    def test_eui64(self):
+        iid = eui64_iid(CLIENT_MAC)
+        assert iid == bytes([0x02 ^ 0x02, 0xCC, 0x00, 0xFF, 0xFE, 0x00, 0x00, 0x42])
+        ll = link_local(CLIENT_MAC)
+        assert ll[:2] == b"\xfe\x80" and ll[8:] == iid
+
+    def test_stable_privacy_deterministic(self):
+        p = bytes.fromhex("20010db801000000") + b"\x00" * 8
+        a = stable_privacy_iid(p, CLIENT_MAC, b"secret")
+        b = stable_privacy_iid(p, CLIENT_MAC, b"secret")
+        c = stable_privacy_iid(p, CLIENT_MAC, b"other")
+        assert a == b and a != c
+        assert not a[0] & 0x02  # universal/local bit cleared
+
+    def test_ra_frame_structure(self):
+        srv = self.mkserver()
+        f = srv.build_ra_frame()
+        assert f[12:14] == b"\x86\xdd"  # IPv6
+        assert f[20] == 58  # ICMPv6
+        assert f[21] == 255  # hop limit
+        icmp = f[54:]
+        assert icmp[0] == 134  # RA
+        # checksum verifies
+        src, dst = f[22:38], f[38:54]
+        body = bytearray(icmp)
+        body[2:4] = b"\x00\x00"
+        expect = _icmp6_checksum(src, dst, bytes(body))
+        got = struct.unpack(">H", icmp[2:4])[0]
+        assert got == expect
+        # prefix option present with A+L flags
+        assert b"\x03\x04\x40\xc0" in icmp
+        # MTU option
+        assert struct.pack(">BBHI", 5, 1, 0, 1500) in icmp
+        # RDNSS
+        assert bytes([25]) in icmp
+
+    def test_managed_flag(self):
+        srv = self.mkserver(managed=True, other_config=True)
+        ra = srv.build_ra()
+        assert ra[5] & 0x80 and ra[5] & 0x40
+
+    def test_rs_answered(self):
+        srv = self.mkserver()
+        client_ll = link_local(CLIENT_MAC)
+        rs = bytearray(54 + 8)
+        rs[0:6] = b"\x33\x33\x00\x00\x00\x02"
+        rs[6:12] = CLIENT_MAC
+        rs[12:14] = b"\x86\xdd"
+        rs[14] = 0x60
+        rs[20] = 58
+        rs[22:38] = client_ll
+        rs[54] = 133  # RS
+        out = srv.handle_frame(bytes(rs))
+        assert out is not None
+        assert out[0:6] == CLIENT_MAC  # unicast reply
+        assert out[38:54] == client_ll
+        assert srv.stats.rs_received == 1
+
+    def test_periodic_tick(self):
+        srv = self.mkserver()
+        assert len(srv.tick(100.0)) == 1
+        assert len(srv.tick(150.0)) == 0
+        assert len(srv.tick(301.0)) == 1
+        assert srv.stats.periodic == 2
+
+    def test_non_rs_ignored(self):
+        srv = self.mkserver()
+        assert srv.handle_frame(b"\x00" * 80) is None
+        assert srv.handle_frame(b"short") is None
+
+
+def test_request_for_other_server_discarded():
+    srv = mkserver()
+    other = generate_duid_ll(b"\x02\xee\x00\x00\x00\x99").encode()
+    req = DHCPv6Message(p6.REQUEST, 1)
+    req.add(p6.OPT_CLIENTID, CLIENT_DUID)
+    req.add(p6.OPT_SERVERID, other)
+    req.add_ia_na(IANA(1))
+    assert srv.handle_message(req.encode()) is None
+    assert len(srv.leases) == 0
+
+
+def test_rebind_keeps_presented_address_after_state_loss():
+    srv = mkserver()
+    # client holds 2001:db8:100::77 from before a server restart
+    addr = (int(srv.addr_pool.net.network_address) + 0x77).to_bytes(16, "big")
+    rebind = DHCPv6Message(p6.REBIND, 2)
+    rebind.add(p6.OPT_CLIENTID, CLIENT_DUID)
+    ia = IANA(1)
+    ia.addresses.append(IAAddress(addr, 100, 200))
+    rebind.add_ia_na(ia)
+    rep = DHCPv6Message.decode(srv.handle_message(rebind.encode()))
+    got = rep.ia_nas()[0].addresses[0].address
+    assert got == addr  # NOT renumbered
+    assert len(srv.leases) == 1
+
+
+def test_dnssl_option_length():
+    import struct as _s
+
+    srv = SLAACServer(SLAACConfig(dnssl=["isp.example"]))
+    ra = srv.build_ra()
+    i = ra.find(bytes([31]))  # DNSSL type
+    assert i > 0
+    length_units = ra[i + 1]
+    body = ra[i + 8:]
+    # encoded domain: 3isp7example0 = 13 bytes -> padded to 16
+    assert length_units == 1 + 16 // 8  # == 3 (RFC 6106)
