@@ -1,0 +1,381 @@
+"""Tests for walled garden, QinQ, WiFi gateway, and DNS resolver."""
+
+import pytest
+
+from bng_tpu.control.dns import (
+    CLASS_IN, DNSConfig, InterceptAction, InterceptRule, Query, RCODE_NAME_ERROR,
+    RCODE_REFUSED, RCODE_SUCCESS, RCODE_SERVER_FAILURE, Record, Resolver,
+    Response, TYPE_A, TYPE_AAAA, TYPE_CNAME, cache_key, dns64_synthesize,
+)
+from bng_tpu.control.qinq import QinQConfig, QinQMapper, VLANPair, VLANRange
+from bng_tpu.control.walledgarden import (
+    SubscriberState, WalledGardenConfig, WalledGardenManager,
+)
+from bng_tpu.control.wifi import (
+    OperatingMode, WiFiGatewayManager, WiFiSessionState,
+    default_olt_bng_config, default_wifi_config,
+)
+
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+# ---------------------------------------------------------------- QinQ
+
+class TestQinQ:
+    def test_pair_classification(self):
+        assert VLANPair(100, 200).is_double_tagged
+        assert VLANPair(0, 200).is_single_tagged
+        assert VLANPair().is_untagged
+        assert str(VLANPair(100, 200)) == "100.200"
+        assert str(VLANPair(0, 200)) == "200"
+
+    def test_key_packing_matches_device_layout(self):
+        assert VLANPair(0x0064, 0x00C8).key() == 0x006400C8
+
+    def test_register_lookup_roundtrip(self):
+        m = QinQMapper()
+        m.register(VLANPair(100, 200), "sub-1")
+        assert m.get_subscriber(VLANPair(100, 200)) == "sub-1"
+        assert m.get_vlan("sub-1") == VLANPair(100, 200)
+
+    def test_conflicting_registration_rejected(self):
+        m = QinQMapper()
+        m.register(VLANPair(100, 200), "sub-1")
+        with pytest.raises(ValueError):
+            m.register(VLANPair(100, 200), "sub-2")
+
+    def test_reregister_moves_subscriber(self):
+        m = QinQMapper()
+        m.register(VLANPair(100, 200), "sub-1")
+        m.register(VLANPair(100, 201), "sub-1")
+        assert m.get_subscriber(VLANPair(100, 200)) is None
+        assert m.get_vlan("sub-1") == VLANPair(100, 201)
+
+    def test_range_enforcement(self):
+        cfg = QinQConfig(s_tag_range=VLANRange(100, 199))
+        m = QinQMapper(cfg)
+        with pytest.raises(ValueError):
+            m.register(VLANPair(500, 10), "sub-x")
+
+    def test_unregister_subscriber(self):
+        m = QinQMapper()
+        m.register(VLANPair(7, 8), "s")
+        m.unregister_subscriber("s")
+        assert m.get_subscriber(VLANPair(7, 8)) is None
+        assert m.stats()["total_mappings"] == 0
+
+    def test_invalid_vid_rejected(self):
+        with pytest.raises(ValueError):
+            VLANPair(5000, 0)
+
+    def test_stag_only_rejected(self):
+        m = QinQMapper(QinQConfig(s_tag_range=VLANRange(100, 199),
+                                  allow_single_tagged=False))
+        with pytest.raises(ValueError):
+            m.register(VLANPair(300, 0), "sub-x")
+
+
+# -------------------------------------------------------- Walled garden
+
+class TestWalledGarden:
+    def test_unknown_mac_defaults_to_garden(self):
+        m = WalledGardenManager()
+        assert m.get_subscriber_state("02:00:00:00:00:01") == SubscriberState.UNKNOWN
+        assert m.should_redirect("02:00:00:00:00:01", "93.184.216.34", 80)
+
+    def test_provisioned_bypasses(self):
+        m = WalledGardenManager()
+        m.release_from_walled_garden("02:00:00:00:00:01")
+        assert not m.should_redirect("02:00:00:00:00:01", "93.184.216.34", 80)
+
+    def test_dns_always_allowed(self):
+        m = WalledGardenManager()
+        m.add_to_walled_garden("02:00:00:00:00:01")
+        assert not m.should_redirect("02:00:00:00:00:01", "8.8.8.8", 53, proto=17)
+
+    def test_portal_always_allowed(self):
+        m = WalledGardenManager()
+        cfg = m.config
+        m.add_to_walled_garden("02:00:00:00:00:01")
+        assert not m.should_redirect("02:00:00:00:00:01", cfg.portal_ip,
+                                     cfg.portal_port, proto=6)
+
+    def test_expiry_reverts_to_unknown(self):
+        clk = FakeClock()
+        m = WalledGardenManager(clock=clk)
+        m.add_to_walled_garden("02:00:00:00:00:01", vlan_id=100)
+        assert m.get_subscriber_state("02:00:00:00:00:01") == SubscriberState.WALLED_GARDEN
+        clk.advance(m.config.default_timeout + 1)
+        assert m.check_expired() == 1
+        assert m.get_subscriber_state("02:00:00:00:00:01") == SubscriberState.UNKNOWN
+
+    def test_provisioned_never_expires(self):
+        clk = FakeClock()
+        m = WalledGardenManager(clock=clk)
+        m.release_from_walled_garden("02:00:00:00:00:01")
+        clk.advance(1e6)
+        assert m.check_expired() == 0
+        assert m.get_subscriber_state("02:00:00:00:00:01") == SubscriberState.PROVISIONED
+
+    def test_redirect_callback_and_stats(self):
+        m = WalledGardenManager()
+        hits = []
+        m.on_redirect(lambda mac, ip: hits.append((mac, ip)))
+        m.add_to_walled_garden("02:00:00:00:00:01")
+        m.should_redirect("02:00:00:00:00:01", "1.2.3.4", 443)
+        assert hits == [("02:00:00:00:00:01", "1.2.3.4")]
+        assert m.stats()["redirects"] == 1
+        assert m.stats()["WALLED_GARDEN"] == 1
+
+    def test_partial_wildcard_destinations(self):
+        from bng_tpu.control.walledgarden import AllowedDestination
+        cfg = WalledGardenConfig(allowed_destinations=[
+            AllowedDestination("1.2.3.4", 443, 0),   # any proto
+            AllowedDestination("5.6.7.8", 0, 6),     # any TCP port
+        ])
+        m = WalledGardenManager(cfg)
+        m.add_to_walled_garden("02:00:00:00:00:09")
+        assert not m.should_redirect("02:00:00:00:00:09", "1.2.3.4", 443, proto=6)
+        assert not m.should_redirect("02:00:00:00:00:09", "1.2.3.4", 443, proto=17)
+        assert not m.should_redirect("02:00:00:00:00:09", "5.6.7.8", 8080, proto=6)
+        assert m.should_redirect("02:00:00:00:00:09", "5.6.7.8", 8080, proto=17)
+
+    def test_blocked_state(self):
+        m = WalledGardenManager()
+        m.block_mac("02:00:00:00:00:02")
+        assert m.get_subscriber_state("02:00:00:00:00:02") == SubscriberState.BLOCKED
+        assert m.should_redirect("02:00:00:00:00:02", "1.2.3.4", 80)
+
+
+# ----------------------------------------------------------------- WiFi
+
+class TestWiFiGateway:
+    def test_mode_defaults(self):
+        wifi = default_wifi_config()
+        olt = default_olt_bng_config()
+        assert wifi.allocation_trigger == "dhcp_discover"
+        assert olt.allocation_trigger == "radius_auth"
+        assert wifi.captive_portal_enabled and not olt.captive_portal_enabled
+        assert olt.mode == OperatingMode.OLT_BNG
+
+    def test_session_starts_in_grace_period(self):
+        m = WiFiGatewayManager()
+        s = m.create_session("02:aa:bb:cc:dd:01", hostname="phone", ip="10.1.0.5")
+        assert s.state == WiFiSessionState.GRACE_PERIOD
+        assert m.is_in_grace_period("02:aa:bb:cc:dd:01")
+        assert m.needs_authentication("02:aa:bb:cc:dd:01")
+
+    def test_portal_auth_flow(self):
+        m = WiFiGatewayManager()
+        m.create_session("02:aa:bb:cc:dd:01")
+        m.authenticate_session("02:aa:bb:cc:dd:01", "portal", "user@example.com")
+        s = m.get_session("02:aa:bb:cc:dd:01")
+        assert s.authenticated and s.state == WiFiSessionState.AUTHENTICATED
+        assert not m.needs_authentication("02:aa:bb:cc:dd:01")
+        m.update_traffic_stats("02:aa:bb:cc:dd:01", 100, 200, 1, 2)
+        assert m.get_session("02:aa:bb:cc:dd:01").state == WiFiSessionState.ACTIVE
+
+    def test_olt_mode_skips_portal(self):
+        m = WiFiGatewayManager(default_olt_bng_config())
+        s = m.create_session("02:aa:bb:cc:dd:02")
+        assert s.state == WiFiSessionState.ACTIVE and s.authenticated
+        assert not m.needs_authentication("02:aa:bb:cc:dd:02")
+
+    def test_grace_period_timeout_expires_session(self):
+        clk = FakeClock()
+        m = WiFiGatewayManager(clock=clk)
+        m.create_session("02:aa:bb:cc:dd:03")
+        clk.advance(m.config.grace_period + 1)
+        assert m.expire_sessions() == 1
+        assert m.get_session("02:aa:bb:cc:dd:03") is None
+
+    def test_renewal_extends_lease(self):
+        clk = FakeClock()
+        m = WiFiGatewayManager(clock=clk)
+        m.create_session("02:aa:bb:cc:dd:04")
+        m.authenticate_session("02:aa:bb:cc:dd:04", "portal", "u")
+        clk.advance(m.config.lease_duration - 1)
+        m.renew_session("02:aa:bb:cc:dd:04")
+        clk.advance(m.config.lease_duration - 1)
+        assert m.expire_sessions() == 0
+
+    def test_recreate_updates_ip_index(self):
+        m = WiFiGatewayManager()
+        m.create_session("02:aa:bb:cc:dd:07")  # DISCOVER, no IP yet
+        m.create_session("02:aa:bb:cc:dd:07", ip="10.1.0.7", hostname="tv")
+        s = m.get_session_by_ip("10.1.0.7")
+        assert s is not None and s.hostname == "tv"
+
+    def test_olt_mode_authenticated_survives_lease_expiry(self):
+        clk = FakeClock()
+        m = WiFiGatewayManager(default_olt_bng_config(), clock=clk)
+        m.create_session("02:aa:bb:cc:dd:08")
+        clk.advance(m.config.lease_duration + 1)
+        assert m.expire_sessions() == 0  # session-termination mode: RADIUS tears down
+        assert m.get_session("02:aa:bb:cc:dd:08") is not None
+
+    def test_by_ip_index(self):
+        m = WiFiGatewayManager()
+        m.create_session("02:aa:bb:cc:dd:05", ip="10.1.0.9")
+        assert m.get_session_by_ip("10.1.0.9").mac == "02:aa:bb:cc:dd:05"
+        m.release_session("02:aa:bb:cc:dd:05")
+        assert m.get_session_by_ip("10.1.0.9") is None
+
+    def test_stats(self):
+        m = WiFiGatewayManager()
+        m.create_session("02:aa:bb:cc:dd:06")
+        m.authenticate_session("02:aa:bb:cc:dd:06", "portal", "u")
+        m.update_traffic_stats("02:aa:bb:cc:dd:06", 10, 20, 1, 1)
+        st = m.stats()
+        assert st["active_sessions"] == 1
+        assert st["authenticated_sessions"] == 1
+        assert st["total_bytes_in"] == 10
+
+
+# ------------------------------------------------------------------ DNS
+
+def _static_forwarder(table):
+    def fwd(query):
+        key = (query.name.rstrip("."), query.qtype)
+        if key in table:
+            return Response(query=query, answers=table[key])
+        return Response(query=query, rcode=RCODE_NAME_ERROR)
+    return fwd
+
+
+class TestDNSResolver:
+    def _resolver(self, table=None, clock=None, **cfg):
+        config = DNSConfig(**cfg)
+        fwd = _static_forwarder(table or {})
+        return Resolver(config, forwarder=fwd, clock=clock or FakeClock())
+
+    def test_forward_and_cache(self):
+        clk = FakeClock()
+        table = {("example.com", TYPE_A):
+                 [Record(name="example.com", rtype=TYPE_A, ttl=120, ipv4="93.184.216.34")]}
+        r = self._resolver(table, clock=clk)
+        resp = r.resolve(Query(name="example.com", source="10.0.0.5"))
+        assert resp.rcode == RCODE_SUCCESS and not resp.cached
+        resp2 = r.resolve(Query(name="example.com", source="10.0.0.5"))
+        assert resp2.cached and resp2.answers[0].ipv4 == "93.184.216.34"
+        assert r.stats()["cache_hits"] == 1
+
+    def test_ttl_clamping(self):
+        clk = FakeClock()
+        table = {("example.com", TYPE_A):
+                 [Record(name="example.com", rtype=TYPE_A, ttl=1, ipv4="1.2.3.4")]}
+        r = self._resolver(table, clock=clk, min_ttl=60)
+        r.resolve(Query(name="example.com"))
+        clk.advance(30)  # raw TTL of 1 would have expired; clamp keeps it
+        assert r.resolve(Query(name="example.com")).cached
+
+    def test_negative_cache(self):
+        clk = FakeClock()
+        r = self._resolver({}, clock=clk)
+        assert r.resolve(Query(name="nope.invalid")).rcode == RCODE_NAME_ERROR
+        resp = r.resolve(Query(name="nope.invalid"))
+        assert resp.rcode == RCODE_NAME_ERROR and resp.cached
+
+    def test_block_rule(self):
+        r = self._resolver()
+        r.add_intercept_rule(InterceptRule(domain="ads.example.com",
+                                           action=InterceptAction.BLOCK))
+        resp = r.resolve(Query(name="tracker.ads.example.com"))
+        assert resp.rcode == RCODE_NAME_ERROR
+        assert r.stats()["intercepted"] == 1
+
+    def test_redirect_rule(self):
+        r = self._resolver()
+        r.add_intercept_rule(InterceptRule(domain="portal.isp.net",
+                                           action=InterceptAction.REDIRECT,
+                                           redirect_ip="10.0.0.80"))
+        resp = r.resolve(Query(name="portal.isp.net"))
+        assert resp.answers[0].ipv4 == "10.0.0.80"
+
+    def test_cname_rule(self):
+        r = self._resolver()
+        r.add_intercept_rule(InterceptRule(domain="old.example.com", exact=True,
+                                           action=InterceptAction.CNAME,
+                                           cname="new.example.com"))
+        resp = r.resolve(Query(name="old.example.com"))
+        assert resp.answers[0].rtype == TYPE_CNAME
+        assert resp.answers[0].target == "new.example.com"
+        # exact match must not catch subdomains
+        assert r.resolve(Query(name="x.old.example.com")).rcode == RCODE_NAME_ERROR
+
+    def test_suffix_rule(self):
+        r = self._resolver()
+        r.add_intercept_rule(InterceptRule(domain_suffix=".evil.com",
+                                           action=InterceptAction.BLOCK))
+        assert r.resolve(Query(name="www.evil.com")).rcode == RCODE_NAME_ERROR
+
+    def test_walled_garden_client_redirected(self):
+        table = {("example.com", TYPE_A):
+                 [Record(name="example.com", rtype=TYPE_A, ttl=60, ipv4="93.184.216.34")]}
+        r = self._resolver(table)
+        r.add_walled_garden_client("10.0.0.99")
+        resp = r.resolve(Query(name="example.com", source="10.0.0.99"))
+        assert resp.answers[0].ipv4 == r.config.walled_garden_redirect_ip
+        # other clients unaffected
+        resp2 = r.resolve(Query(name="example.com", source="10.0.0.5"))
+        assert resp2.answers[0].ipv4 == "93.184.216.34"
+        # release
+        assert r.remove_walled_garden_client("10.0.0.99")
+        resp3 = r.resolve(Query(name="example.com", source="10.0.0.99"))
+        assert resp3.answers[0].ipv4 == "93.184.216.34"
+
+    def test_dns64_synthesis(self):
+        # v4-only domain: AAAA returns NOERROR-empty, A has a record
+        def fwd(q):
+            if q.qtype == TYPE_A and q.name.rstrip(".") == "v4only.example":
+                return Response(query=q, answers=[Record(
+                    name="v4only.example", rtype=TYPE_A, ttl=60, ipv4="192.0.2.33")])
+            return Response(query=q, rcode=RCODE_SUCCESS)
+        r = Resolver(DNSConfig(dns64_enabled=True), forwarder=fwd, clock=FakeClock())
+        resp = r.resolve(Query(name="v4only.example", qtype=TYPE_AAAA))
+        assert resp.answers[0].rtype == TYPE_AAAA
+        assert resp.answers[0].ipv6 == "64:ff9b::c000:221"
+
+    def test_dns64_not_applied_on_nxdomain(self):
+        # RFC 6147: synthesize only on NOERROR-empty, never mask NXDOMAIN
+        r = self._resolver({}, dns64_enabled=True)
+        resp = r.resolve(Query(name="gone.example", qtype=TYPE_AAAA))
+        assert resp.rcode == RCODE_NAME_ERROR and not resp.answers
+
+    def test_dns64_helper(self):
+        assert dns64_synthesize("64:ff9b::", "192.0.2.33") == "64:ff9b::c000:221"
+
+    def test_rate_limit(self):
+        clk = FakeClock()
+        r = self._resolver({}, clock=clk, rate_limit_qps=1, rate_limit_burst=2)
+        q = lambda: r.resolve(Query(name="x.test", source="10.9.9.9")).rcode
+        assert q() != RCODE_REFUSED
+        assert q() != RCODE_REFUSED
+        assert q() == RCODE_REFUSED  # burst exhausted
+        clk.advance(2.0)
+        assert q() != RCODE_REFUSED  # refilled
+        assert r.stats()["rate_limited"] >= 1
+
+    def test_no_forwarder_is_servfail(self):
+        r = Resolver(DNSConfig(), forwarder=None)
+        assert r.resolve(Query(name="a.b")).rcode == RCODE_SERVER_FAILURE
+
+    def test_cache_lru_eviction(self):
+        clk = FakeClock()
+        table = {(f"h{i}.test", TYPE_A):
+                 [Record(name=f"h{i}.test", rtype=TYPE_A, ttl=600, ipv4=f"10.0.0.{i}")]
+                 for i in range(5)}
+        r = self._resolver(table, clock=clk, cache_size=3)
+        for i in range(5):
+            r.resolve(Query(name=f"h{i}.test"))
+        assert r.cache.size() == 3
+        assert r.cache.stats()["evictions"] == 2
