@@ -1,0 +1,363 @@
+"""Prometheus-compatible metrics registry with bng_* name parity.
+
+Parity: pkg/metrics — Metrics struct with ~30 bng_* families
+(metrics.go:16-380, names at :92-280), Collect polling fast-path stats +
+pool stats + DHCP server counters every interval (metrics.go:555-623),
+StartCollector (:625), /metrics HTTP endpoint (cmd/bng/main.go:1219-1241).
+
+Implemented without the prometheus client library: a small registry
+producing the text exposition format (v0.0.4), which Prometheus scrapes
+identically. Counter/Gauge/Histogram support labels.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import OrderedDict
+
+
+def _fmt_labels(labels: dict) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(f'{k}="{v}"' for k, v in labels.items())
+    return "{" + inner + "}"
+
+
+def _fmt_value(v: float) -> str:
+    if v == float("inf"):
+        return "+Inf"
+    if isinstance(v, float) and v.is_integer():
+        return str(int(v))
+    return repr(v) if isinstance(v, float) else str(v)
+
+
+class _Metric:
+    kind = "untyped"
+
+    def __init__(self, name: str, help_text: str, label_names: tuple = ()):
+        self.name = name
+        self.help = help_text
+        self.label_names = tuple(label_names)
+        self._lock = threading.Lock()
+        self._children: OrderedDict[tuple, float] = OrderedDict()
+
+    def _key(self, labels: dict) -> tuple:
+        if set(labels) != set(self.label_names):
+            raise ValueError(f"{self.name}: expected labels {self.label_names}, "
+                             f"got {tuple(labels)}")
+        return tuple(labels[n] for n in self.label_names)
+
+    def collect(self) -> list[str]:
+        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} {self.kind}"]
+        with self._lock:
+            if not self._children and not self.label_names:
+                out.append(f"{self.name} 0")
+            for key, val in self._children.items():
+                labels = dict(zip(self.label_names, key))
+                out.append(f"{self.name}{_fmt_labels(labels)} {_fmt_value(val)}")
+        return out
+
+
+class Counter(_Metric):
+    kind = "counter"
+
+    def inc(self, value: float = 1.0, **labels) -> None:
+        if value < 0:
+            raise ValueError("counters only go up")
+        key = self._key(labels)
+        with self._lock:
+            self._children[key] = self._children.get(key, 0.0) + value
+
+    def set_total(self, value: float, **labels) -> None:
+        """Absolute set for counters mirrored from device stats arrays
+        (the reference overwrites from the eBPF stats map the same way)."""
+        key = self._key(labels)
+        with self._lock:
+            self._children[key] = max(self._children.get(key, 0.0), float(value))
+
+    def value(self, **labels) -> float:
+        with self._lock:
+            return self._children.get(self._key(labels), 0.0)
+
+
+class Gauge(_Metric):
+    kind = "gauge"
+
+    def set(self, value: float, **labels) -> None:
+        with self._lock:
+            self._children[self._key(labels)] = float(value)
+
+    def inc(self, value: float = 1.0, **labels) -> None:
+        key = self._key(labels)
+        with self._lock:
+            self._children[key] = self._children.get(key, 0.0) + value
+
+    def dec(self, value: float = 1.0, **labels) -> None:
+        self.inc(-value, **labels)
+
+    def value(self, **labels) -> float:
+        with self._lock:
+            return self._children.get(self._key(labels), 0.0)
+
+
+class Histogram:
+    kind = "histogram"
+    DEFAULT_BUCKETS = (1e-5, 5e-5, 1e-4, 5e-4, 1e-3, 5e-3, 1e-2, 5e-2,
+                       1e-1, 5e-1, 1.0, float("inf"))
+
+    def __init__(self, name: str, help_text: str, label_names: tuple = (),
+                 buckets: tuple = DEFAULT_BUCKETS):
+        self.name = name
+        self.help = help_text
+        self.label_names = tuple(label_names)
+        self.buckets = tuple(sorted(buckets))
+        if self.buckets[-1] != float("inf"):
+            self.buckets = self.buckets + (float("inf"),)
+        self._lock = threading.Lock()
+        self._counts: dict[tuple, list[int]] = {}
+        self._sums: dict[tuple, float] = {}
+
+    def _key(self, labels: dict) -> tuple:
+        if set(labels) != set(self.label_names):
+            raise ValueError(f"{self.name}: bad labels {tuple(labels)}")
+        return tuple(labels[n] for n in self.label_names)
+
+    def observe(self, value: float, **labels) -> None:
+        key = self._key(labels)
+        with self._lock:
+            counts = self._counts.setdefault(key, [0] * len(self.buckets))
+            for i, ub in enumerate(self.buckets):
+                if value <= ub:
+                    counts[i] += 1
+            self._sums[key] = self._sums.get(key, 0.0) + value
+
+    def collect(self) -> list[str]:
+        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} histogram"]
+        with self._lock:
+            for key, counts in self._counts.items():
+                labels = dict(zip(self.label_names, key))
+                for ub, c in zip(self.buckets, counts):
+                    ls = dict(labels, le=_fmt_value(ub))
+                    out.append(f"{self.name}_bucket{_fmt_labels(ls)} {c}")
+                out.append(f"{self.name}_sum{_fmt_labels(labels)} "
+                           f"{self._sums[key]}")
+                out.append(f"{self.name}_count{_fmt_labels(labels)} "
+                           f"{counts[-1]}")
+        return out
+
+
+class Registry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics: OrderedDict[str, object] = OrderedDict()
+
+    def register(self, metric) -> object:
+        with self._lock:
+            if metric.name in self._metrics:
+                raise ValueError(f"duplicate metric {metric.name}")
+            self._metrics[metric.name] = metric
+        return metric
+
+    def counter(self, name, help_text, labels=()):
+        return self.register(Counter(name, help_text, labels))
+
+    def gauge(self, name, help_text, labels=()):
+        return self.register(Gauge(name, help_text, labels))
+
+    def histogram(self, name, help_text, labels=(), buckets=Histogram.DEFAULT_BUCKETS):
+        return self.register(Histogram(name, help_text, labels, buckets))
+
+    def expose(self) -> str:
+        """Text exposition format, scrape-ready."""
+        lines: list[str] = []
+        with self._lock:
+            metrics = list(self._metrics.values())
+        for m in metrics:
+            lines.extend(m.collect())
+        return "\n".join(lines) + "\n"
+
+
+# Device stats array indexes (mirrors runtime.engine stat layouts; the
+# reference reads the same counters from the dhcp_stats map,
+# bpf/maps.h:171-191).
+DHCP_STAT_NAMES = ("packets_seen", "fastpath_hits", "fastpath_misses",
+                   "offers_sent", "acks_sent", "errors", "expired",
+                   "non_dhcp", "malformed", "punted")
+
+
+class BNGMetrics:
+    """All bng_* families (metrics.go:16-380) + the 5s collector loop."""
+
+    def __init__(self, registry: Registry | None = None):
+        r = self.registry = registry or Registry()
+        lbl_type = ("type",)
+        self.dhcp_requests_total = r.counter(
+            "bng_dhcp_requests_total", "DHCP requests processed", lbl_type)
+        self.dhcp_request_duration = r.histogram(
+            "bng_dhcp_request_duration_seconds", "DHCP handling latency", ("path",))
+        self.dhcp_cache_hit_rate = r.gauge(
+            "bng_dhcp_cache_hit_rate", "Fast-path cache hit rate")
+        self.dhcp_active_leases = r.gauge(
+            "bng_dhcp_active_leases", "Active DHCP leases")
+        self.ebpf_fastpath_hits = r.counter(
+            "bng_ebpf_fastpath_hits_total", "Device fast-path hits")
+        self.ebpf_fastpath_misses = r.counter(
+            "bng_ebpf_fastpath_misses_total", "Device fast-path misses")
+        self.ebpf_errors = r.counter(
+            "bng_ebpf_errors_total", "Device pipeline errors")
+        self.ebpf_cache_expired = r.counter(
+            "bng_ebpf_cache_expired_total", "Expired fast-path entries")
+        self.ebpf_map_entries = r.gauge(
+            "bng_ebpf_map_entries", "Entries per device table", ("map",))
+        self.pool_utilization = r.gauge(
+            "bng_pool_utilization_ratio", "Pool utilization 0-1", ("pool",))
+        self.pool_available = r.gauge(
+            "bng_pool_available_ips", "Available IPs", ("pool",))
+        self.pool_allocated = r.gauge(
+            "bng_pool_allocated_ips", "Allocated IPs", ("pool",))
+        self.circuit_id_collisions = r.counter(
+            "bng_circuit_id_hash_collisions_total", "Circuit-ID hash collisions")
+        self.circuit_id_collision_rate = r.gauge(
+            "bng_circuit_id_collision_rate", "Circuit-ID collision rate")
+        self.session_active = r.gauge(
+            "bng_session_active", "Active sessions", lbl_type)
+        self.session_total = r.counter(
+            "bng_session_total", "Sessions created", lbl_type)
+        self.session_bytes_in = r.counter(
+            "bng_session_bytes_in_total", "Subscriber bytes in")
+        self.session_bytes_out = r.counter(
+            "bng_session_bytes_out_total", "Subscriber bytes out")
+        self.nat_bindings_active = r.gauge(
+            "bng_nat_bindings_active", "Active NAT bindings")
+        self.nat_translations_total = r.counter(
+            "bng_nat_translations_total", "NAT translations", ("direction",))
+        self.nat_ports_used = r.gauge(
+            "bng_nat_ports_used", "NAT ports in use", ("public_ip",))
+        self.radius_requests_total = r.counter(
+            "bng_radius_requests_total", "RADIUS requests", ("type", "status"))
+        self.radius_timeouts_total = r.counter(
+            "bng_radius_timeouts_total", "RADIUS timeouts")
+        self.qos_policies_active = r.gauge(
+            "bng_qos_policies_active", "Active QoS policies")
+        self.qos_packets_dropped = r.counter(
+            "bng_qos_packets_dropped_total", "QoS-dropped packets")
+        self.qos_bytes_dropped = r.counter(
+            "bng_qos_bytes_dropped_total", "QoS-dropped bytes")
+        self.pppoe_sessions_active = r.gauge(
+            "bng_pppoe_sessions_active", "Active PPPoE sessions")
+        self.pppoe_negotiations_total = r.counter(
+            "bng_pppoe_negotiations_total", "PPPoE negotiations", ("result",))
+        self.routes_active = r.gauge(
+            "bng_routes_active", "Installed routes", ("isp",))
+        self.bgp_peers_up = r.gauge(
+            "bng_bgp_peers_up", "Established BGP peers")
+        self.bgp_prefixes_received = r.gauge(
+            "bng_bgp_prefixes_received", "Prefixes from peers", ("peer",))
+        self.subscriber_total = r.gauge(
+            "bng_subscriber_total", "Known subscribers")
+        self.subscriber_by_class = r.gauge(
+            "bng_subscriber_by_class", "Subscribers per class", ("class",))
+        self.subscriber_by_isp = r.gauge(
+            "bng_subscriber_by_isp", "Subscribers per ISP", ("isp",))
+
+    # -- collection (metrics.go:555-623) -------------------------------
+
+    def collect_engine(self, engine_stats) -> None:
+        """Pull device-side counters from runtime.engine.EngineStats."""
+        d = engine_stats.dhcp
+        names = DHCP_STAT_NAMES[: len(d)]
+        vals = {n: int(v) for n, v in zip(names, d)}
+        hits = vals.get("fastpath_hits", 0)
+        misses = vals.get("fastpath_misses", 0)
+        self.ebpf_fastpath_hits.set_total(hits)
+        self.ebpf_fastpath_misses.set_total(misses)
+        self.ebpf_errors.set_total(vals.get("errors", 0) + vals.get("malformed", 0))
+        self.ebpf_cache_expired.set_total(vals.get("expired", 0))
+        total = hits + misses
+        if total:
+            self.dhcp_cache_hit_rate.set(hits / total)
+
+    def collect_pools(self, pool_stats: dict) -> None:
+        """pool_stats: {pool_name: {"size": N, "allocated": M}}."""
+        for name, st in pool_stats.items():
+            size = st.get("size", 0)
+            alloc = st.get("allocated", 0)
+            self.pool_allocated.set(alloc, pool=name)
+            self.pool_available.set(size - alloc, pool=name)
+            if size:
+                self.pool_utilization.set(alloc / size, pool=name)
+
+    def collect_dhcp_server(self, server_stats) -> None:
+        for msg in ("discover", "offer", "request", "ack", "nak", "release"):
+            v = getattr(server_stats, msg, None)
+            if v is not None:
+                self.dhcp_requests_total.set_total(v, type=msg)
+
+    def expose(self) -> str:
+        return self.registry.expose()
+
+
+class MetricsCollector:
+    """Background collector loop (metrics.go:625) + HTTP /metrics server."""
+
+    def __init__(self, metrics: BNGMetrics, interval: float = 5.0):
+        self.metrics = metrics
+        self.interval = interval
+        self._sources: list = []  # callables () -> None that update metrics
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._httpd = None
+
+    def add_source(self, fn) -> None:
+        self._sources.append(fn)
+
+    def collect_once(self) -> None:
+        for fn in self._sources:
+            try:
+                fn()
+            except Exception:
+                pass
+
+    def start(self) -> None:
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval):
+            self.collect_once()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=2)
+        if self._httpd:
+            self._httpd.shutdown()
+
+    def serve_http(self, port: int = 9090, host: str = "127.0.0.1") -> int:
+        """Expose /metrics; returns the bound port (0 picks a free one)."""
+        import http.server
+
+        metrics = self.metrics
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802
+                if self.path != "/metrics":
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                body = metrics.expose().encode()
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "text/plain; version=0.0.4; charset=utf-8")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):
+                pass
+
+        self._httpd = http.server.ThreadingHTTPServer((host, port), Handler)
+        threading.Thread(target=self._httpd.serve_forever, daemon=True).start()
+        return self._httpd.server_address[1]

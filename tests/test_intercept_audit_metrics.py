@@ -1,0 +1,352 @@
+"""Tests for lawful intercept, audit pipeline, and metrics registry."""
+
+import pytest
+
+from bng_tpu.control.audit import (
+    AuditLogger, AuditQuery, Event, EventType, IPFIXAuditExporter,
+    JSONAuditExporter, LegalHold, MemoryStorage, RetentionManager,
+    RotatingFileExporter, Severity, SyslogAuditExporter, event_category,
+    standard_retention_policies,
+)
+from bng_tpu.control.intercept import (
+    DeliveryMethod, Direction, ETSIExporter, IRIEventType, InterceptManager,
+    JSONExporter, SyslogExporter, Warrant, WarrantStatus, WarrantType,
+    parse_etsi_pdu,
+)
+from bng_tpu.control.metrics import BNGMetrics, MetricsCollector, Registry
+
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def _warrant(clk, **kw):
+    base = dict(id="w1", liid="LIID-001", target_subscriber_id="sub-1",
+                valid_from=clk.t - 10, valid_until=clk.t + 3600,
+                delivery_method=DeliveryMethod.ETSI)
+    base.update(kw)
+    return Warrant(**base)
+
+
+# ------------------------------------------------------------ intercept
+
+class TestInterceptManager:
+    def test_warrant_validation(self):
+        m = InterceptManager()
+        with pytest.raises(ValueError):
+            m.add_warrant(Warrant(id="w", liid="L"))  # no target
+        with pytest.raises(ValueError):
+            m.add_warrant(Warrant(id="w", liid="L", target_mac="02:00:00:00:00:01",
+                                  valid_from=100, valid_until=50))
+
+    def test_match_by_each_identifier(self):
+        clk = FakeClock()
+        m = InterceptManager(clock=clk)
+        m.add_warrant(_warrant(clk))
+        m.add_warrant(_warrant(clk, id="w2", liid="LIID-002",
+                               target_subscriber_id="",
+                               target_mac="02:AA:BB:CC:DD:01"))
+        m.add_warrant(_warrant(clk, id="w3", liid="LIID-003",
+                               target_subscriber_id="", target_ipv4="10.0.0.5"))
+        assert [w.id for w in m.match_session(subscriber_id="sub-1")] == ["w1"]
+        assert [w.id for w in m.match_session(mac="02:aa:bb:cc:dd:01")] == ["w2"]
+        assert [w.id for w in m.match_session(ipv4="10.0.0.5")] == ["w3"]
+        # one session matching several warrants
+        hits = m.match_session(subscriber_id="sub-1", ipv4="10.0.0.5")
+        assert {w.id for w in hits} == {"w1", "w3"}
+
+    def test_expired_warrant_does_not_match(self):
+        clk = FakeClock()
+        m = InterceptManager(clock=clk)
+        m.add_warrant(_warrant(clk))
+        clk.advance(7200)
+        assert m.match_session(subscriber_id="sub-1") == []
+        assert m.expire_warrants() == 1
+        assert m.get_warrant("w1").status == WarrantStatus.EXPIRED
+
+    def test_suspended_warrant_does_not_match(self):
+        clk = FakeClock()
+        m = InterceptManager(clock=clk)
+        m.add_warrant(_warrant(clk))
+        m.update_warrant_status("w1", WarrantStatus.SUSPENDED)
+        assert m.match_session(subscriber_id="sub-1") == []
+
+    def test_iri_cc_pipeline_with_etsi_export(self):
+        clk = FakeClock()
+        pdus = []
+        m = InterceptManager(clock=clk)
+        m.add_exporter(DeliveryMethod.ETSI, ETSIExporter(pdus.append, "GB"))
+        w = _warrant(clk)
+        m.add_warrant(w)
+        s = m.start_intercept_session(w, "sess-1", subscriber_id="sub-1",
+                                      mac="02:aa:bb:cc:dd:01", ipv4="10.0.0.5")
+        assert m.record_cc(w, s, Direction.UPSTREAM, "10.0.0.5", "93.184.216.34",
+                           40000, 443, 6, b"\x16\x03\x01")
+        m.stop_intercept_session("sess-1")
+
+        assert len(pdus) == 3  # IRI start, CC, IRI stop
+        start = parse_etsi_pdu(pdus[0])
+        assert start["handover"] == ETSIExporter.HI2
+        assert start["liid"] == "LIID-001" and start["seq"] == 0
+        assert start["iri"]["event_type"] == IRIEventType.SESSION_START.value
+        cc = parse_etsi_pdu(pdus[1])
+        assert cc["handover"] == ETSIExporter.HI3 and cc["seq"] == 1
+        assert cc["source_ip"] == "10.0.0.5" and cc["dest_port"] == 443
+        assert cc["payload"] == b"\x16\x03\x01"
+        stop = parse_etsi_pdu(pdus[2])
+        assert stop["iri"]["event_type"] == IRIEventType.SESSION_STOP.value
+        assert w.bytes_intercepted == 3
+
+    def test_cc_filters(self):
+        clk = FakeClock()
+        m = InterceptManager(clock=clk)
+        w = _warrant(clk, filter_dest_ports=[443], filter_protocols=[6])
+        m.add_warrant(w)
+        s = m.start_intercept_session(w, "sess-1", subscriber_id="sub-1")
+        assert m.record_cc(w, s, Direction.UPSTREAM, "10.0.0.5", "1.2.3.4",
+                           1111, 443, 6, b"x")
+        assert not m.record_cc(w, s, Direction.UPSTREAM, "10.0.0.5", "1.2.3.4",
+                               1111, 80, 6, b"x")
+        assert not m.record_cc(w, s, Direction.UPSTREAM, "10.0.0.5", "1.2.3.4",
+                               1111, 443, 17, b"x")
+        assert m.stats()["filtered"] == 2
+
+    def test_remove_warrant_drops_sessions(self):
+        clk = FakeClock()
+        m = InterceptManager(clock=clk)
+        w = _warrant(clk)
+        m.add_warrant(w)
+        m.start_intercept_session(w, "sess-1")
+        m.remove_warrant("w1")
+        assert m.get_session("sess-1") is None
+        assert m.list_warrants() == []
+
+    def test_json_and_syslog_exporters(self):
+        clk = FakeClock()
+        out_json, out_syslog = [], []
+        m = InterceptManager(clock=clk)
+        m.add_exporter(DeliveryMethod.JSON_HTTPS, JSONExporter(out_json.append))
+        m.add_exporter(DeliveryMethod.SYSLOG, SyslogExporter(out_syslog.append))
+        wj = _warrant(clk, delivery_method=DeliveryMethod.JSON_HTTPS)
+        m.add_warrant(wj)
+        ws = _warrant(clk, id="w2", liid="LIID-002",
+                      delivery_method=DeliveryMethod.SYSLOG)
+        m.add_warrant(ws)
+        sj = m.start_intercept_session(wj, "sess-j", subscriber_id="sub-1")
+        m.start_intercept_session(ws, "sess-s", subscriber_id="sub-1")
+        m.record_cc(wj, sj, Direction.DOWNSTREAM, "1.2.3.4", "10.0.0.5",
+                    443, 40000, 6, b"abc")
+        import json as _json
+        lines = [_json.loads(x) for x in out_json]
+        assert lines[0]["record_type"] == "IRI"
+        assert lines[1]["record_type"] == "CC" and lines[1]["payload_hex"] == "616263"
+        assert b"LIID-002" in out_syslog[0]
+        # syslog CC delivery is refused -> export_errors counted
+        ss = m.get_session("sess-s")
+        m.record_cc(ws, ss, Direction.UPSTREAM, "a", "b", 1, 2, 6, b"x")
+        assert m.stats()["export_errors"] == 1
+
+
+# ---------------------------------------------------------------- audit
+
+class TestAudit:
+    def test_severity_filter_and_storage(self):
+        clk = FakeClock()
+        log = AuditLogger(min_severity=Severity.INFO, clock=clk, async_mode=False)
+        log.log(EventType.SESSION_START, subscriber_id="s1", mac="02:00:00:00:00:01")
+        log.log(EventType.SYSTEM_ERROR, Severity.DEBUG)  # filtered out
+        assert log.storage.count() == 1
+        assert log.stats["filtered"] == 1
+
+    def test_query(self):
+        clk = FakeClock()
+        log = AuditLogger(clock=clk, async_mode=False)
+        log.log(EventType.AUTH_SUCCESS, subscriber_id="s1", username="alice")
+        clk.advance(100)
+        log.log(EventType.AUTH_FAILURE, Severity.WARNING, subscriber_id="s2")
+        got = log.storage.query(AuditQuery(event_types=[EventType.AUTH_FAILURE]))
+        assert len(got) == 1 and got[0].subscriber_id == "s2"
+        got = log.storage.query(AuditQuery(start_time=1050.0))
+        assert len(got) == 1
+        got = log.storage.query(AuditQuery(min_severity=Severity.WARNING))
+        assert len(got) == 1
+
+    def test_async_worker_drains(self):
+        log = AuditLogger(async_mode=True)
+        log.start()
+        for _ in range(50):
+            log.log(EventType.DHCP_ACK, ip="10.0.0.1")
+        log.stop()
+        assert log.storage.count() == 50
+
+    def test_helper_entry_points(self):
+        log = AuditLogger(async_mode=False)
+        log.log_auth(False, username="bob")
+        log.log_suspicious("dhcp_starvation", 80, mac="02:00:00:00:00:09")
+        log.log_nat_mapping(ip="100.64.0.5", nat_public_ip="203.0.113.1",
+                            nat_public_port=4096, protocol=6)
+        evs = log.storage.query(AuditQuery())
+        assert evs[0].event_type == EventType.AUTH_FAILURE
+        assert evs[1].details["threat_type"] == "dhcp_starvation"
+        assert evs[2].category == "nat"
+
+    def test_event_category(self):
+        assert event_category(EventType.DHCP_ACK) == "dhcp"
+        assert event_category(EventType.WALLED_GARDEN_ADD) == "walledgarden"
+        assert event_category(EventType.BRUTE_FORCE_DETECTED) == "security"
+        assert event_category(EventType.API_RATE_LIMITED) == "api"
+
+    def test_syslog_exporter_format(self):
+        lines = []
+        log = AuditLogger(async_mode=False, clock=FakeClock(1700000000.0))
+        log.add_exporter(SyslogAuditExporter(lines.append))
+        log.log(EventType.SESSION_START, subscriber_id="s1", message="up")
+        text = lines[0].decode()
+        assert text.startswith("<") and 'type="SESSION_START"' in text
+        assert 'subscriber="s1"' in text and text.endswith("up")
+
+    def test_ipfix_exporter_binary_record(self):
+        from bng_tpu.utils.net import fnv1a32
+        recs = []
+        log = AuditLogger(async_mode=False, clock=FakeClock(1700000000.0))
+        log.add_exporter(IPFIXAuditExporter(recs.append))
+        log.log(EventType.SESSION_START)  # not a NAT event -> skipped
+        log.log_nat_mapping(ip="100.64.0.5", nat_private_port=5555,
+                            nat_public_ip="203.0.113.1", nat_public_port=4096,
+                            protocol=6, subscriber_id="s1")
+        assert len(recs) == 1 and len(recs[0]) == IPFIXAuditExporter.RECORD.size
+        ts, priv, pport, pub, pubport, proto, ev, subhash, _ = \
+            IPFIXAuditExporter.RECORD.unpack(recs[0])
+        assert ts == 1700000000000 and pport == 5555 and pubport == 4096
+        assert proto == 6 and ev == 1 and subhash == fnv1a32(b"s1")
+
+    def test_rotating_file_exporter(self, tmp_path):
+        path = str(tmp_path / "audit.log")
+        exp = RotatingFileExporter(path, max_bytes=200, max_files=2)
+        log = AuditLogger(async_mode=False)
+        log.add_exporter(exp)
+        for i in range(20):
+            log.log(EventType.CONFIG_CHANGE, message=f"change {i}")
+        exp.close()
+        files = list(tmp_path.iterdir())
+        gz = [f for f in files if f.suffix == ".gz"]
+        assert gz, "rotation should gzip old files"
+        assert len(gz) <= 2, "retention should cap rotated files"
+
+    def test_retention_with_legal_hold(self):
+        clk = FakeClock(1_000_000_000.0)
+        storage = MemoryStorage()
+        log = AuditLogger(storage=storage, clock=clk, async_mode=False)
+        log.log(EventType.DHCP_ACK, subscriber_id="keep-me")
+        log.log(EventType.DHCP_ACK, subscriber_id="drop-me")
+        rm = RetentionManager(clock=clk)
+        rm.add_legal_hold(LegalHold(id="h1", subscriber_id="keep-me"))
+        clk.advance(91 * 86400)  # dhcp retention is 90 days
+        dropped = rm.enforce(storage)
+        assert dropped == 1
+        left = storage.query(AuditQuery())
+        assert len(left) == 1 and left[0].subscriber_id == "keep-me"
+
+    def test_expired_hold_releases_events(self):
+        clk = FakeClock(1_000_000_000.0)
+        rm = RetentionManager(clock=clk)
+        rm.add_legal_hold(LegalHold(id="h1", subscriber_id="s",
+                                    expires_at=clk.t + 10))
+        e = Event(event_type=EventType.DHCP_ACK, subscriber_id="s",
+                  timestamp=clk.t)
+        assert rm.is_under_legal_hold(e)
+        clk.advance(11)
+        assert not rm.is_under_legal_hold(e)
+        assert rm.cleanup_expired_holds() == 1
+
+    def test_standard_policies(self):
+        p = standard_retention_policies()
+        assert p["nat"] == 365 and p["admin"] == 730 and p["system"] == 30
+
+
+# -------------------------------------------------------------- metrics
+
+class TestMetrics:
+    def test_counter_gauge_exposition(self):
+        r = Registry()
+        c = r.counter("bng_test_total", "test counter", ("type",))
+        g = r.gauge("bng_test_gauge", "test gauge")
+        c.inc(type="a")
+        c.inc(2, type="b")
+        g.set(7)
+        text = r.expose()
+        assert 'bng_test_total{type="a"} 1' in text
+        assert 'bng_test_total{type="b"} 2' in text
+        assert "bng_test_gauge 7" in text
+        assert "# TYPE bng_test_total counter" in text
+
+    def test_histogram(self):
+        r = Registry()
+        h = r.histogram("bng_lat_seconds", "latency", buckets=(0.001, 0.01, 0.1))
+        h.observe(0.005)
+        h.observe(0.05)
+        text = r.expose()
+        assert 'bng_lat_seconds_bucket{le="0.01"} 1' in text
+        assert 'bng_lat_seconds_bucket{le="+Inf"} 2' in text
+        assert "bng_lat_seconds_count 2" in text
+
+    def test_duplicate_name_rejected(self):
+        r = Registry()
+        r.counter("x_total", "x")
+        with pytest.raises(ValueError):
+            r.counter("x_total", "x")
+
+    def test_bng_families_name_parity(self):
+        m = BNGMetrics()
+        text = m.expose()
+        for name in ("bng_dhcp_requests_total", "bng_dhcp_cache_hit_rate",
+                     "bng_ebpf_fastpath_hits_total", "bng_ebpf_fastpath_misses_total",
+                     "bng_pool_utilization_ratio", "bng_session_active",
+                     "bng_nat_bindings_active", "bng_radius_requests_total",
+                     "bng_qos_policies_active", "bng_pppoe_sessions_active",
+                     "bng_bgp_peers_up", "bng_circuit_id_hash_collisions_total"):
+            assert name in text, name
+
+    def test_collect_engine_stats(self):
+        import numpy as np
+        from bng_tpu.runtime.engine import EngineStats
+        m = BNGMetrics()
+        st = EngineStats()
+        st.dhcp = np.array([100, 80, 20, 75, 5, 1, 2, 0, 1, 20], dtype=np.uint64)
+        m.collect_engine(st)
+        assert m.ebpf_fastpath_hits.value() == 80
+        assert m.dhcp_cache_hit_rate.value() == 0.8
+
+    def test_collect_pools(self):
+        m = BNGMetrics()
+        m.collect_pools({"res-a": {"size": 100, "allocated": 25}})
+        assert m.pool_utilization.value(pool="res-a") == 0.25
+        assert m.pool_available.value(pool="res-a") == 75
+
+    def test_http_endpoint(self):
+        import urllib.request
+        m = BNGMetrics()
+        col = MetricsCollector(m, interval=60)
+        port = col.serve_http(port=0)
+        try:
+            body = urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=5).read().decode()
+            assert "bng_dhcp_requests_total" in body
+            assert urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=5).status == 200
+        finally:
+            col.stop()
+
+    def test_collector_sources(self):
+        m = BNGMetrics()
+        col = MetricsCollector(m, interval=60)
+        col.add_source(lambda: m.subscriber_total.set(42))
+        col.collect_once()
+        assert m.subscriber_total.value() == 42
