@@ -1,0 +1,404 @@
+"""Tests for deviceauth, ztp, agent, pon, direct (L5 provisioning layer)."""
+
+import os
+import subprocess
+import time
+
+import pytest
+
+from bng_tpu.control.agent import Agent, AgentConfig, AgentState
+from bng_tpu.control.deviceauth import (
+    AuthMode, DeviceIdentity, MTLSAuthenticator, NoneAuthenticator,
+    PSKAuthenticator, AuthenticatedTransport, cert_fingerprint, cert_not_after,
+    generate_device_id, new_authenticator, read_device_identity, sanitize_id,
+)
+from bng_tpu.control.direct import (
+    BindingEvent, DirectAuthenticator, DirectConfig, ONTMapping, StubBSSClient,
+)
+from bng_tpu.control.nexus import (
+    NexusClient, NTEEntity, SubscriberEntity, VLANAllocator,
+)
+from bng_tpu.control.pon import (
+    DiscoveryEvent, NTEState, PONConfig, PONManager,
+)
+from bng_tpu.control.subscriber import SessionKind, SubscriberManager
+from bng_tpu.control.ztp import (
+    BootstrapClient, BootstrapConfig, BootstrapPending, build_vendor_option,
+    discover_from_lease, extract_nexus_url, parse_vendor_options,
+)
+
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+# ----------------------------------------------------------- deviceauth
+
+class TestDeviceAuth:
+    def test_sanitize_and_device_id(self):
+        assert sanitize_id("AB/CD 12!") == "ab-cd-12-"
+        assert generate_device_id("SN123", "") == "dev-sn123"
+        assert generate_device_id("", "02:aa:bb:cc:dd:01") == "dev-02aabbccdd01"
+        assert generate_device_id("", "").startswith("dev-")
+
+    def test_read_identity_from_fake_sysfs(self, tmp_path):
+        dmi = tmp_path / "sys/class/dmi/id"
+        dmi.mkdir(parents=True)
+        (dmi / "product_serial").write_text("SER-42\n")
+        (dmi / "product_name").write_text("edge-box\n")
+        net = tmp_path / "sys/class/net/eth0"
+        net.mkdir(parents=True)
+        (net / "address").write_text("02:aa:bb:cc:dd:ee\n")
+        ident = read_device_identity(str(tmp_path))
+        assert ident.serial == "SER-42"
+        assert ident.mac == "02:aa:bb:cc:dd:ee"
+        assert ident.model == "edge-box"
+        assert ident.device_id == "dev-ser-42"
+
+    def test_psk_sign_verify_roundtrip(self):
+        clk = FakeClock(1_700_000_000.0)
+        ident = DeviceIdentity(device_id="dev-a", serial="S1", mac="02:00:00:00:00:01")
+        client = PSKAuthenticator(psk="super-secret-key-16", identity=ident,
+                                  clock=clk)
+        server = PSKAuthenticator(psk="super-secret-key-16", clock=clk)
+        h = client.http_headers()
+        assert h["X-Device-ID"] == "dev-a" and h["X-Device-MAC"]
+        server.verify_signature(h["X-Device-ID"], h["X-Device-Timestamp"],
+                                h["X-Device-Signature"])
+
+    def test_psk_verify_rejects_skew_and_forgery(self):
+        clk = FakeClock(1_700_000_000.0)
+        client = PSKAuthenticator(psk="super-secret-key-16",
+                                  identity=DeviceIdentity(device_id="d"),
+                                  clock=clk)
+        h = client.http_headers()
+        clk.advance(600)  # beyond MaxTimestampSkew
+        with pytest.raises(ValueError, match="skew"):
+            client.verify_signature("d", h["X-Device-Timestamp"],
+                                    h["X-Device-Signature"])
+        clk.advance(-600)
+        with pytest.raises(ValueError, match="mismatch"):
+            client.verify_signature("d", h["X-Device-Timestamp"], "00" * 32)
+
+    def test_psk_rotation_and_minimum_length(self):
+        with pytest.raises(ValueError):
+            PSKAuthenticator(psk="short")
+        a = PSKAuthenticator(psk="super-secret-key-16")
+        sig_old = a.sign_message("m")
+        a.rotate_psk("another-secret-key-32chars")
+        assert a.sign_message("m") != sig_old
+        with pytest.raises(ValueError):
+            a.rotate_psk("short")
+
+    def test_none_authenticator_and_dispatch(self):
+        a = new_authenticator("none", identity=DeviceIdentity(device_id="x"))
+        assert isinstance(a, NoneAuthenticator)
+        assert a.authenticate().success and a.mode == AuthMode.NONE
+        assert a.http_headers()["X-Device-ID"] == "x"
+
+    def test_authenticated_transport_injects_headers(self):
+        seen = {}
+
+        def base(method, url, headers, body):
+            seen.update(headers)
+            return 200
+
+        t = AuthenticatedTransport(base, PSKAuthenticator(
+            psk="super-secret-key-16", identity=DeviceIdentity(device_id="d")))
+        assert t("GET", "http://nexus/api", {"Accept": "json"}) == 200
+        assert seen["X-Device-ID"] == "d" and "X-Device-Signature" in seen
+        assert seen["Accept"] == "json"
+
+
+@pytest.fixture(scope="module")
+def cert_pair(tmp_path_factory):
+    d = tmp_path_factory.mktemp("certs")
+    cert, key = str(d / "dev.crt"), str(d / "dev.key")
+    subprocess.run(
+        ["openssl", "req", "-x509", "-newkey", "ec", "-pkeyopt",
+         "ec_paramgen_curve:P-256", "-nodes", "-keyout", key, "-out", cert,
+         "-days", "30", "-subj", "/CN=device-001"],
+        check=True, capture_output=True, timeout=60)
+    return cert, key
+
+
+class TestMTLS:
+    def test_cert_parsing(self, cert_pair):
+        cert, _ = cert_pair
+        pem = open(cert).read()
+        not_after = cert_not_after(pem)
+        # ~30 days out
+        assert 25 * 86400 < not_after - time.time() < 35 * 86400
+        assert len(cert_fingerprint(pem)) == 64
+
+    def test_mtls_authenticator(self, cert_pair):
+        cert, key = cert_pair
+        a = MTLSAuthenticator(cert, key)
+        assert a.mode == AuthMode.MTLS
+        assert a.authenticate().success
+        assert not a.expires_within(86400)
+        assert a.expires_within(40 * 86400)
+        assert a.identity.device_id == "dev-device-001"
+        assert a.http_headers()["X-Device-Cert-Fingerprint"] == a.fingerprint
+
+    def test_rotation_reload(self, cert_pair, tmp_path):
+        cert, key = cert_pair
+        a = MTLSAuthenticator(cert, key)
+        assert not a.maybe_rotate()  # unchanged
+        os.utime(cert, (time.time() + 5, time.time() + 5))
+        assert a.maybe_rotate()
+
+    def test_tls_config_builds(self, cert_pair):
+        cert, key = cert_pair
+        ctx = MTLSAuthenticator(cert, key).tls_config()
+        import ssl
+        assert isinstance(ctx, ssl.SSLContext)
+
+
+# ------------------------------------------------------------------ ztp
+
+class TestZTP:
+    def test_option_224_priority(self):
+        opts = {224: b"https://nexus.isp.net",
+                43: build_vendor_option("https://other")}
+        assert extract_nexus_url(opts) == "https://nexus.isp.net"
+
+    def test_option_43_tlv(self):
+        data = bytes([9, 2, 0, 0]) + build_vendor_option("https://n")
+        assert parse_vendor_options(data) == "https://n"
+        assert parse_vendor_options(b"\x01\xff") == ""  # truncated
+
+    def test_discover_from_lease(self):
+        r = discover_from_lease(ip="10.0.0.9", gateway="10.0.0.1",
+                                options={43: build_vendor_option("https://n")})
+        assert r.nexus_url == "https://n" and r.ip == "10.0.0.9"
+
+    def test_bootstrap_pending_then_configured(self):
+        clk = FakeClock()
+        sleeps = []
+        responses = [
+            ConnectionError("down"),
+            {"status": "pending", "retry_after": 7},
+            {"status": "pending"},
+            {"status": "configured", "node_id": "bng-7", "site_id": "site-1",
+             "role": "edge"},
+        ]
+
+        def transport(req):
+            assert req.serial == "SER-1"
+            r = responses.pop(0)
+            if isinstance(r, Exception):
+                raise r
+            return r
+
+        c = BootstrapClient(
+            BootstrapConfig(initial_backoff=2.0), transport,
+            identity=DeviceIdentity(device_id="d", serial="SER-1",
+                                    mac="02:00:00:00:00:01"),
+            clock=clk, sleep=sleeps.append)
+        cfg = c.bootstrap()
+        assert cfg.node_id == "bng-7" and cfg.role == "edge"
+        assert sleeps == [2.0, 7, 2.0]  # net-error backoff, server hint, reset
+
+    def test_bootstrap_max_retries(self):
+        c = BootstrapClient(
+            BootstrapConfig(max_retries=2), lambda req: {"status": "pending"},
+            identity=DeviceIdentity(device_id="d", serial="S"),
+            clock=FakeClock(), sleep=lambda s: None)
+        with pytest.raises(TimeoutError):
+            c.bootstrap()
+
+
+# ---------------------------------------------------------------- agent
+
+class TestAgent:
+    def _nexus(self):
+        n = NexusClient()
+        n.subscribers.put("s1", SubscriberEntity(
+            id="s1", mac="02:aa:bb:cc:dd:01", isp_id="isp-a", nte_id="ONT1"))
+        n.subscribers.put("s2", SubscriberEntity(id="s2", isp_id="isp-b"))
+        n.ntes.put("ONT1", NTEEntity(id="ONT1", serial="ONT1"))
+        return n
+
+    def test_start_syncs_and_goes_online(self):
+        a = Agent(AgentConfig(device_id="dev-1"), self._nexus())
+        states = []
+        a.on_state_change = lambda old, new: states.append(new)
+        a.start()
+        assert a.state == AgentState.ONLINE
+        assert AgentState.SYNCING in states
+        assert a.subscriber_count() == 2
+        assert a.get_subscriber_by_mac("02:AA:BB:CC:DD:01").id == "s1"
+        assert a.get_subscriber_by_nte("ONT1").id == "s1"
+        assert a.nte_count() == 1
+
+    def test_watcher_keeps_cache_warm(self):
+        n = self._nexus()
+        a = Agent(AgentConfig(device_id="dev-1"), n)
+        a.start()
+        n.subscribers.put("s3", SubscriberEntity(id="s3", mac="02:00:00:00:00:03"))
+        assert a.get_subscriber("s3") is not None
+        n.subscribers.delete("s1")
+        assert a.get_subscriber("s1") is None
+        assert a.get_subscriber_by_mac("02:aa:bb:cc:dd:01") is None
+
+    def test_isp_churn_event(self):
+        n = self._nexus()
+        a = Agent(AgentConfig(device_id="dev-1"), n)
+        a.start()
+        churns = []
+        a.on_isp_churn = lambda sid, old, new: churns.append((sid, old, new))
+        n.subscribers.put("s1", SubscriberEntity(
+            id="s1", mac="02:aa:bb:cc:dd:01", isp_id="isp-z", nte_id="ONT1"))
+        assert churns == [("s1", "isp-a", "isp-z")]
+        assert a.subscriber_count_by_isp() == {"isp-z": 1, "isp-b": 1}
+
+    def test_heartbeat_and_degradation(self):
+        clk = FakeClock()
+        n = NexusClient(clock=clk)
+        from bng_tpu.control.nexus import DeviceEntity
+        n.devices.put("dev-1", DeviceEntity(id="dev-1", state="approved"))
+        a = Agent(AgentConfig(device_id="dev-1", degraded_after=60), n, clock=clk)
+        a.start()
+        assert a.heartbeat()
+        assert n.devices.get("dev-1").last_heartbeat == clk.t
+        clk.advance(120)
+        a.tick()
+        assert a.state == AgentState.DEGRADED
+        assert a.heartbeat()  # recovery
+        assert a.state == AgentState.ONLINE
+        assert a.health()["heartbeats"] == 2
+
+
+# ------------------------------------------------------------------ pon
+
+class TestPON:
+    def _mgr(self, require_approval=True):
+        n = NexusClient()
+        vlans = VLANAllocator(s_tag_range=(100, 200), c_tag_range=(1, 100))
+        m = PONManager(PONConfig(require_approval=require_approval), n, vlans)
+        return m, n
+
+    def test_unknown_ont_registers_pending(self):
+        m, n = self._mgr()
+        assert m.handle_discovery(DiscoveryEvent(serial="ONT-X")) is None
+        assert m.get_state("ONT-X") == NTEState.PENDING_APPROVAL
+        assert n.ntes.get("ONT-X").approved is False
+        assert len(m.list_pending()) == 1
+
+    def test_approval_triggers_provisioning(self):
+        m, n = self._mgr()
+        results = []
+        m.on_provisioned = results.append
+        m.handle_discovery(DiscoveryEvent(serial="ONT-X"))
+        nte = n.ntes.get("ONT-X")
+        nte.approved = True
+        n.ntes.put("ONT-X", nte)  # operator approves in Nexus
+        assert m.get_state("ONT-X") == NTEState.CONNECTED
+        assert results and results[0].success
+        assert results[0].s_tag and results[0].c_tag
+        assert n.ntes.get("ONT-X").state == "connected"
+        assert m.list_connected() == ["ONT-X"]
+
+    def test_preapproved_provisions_immediately(self):
+        m, n = self._mgr()
+        n.ntes.put("ONT-Y", NTEEntity(id="ONT-Y", serial="ONT-Y", approved=True,
+                                      s_tag=150, c_tag=7))
+        r = m.handle_discovery(DiscoveryEvent(serial="ONT-Y"))
+        assert r.success and (r.s_tag, r.c_tag) == (150, 7)
+
+    def test_no_approval_mode(self):
+        m, n = self._mgr(require_approval=False)
+        n.ntes.put("ONT-Z", NTEEntity(id="ONT-Z", serial="ONT-Z"))
+        assert m.handle_discovery(DiscoveryEvent(serial="ONT-Z")).success
+
+    def test_disconnect(self):
+        m, n = self._mgr(require_approval=False)
+        n.ntes.put("ONT-Z", NTEEntity(id="ONT-Z", serial="ONT-Z"))
+        m.handle_discovery(DiscoveryEvent(serial="ONT-Z"))
+        gone = []
+        m.on_disconnected = gone.append
+        m.handle_disconnect("ONT-Z")
+        assert m.get_state("ONT-Z") == NTEState.DISCONNECTED
+        assert n.ntes.get("ONT-Z").state == "disconnected"
+        assert gone == ["ONT-Z"]
+
+
+# ---------------------------------------------------------------- direct
+
+class TestDirectAuth:
+    def _nexus(self):
+        n = NexusClient()
+        n.subscribers.put("s1", SubscriberEntity(
+            id="s1", mac="02:aa:bb:cc:dd:01", circuit_id="olt1/1/1",
+            nte_id="ONT1", isp_id="isp-a", qos_policy="residential-100mbps"))
+        n.ntes.put("ONT1", NTEEntity(id="ONT1", serial="ONT1", s_tag=100, c_tag=5))
+        return n
+
+    def test_lookup_cascade_nexus(self):
+        clk = FakeClock()
+        auth = DirectAuthenticator(nexus=self._nexus(), clock=clk)
+        m = auth.lookup(circuit_id="olt1/1/1")
+        assert m.subscriber_id == "s1" and m.s_tag == 100
+        assert auth.stats["nexus_lookups"] == 1
+        # second hit comes from cache
+        assert auth.lookup(circuit_id="olt1/1/1").subscriber_id == "s1"
+        assert auth.stats["cache_hits"] == 1
+        # TTL expiry forces re-lookup
+        clk.advance(301)
+        auth.lookup(circuit_id="olt1/1/1")
+        assert auth.stats["nexus_lookups"] == 2
+
+    def test_bss_fallback_and_sync(self):
+        bss = StubBSSClient([ONTMapping(ont_serial="ONT9", circuit_id="c9",
+                                        subscriber_id="s9", isp_id="isp-b")])
+        auth = DirectAuthenticator(nexus=NexusClient(), bss=bss)
+        assert auth.lookup(serial="ONT9").subscriber_id == "s9"
+        assert auth.stats["bss_lookups"] == 1
+        assert auth.sync_from_bss() == 1
+
+    def test_subscriber_manager_integration(self):
+        auth = DirectAuthenticator(nexus=self._nexus())
+        mgr = SubscriberManager(authenticator=auth)
+        s = mgr.create_session(SessionKind.IPOE, mac="02:aa:bb:cc:dd:01",
+                               circuit_id="olt1/1/1")
+        assert mgr.authenticate(s.id)
+        assert s.subscriber_id == "s1"
+        assert s.attributes["qos_policy"] == "residential-100mbps"
+
+    def test_unknown_goes_to_walled_garden(self):
+        auth = DirectAuthenticator(nexus=NexusClient())
+        mgr = SubscriberManager(authenticator=auth)
+        s = mgr.create_session(SessionKind.IPOE, mac="02:00:00:00:00:99")
+        assert not mgr.authenticate(s.id)
+        assert s.walled
+
+    def test_binding_events_reported(self):
+        bss = StubBSSClient([ONTMapping(ont_serial="ONT9", subscriber_id="s9")])
+        auth = DirectAuthenticator(nexus=NexusClient(), bss=bss)
+        mgr = SubscriberManager(authenticator=auth)
+        s = mgr.create_session(SessionKind.IPOE, mac="02:00:00:00:00:01")
+        s.attributes["ont_serial"] = "ONT9"
+        mgr.authenticate(s.id)
+        kinds = [e.event_type for e in bss.events]
+        assert kinds == ["bind"]
+        # rejection also reported
+        s2 = mgr.create_session(SessionKind.IPOE, mac="02:00:00:00:00:02")
+        mgr.authenticate(s2.id)
+        assert [e.event_type for e in bss.events] == ["bind", "reject"]
+
+    def test_disabled_mapping_rejected(self):
+        bss = StubBSSClient([ONTMapping(ont_serial="ONT9", subscriber_id="s9",
+                                        enabled=False)])
+        auth = DirectAuthenticator(bss=bss)
+        mgr = SubscriberManager(authenticator=auth)
+        s = mgr.create_session(SessionKind.IPOE)
+        s.attributes["ont_serial"] = "ONT9"
+        assert not mgr.authenticate(s.id)
