@@ -1,0 +1,290 @@
+"""Tests for the routing package: platform, manager, BGP, BFD, sub routes."""
+
+import json
+
+import pytest
+
+from bng_tpu.control.routing import (
+    BFDManager, BFDState, BGPAnnouncement, BGPConfig, BGPController,
+    BGPNeighbor, BGPState, LinkState, NextHop, PolicyRule, Route,
+    RoutingConfig, RoutingManager, StubPlatform, SubscriberRoute,
+    SubscriberRouteConfig, SubscriberRouteManager, aggressive_bfd_config,
+    parse_bgp_state,
+)
+
+
+class RecordingFRR:
+    """Records commands; canned JSON per 'show' command."""
+
+    def __init__(self):
+        self.commands = []
+        self.responses = {}
+        self.fail_next = 0
+
+    def __call__(self, command):
+        if self.fail_next:
+            self.fail_next -= 1
+            raise RuntimeError("vtysh failed")
+        self.commands.append(command)
+        for key, resp in self.responses.items():
+            if command.startswith(key):
+                return resp
+        return ""
+
+    def all_text(self):
+        return "\n".join(self.commands)
+
+
+# ----------------------------------------------------------- platform
+
+class TestStubPlatform:
+    def test_route_crud(self):
+        p = StubPlatform()
+        r = Route(destination="10.0.0.0/24", gateway="192.168.1.1", table=100)
+        p.add_route(r)
+        assert p.get_routes(100) == [r]
+        with pytest.raises(FileExistsError):
+            p.add_route(r)
+        p.delete_route(r)
+        assert p.get_routes(100) == []
+        with pytest.raises(FileNotFoundError):
+            p.delete_route(r)
+
+    def test_rules_sorted_by_priority(self):
+        p = StubPlatform()
+        p.add_rule(PolicyRule(priority=200, table=2))
+        p.add_rule(PolicyRule(priority=100, table=1))
+        assert [r.priority for r in p.get_rules()] == [100, 200]
+
+    def test_ping(self):
+        p = StubPlatform()
+        p.reachable["8.8.8.8"] = 0.01
+        assert p.ping("8.8.8.8") == 0.01
+        with pytest.raises(TimeoutError):
+            p.ping("1.2.3.4")
+
+
+# ------------------------------------------------------------ manager
+
+class TestRoutingManager:
+    def test_isp_table_and_subscriber_steering(self):
+        m = RoutingManager()
+        m.add_upstream_table = None
+        m.create_isp_table("isp-a", 100, "192.168.1.1", "eth1")
+        assert m.platform.get_routes(100)[0].gateway == "192.168.1.1"
+        rule = m.route_subscriber_to_isp("10.5.0.9", 100)
+        assert rule.src == "10.5.0.9/32" and rule.table == 100
+        m.unroute_subscriber("10.5.0.9", 100)
+        assert m.platform.get_rules() == []
+
+    def test_upstream_add_creates_table(self):
+        from bng_tpu.control.routing import Upstream
+        m = RoutingManager()
+        m.add_upstream(Upstream(name="isp-a", interface="eth1",
+                                gateway="192.168.1.1", table=100))
+        assert m.platform.get_routes(100)
+        m.remove_upstream("isp-a")
+        assert m.platform.get_routes(100) == []
+
+    def test_ecmp_default_gateway(self):
+        m = RoutingManager()
+        m.set_default_gateway_ecmp([NextHop("192.168.1.1", "eth1"),
+                                    NextHop("192.168.2.1", "eth2")])
+        r = m.platform.get_routes(254)[0]
+        assert len(r.nexthops) == 2
+
+    def test_health_check_failover_and_recovery(self):
+        from bng_tpu.control.routing import Upstream
+        m = RoutingManager(RoutingConfig(failure_threshold=2))
+        events = []
+        m.on_upstream_down = lambda n: events.append(("down", n))
+        m.on_upstream_up = lambda n: events.append(("up", n))
+        m.add_upstream(Upstream(name="isp-a", health_target="1.1.1.1"))
+        m.platform.reachable["1.1.1.1"] = 0.005
+        m.check_health()
+        assert m.get_upstream("isp-a").state == LinkState.UP
+        del m.platform.reachable["1.1.1.1"]
+        m.check_health()  # 1st failure: still UP
+        assert m.get_upstream("isp-a").state == LinkState.UP
+        m.check_health()  # 2nd failure: DOWN
+        assert m.get_upstream("isp-a").state == LinkState.DOWN
+        m.platform.reachable["1.1.1.1"] = 0.005
+        m.check_health()
+        assert events == [("up", "isp-a"), ("down", "isp-a"), ("up", "isp-a")]
+        assert m.routing_stats()["failovers"] == 1
+
+
+# ---------------------------------------------------------------- BGP
+
+class TestBGP:
+    def test_add_neighbor_emits_frr_config(self):
+        frr = RecordingFRR()
+        b = BGPController(BGPConfig(local_as=65001), frr)
+        b.add_neighbor(BGPNeighbor(address="10.0.0.2", remote_as=65002,
+                                   description="upstream-a", bfd_enabled=True,
+                                   next_hop_self=True))
+        text = frr.all_text()
+        assert "router bgp 65001" in text
+        assert "neighbor 10.0.0.2 remote-as 65002" in text
+        assert "neighbor 10.0.0.2 bfd" in text
+        assert "neighbor 10.0.0.2 next-hop-self" in text
+        with pytest.raises(ValueError):
+            b.add_neighbor(BGPNeighbor(address="10.0.0.2", remote_as=1))
+
+    def test_announce_withdraw(self):
+        frr = RecordingFRR()
+        b = BGPController(BGPConfig(), frr)
+        b.announce_prefix("203.0.113.0/24")
+        assert "network 203.0.113.0/24" in frr.all_text()
+        assert len(b.list_announcements()) == 1
+        b.withdraw_prefix("203.0.113.0/24")
+        assert "no network 203.0.113.0/24" in frr.all_text()
+        assert b.list_announcements() == []
+        with pytest.raises(ValueError):
+            b.announce_prefix("not-a-prefix")
+
+    def test_refresh_fires_callbacks(self):
+        frr = RecordingFRR()
+        frr.responses["show bgp"] = json.dumps({
+            "peers": {"10.0.0.2": {"state": "Established", "pfxRcd": 42}}})
+        b = BGPController(BGPConfig(), frr)
+        ups, downs = [], []
+        b.on_neighbor_up = ups.append
+        b.on_neighbor_down = downs.append
+        b.add_neighbor(BGPNeighbor(address="10.0.0.2", remote_as=65002))
+        b.refresh_neighbors()
+        assert ups == ["10.0.0.2"]
+        n = b.get_neighbor("10.0.0.2")
+        assert n.state == BGPState.ESTABLISHED and n.prefixes_received == 42
+        frr.responses["show bgp"] = json.dumps({
+            "peers": {"10.0.0.2": {"state": "Active"}}})
+        b.refresh_neighbors()
+        assert downs == ["10.0.0.2"]
+        assert b.summary()["established"] == 0
+
+    def test_generate_config(self):
+        b = BGPController(BGPConfig(local_as=65001, router_id="10.0.0.1"),
+                          RecordingFRR())
+        b.add_neighbor(BGPNeighbor(address="10.0.0.2", remote_as=65002,
+                                   route_map_out="EXPORT"))
+        b.announce_prefix("203.0.113.0/24")
+        cfg = b.generate_config()
+        assert "router bgp 65001" in cfg
+        assert " bgp router-id 10.0.0.1" in cfg
+        assert "  network 203.0.113.0/24" in cfg
+        assert "  neighbor 10.0.0.2 route-map EXPORT out" in cfg
+
+    def test_route_map_and_max_paths(self):
+        frr = RecordingFRR()
+        b = BGPController(BGPConfig(), frr)
+        b.create_route_map("EXPORT", 10, "permit",
+                           match_clauses=["ip address prefix-list SUBS"],
+                           set_clauses=["community 65000:100"])
+        b.enable_max_paths(4)
+        text = frr.all_text()
+        assert "route-map EXPORT permit 10" in text
+        assert "set community 65000:100" in text
+        assert "maximum-paths 4" in text
+        with pytest.raises(ValueError):
+            b.enable_max_paths(0)
+
+    def test_parse_state(self):
+        assert parse_bgp_state("established") == BGPState.ESTABLISHED
+        assert parse_bgp_state("garbage") == BGPState.IDLE
+
+
+# ---------------------------------------------------------------- BFD
+
+class TestBFD:
+    def test_peer_lifecycle(self):
+        frr = RecordingFRR()
+        m = BFDManager(executor=frr)
+        p = m.add_peer("10.0.0.2")
+        assert p.min_rx_ms == 300
+        assert "peer 10.0.0.2" in frr.all_text()
+        with pytest.raises(ValueError):
+            m.add_peer("10.0.0.2")
+        m.remove_peer("10.0.0.2")
+        assert "no peer 10.0.0.2" in frr.all_text()
+
+    def test_aggressive_profile(self):
+        cfg = aggressive_bfd_config()
+        m = BFDManager(cfg, executor=RecordingFRR())
+        assert m.add_peer("10.0.0.3").min_rx_ms == 50
+
+    def test_link_to_bgp(self):
+        frr = RecordingFRR()
+        m = BFDManager(executor=frr)
+        m.link_to_bgp_neighbor(65001, "10.0.0.2")
+        assert m.get_peer("10.0.0.2").linked_bgp_as == 65001
+        assert "neighbor 10.0.0.2 bfd" in frr.all_text()
+
+    def test_refresh_transitions(self):
+        frr = RecordingFRR()
+        frr.responses["show bfd"] = json.dumps(
+            [{"peer": "10.0.0.2", "status": "up"}])
+        m = BFDManager(executor=frr)
+        ups, downs = [], []
+        m.on_peer_up = ups.append
+        m.on_peer_down = downs.append
+        m.add_peer("10.0.0.2")
+        m.refresh_peers()
+        assert ups == ["10.0.0.2"]
+        assert m.bfd_stats() == {"peers": 1, "up": 1}
+        frr.responses["show bfd"] = json.dumps(
+            [{"peer": "10.0.0.2", "status": "down"}])
+        m.refresh_peers()
+        assert downs == ["10.0.0.2"]
+
+
+# -------------------------------------------------- subscriber routes
+
+class TestSubscriberRoutes:
+    def test_inject_with_class_community(self):
+        frr = RecordingFRR()
+        m = SubscriberRouteManager(executor=frr)
+        r = m.inject_route("sess-1", "sub-1", "100.64.0.5", "business")
+        assert r.community == "65000:200"
+        assert "ip route 100.64.0.5/32" in frr.all_text()
+        assert m.get_route_by_ip("100.64.0.5").session_id == "sess-1"
+
+    def test_unknown_class_gets_default(self):
+        m = SubscriberRouteManager(executor=RecordingFRR())
+        r = m.inject_route("s", "x", "100.64.0.6", "mystery")
+        assert r.community == "65000:100"
+
+    def test_withdraw(self):
+        frr = RecordingFRR()
+        m = SubscriberRouteManager(executor=frr)
+        m.inject_route("sess-1", "sub-1", "100.64.0.5")
+        m.withdraw_route("sess-1")
+        assert "no ip route 100.64.0.5/32" in frr.all_text()
+        assert m.get_active_routes() == []
+        with pytest.raises(KeyError):
+            m.withdraw_route("sess-1")
+
+    def test_bulk_ops_single_session(self):
+        frr = RecordingFRR()
+        m = SubscriberRouteManager(executor=frr)
+        routes = [SubscriberRoute(session_id=f"s{i}", subscriber_id=f"u{i}",
+                                  ip=f"100.64.1.{i}") for i in range(5)]
+        assert m.bulk_inject(routes) == 5
+        assert len(frr.commands) == 1  # one config session
+        assert m.bulk_withdraw() == 5
+        assert m.route_stats()["active"] == 0
+
+    def test_retry_queue(self):
+        frr = RecordingFRR()
+        m = SubscriberRouteManager(executor=frr)
+        frr.fail_next = 1
+        with pytest.raises(RuntimeError):
+            m.inject_route("sess-1", "sub-1", "100.64.0.5")
+        assert m.route_stats()["failed"] == 1
+        assert m.retry_pending() == 1
+        assert m.get_route_by_ip("100.64.0.5") is not None
+        assert m.route_stats()["retried"] == 1
+
+    def test_invalid_ip_rejected(self):
+        m = SubscriberRouteManager(executor=RecordingFRR())
+        with pytest.raises(ValueError):
+            m.inject_route("s", "u", "not-an-ip")
