@@ -1,0 +1,229 @@
+"""Tests for NAT ALGs and RFC 6908 compliance logging."""
+
+import gzip
+import json
+
+import pytest
+
+from bng_tpu.control.nat import (
+    LOG_PORT_BLOCK_ASSIGN, LOG_SESSION_CREATE, LOG_SESSION_DELETE,
+    NATLogEntry, NATManager,
+)
+from bng_tpu.control.nat_alg import (
+    ALGConnection, ALGHandler, FTPALG, FTP_PORT, SIPALG, SIP_PORT,
+)
+from bng_tpu.control.nat_logging import (
+    NATComplianceLogger, NATLoggerConfig,
+)
+from bng_tpu.utils.net import ip_to_u32
+
+
+class StaticMapper:
+    """Maps any (ip, port) to a fixed public IP with port+1000."""
+
+    def __init__(self, public_ip="203.0.113.1", fail=False):
+        self.public_ip = public_ip
+        self.fail = fail
+        self.calls = []
+
+    def __call__(self, ip, port):
+        self.calls.append((ip, port))
+        if self.fail:
+            return None
+        return self.public_ip, port + 1000
+
+
+CONN = ALGConnection(private_ip="100.64.0.5", private_port=21,
+                     public_ip="203.0.113.1", public_port=2021)
+
+
+class TestFTPALG:
+    def test_port_command_rewritten(self):
+        alg = FTPALG(StaticMapper())
+        data = b"USER x\r\nPORT 100,64,0,5,19,137\r\n"  # port 5001
+        out = alg.process_outbound(CONN, data)
+        # 5001 + 1000 = 6001 = 23*256 + 113
+        assert b"PORT 203,0,113,1,23,113" in out
+        assert b"USER x" in out
+        assert alg.stats["port_rewrites"] == 1
+
+    def test_foreign_ip_untouched(self):
+        alg = FTPALG(StaticMapper())
+        data = b"PORT 10,9,9,9,19,137\r\n"  # not the NAT'd client
+        assert alg.process_outbound(CONN, data) == data
+
+    def test_eprt_rewritten(self):
+        alg = FTPALG(StaticMapper())
+        out = alg.process_outbound(CONN, b"EPRT |1|100.64.0.5|5001|\r\n")
+        assert b"EPRT |1|203.0.113.1|6001|" in out
+
+    def test_pasv_response_rewritten_inbound(self):
+        alg = FTPALG(StaticMapper())
+        data = b"227 Entering Passive Mode (100,64,0,5,19,137)\r\n"
+        out = alg.process_inbound(CONN, data)
+        assert b"(203,0,113,1,23,113)" in out
+
+    def test_epsv_creates_mapping_only(self):
+        mapper = StaticMapper()
+        alg = FTPALG(mapper)
+        data = b"229 Entering Extended Passive Mode (|||5005|)\r\n"
+        assert alg.process_inbound(CONN, data) == data
+        assert mapper.calls == [("100.64.0.5", 5005)]
+        assert alg.stats["epsv_mappings"] == 1
+
+    def test_mapper_failure_leaves_payload(self):
+        alg = FTPALG(StaticMapper(fail=True))
+        data = b"PORT 100,64,0,5,19,137\r\n"
+        assert alg.process_outbound(CONN, data) == data
+        assert alg.stats["failures"] == 1
+
+
+class TestSIPALG:
+    def test_outbound_headers_and_sdp(self):
+        mapper = StaticMapper()
+        alg = SIPALG(mapper)
+        msg = (b"INVITE sip:bob@example.com SIP/2.0\r\n"
+               b"Via: SIP/2.0/UDP 100.64.0.5:5060\r\n"
+               b"Contact: <sip:alice@100.64.0.5:5060>\r\n"
+               b"\r\n"
+               b"o=- 1 1 IN IP4 100.64.0.5\r\n"
+               b"c=IN IP4 100.64.0.5\r\n"
+               b"m=audio 49170 RTP/AVP 0\r\n")
+        out = alg.process_outbound(CONN, msg)
+        assert b"100.64.0.5" not in out
+        assert out.count(b"203.0.113.1") == 4
+        assert ("100.64.0.5", 49170) in mapper.calls  # RTP pre-mapped
+
+    def test_inbound_reverses(self):
+        alg = SIPALG()
+        msg = b"SIP/2.0 200 OK\r\nContact: <sip:bob@203.0.113.1:5060>\r\n"
+        out = alg.process_inbound(CONN, msg)
+        assert b"100.64.0.5" in out and b"203.0.113.1" not in out
+
+
+class TestALGHandler:
+    def test_dispatch_by_port(self):
+        h = ALGHandler(StaticMapper())
+        assert h.ports() == [FTP_PORT, SIP_PORT]
+        out = h.process(CONN, FTP_PORT, b"PORT 100,64,0,5,19,137\r\n", True)
+        assert b"203,0,113,1" in out
+        # unknown port passes through
+        data = b"GET / HTTP/1.1\r\n"
+        assert h.process(CONN, 80, data, True) == data
+
+
+class TestComplianceLogging:
+    def _entry(self, event, t=1000, priv_port=5000, pub_port=4096,
+               dest_port=443):
+        return NATLogEntry(
+            timestamp=t, event_type=event, subscriber_id=7,
+            private_ip=ip_to_u32("100.64.0.5"),
+            public_ip=ip_to_u32("203.0.113.1"),
+            private_port=priv_port, public_port=pub_port,
+            dest_ip=ip_to_u32("93.184.216.34"), dest_port=dest_port,
+            protocol=6)
+
+    def test_json_format_and_flush(self, tmp_path):
+        path = str(tmp_path / "nat.log")
+        log = NATComplianceLogger(NATLoggerConfig(file_path=path,
+                                                  buffer_size=2))
+        log.log_device_event(self._entry(LOG_SESSION_CREATE))
+        assert log.get_stats()["buffer_used"] == 1
+        log.log_device_event(self._entry(LOG_SESSION_DELETE, t=1100))
+        # buffer_size=2 -> auto-flush
+        lines = [json.loads(x) for x in open(path)]
+        assert lines[0]["event"] == "session_create"
+        assert lines[0]["public_ip"] == "203.0.113.1"
+        assert lines[1]["event"] == "session_delete"
+        log.close()
+
+    @pytest.mark.parametrize("fmt,needle", [
+        ("syslog", b"NAT session_create: subscriber=7"),
+        ("csv", b"session_create,7,100.64.0.5,5000"),
+        ("nel", b'"type":"NAT"'),
+    ])
+    def test_other_formats(self, tmp_path, fmt, needle):
+        path = str(tmp_path / f"nat.{fmt}")
+        log = NATComplianceLogger(NATLoggerConfig(file_path=path, fmt=fmt))
+        log.log_device_event(self._entry(LOG_SESSION_CREATE))
+        log.close()
+        assert needle in open(path, "rb").read()
+
+    def test_lea_query_by_session(self):
+        log = NATComplianceLogger()
+        log.log_device_event(self._entry(LOG_SESSION_CREATE, t=1000))
+        log.log_device_event(self._entry(LOG_SESSION_DELETE, t=2000))
+        hit = log.query_by_public_endpoint("203.0.113.1", 4096, 1500)
+        assert hit and hit["private_ip"] == "100.64.0.5" and hit["subscriber"] == 7
+        assert log.query_by_public_endpoint("203.0.113.1", 4096, 2500) is None
+        assert log.query_by_public_endpoint("203.0.113.1", 9999, 1500) is None
+
+    def test_bulk_logging_block_records(self, tmp_path):
+        path = str(tmp_path / "nat.log")
+        log = NATComplianceLogger(NATLoggerConfig(file_path=path,
+                                                  bulk_logging=True))
+        # sessions suppressed in bulk mode; blocks logged
+        log.log_device_event(self._entry(LOG_SESSION_CREATE))
+        log.log_allocation(7, "100.64.0.5", "203.0.113.1", 4096, 5119)
+        log.close()
+        lines = [json.loads(x) for x in open(path)]
+        assert len(lines) == 1
+        assert lines[0]["event"] == "port_block_assign"
+        assert lines[0]["port_end"] == 5119
+
+    def test_lea_query_by_block(self):
+        clk = [1000.0]
+        log = NATComplianceLogger(NATLoggerConfig(bulk_logging=True),
+                                  clock=lambda: clk[0])
+        log.log_allocation(7, "100.64.0.5", "203.0.113.1", 4096, 5119)
+        clk[0] = 3000.0
+        log.log_allocation(7, "100.64.0.5", "203.0.113.1", 4096, 5119,
+                           release=True)
+        hit = log.query_by_public_endpoint("203.0.113.1", 4500, 2000)
+        assert hit and hit["event"] == "port_block"
+        assert hit["private_ip"] == "100.64.0.5"
+        assert log.query_by_public_endpoint("203.0.113.1", 4500, 3500) is None
+
+    def test_rotation_with_gzip(self, tmp_path):
+        path = str(tmp_path / "nat.log")
+        log = NATComplianceLogger(NATLoggerConfig(
+            file_path=path, buffer_size=1, max_file_size=200))
+        for i in range(10):
+            log.log_device_event(self._entry(LOG_SESSION_CREATE, t=1000 + i))
+        log.close()
+        gz = [f for f in tmp_path.iterdir() if f.suffix == ".gz"]
+        assert gz, "rotation should produce gzipped archives"
+        with gzip.open(gz[0]) as f:
+            assert b"session_create" in f.read()
+        assert log.get_stats()["rotations"] >= 1
+
+    def test_age_cleanup(self, tmp_path):
+        import os
+        path = str(tmp_path / "nat.log")
+        clk = [1000.0]
+        log = NATComplianceLogger(NATLoggerConfig(
+            file_path=path, max_age=100.0, compress=False), clock=lambda: clk[0])
+        old = path + ".20260101-000000.0"
+        open(old, "w").write("x")
+        os.utime(old, (500, 500))
+        clk[0] = 1_000_000.0
+        # mtime 500 is way past max_age relative to wall clock? clean uses
+        # file mtime vs clock - max_age
+        assert log.clean_old_logs() == 1
+        log.close()
+
+    def test_nat_manager_integration(self):
+        """Device punts new flow -> NATManager allocates -> logger records
+        -> LEA query answers."""
+        log = NATComplianceLogger()
+        nat = NATManager(public_ips=[ip_to_u32("203.0.113.1")],
+                         sessions_nbuckets=256, sub_nat_nbuckets=64,
+                         log_sink=log.log_device_event)
+        priv = ip_to_u32("100.64.0.5")
+        nat.allocate_nat(priv, now=1000)
+        verdict = nat.handle_new_flow(priv, ip_to_u32("93.184.216.34"),
+                                      40000, 443, 6, pkt_len=64, now=1000)
+        assert verdict is not None
+        _, pub_port = verdict
+        hit = log.query_by_public_endpoint("203.0.113.1", int(pub_port), 1000)
+        assert hit is not None
