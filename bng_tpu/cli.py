@@ -1,0 +1,473 @@
+"""Composition root + CLI: run / demo / stats / version.
+
+Parity: cmd/bng — cobra run/demo/stats/version (main.go:48-62,421-439),
+flag surface + YAML overlay where CLI wins (main.go:195-419, loadConfigFile
+main.go:1420-1457), secret-file resolution keeping secrets out of ps
+(resolveSecret main.go:1567), runBNG construction order
+loader->antispoof->walledgarden->pools->deviceauth->DHCP->Nexus->peer-pool
+->HA->BGP/BFD->RADIUS->policy->QoS->NAT(+logger)->PPPoE->DHCPv6->SLAAC->
+resilience->metrics with LIFO cleanup (main.go:441-1380), demo mode's
+eBPF-free full-lifecycle simulation (demo.go:46-120).
+
+The TPU twist: where runBNG loads XDP programs, run() builds the device
+Engine (fused Pallas/jnp pipeline + HBM tables) and drives it from a
+packet source; everything else stays host-side control plane.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import sys
+import time
+
+__version__ = "0.1.0"
+
+
+@dataclasses.dataclass
+class BNGConfig:
+    """Flattened flag surface (main.go:195-419 subset, grouped)."""
+
+    # dataplane
+    server_ip: str = "10.0.0.1"
+    server_mac: str = "02:aa:bb:cc:dd:01"
+    batch_size: int = 256
+    # pools (single primary pool via flags; more via YAML `pools:`)
+    pool_cidr: str = "10.0.0.0/16"
+    pool_gateway: str = ""
+    dns_primary: str = "1.1.1.1"
+    dns_secondary: str = "8.8.8.8"
+    lease_time: int = 3600
+    pools: list = dataclasses.field(default_factory=list)
+    # RADIUS
+    radius_server: str = ""
+    radius_secret: str = ""
+    radius_secret_file: str = ""
+    # NAT
+    nat_enabled: bool = True
+    nat_public_ips: list = dataclasses.field(default_factory=lambda: ["203.0.113.1"])
+    nat_ports_per_subscriber: int = 1024
+    nat_log_path: str = ""
+    nat_log_format: str = "json"
+    nat_bulk_logging: bool = False
+    # QoS
+    qos_enabled: bool = True
+    default_policy: str = "residential-100mbps"
+    # walled garden
+    walled_garden_enabled: bool = True
+    portal_ip: str = "10.255.255.1"
+    portal_port: int = 8080
+    # HA
+    ha_role: str = ""  # "", "active", "standby"
+    ha_peer: str = ""
+    # BGP
+    bgp_enabled: bool = False
+    bgp_local_as: int = 65000
+    bgp_router_id: str = ""
+    # metrics
+    metrics_port: int = 9090
+    metrics_enabled: bool = True
+    # dhcpv6 / slaac
+    dhcpv6_enabled: bool = True
+    dhcpv6_prefix: str = "2001:db8:1::/64"
+    slaac_enabled: bool = True
+    # misc
+    node_id: str = "bng0"
+
+
+def resolve_secret(value: str, file_path: str) -> str:
+    """main.go:1567: prefer --*-file so secrets stay out of ps."""
+    if file_path:
+        with open(file_path) as f:
+            return f.read().strip()
+    return value
+
+
+def load_config_file(path: str, cli_set: set[str],
+                     base: BNGConfig) -> BNGConfig:
+    """YAML overlay applied only to fields NOT set on the CLI
+    (main.go:1420-1457: CLI wins)."""
+    import yaml
+    with open(path) as f:
+        data = yaml.safe_load(f) or {}
+    for key, value in data.items():
+        key = key.replace("-", "_")
+        if key in cli_set or not hasattr(base, key):
+            continue
+        setattr(base, key, value)
+    return base
+
+
+class BNGApp:
+    """Everything `bng run` constructs, with LIFO cleanup
+    (main.go:441-1380)."""
+
+    def __init__(self, config: BNGConfig, clock=time.time):
+        self.config = config
+        self.clock = clock
+        self._cleanup = []
+        self.components: dict[str, object] = {}
+        self._build()
+
+    def _on_close(self, fn) -> None:
+        self._cleanup.append(fn)
+
+    def _build(self) -> None:
+        import ipaddress
+
+        from bng_tpu.control import walledgarden as wg
+        from bng_tpu.control.dhcp_server import DHCPServer
+        from bng_tpu.control.metrics import BNGMetrics, MetricsCollector
+        from bng_tpu.control.nat import NATManager
+        from bng_tpu.control.nat_logging import (NATComplianceLogger,
+                                                 NATLoggerConfig)
+        from bng_tpu.control.nexus import NexusClient
+        from bng_tpu.control.pool import Pool, PoolManager
+        from bng_tpu.control.radius.policy import PolicyManager
+        from bng_tpu.control.subscriber import SubscriberManager
+        from bng_tpu.runtime.engine import AntispoofTables, Engine, QoSTables
+        from bng_tpu.runtime.tables import FastPathTables
+        from bng_tpu.utils.net import ip_to_u32
+
+        cfg = self.config
+        c = self.components
+
+        # 1. device tables (the Loader.Load role, main.go:498-506)
+        fastpath = c["fastpath"] = FastPathTables()
+        fastpath.set_server_config(
+            bytes(int(b, 16) for b in cfg.server_mac.split(":")),
+            ip_to_u32(cfg.server_ip))
+
+        # 2. antispoof + walled garden (main.go:509-564)
+        c["antispoof"] = AntispoofTables()
+        if cfg.walled_garden_enabled:
+            garden = c["walledgarden"] = wg.WalledGardenManager(
+                wg.WalledGardenConfig(portal_ip=cfg.portal_ip,
+                                      portal_port=cfg.portal_port),
+                clock=self.clock)
+            self._on_close(lambda: garden.check_expired())
+
+        # 3. pools (main.go:567-594)
+        pool_mgr = c["pools"] = PoolManager(fastpath_tables=fastpath)
+        pool_specs = cfg.pools or [{
+            "cidr": cfg.pool_cidr, "gateway": cfg.pool_gateway,
+            "lease_time": cfg.lease_time}]
+        for i, spec in enumerate(pool_specs, start=1):
+            net = ipaddress.ip_network(spec["cidr"])
+            gw = spec.get("gateway") or str(net.network_address + 1)
+            pool_mgr.add_pool(Pool(
+                pool_id=i, network=int(net.network_address),
+                prefix_len=net.prefixlen, gateway=ip_to_u32(gw),
+                dns_primary=ip_to_u32(spec.get("dns_primary", cfg.dns_primary)),
+                dns_secondary=ip_to_u32(spec.get("dns_secondary",
+                                                 cfg.dns_secondary)),
+                lease_time=int(spec.get("lease_time", cfg.lease_time)),
+                client_class=int(spec.get("client_class", 0))))
+
+        # 4. Nexus + subscriber orchestration (main.go:628-756 role)
+        c["nexus"] = NexusClient(node_id=cfg.node_id, clock=self.clock)
+        c["subscribers"] = SubscriberManager(clock=self.clock)
+
+        # 5. RADIUS (main.go:946-973)
+        authenticator = None
+        if cfg.radius_server:
+            from bng_tpu.control.radius.client import (RadiusClient,
+                                                       RadiusServerConfig)
+            secret = resolve_secret(cfg.radius_secret, cfg.radius_secret_file)
+            host, _, port = cfg.radius_server.partition(":")
+            radius = c["radius"] = RadiusClient(
+                servers=[RadiusServerConfig(host=host,
+                                            auth_port=int(port or 1812),
+                                            secret=secret.encode())])
+
+            def authenticator(username="", password="", mac=b"",
+                              circuit_id=b"", **kw):
+                res = radius.authenticate(username, password, mac=mac,
+                                          circuit_id=circuit_id)
+                if res is None or not res.success:
+                    return None
+                return {"policy_name": res.policy_name,
+                        "framed_ip": res.framed_ip,
+                        "session_timeout": res.session_timeout,
+                        **res.attributes}
+
+        # 6. QoS (main.go:977-995)
+        qos = c["qos"] = QoSTables()
+        policies = c["policies"] = PolicyManager()
+        qos_hook = None
+        if cfg.qos_enabled:
+            def qos_hook(ip, policy_name):
+                p = policies.get(policy_name or cfg.default_policy)
+                if p is not None:
+                    qos.set_subscriber(ip, p.download_bps, p.upload_bps,
+                                       priority=p.priority)
+
+        # 7. NAT + compliance logger (main.go:1000-1060)
+        nat = None
+        nat_hook = None
+        if cfg.nat_enabled:
+            nat_logger = c["nat_logger"] = NATComplianceLogger(
+                NATLoggerConfig(file_path=cfg.nat_log_path,
+                                fmt=cfg.nat_log_format,
+                                bulk_logging=cfg.nat_bulk_logging),
+                clock=self.clock)
+            self._on_close(nat_logger.close)
+            nat = c["nat"] = NATManager(
+                public_ips=[ip_to_u32(ip) for ip in cfg.nat_public_ips],
+                ports_per_subscriber=cfg.nat_ports_per_subscriber,
+                log_sink=nat_logger.log_device_event)
+            def nat_hook(ip, now):
+                nat.allocate_nat(ip, int(now))
+        else:
+            nat = NATManager(public_ips=[ip_to_u32("203.0.113.1")],
+                             sessions_nbuckets=256, sub_nat_nbuckets=64)
+
+        # 8. DHCP server, wired like main.go:642 + SetXxx hooks
+        dhcp = c["dhcp"] = DHCPServer(
+            server_mac=bytes(int(b, 16) for b in cfg.server_mac.split(":")),
+            server_ip=ip_to_u32(cfg.server_ip),
+            pool_manager=pool_mgr, fastpath_tables=fastpath,
+            authenticator=authenticator, qos_hook=qos_hook,
+            nat_hook=nat_hook, clock=self.clock)
+
+        # 9. engine: the TPU dataplane replacing the XDP attach
+        c["engine"] = Engine(
+            fastpath=fastpath, nat=nat, qos=qos, antispoof=c["antispoof"],
+            batch_size=cfg.batch_size, slow_path=dhcp.handle_frame,
+            clock=self.clock)
+
+        # 10. DHCPv6 + SLAAC (main.go:1063-1180)
+        if cfg.dhcpv6_enabled:
+            from bng_tpu.control.dhcpv6.server import (DHCPv6Server,
+                                                       DHCPv6ServerConfig)
+            c["dhcpv6"] = DHCPv6Server(
+                DHCPv6ServerConfig(), clock=self.clock)
+        if cfg.slaac_enabled:
+            from bng_tpu.control.slaac import SLAACConfig, SLAACServer
+            c["slaac"] = SLAACServer(SLAACConfig())
+
+        # 11. HA pair (main.go:759-881)
+        if cfg.ha_role:
+            from bng_tpu.control.ha import (ActiveSyncer, InMemorySessionStore,
+                                            Role, StandbySyncer)
+            store = c["ha_store"] = InMemorySessionStore()
+            if cfg.ha_role == "active":
+                c["ha"] = ActiveSyncer(store)
+                c["ha_role"] = Role.ACTIVE
+            else:
+                # transport to the active peer is wired by the operator
+                # (cfg.ha_peer); a disconnected standby retries with backoff.
+                def _no_peer():
+                    raise ConnectionError(f"HA peer unreachable: {cfg.ha_peer}")
+                c["ha"] = StandbySyncer(store, transport=_no_peer)
+                c["ha_role"] = Role.STANDBY
+
+        # 12. BGP (main.go:884-940) — executor supplied by operator; stub here
+        if cfg.bgp_enabled:
+            from bng_tpu.control.routing import BGPConfig, BGPController
+            c["bgp"] = BGPController(
+                BGPConfig(local_as=cfg.bgp_local_as,
+                          router_id=cfg.bgp_router_id),
+                executor=lambda cmd: "")
+
+        # 13. metrics (main.go:1214-1241)
+        if cfg.metrics_enabled:
+            metrics = c["metrics"] = BNGMetrics()
+            collector = c["collector"] = MetricsCollector(metrics)
+            engine = c["engine"]
+            collector.add_source(lambda: metrics.collect_engine(engine.stats))
+            collector.add_source(lambda: metrics.collect_dhcp_server(dhcp.stats))
+            collector.add_source(lambda: metrics.collect_pools(
+                {str(pid): st for pid, st in pool_mgr.stats().items()}))
+            self._on_close(collector.stop)
+
+    def close(self) -> None:
+        """LIFO cleanup (main.go:1301-1379)."""
+        for fn in reversed(self._cleanup):
+            try:
+                fn()
+            except Exception:
+                pass
+        self._cleanup.clear()
+
+    def stats(self) -> dict:
+        out = {"version": __version__, "node_id": self.config.node_id}
+        eng = self.components.get("engine")
+        if eng is not None:
+            out["engine"] = {
+                "batches": eng.stats.batches, "tx": eng.stats.tx,
+                "passed": eng.stats.passed, "dropped": eng.stats.dropped}
+        dhcp = self.components.get("dhcp")
+        if dhcp is not None:
+            out["dhcp"] = {k: getattr(dhcp.stats, k) for k in
+                           ("discover", "offer", "request", "ack", "nak",
+                            "release") if hasattr(dhcp.stats, k)}
+        pools = self.components.get("pools")
+        if pools is not None:
+            out["pools"] = pools.stats()
+        return out
+
+
+# ---------------------------------------------------------------------------
+# demo mode (demo.go:46-120): full lifecycle, no device required
+# ---------------------------------------------------------------------------
+
+def run_demo(subscriber_count: int = 3, out=None, clock=time.time) -> dict:
+    """ONT discovery -> walled garden -> activation -> session, with stub
+    auth/allocator — 'No eBPF required' (demo.go:47-58); here: no TPU
+    required either (pure host path)."""
+    from bng_tpu.control.nexus import (NexusClient, NTEEntity,
+                                       SubscriberEntity, VLANAllocator)
+    from bng_tpu.control.pon import DiscoveryEvent, PONConfig, PONManager
+    from bng_tpu.control.direct import DirectAuthenticator
+    from bng_tpu.control.subscriber import SessionKind, SubscriberManager
+    from bng_tpu.control.walledgarden import WalledGardenManager
+
+    def log(msg):
+        print(msg, file=out if out is not None else sys.stdout)
+
+    nexus = NexusClient(clock=clock)
+    vlans = VLANAllocator()
+    pon = PONManager(PONConfig(), nexus, vlans, clock=clock)
+    garden = WalledGardenManager(clock=clock)
+    auth = DirectAuthenticator(nexus=nexus, clock=clock)
+
+    class DemoAllocator:
+        def __init__(self):
+            self.next = 10
+        def allocate(self, sid):
+            ip = f"10.1.0.{self.next}"
+            self.next += 1
+            return ip
+        def release(self, sid):
+            return True
+
+    class GardenBridge:
+        def add(self, session):
+            garden.add_to_walled_garden(session.mac or "02:00:00:00:00:00")
+        def remove(self, session):
+            garden.release_from_walled_garden(session.mac or "02:00:00:00:00:00")
+
+    subs = SubscriberManager(authenticator=auth, allocator=DemoAllocator(),
+                             walled_garden=GardenBridge(), clock=clock)
+
+    results = {"provisioned": 0, "active": 0, "walled": 0}
+    for i in range(1, subscriber_count + 1):
+        serial = f"DEMO-ONT-{i:03d}"
+        mac = f"02:de:e0:00:00:{i:02x}"
+        log(f"--- subscriber {i}: ONT {serial} ---")
+
+        # 1. ONT appears; operator pre-approved it in Nexus
+        nexus.ntes.put(serial, NTEEntity(id=serial, serial=serial,
+                                         approved=True))
+        r = pon.handle_discovery(DiscoveryEvent(serial=serial))
+        log(f"  provisioned: s_tag={r.s_tag} c_tag={r.c_tag}")
+        results["provisioned"] += 1
+
+        # 2. subscriber record exists for odd ONTs; evens hit the garden
+        if i % 2:
+            nexus.subscribers.put(f"sub-{i}", SubscriberEntity(
+                id=f"sub-{i}", mac=mac, nte_id=serial,
+                circuit_id=f"olt1/1/{i}", qos_policy="residential-100mbps"))
+
+        s = subs.create_session(SessionKind.IPOE, mac=mac,
+                                circuit_id=f"olt1/1/{i}")
+        if subs.authenticate(s.id):
+            ip = subs.assign_address(s.id)
+            subs.activate(s.id)
+            log(f"  ACTIVE: {s.subscriber_id} ip={ip}")
+            results["active"] += 1
+        else:
+            log("  WALLED GARDEN: unknown subscriber, portal redirect on")
+            results["walled"] += 1
+
+    log(f"demo complete: {results}")
+    return results
+
+
+# ---------------------------------------------------------------------------
+# entry points
+# ---------------------------------------------------------------------------
+
+def _add_run_flags(p: argparse.ArgumentParser) -> None:
+    defaults = BNGConfig()
+    for f in dataclasses.fields(BNGConfig):
+        flag = "--" + f.name.replace("_", "-")
+        default = getattr(defaults, f.name)
+        if isinstance(default, bool):
+            p.add_argument(flag, dest=f.name, default=None,
+                           action=argparse.BooleanOptionalAction)
+        elif isinstance(default, list):
+            p.add_argument(flag, dest=f.name, default=None, nargs="*")
+        else:
+            p.add_argument(flag, dest=f.name, default=None,
+                           type=type(default))
+    p.add_argument("--config", dest="config_file", default="")
+
+
+def _config_from_args(args) -> BNGConfig:
+    cfg = BNGConfig()
+    cli_set = set()
+    for f in dataclasses.fields(BNGConfig):
+        v = getattr(args, f.name, None)
+        if v is not None:
+            setattr(cfg, f.name, v)
+            cli_set.add(f.name)
+    if args.config_file:
+        cfg = load_config_file(args.config_file, cli_set, cfg)
+    return cfg
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="bng-tpu", description="TPU-native BNG dataplane")
+    sub = parser.add_subparsers(dest="command")
+
+    runp = sub.add_parser("run", help="run the BNG (full stack)")
+    _add_run_flags(runp)
+    runp.add_argument("--once", action="store_true",
+                      help="build everything, print stats, exit (smoke mode)")
+
+    demop = sub.add_parser("demo", help="device-free lifecycle demo")
+    demop.add_argument("--subscribers", type=int, default=3)
+
+    statsp = sub.add_parser("stats", help="print stats for a built app")
+    _add_run_flags(statsp)
+
+    sub.add_parser("version", help="print version")
+
+    args = parser.parse_args(argv)
+
+    if args.command == "version":
+        print(f"bng-tpu {__version__}")
+        return 0
+    if args.command == "demo":
+        run_demo(args.subscribers)
+        return 0
+    if args.command in ("run", "stats"):
+        app = BNGApp(_config_from_args(args))
+        try:
+            if args.command == "stats" or args.once:
+                print(json.dumps(app.stats(), indent=2, default=str))
+                return 0
+            # Serve until interrupted: metrics + collector loops live in
+            # threads; the engine is driven by the packet source the
+            # operator attaches (synthetic source in tests/bench).
+            collector = app.components.get("collector")
+            if collector is not None:
+                collector.start()
+                port = collector.serve_http(app.config.metrics_port)
+                print(f"metrics on :{port}/metrics", file=sys.stderr)
+            while True:
+                time.sleep(1)
+        except KeyboardInterrupt:
+            return 0
+        finally:
+            app.close()
+    parser.print_help()
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -279,10 +279,10 @@ class BNGMetrics:
             self.dhcp_cache_hit_rate.set(hits / total)
 
     def collect_pools(self, pool_stats: dict) -> None:
-        """pool_stats: {pool_name: {"size": N, "allocated": M}}."""
+        """pool_stats: {pool_name: {"size": N, "allocated"|"used": M}}."""
         for name, st in pool_stats.items():
             size = st.get("size", 0)
-            alloc = st.get("allocated", 0)
+            alloc = st.get("allocated", st.get("used", 0))
             self.pool_allocated.set(alloc, pool=name)
             self.pool_available.set(size - alloc, pool=name)
             if size:

@@ -1,0 +1,154 @@
+"""Tests for the composition root and CLI (cmd/bng parity)."""
+
+import io
+import json
+
+import pytest
+
+from bng_tpu.cli import (
+    BNGApp, BNGConfig, load_config_file, main, resolve_secret, run_demo,
+)
+
+
+class TestConfig:
+    def test_resolve_secret_prefers_file(self, tmp_path):
+        f = tmp_path / "secret"
+        f.write_text("s3cret\n")
+        assert resolve_secret("inline", str(f)) == "s3cret"
+        assert resolve_secret("inline", "") == "inline"
+
+    def test_yaml_overlay_cli_wins(self, tmp_path):
+        f = tmp_path / "bng.yaml"
+        f.write_text("server-ip: 10.9.0.1\nlease-time: 600\n"
+                     "nat-enabled: false\n")
+        cfg = BNGConfig(server_ip="10.1.1.1")
+        cfg = load_config_file(str(f), {"server_ip"}, cfg)
+        assert cfg.server_ip == "10.1.1.1"  # CLI wins
+        assert cfg.lease_time == 600  # YAML fills the rest
+        assert cfg.nat_enabled is False
+
+    def test_unknown_yaml_keys_ignored(self, tmp_path):
+        f = tmp_path / "bng.yaml"
+        f.write_text("bogus-key: 1\nlease-time: 120\n")
+        cfg = load_config_file(str(f), set(), BNGConfig())
+        assert cfg.lease_time == 120
+
+
+class TestApp:
+    def test_full_wiring(self):
+        app = BNGApp(BNGConfig(ha_role="active", bgp_enabled=True))
+        try:
+            for name in ("fastpath", "antispoof", "walledgarden", "pools",
+                         "nexus", "subscribers", "qos", "policies", "nat",
+                         "nat_logger", "dhcp", "engine", "dhcpv6", "slaac",
+                         "ha", "bgp", "metrics", "collector"):
+                assert name in app.components, name
+            st = app.stats()
+            assert st["pools"][1]["size"] > 0
+            assert st["engine"]["batches"] == 0
+        finally:
+            app.close()
+
+    def test_minimal_wiring(self):
+        app = BNGApp(BNGConfig(nat_enabled=False, qos_enabled=False,
+                               walled_garden_enabled=False,
+                               metrics_enabled=False, dhcpv6_enabled=False,
+                               slaac_enabled=False))
+        try:
+            assert "nat_logger" not in app.components
+            assert "walledgarden" not in app.components
+            assert "metrics" not in app.components
+            assert "dhcp" in app.components and "engine" in app.components
+        finally:
+            app.close()
+
+    def test_dhcp_dora_through_app(self):
+        """The composition root produces a working slow path end to end."""
+        from bng_tpu.control import dhcp_codec, packets
+        from bng_tpu.utils.net import ip_to_u32, u32_to_ip
+
+        def client_frame(mac, msg_type, **kw):
+            pkt = dhcp_codec.build_request(mac, msg_type, **kw)
+            return packets.udp_packet(mac, b"\xff" * 6, 0, 0xFFFFFFFF, 68, 67,
+                                      pkt.encode().ljust(320, b"\x00"))
+
+        app = BNGApp(BNGConfig(pool_cidr="10.50.0.0/24"))
+        try:
+            dhcp = app.components["dhcp"]
+            mac = bytes.fromhex("02deadbeef01")
+            offer = dhcp.handle_frame(client_frame(
+                mac, dhcp_codec.DISCOVER, xid=0x1234))
+            assert offer is not None
+            msg = dhcp_codec.decode(packets.decode(offer).payload)
+            assert msg.yiaddr != 0
+            ack = dhcp.handle_frame(client_frame(
+                mac, dhcp_codec.REQUEST, xid=0x1235,
+                requested_ip=msg.yiaddr,
+                server_id=ip_to_u32(app.config.server_ip)))
+            assert ack is not None
+            ack_msg = dhcp_codec.decode(packets.decode(ack).payload)
+            assert ack_msg.yiaddr == msg.yiaddr
+            assert u32_to_ip(ack_msg.yiaddr).startswith("10.50.0.")
+            # NAT hook fired: subscriber has a port block
+            nat = app.components["nat"]
+            assert nat.blocks.get(ack_msg.yiaddr) is not None
+        finally:
+            app.close()
+
+    def test_metrics_collect_after_traffic(self):
+        app = BNGApp(BNGConfig())
+        try:
+            app.components["collector"].collect_once()
+            text = app.components["metrics"].expose()
+            assert "bng_pool_utilization_ratio" in text
+        finally:
+            app.close()
+
+    def test_yaml_multi_pool(self, tmp_path):
+        f = tmp_path / "bng.yaml"
+        f.write_text(
+            "pools:\n"
+            "  - cidr: 10.1.0.0/24\n    lease_time: 300\n"
+            "  - cidr: 10.2.0.0/24\n    client_class: 2\n")
+        cfg = load_config_file(str(f), set(), BNGConfig())
+        app = BNGApp(cfg)
+        try:
+            assert len(app.components["pools"].pools) == 2
+        finally:
+            app.close()
+
+
+class TestDemo:
+    def test_demo_lifecycle(self):
+        out = io.StringIO()
+        results = run_demo(subscriber_count=4, out=out)
+        assert results["provisioned"] == 4
+        assert results["active"] == 2  # odd ONTs have subscriber records
+        assert results["walled"] == 2
+        text = out.getvalue()
+        assert "ACTIVE" in text and "WALLED GARDEN" in text
+
+
+class TestMain:
+    def test_version(self, capsys):
+        assert main(["version"]) == 0
+        assert "bng-tpu" in capsys.readouterr().out
+
+    def test_demo_command(self, capsys):
+        assert main(["demo", "--subscribers", "2"]) == 0
+        assert "demo complete" in capsys.readouterr().out
+
+    def test_run_once_smoke(self, capsys):
+        assert main(["run", "--once", "--no-metrics-enabled"]) == 0
+        st = json.loads(capsys.readouterr().out)
+        assert st["node_id"] == "bng0" and "engine" in st
+
+    def test_stats_command(self, capsys):
+        assert main(["stats"]) == 0
+        assert "pools" in json.loads(capsys.readouterr().out)
+
+    def test_cli_flag_override(self, capsys):
+        assert main(["run", "--once", "--node-id", "edge-7",
+                     "--no-nat-enabled"]) == 0
+        st = json.loads(capsys.readouterr().out)
+        assert st["node_id"] == "edge-7"
