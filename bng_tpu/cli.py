@@ -128,7 +128,7 @@ class BNGApp:
         from bng_tpu.control.subscriber import SubscriberManager
         from bng_tpu.runtime.engine import AntispoofTables, Engine, QoSTables
         from bng_tpu.runtime.tables import FastPathTables
-        from bng_tpu.utils.net import ip_to_u32
+        from bng_tpu.utils.net import ip_to_u32, parse_mac
 
         cfg = self.config
         c = self.components
@@ -136,7 +136,7 @@ class BNGApp:
         # 1. device tables (the Loader.Load role, main.go:498-506)
         fastpath = c["fastpath"] = FastPathTables()
         fastpath.set_server_config(
-            bytes(int(b, 16) for b in cfg.server_mac.split(":")),
+            parse_mac(cfg.server_mac),
             ip_to_u32(cfg.server_ip))
 
         # 2. antispoof + walled garden (main.go:509-564)
@@ -154,6 +154,8 @@ class BNGApp:
             "cidr": cfg.pool_cidr, "gateway": cfg.pool_gateway,
             "lease_time": cfg.lease_time}]
         for i, spec in enumerate(pool_specs, start=1):
+            if isinstance(spec, str):  # --pools 10.1.0.0/24 (CLI shorthand)
+                spec = {"cidr": spec}
             net = ipaddress.ip_network(spec["cidr"])
             gw = spec.get("gateway") or str(net.network_address + 1)
             pool_mgr.add_pool(Pool(
@@ -187,10 +189,15 @@ class BNGApp:
                                           circuit_id=circuit_id)
                 if res is None or not res.success:
                     return None
-                return {"policy_name": res.policy_name,
-                        "framed_ip": res.framed_ip,
-                        "session_timeout": res.session_timeout,
-                        **res.attributes}
+                # keys DHCPServer._request actually consumes: qos_policy
+                # (Filter-Id -> policy, server.go:774-794 role) and
+                # lease_time (Session-Timeout caps the lease)
+                profile = {"qos_policy": res.policy_name,
+                           "framed_ip": res.framed_ip,
+                           **res.attributes}
+                if res.session_timeout:
+                    profile["lease_time"] = res.session_timeout
+                return profile
 
         # 6. QoS (main.go:977-995)
         qos = c["qos"] = QoSTables()
@@ -225,7 +232,7 @@ class BNGApp:
 
         # 8. DHCP server, wired like main.go:642 + SetXxx hooks
         dhcp = c["dhcp"] = DHCPServer(
-            server_mac=bytes(int(b, 16) for b in cfg.server_mac.split(":")),
+            server_mac=parse_mac(cfg.server_mac),
             server_ip=ip_to_u32(cfg.server_ip),
             pool_manager=pool_mgr, fastpath_tables=fastpath,
             authenticator=authenticator, qos_hook=qos_hook,

@@ -87,6 +87,7 @@ class EngineStats:
     fwd: int = 0
     dropped: int = 0
     passed: int = 0
+    slow_errors: int = 0
 
 
 class QoSTables:
@@ -245,23 +246,13 @@ class Engine:
             fa = np.zeros((self.B,), dtype=bool)
             fa[: len(from_access)] = from_access
 
-        res: PipelineResult = self._step(
-            self.tables, self._drain_updates(), jnp.asarray(pkt), jnp.asarray(length),
-            jnp.asarray(fa), now_s, now_us,
-        )
-        self.tables = res.tables
+        res = self._run_step(pkt, length, fa, now_s, now_us)
 
         verdict = np.asarray(res.verdict)[: len(frames)]
         out_len = np.asarray(res.out_len)
         out_pkt = res.out_pkt  # fetch rows lazily
         punt = np.asarray(res.nat_punt)[: len(frames)]
         viol = np.asarray(res.spoof_violation)[: len(frames)]
-
-        self.stats.batches += 1
-        self.stats.dhcp += np.asarray(res.dhcp_stats, dtype=np.uint64)
-        self.stats.nat += np.asarray(res.nat_stats, dtype=np.uint64)
-        self.stats.qos += np.asarray(res.qos_stats, dtype=np.uint64)
-        self.stats.spoof += np.asarray(res.spoof_stats, dtype=np.uint64)
 
         out = {"tx": [], "fwd": [], "dropped": [], "slow": []}
         out_rows = None
@@ -290,6 +281,84 @@ class Engine:
             if viol[i] and self.violation_sink is not None:
                 self.violation_sink(i, frames[i])
         return out
+
+    def _run_step(self, pkt, length, fa, now_s, now_us) -> PipelineResult:
+        """Invoke the jitted step and fold device stats into host counters
+        (shared by process/process_ring — one copy of the timestamp/stats
+        discipline)."""
+        res: PipelineResult = self._step(
+            self.tables, self._drain_updates(), jnp.asarray(pkt), jnp.asarray(length),
+            jnp.asarray(fa), now_s, now_us,
+        )
+        self.tables = res.tables
+        self.stats.batches += 1
+        self.stats.dhcp += np.asarray(res.dhcp_stats, dtype=np.uint64)
+        self.stats.nat += np.asarray(res.nat_stats, dtype=np.uint64)
+        self.stats.qos += np.asarray(res.qos_stats, dtype=np.uint64)
+        self.stats.spoof += np.asarray(res.spoof_stats, dtype=np.uint64)
+        return res
+
+    def process_ring(self, ring, now: float | None = None) -> int:
+        """Drain one batch from a packet ring (NativeRing/PyRing) through
+        the device pipeline and apply verdicts back to the ring.
+
+        This is the production I/O loop: the ring's assembler writes frames
+        straight into the [B, L] staging buffer that goes to the device,
+        and complete() demuxes the verdicts (TX/FWD back to the wire, PASS
+        to the slow ring — drained here into the slow-path handlers, the
+        XDP_PASS delivery). Returns the number of frames processed.
+        """
+        pkt = np.zeros((self.B, PKT_SLOT), dtype=np.uint8)
+        length = np.zeros((self.B,), dtype=np.uint32)
+        flags = np.zeros((self.B,), dtype=np.uint32)
+        n = ring.assemble(pkt, length, flags)
+        if n == 0:
+            return 0
+        now = now if now is not None else self.clock()
+        now_s = np.uint32(int(now))
+        now_us = np.uint32(int(now * 1e6) & 0xFFFFFFFF)
+        fa = (flags & 0x1) != 0
+
+        res = self._run_step(pkt, length, fa, now_s, now_us)
+        vv = np.asarray(res.verdict)[:n]
+        out_pkt = np.asarray(res.out_pkt)
+        out_len = np.asarray(res.out_len).astype(np.uint32)
+        ring.complete(vv.astype(np.uint8), out_pkt, out_len, n)
+
+        self.stats.tx += int((vv == VERDICT_TX).sum())
+        self.stats.fwd += int((vv == VERDICT_FWD).sum())
+        self.stats.dropped += int((vv == VERDICT_DROP).sum())
+        self.stats.passed += int((vv == VERDICT_PASS).sum())
+
+        if self.violation_sink is not None:
+            viol = np.asarray(res.spoof_violation)[:n]
+            for lane in np.nonzero(viol)[0]:
+                self.violation_sink(int(lane), bytes(pkt[lane, : int(length[lane])]))
+
+        # Drain the slow ring: the slow ring preserves lane order (PASS
+        # frames are queued in lane order by complete()), so align pops
+        # with the PASS lanes to recover per-lane punt flags. NAT new-flow
+        # punts are handled inline; everything else goes to the slow-path
+        # handler, whose replies are injected on the TX ring (the Go
+        # server's socket-write role). Per-frame handler errors must not
+        # abort the drain: a partially drained slow ring would misalign
+        # every later batch's lane/punt matching (and wedge PyRing).
+        punt = np.asarray(res.nat_punt)[:n]
+        for lane in np.nonzero(vv == VERDICT_PASS)[0]:
+            got = ring.slow_pop()
+            if got is None:
+                break  # slow ring overflowed during complete()
+            frame, fl = got
+            try:
+                if punt[lane]:
+                    self._punt_new_flow(frame, int(now))
+                elif self.slow_path is not None:
+                    reply = self.slow_path(frame)
+                    if reply is not None:
+                        ring.tx_inject(reply, from_access=(fl & 0x1) != 0)
+            except Exception:  # noqa: BLE001 — slow path is untrusted input
+                self.stats.slow_errors += 1
+        return n
 
     def _punt_new_flow(self, frame: bytes, now: int) -> None:
         """Device egress-miss: create the session host-side (packet 1 of a

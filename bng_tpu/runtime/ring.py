@@ -1,0 +1,378 @@
+"""Python binding for the native packet ring (native/bngring.cpp).
+
+The ring is the pkg/ebpf replacement's I/O half (SURVEY.md §7): an
+AF_XDP-style UMEM + SPSC descriptor rings in C++, consumed here via
+ctypes (no pybind11 in the image — C ABI + ctypes is the binding layer).
+
+Build model: the .so is compiled on demand from the in-tree source with
+g++ (mirroring how the reference ships bpf/ sources and compiles with
+clang at build time, bpf/Makefile). If no C++ toolchain is available the
+pure-Python `PyRing` fallback provides the same API — the _stub.go role
+(SURVEY.md §4.6) — so tests and dev hosts never hard-require the native
+build.
+"""
+
+from __future__ import annotations
+
+import ctypes as C
+import os
+import subprocess
+import threading
+from collections import deque
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC_DIR = os.path.join(os.path.dirname(os.path.dirname(_HERE)), "native")
+_SO_PATH = os.path.join(_HERE, "libbngring.so")
+
+FLAG_FROM_ACCESS = 0x1
+
+VERDICT_PASS, VERDICT_DROP, VERDICT_TX, VERDICT_FWD = 0, 1, 2, 3
+
+
+class RingStats(C.Structure):
+    _fields_ = [
+        ("rx", C.c_uint64),
+        ("tx", C.c_uint64),
+        ("fwd", C.c_uint64),
+        ("drop", C.c_uint64),
+        ("slow", C.c_uint64),
+        ("fill_empty", C.c_uint64),
+        ("rx_full", C.c_uint64),
+        ("tx_full", C.c_uint64),
+        ("bad_desc", C.c_uint64),
+    ]
+
+
+class Desc(C.Structure):
+    """Python mirror of bng_desc — layout asserted against the C side."""
+
+    _fields_ = [
+        ("addr", C.c_uint64),
+        ("len", C.c_uint32),
+        ("flags", C.c_uint32),
+    ]
+
+
+_lib = None
+_lib_lock = threading.Lock()
+
+
+def _build_so() -> str | None:
+    src = os.path.join(_SRC_DIR, "bngring.cpp")
+    if not os.path.exists(src):
+        return None
+    if os.path.exists(_SO_PATH) and os.path.getmtime(_SO_PATH) >= os.path.getmtime(src):
+        return _SO_PATH
+    cmd = ["g++", "-O2", "-g", "-Wall", "-fPIC", "-std=c++17", "-shared",
+           "-o", _SO_PATH, src]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+    except (OSError, subprocess.SubprocessError):
+        return None
+    return _SO_PATH
+
+
+def load_native():
+    """Load (building if needed) the native library, or None."""
+    global _lib
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        path = _build_so()
+        if path is None:
+            return None
+        try:
+            lib = C.CDLL(path)
+        except OSError:
+            return None
+        lib.bng_ring_create.restype = C.c_void_p
+        lib.bng_ring_create.argtypes = [C.c_uint32, C.c_uint32, C.c_uint32]
+        lib.bng_ring_destroy.argtypes = [C.c_void_p]
+        lib.bng_ring_umem.restype = C.POINTER(C.c_uint8)
+        lib.bng_ring_umem.argtypes = [C.c_void_p]
+        lib.bng_ring_umem_size.restype = C.c_uint64
+        lib.bng_ring_umem_size.argtypes = [C.c_void_p]
+        lib.bng_ring_frame_size.restype = C.c_uint32
+        lib.bng_ring_frame_size.argtypes = [C.c_void_p]
+        lib.bng_ring_rx_push.restype = C.c_int
+        lib.bng_ring_rx_push.argtypes = [C.c_void_p, C.POINTER(C.c_uint8),
+                                         C.c_uint32, C.c_uint32]
+        lib.bng_batch_assemble.restype = C.c_uint32
+        lib.bng_batch_assemble.argtypes = [
+            C.c_void_p, C.POINTER(C.c_uint8), C.POINTER(C.c_uint32),
+            C.POINTER(C.c_uint32), C.c_uint32, C.c_uint32]
+        lib.bng_ring_tx_inject.restype = C.c_int
+        lib.bng_ring_tx_inject.argtypes = [C.c_void_p, C.POINTER(C.c_uint8),
+                                           C.c_uint32, C.c_uint32]
+        lib.bng_batch_complete.restype = C.c_int
+        lib.bng_batch_complete.argtypes = [
+            C.c_void_p, C.POINTER(C.c_uint8), C.POINTER(C.c_uint8),
+            C.POINTER(C.c_uint32), C.c_uint32, C.c_uint32]
+        for name in ("tx", "fwd", "slow"):
+            fn = getattr(lib, f"bng_ring_{name}_pop")
+            fn.restype = C.c_int
+            fn.argtypes = [C.c_void_p, C.POINTER(C.c_uint8), C.c_uint32,
+                           C.POINTER(C.c_uint32)]
+        for name in ("rx_pending", "tx_pending", "fwd_pending",
+                     "slow_pending", "free_frames"):
+            fn = getattr(lib, f"bng_ring_{name}")
+            fn.restype = C.c_uint32
+            fn.argtypes = [C.c_void_p]
+        lib.bng_ring_get_stats.argtypes = [C.c_void_p, C.POINTER(RingStats)]
+        lib.bng_wire_pump.restype = C.c_int
+        lib.bng_wire_pump.argtypes = [C.c_void_p, C.c_void_p, C.c_uint32]
+        for name in ("desc_size", "desc_addr_off", "desc_len_off",
+                     "desc_flags_off", "stats_size", "version"):
+            fn = getattr(lib, f"bng_abi_{name}")
+            fn.restype = C.c_uint32
+            fn.argtypes = []
+        _lib = lib
+        return _lib
+
+
+def _u8p(arr: np.ndarray):
+    return arr.ctypes.data_as(C.POINTER(C.c_uint8))
+
+
+def _u32p(arr: np.ndarray):
+    return arr.ctypes.data_as(C.POINTER(C.c_uint32))
+
+
+class NativeRing:
+    """One port's ring pair backed by the C++ UMEM/SPSC implementation."""
+
+    def __init__(self, nframes: int = 4096, frame_size: int = 2048,
+                 depth: int = 1024):
+        lib = load_native()
+        if lib is None:
+            raise RuntimeError("native ring library unavailable")
+        self._lib = lib
+        self._h = lib.bng_ring_create(nframes, frame_size, depth)
+        if not self._h:
+            raise RuntimeError("bng_ring_create failed (sizes must be pow2)")
+        self.frame_size = frame_size
+        self.depth = depth
+
+    def close(self) -> None:
+        if self._h:
+            self._lib.bng_ring_destroy(self._h)
+            self._h = None
+
+    def __del__(self):  # pragma: no cover
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    # -- producer --
+    def rx_push(self, frame: bytes, from_access: bool = True) -> bool:
+        buf = np.frombuffer(frame, dtype=np.uint8)
+        fl = FLAG_FROM_ACCESS if from_access else 0
+        return self._lib.bng_ring_rx_push(self._h, _u8p(buf), len(frame), fl) == 0
+
+    def tx_inject(self, frame: bytes, from_access: bool = True) -> bool:
+        buf = np.frombuffer(frame, dtype=np.uint8)
+        fl = FLAG_FROM_ACCESS if from_access else 0
+        return self._lib.bng_ring_tx_inject(self._h, _u8p(buf), len(frame), fl) == 0
+
+    # -- consumer --
+    def assemble(self, out: np.ndarray, out_len: np.ndarray,
+                 out_flags: np.ndarray) -> int:
+        """Fill out[B, slot] (uint8 C-contiguous) from RX; returns count."""
+        B, slot = out.shape
+        return int(self._lib.bng_batch_assemble(
+            self._h, _u8p(out), _u32p(out_len), _u32p(out_flags), B, slot))
+
+    def complete(self, verdict: np.ndarray, out: np.ndarray,
+                 out_len: np.ndarray, n: int) -> None:
+        slot = out.shape[1]
+        rc = self._lib.bng_batch_complete(
+            self._h, _u8p(verdict.astype(np.uint8, copy=False)), _u8p(out),
+            _u32p(out_len), n, slot)
+        if rc != 0:
+            raise RuntimeError("batch_complete: no batch in flight / n mismatch")
+
+    def _pop(self, which: str) -> tuple[bytes, int] | None:
+        buf = np.zeros((self.frame_size,), dtype=np.uint8)
+        fl = C.c_uint32(0)
+        rc = getattr(self._lib, f"bng_ring_{which}_pop")(
+            self._h, _u8p(buf), self.frame_size, C.byref(fl))
+        if rc <= 0:
+            return None
+        return bytes(buf[:rc]), fl.value
+
+    def tx_pop(self):
+        return self._pop("tx")
+
+    def fwd_pop(self):
+        return self._pop("fwd")
+
+    def slow_pop(self):
+        return self._pop("slow")
+
+    # -- introspection --
+    def rx_pending(self) -> int:
+        return self._lib.bng_ring_rx_pending(self._h)
+
+    def tx_pending(self) -> int:
+        return self._lib.bng_ring_tx_pending(self._h)
+
+    def fwd_pending(self) -> int:
+        return self._lib.bng_ring_fwd_pending(self._h)
+
+    def slow_pending(self) -> int:
+        return self._lib.bng_ring_slow_pending(self._h)
+
+    def free_frames(self) -> int:
+        return self._lib.bng_ring_free_frames(self._h)
+
+    def stats(self) -> dict:
+        s = RingStats()
+        self._lib.bng_ring_get_stats(self._h, C.byref(s))
+        return {f: getattr(s, f) for f, _ in RingStats._fields_}
+
+
+def wire_pump(a, b, budget: int = 256) -> int:
+    """Loopback cable between two rings (tests/demo): moves TX+FWD output
+    of each ring into the peer's RX, flipping the from_access flag (a
+    frame leaving the access side arrives at the core side)."""
+    if isinstance(a, NativeRing) and isinstance(b, NativeRing):
+        return a._lib.bng_wire_pump(a._h, b._h, budget)
+    moved = 0
+    for src, dst in ((a, b), (b, a)):
+        for _ in range(budget):
+            got = src.tx_pop() or src.fwd_pop()
+            if got is None:
+                break
+            frame, fl = got
+            dst.rx_push(frame, from_access=(fl & FLAG_FROM_ACCESS) == 0)
+            moved += 1
+    return moved
+
+
+class PyRing:
+    """Pure-Python ring with the NativeRing API (the _stub.go fallback)."""
+
+    def __init__(self, nframes: int = 4096, frame_size: int = 2048,
+                 depth: int = 1024):
+        self.frame_size = frame_size
+        self.depth = depth
+        self._free = nframes
+        self._rx: deque[tuple[bytes, int]] = deque()
+        self._tx: deque[tuple[bytes, int]] = deque()
+        self._fwd: deque[tuple[bytes, int]] = deque()
+        self._slow: deque[tuple[bytes, int]] = deque()
+        self._inflight: list[tuple[bytes, int]] = []
+        self._stats = {k: 0 for k, _ in RingStats._fields_}
+
+    def close(self) -> None:
+        pass
+
+    def rx_push(self, frame: bytes, from_access: bool = True) -> bool:
+        if len(frame) > self.frame_size:
+            self._stats["bad_desc"] += 1
+            return False
+        if self._free == 0 or len(self._rx) >= self.depth:
+            self._stats["fill_empty" if self._free == 0 else "rx_full"] += 1
+            return False
+        self._free -= 1
+        self._rx.append((frame, FLAG_FROM_ACCESS if from_access else 0))
+        return True
+
+    def tx_inject(self, frame: bytes, from_access: bool = True) -> bool:
+        if len(frame) > self.frame_size or self._free == 0 or len(self._tx) >= self.depth:
+            return False
+        self._free -= 1
+        self._tx.append((frame, FLAG_FROM_ACCESS if from_access else 0))
+        self._stats["tx"] += 1
+        return True
+
+    def assemble(self, out: np.ndarray, out_len: np.ndarray,
+                 out_flags: np.ndarray) -> int:
+        if self._inflight:
+            return 0
+        B, slot = out.shape
+        n = 0
+        while n < B and self._rx:
+            frame, fl = self._rx.popleft()
+            copy = min(len(frame), slot)
+            row = np.zeros((slot,), dtype=np.uint8)
+            row[:copy] = np.frombuffer(frame[:copy], dtype=np.uint8)
+            out[n] = row
+            out_len[n] = copy
+            out_flags[n] = fl
+            self._inflight.append((frame, fl))
+            n += 1
+        self._stats["rx"] += n
+        return n
+
+    def complete(self, verdict: np.ndarray, out: np.ndarray,
+                 out_len: np.ndarray, n: int) -> None:
+        if n != len(self._inflight):
+            raise RuntimeError("batch_complete: n mismatch")
+        for i in range(n):
+            frame, fl = self._inflight[i]
+            v = int(verdict[i])
+            if v in (VERDICT_TX, VERDICT_FWD):
+                payload = bytes(out[i, : int(out_len[i])])
+                dst, stat = (self._tx, "tx") if v == VERDICT_TX else (self._fwd, "fwd")
+            elif v == VERDICT_PASS:
+                payload, dst, stat = frame, self._slow, "slow"
+            else:
+                self._stats["drop"] += 1
+                self._free += 1
+                continue
+            if len(dst) < self.depth:
+                dst.append((payload, fl))  # frame stays held until popped
+                self._stats[stat] += 1
+            else:
+                self._stats["tx_full"] += 1
+                self._free += 1
+        self._inflight = []
+
+    def _pop(self, q: deque):
+        if not q:
+            return None
+        frame, fl = q.popleft()
+        self._free += 1
+        return frame, fl
+
+    def tx_pop(self):
+        return self._pop(self._tx)
+
+    def fwd_pop(self):
+        return self._pop(self._fwd)
+
+    def slow_pop(self):
+        return self._pop(self._slow)
+
+    def rx_pending(self) -> int:
+        return len(self._rx)
+
+    def tx_pending(self) -> int:
+        return len(self._tx)
+
+    def fwd_pending(self) -> int:
+        return len(self._fwd)
+
+    def slow_pending(self) -> int:
+        return len(self._slow)
+
+    def free_frames(self) -> int:
+        return self._free
+
+    def stats(self) -> dict:
+        return dict(self._stats)
+
+
+def make_ring(nframes: int = 4096, frame_size: int = 2048,
+              depth: int = 1024, prefer_native: bool = True):
+    """NativeRing when the toolchain allows, PyRing otherwise."""
+    if prefer_native:
+        try:
+            return NativeRing(nframes, frame_size, depth)
+        except RuntimeError:
+            pass
+    return PyRing(nframes, frame_size, depth)

@@ -22,10 +22,22 @@ _U32 = 0xFFFFFFFF
 _U64 = 0xFFFFFFFFFFFFFFFF
 
 
+def parse_mac(mac: str) -> bytes:
+    """Parse "aa:bb:cc:dd:ee:ff" (or '-' separated) into 6 bytes."""
+    parts = mac.replace("-", ":").split(":")
+    if len(parts) != 6:
+        raise ValueError(f"malformed MAC {mac!r}: want 6 colon-separated octets")
+    try:
+        out = bytes(int(p, 16) for p in parts)
+    except ValueError as e:
+        raise ValueError(f"malformed MAC {mac!r}: {e}") from None
+    return out
+
+
 def mac_to_u64(mac: bytes | str) -> int:
     """Convert a 6-byte MAC to a u64 key (big-endian, like the reference)."""
     if isinstance(mac, str):
-        mac = bytes(int(b, 16) for b in mac.split(":"))
+        mac = parse_mac(mac)
     if len(mac) != 6:
         raise ValueError(f"MAC must be 6 bytes, got {len(mac)}")
     out = 0

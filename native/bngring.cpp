@@ -1,0 +1,329 @@
+/* bngring implementation — see bngring.h for the design contract.
+ *
+ * SPSC rings follow the classic AF_XDP layout: free-running 32-bit
+ * producer/consumer cursors, power-of-two capacity, entries addressed by
+ * cursor & mask. Producer publishes with release, consumer observes with
+ * acquire; each side caches the opposite cursor to avoid cross-core
+ * traffic on every op (the if_xdp.h / io_uring discipline).
+ */
+#include "bngring.h"
+
+#include <atomic>
+#include <cstdlib>
+#include <cstring>
+#include <new>
+
+namespace {
+
+inline bool is_pow2(uint32_t v) { return v != 0 && (v & (v - 1)) == 0; }
+
+/* One SPSC descriptor ring. */
+struct Ring {
+  bng_desc *entries = nullptr;
+  uint32_t mask = 0;
+  std::atomic<uint32_t> prod{0};
+  std::atomic<uint32_t> cons{0};
+  uint32_t cached_prod = 0; /* consumer's view */
+  uint32_t cached_cons = 0; /* producer's view */
+
+  bool init(uint32_t depth) {
+    entries = static_cast<bng_desc *>(calloc(depth, sizeof(bng_desc)));
+    mask = depth - 1;
+    return entries != nullptr;
+  }
+  void fini() { free(entries); }
+
+  uint32_t size() const { return mask + 1; }
+
+  bool push(const bng_desc &d) {
+    uint32_t p = prod.load(std::memory_order_relaxed);
+    if (p - cached_cons == size()) {
+      cached_cons = cons.load(std::memory_order_acquire);
+      if (p - cached_cons == size()) return false; /* full */
+    }
+    entries[p & mask] = d;
+    prod.store(p + 1, std::memory_order_release);
+    return true;
+  }
+
+  bool pop(bng_desc *out) {
+    uint32_t c = cons.load(std::memory_order_relaxed);
+    if (cached_prod == c) {
+      cached_prod = prod.load(std::memory_order_acquire);
+      if (cached_prod == c) return false; /* empty */
+    }
+    *out = entries[c & mask];
+    cons.store(c + 1, std::memory_order_release);
+    return true;
+  }
+
+  uint32_t pending() const {
+    return prod.load(std::memory_order_acquire) -
+           cons.load(std::memory_order_acquire);
+  }
+};
+
+} // namespace
+
+struct bng_ring {
+  uint8_t *umem = nullptr;
+  uint64_t umem_size = 0;
+  uint32_t frame_size = 0;
+  uint32_t nframes = 0;
+
+  Ring fill; /* free frames (addr only) */
+  Ring rx;   /* wire -> engine */
+  Ring tx;   /* engine TX verdicts -> wire (same port) */
+  Ring fwd;  /* engine FWD verdicts -> wire (other port) */
+  Ring slow; /* engine PASS verdicts -> slow path */
+
+  /* in-flight batch (assemble..complete window) */
+  bng_desc *inflight = nullptr;
+  uint32_t inflight_n = 0;
+  uint32_t inflight_cap = 0;
+
+  bng_ring_stats stats{};
+};
+
+extern "C" {
+
+bng_ring *bng_ring_create(uint32_t nframes, uint32_t frame_size,
+                          uint32_t depth) {
+  if (!is_pow2(nframes) || !is_pow2(depth) || frame_size < 64) return nullptr;
+  auto *r = new (std::nothrow) bng_ring();
+  if (!r) return nullptr;
+  r->frame_size = frame_size;
+  r->nframes = nframes;
+  r->umem_size = static_cast<uint64_t>(nframes) * frame_size;
+  /* 64B alignment: cache-line friendly staging copies */
+  r->umem = static_cast<uint8_t *>(aligned_alloc(64, r->umem_size));
+  bool ok = r->umem && r->fill.init(nframes) && r->rx.init(depth) &&
+            r->tx.init(depth) && r->fwd.init(depth) && r->slow.init(depth);
+  r->inflight_cap = depth;
+  r->inflight = static_cast<bng_desc *>(calloc(depth, sizeof(bng_desc)));
+  ok = ok && r->inflight;
+  if (!ok) {
+    bng_ring_destroy(r);
+    return nullptr;
+  }
+  memset(r->umem, 0, r->umem_size);
+  /* all frames start free */
+  for (uint32_t i = 0; i < nframes; i++) {
+    bng_desc d{static_cast<uint64_t>(i) * frame_size, 0, 0};
+    r->fill.push(d);
+  }
+  return r;
+}
+
+void bng_ring_destroy(bng_ring *r) {
+  if (!r) return;
+  r->fill.fini();
+  r->rx.fini();
+  r->tx.fini();
+  r->fwd.fini();
+  r->slow.fini();
+  free(r->inflight);
+  free(r->umem);
+  delete r;
+}
+
+uint8_t *bng_ring_umem(bng_ring *r) { return r->umem; }
+uint64_t bng_ring_umem_size(bng_ring *r) { return r->umem_size; }
+uint32_t bng_ring_frame_size(bng_ring *r) { return r->frame_size; }
+
+static bool valid_addr(bng_ring *r, uint64_t addr) {
+  return addr < r->umem_size && addr % r->frame_size == 0;
+}
+
+uint64_t bng_ring_rx_reserve(bng_ring *r) {
+  bng_desc d;
+  if (!r->fill.pop(&d)) {
+    r->stats.fill_empty++;
+    return UINT64_MAX;
+  }
+  return d.addr;
+}
+
+int bng_ring_rx_submit(bng_ring *r, uint64_t addr, uint32_t len,
+                       uint32_t flags) {
+  if (!valid_addr(r, addr) || len > r->frame_size) {
+    r->stats.bad_desc++;
+    return -1;
+  }
+  bng_desc d{addr, len, flags};
+  if (!r->rx.push(d)) {
+    r->stats.rx_full++;
+    r->fill.push(d); /* recycle */
+    return -1;
+  }
+  return 0;
+}
+
+int bng_ring_rx_push(bng_ring *r, const uint8_t *data, uint32_t len,
+                     uint32_t flags) {
+  if (len > r->frame_size) {
+    r->stats.bad_desc++;
+    return -1;
+  }
+  uint64_t addr = bng_ring_rx_reserve(r);
+  if (addr == UINT64_MAX) return -1;
+  memcpy(r->umem + addr, data, len);
+  return bng_ring_rx_submit(r, addr, len, flags);
+}
+
+uint32_t bng_batch_assemble(bng_ring *r, uint8_t *out, uint32_t *out_len,
+                            uint32_t *out_flags, uint32_t max_batch,
+                            uint32_t slot) {
+  if (r->inflight_n != 0) return 0; /* previous batch not completed */
+  if (max_batch > r->inflight_cap) max_batch = r->inflight_cap;
+  uint32_t n = 0;
+  bng_desc d;
+  while (n < max_batch && r->rx.pop(&d)) {
+    uint32_t copy = d.len < slot ? d.len : slot;
+    memcpy(out + static_cast<size_t>(n) * slot, r->umem + d.addr, copy);
+    if (copy < slot)
+      memset(out + static_cast<size_t>(n) * slot + copy, 0, slot - copy);
+    out_len[n] = copy;
+    out_flags[n] = d.flags;
+    r->inflight[n] = d;
+    n++;
+  }
+  r->inflight_n = n;
+  r->stats.rx += n;
+  return n;
+}
+
+int bng_batch_complete(bng_ring *r, const uint8_t *verdict,
+                       const uint8_t *out, const uint32_t *out_len,
+                       uint32_t n, uint32_t slot) {
+  if (n != r->inflight_n || n > r->inflight_cap) return -1;
+  for (uint32_t i = 0; i < n; i++) {
+    bng_desc d = r->inflight[i];
+    uint8_t v = verdict[i];
+    if (v == BNG_VERDICT_TX || v == BNG_VERDICT_FWD) {
+      /* device rewrote the packet: copy staged bytes back over the frame */
+      uint32_t len = out_len[i];
+      if (len > r->frame_size) len = r->frame_size;
+      if (out) {
+        memcpy(r->umem + d.addr, out + static_cast<size_t>(i) * slot,
+               len < slot ? len : slot);
+      }
+      d.len = len;
+      Ring &dst = (v == BNG_VERDICT_TX) ? r->tx : r->fwd;
+      if (dst.push(d)) {
+        if (v == BNG_VERDICT_TX) r->stats.tx++;
+        else r->stats.fwd++;
+      } else {
+        r->stats.tx_full++;
+        r->fill.push(d);
+      }
+    } else if (v == BNG_VERDICT_PASS) {
+      if (r->slow.push(d)) r->stats.slow++;
+      else {
+        r->stats.tx_full++;
+        r->fill.push(d);
+      }
+    } else { /* DROP (and any unknown verdict fails closed) */
+      r->stats.drop++;
+      r->fill.push(d);
+    }
+  }
+  r->inflight_n = 0;
+  return 0;
+}
+
+int bng_ring_tx_inject(bng_ring *r, const uint8_t *data, uint32_t len,
+                       uint32_t flags) {
+  if (len > r->frame_size) {
+    r->stats.bad_desc++;
+    return -1;
+  }
+  bng_desc d;
+  if (!r->fill.pop(&d)) {
+    r->stats.fill_empty++;
+    return -1;
+  }
+  memcpy(r->umem + d.addr, data, len);
+  d.len = len;
+  d.flags = flags;
+  if (!r->tx.push(d)) {
+    r->stats.tx_full++;
+    r->fill.push(d);
+    return -1;
+  }
+  r->stats.tx++;
+  return 0;
+}
+
+static int pop_from(bng_ring *r, Ring &ring, uint8_t *buf, uint32_t cap,
+                    uint32_t *flags) {
+  bng_desc d;
+  if (!ring.pop(&d)) return 0;
+  int rc;
+  if (d.len <= cap) {
+    memcpy(buf, r->umem + d.addr, d.len);
+    rc = static_cast<int>(d.len);
+  } else {
+    rc = -1;
+  }
+  if (flags) *flags = d.flags;
+  r->fill.push(d); /* recycle */
+  return rc;
+}
+
+int bng_ring_tx_pop(bng_ring *r, uint8_t *buf, uint32_t cap,
+                    uint32_t *flags) {
+  return pop_from(r, r->tx, buf, cap, flags);
+}
+int bng_ring_fwd_pop(bng_ring *r, uint8_t *buf, uint32_t cap,
+                     uint32_t *flags) {
+  return pop_from(r, r->fwd, buf, cap, flags);
+}
+int bng_ring_slow_pop(bng_ring *r, uint8_t *buf, uint32_t cap,
+                      uint32_t *flags) {
+  return pop_from(r, r->slow, buf, cap, flags);
+}
+
+uint32_t bng_ring_rx_pending(bng_ring *r) { return r->rx.pending(); }
+uint32_t bng_ring_tx_pending(bng_ring *r) { return r->tx.pending(); }
+uint32_t bng_ring_fwd_pending(bng_ring *r) { return r->fwd.pending(); }
+uint32_t bng_ring_slow_pending(bng_ring *r) { return r->slow.pending(); }
+uint32_t bng_ring_free_frames(bng_ring *r) { return r->fill.pending(); }
+
+void bng_ring_get_stats(bng_ring *r, bng_ring_stats *out) {
+  *out = r->stats;
+}
+
+/* Move up to budget frames per direction between two rings' output sides
+ * and the peer's RX. TX and FWD both land on the peer wire (a loopback
+ * cable has one far end). */
+static uint32_t pump_dir(bng_ring *src, bng_ring *dst, uint32_t budget) {
+  uint32_t moved = 0;
+  bng_desc d;
+  while (moved < budget) {
+    bool got = src->tx.pop(&d);
+    if (!got) got = src->fwd.pop(&d);
+    if (!got) break;
+    /* flags flip: frames leaving the access side arrive at the core side */
+    uint32_t fl = d.flags ^ BNG_DESC_F_FROM_ACCESS;
+    bng_ring_rx_push(dst, src->umem + d.addr, d.len, fl);
+    src->fill.push(d);
+    moved++;
+  }
+  return moved;
+}
+
+int bng_wire_pump(bng_ring *a, bng_ring *b, uint32_t budget) {
+  uint32_t m = pump_dir(a, b, budget);
+  m += pump_dir(b, a, budget);
+  return static_cast<int>(m);
+}
+
+uint32_t bng_abi_desc_size(void) { return sizeof(bng_desc); }
+uint32_t bng_abi_desc_addr_off(void) { return offsetof(bng_desc, addr); }
+uint32_t bng_abi_desc_len_off(void) { return offsetof(bng_desc, len); }
+uint32_t bng_abi_desc_flags_off(void) { return offsetof(bng_desc, flags); }
+uint32_t bng_abi_stats_size(void) { return sizeof(bng_ring_stats); }
+uint32_t bng_abi_version(void) { return 1; }
+
+} /* extern "C" */

@@ -1,0 +1,158 @@
+/* bngring — AF_XDP-style zero-copy packet ring for the TPU dataplane.
+ *
+ * This is the native host runtime the build plan calls for (SURVEY.md §7
+ * "I/O: C++ host runtime implementing the AF_XDP zero-copy ring — the new
+ * pkg/ebpf role"). The reference's pkg/ebpf loads BPF programs and talks to
+ * kernel maps (pkg/ebpf/loader.go:74-661); here the "program" runs on the
+ * TPU, so the native layer's job is moving frames:
+ *
+ *   NIC/driver -> UMEM frames -> RX ring -> batch assembler -> [B,L] buffer
+ *       -> (TPU pipeline, Python/JAX) -> verdicts -> TX/forward/slow rings
+ *
+ * Layout mirrors AF_XDP (if_xdp.h): one UMEM frame area + four
+ * single-producer/single-consumer descriptor rings (fill, rx, tx,
+ * completion), plus two verdict-side rings (forward, slow/punt). Rings are
+ * lock-free SPSC with acquire/release ordering, power-of-two sized.
+ *
+ * The batch assembler writes frames into a caller-provided contiguous
+ * [B, slot] buffer — the same buffer handed to jax.device_put — so the
+ * only copy on the hot path is the unavoidable host->HBM DMA staging.
+ * Verdict application (bng_batch_complete) is the XDP_TX / XDP_PASS /
+ * TC_ACT_SHOT demux of the reference's hook returns (SURVEY.md §1 L0).
+ *
+ * C ABI throughout: consumed from Python via ctypes (no pybind11 in the
+ * image) and from any future C++ driver (AF_XDP socket, DPDK port).
+ */
+#ifndef BNGRING_H
+#define BNGRING_H
+
+#include <stddef.h>
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+/* Verdicts — must match bng_tpu/ops/pipeline.py VERDICT_*. */
+enum bng_verdict {
+  BNG_VERDICT_PASS = 0, /* slow path (XDP_PASS role) */
+  BNG_VERDICT_DROP = 1, /* TC_ACT_SHOT role */
+  BNG_VERDICT_TX = 2,   /* device-built reply out same port (XDP_TX role) */
+  BNG_VERDICT_FWD = 3,  /* rewritten, forward out the other port */
+};
+
+/* Frame descriptor — the xdp_desc role (addr is a UMEM byte offset). */
+typedef struct bng_desc {
+  uint64_t addr;
+  uint32_t len;
+  uint32_t flags; /* bit0: from_access (subscriber-side ingress) */
+} bng_desc;
+
+#define BNG_DESC_F_FROM_ACCESS 0x1u
+
+typedef struct bng_ring_stats {
+  uint64_t rx;          /* frames assembled into batches */
+  uint64_t tx;          /* TX verdict frames queued */
+  uint64_t fwd;         /* FWD verdict frames queued */
+  uint64_t drop;        /* DROP verdict frames recycled */
+  uint64_t slow;        /* PASS verdict frames queued for slow path */
+  uint64_t fill_empty;  /* producer stalls: no free frame in fill ring */
+  uint64_t rx_full;     /* producer stalls: rx ring full */
+  uint64_t tx_full;     /* tx/fwd/slow ring full -> frame dropped */
+  uint64_t bad_desc;    /* descriptor validation failures */
+} bng_ring_stats;
+
+typedef struct bng_ring bng_ring; /* opaque */
+
+/* ---- lifecycle ---- */
+
+/* Create a ring pair over a private UMEM.
+ * nframes, depth: power of two. frame_size: bytes per UMEM slot (>= 64). */
+bng_ring *bng_ring_create(uint32_t nframes, uint32_t frame_size,
+                          uint32_t depth);
+void bng_ring_destroy(bng_ring *r);
+
+/* Raw UMEM view (for tests / zero-copy producers). */
+uint8_t *bng_ring_umem(bng_ring *r);
+uint64_t bng_ring_umem_size(bng_ring *r);
+uint32_t bng_ring_frame_size(bng_ring *r);
+
+/* ---- producer side (driver / wire) ---- */
+
+/* Push one frame: grabs a free UMEM slot, copies data, enqueues on RX.
+ * Returns 0 on success, -1 if no free frame or RX full. */
+int bng_ring_rx_push(bng_ring *r, const uint8_t *data, uint32_t len,
+                     uint32_t flags);
+
+/* Zero-copy producer path: reserve a free frame (returns UMEM offset or
+ * UINT64_MAX), write into bng_ring_umem()+off, then submit. */
+uint64_t bng_ring_rx_reserve(bng_ring *r);
+int bng_ring_rx_submit(bng_ring *r, uint64_t addr, uint32_t len,
+                       uint32_t flags);
+
+/* ---- consumer side (TPU engine) ---- */
+
+/* Pop up to max_batch RX frames into out[b*slot .. b*slot+len) and
+ * out_len[b]/out_flags[b]; parks the popped descriptors in the in-flight
+ * table. Frames longer than slot are truncated (slot bytes staged; full
+ * frame stays in UMEM for TX-side use). Returns number of frames. */
+uint32_t bng_batch_assemble(bng_ring *r, uint8_t *out, uint32_t *out_len,
+                            uint32_t *out_flags, uint32_t max_batch,
+                            uint32_t slot);
+
+/* Apply per-lane verdicts to the in-flight batch from the last assemble.
+ * For TX/FWD lanes, rewritten bytes come from out[b*slot..] with
+ * out_len[b] (device-rewritten packet); the frame is updated in UMEM and
+ * queued on the tx/fwd ring. PASS lanes go to the slow ring; DROP lanes
+ * are recycled to the fill pool. n must equal the last assemble count.
+ * Returns 0, or -1 if no batch is in flight / n mismatch. */
+int bng_batch_complete(bng_ring *r, const uint8_t *verdict,
+                       const uint8_t *out, const uint32_t *out_len,
+                       uint32_t n, uint32_t slot);
+
+/* Inject a host-built frame onto the TX ring (slow-path replies: the
+ * reference's Go server answers via its own socket, pkg/dhcp/server.go;
+ * here replies leave through the same wire as device TX). Returns 0, or
+ * -1 if no free frame / ring full. */
+int bng_ring_tx_inject(bng_ring *r, const uint8_t *data, uint32_t len,
+                       uint32_t flags);
+
+/* Drain one frame from the tx / fwd / slow ring into buf (cap bytes).
+ * Returns frame length, 0 if empty, or -1 on truncation (frame bigger
+ * than cap; frame is consumed). Recycles the UMEM frame. */
+int bng_ring_tx_pop(bng_ring *r, uint8_t *buf, uint32_t cap,
+                    uint32_t *flags);
+int bng_ring_fwd_pop(bng_ring *r, uint8_t *buf, uint32_t cap,
+                     uint32_t *flags);
+int bng_ring_slow_pop(bng_ring *r, uint8_t *buf, uint32_t cap,
+                      uint32_t *flags);
+
+/* Pending counts (consumer-visible). */
+uint32_t bng_ring_rx_pending(bng_ring *r);
+uint32_t bng_ring_tx_pending(bng_ring *r);
+uint32_t bng_ring_fwd_pending(bng_ring *r);
+uint32_t bng_ring_slow_pending(bng_ring *r);
+uint32_t bng_ring_free_frames(bng_ring *r);
+
+void bng_ring_get_stats(bng_ring *r, bng_ring_stats *out);
+
+/* ---- loopback wire (tests / demo) ----
+ * Connect two rings so a's TX+FWD output is delivered into b's RX and
+ * vice versa; bng_wire_pump moves up to budget frames per direction.
+ * This is the stub-platform role of the reference's _stub.go backends
+ * (SURVEY.md §4.6) — same API as a real port, memory transport. */
+int bng_wire_pump(bng_ring *a, bng_ring *b, uint32_t budget);
+
+/* ---- ABI self-description (layout tests, test/ebpf/maps_test.go role) */
+uint32_t bng_abi_desc_size(void);
+uint32_t bng_abi_desc_addr_off(void);
+uint32_t bng_abi_desc_len_off(void);
+uint32_t bng_abi_desc_flags_off(void);
+uint32_t bng_abi_stats_size(void);
+uint32_t bng_abi_version(void);
+
+#ifdef __cplusplus
+} /* extern "C" */
+#endif
+
+#endif /* BNGRING_H */

@@ -1,0 +1,242 @@
+"""Native packet ring: ABI layout, SPSC semantics, verdict demux, and the
+ring-driven end-to-end DORA loop.
+
+The ABI tests are the test/ebpf/maps_test.go role (reference asserts
+unsafe.Sizeof(Go mirror) == C layout, maps_test.go:17-80): here the C
+library self-describes bng_desc offsets and the ctypes mirror must match
+byte-for-byte, or host<->native frame descriptors would corrupt.
+
+Every behavioral test runs against BOTH backends (NativeRing via the C++
+.so built from native/bngring.cpp, and the PyRing stub) — the reference's
+_linux.go/_stub.go parity discipline (SURVEY.md §4.6).
+"""
+
+import ctypes as C
+
+import numpy as np
+import pytest
+
+from bng_tpu.runtime.ring import (
+    Desc,
+    NativeRing,
+    PyRing,
+    RingStats,
+    load_native,
+    wire_pump,
+)
+
+native_available = load_native() is not None
+
+
+@pytest.fixture(params=["native", "py"])
+def ring_cls(request):
+    if request.param == "native":
+        if not native_available:
+            pytest.skip("native toolchain unavailable")
+        return NativeRing
+    return PyRing
+
+
+class TestABI:
+    """Host mirror <-> C layout (maps_test.go:17-80 role)."""
+
+    @pytest.mark.skipif(not native_available, reason="no native lib")
+    def test_desc_layout(self):
+        lib = load_native()
+        assert lib.bng_abi_desc_size() == C.sizeof(Desc)
+        assert lib.bng_abi_desc_addr_off() == Desc.addr.offset
+        assert lib.bng_abi_desc_len_off() == Desc.len.offset
+        assert lib.bng_abi_desc_flags_off() == Desc.flags.offset
+
+    @pytest.mark.skipif(not native_available, reason="no native lib")
+    def test_stats_layout_and_version(self):
+        lib = load_native()
+        assert lib.bng_abi_stats_size() == C.sizeof(RingStats)
+        assert lib.bng_abi_version() == 1
+
+
+class TestRingBasics:
+    def test_push_assemble_roundtrip(self, ring_cls):
+        r = ring_cls(nframes=64, frame_size=256, depth=32)
+        frames = [bytes([i]) * (20 + i) for i in range(5)]
+        for i, f in enumerate(frames):
+            assert r.rx_push(f, from_access=(i % 2 == 0))
+        assert r.rx_pending() == 5
+
+        out = np.zeros((8, 128), dtype=np.uint8)
+        ln = np.zeros((8,), dtype=np.uint32)
+        fl = np.zeros((8,), dtype=np.uint32)
+        n = r.assemble(out, ln, fl)
+        assert n == 5
+        for i, f in enumerate(frames):
+            assert bytes(out[i, : ln[i]]) == f
+            assert (fl[i] & 1) == (1 if i % 2 == 0 else 0)
+        r.close()
+
+    def test_verdict_demux(self, ring_cls):
+        r = ring_cls(nframes=64, frame_size=256, depth=32)
+        for i in range(4):
+            r.rx_push(bytes([i]) * 64)
+        out = np.zeros((8, 128), dtype=np.uint8)
+        ln = np.zeros((8,), dtype=np.uint32)
+        fl = np.zeros((8,), dtype=np.uint32)
+        n = r.assemble(out, ln, fl)
+        assert n == 4
+
+        # lane 0 TX (rewritten), 1 DROP, 2 FWD (rewritten), 3 PASS
+        out[0, :4] = (0xAA, 0xBB, 0xCC, 0xDD)
+        ln[0] = 4
+        out[2, :2] = (0x11, 0x22)
+        ln[2] = 2
+        verdict = np.array([2, 1, 3, 0], dtype=np.uint8)
+        r.complete(verdict, out, ln, n)
+
+        assert r.tx_pending() == 1 and r.fwd_pending() == 1 and r.slow_pending() == 1
+        frame, _ = r.tx_pop()
+        assert frame == bytes([0xAA, 0xBB, 0xCC, 0xDD])
+        frame, _ = r.fwd_pop()
+        assert frame == bytes([0x11, 0x22])
+        frame, _ = r.slow_pop()
+        assert frame == bytes([3]) * 64  # PASS keeps original bytes
+        s = r.stats()
+        assert s["tx"] == 1 and s["fwd"] == 1 and s["drop"] == 1 and s["slow"] == 1
+        r.close()
+
+    def test_frames_recycle(self, ring_cls):
+        r = ring_cls(nframes=8, frame_size=128, depth=8)
+        out = np.zeros((8, 128), dtype=np.uint8)
+        ln = np.zeros((8,), dtype=np.uint32)
+        fl = np.zeros((8,), dtype=np.uint32)
+        for _round in range(5):  # > nframes total frames: must recycle
+            for i in range(4):
+                assert r.rx_push(b"x" * 60)
+            n = r.assemble(out, ln, fl)
+            r.complete(np.full((n,), 1, dtype=np.uint8), out, ln, n)  # DROP all
+        assert r.free_frames() == 8
+
+    def test_fill_exhaustion(self, ring_cls):
+        r = ring_cls(nframes=8, frame_size=128, depth=16)
+        ok = sum(1 for _ in range(12) if r.rx_push(b"y" * 32))
+        assert ok == 8  # only nframes fit
+        assert r.stats()["fill_empty"] >= 1 or r.free_frames() == 0
+        r.close()
+
+    def test_oversize_frame_rejected(self, ring_cls):
+        r = ring_cls(nframes=8, frame_size=128, depth=8)
+        assert not r.rx_push(b"z" * 500)
+        r.close()
+
+    def test_tx_inject(self, ring_cls):
+        r = ring_cls(nframes=8, frame_size=128, depth=8)
+        assert r.tx_inject(b"reply" * 4)
+        frame, fl = r.tx_pop()
+        assert frame == b"reply" * 4 and (fl & 1) == 1
+        r.close()
+
+    def test_assemble_requires_complete(self, ring_cls):
+        r = ring_cls(nframes=8, frame_size=128, depth=8)
+        r.rx_push(b"a" * 32)
+        out = np.zeros((4, 64), dtype=np.uint8)
+        ln = np.zeros((4,), dtype=np.uint32)
+        fl = np.zeros((4,), dtype=np.uint32)
+        assert r.assemble(out, ln, fl) == 1
+        r.rx_push(b"b" * 32)
+        assert r.assemble(out, ln, fl) == 0  # in-flight batch blocks
+        r.complete(np.array([1], dtype=np.uint8), out, ln, 1)
+        assert r.assemble(out, ln, fl) == 1
+        r.close()
+
+
+class TestWire:
+    def test_loopback_pump_flips_direction(self, ring_cls):
+        a = ring_cls(nframes=32, frame_size=256, depth=16)
+        b = ring_cls(nframes=32, frame_size=256, depth=16)
+        a.rx_push(b"ping" * 8, from_access=True)
+        out = np.zeros((4, 128), dtype=np.uint8)
+        ln = np.zeros((4,), dtype=np.uint32)
+        fl = np.zeros((4,), dtype=np.uint32)
+        n = a.assemble(out, ln, fl)
+        r_verdict = np.array([3], dtype=np.uint8)  # FWD
+        a.complete(r_verdict, out, ln, n)
+        moved = wire_pump(a, b, budget=8)
+        assert moved == 1
+        n = b.assemble(out, ln, fl)
+        assert n == 1 and (fl[0] & 1) == 0  # arrived on the core side
+        a.close()
+        b.close()
+
+
+class TestRingEngine:
+    """Ring-driven end-to-end: the production I/O loop."""
+
+    def _stack(self, ring):
+        from bng_tpu.control.dhcp_server import DHCPServer
+        from bng_tpu.control.nat import NATManager
+        from bng_tpu.control.pool import Pool, PoolManager
+        from bng_tpu.runtime.engine import Engine
+        from bng_tpu.runtime.tables import FastPathTables
+        from bng_tpu.utils.net import ip_to_u32
+
+        server_mac = bytes.fromhex("02aabbccdd01")
+        server_ip = ip_to_u32("10.0.0.1")
+        fastpath = FastPathTables(sub_nbuckets=512, vlan_nbuckets=64,
+                                  cid_nbuckets=64, max_pools=16)
+        fastpath.set_server_config(server_mac, server_ip)
+        pools = PoolManager(fastpath)
+        pools.add_pool(Pool(pool_id=1, network=ip_to_u32("10.0.0.0"),
+                            prefix_len=24, gateway=server_ip,
+                            dns_primary=ip_to_u32("1.1.1.1"), lease_time=3600))
+        nat = NATManager(public_ips=[ip_to_u32("203.0.113.1")],
+                         sessions_nbuckets=256, sub_nat_nbuckets=64)
+        server = DHCPServer(server_mac, server_ip, pools,
+                            fastpath_tables=fastpath,
+                            clock=lambda: 1_753_000_000.0)
+        engine = Engine(fastpath, nat, batch_size=8,
+                        slow_path=server.handle_frame,
+                        clock=lambda: 1_753_000_000.0)
+        return engine, server
+
+    def test_ring_dora_slow_then_fast(self, ring_cls):
+        from bng_tpu.control import dhcp_codec, packets
+
+        ring = ring_cls(nframes=64, frame_size=1024, depth=32)
+        engine, server = self._stack(ring)
+        mac = bytes.fromhex("02c0ffee0009")
+
+        def discover():
+            p = dhcp_codec.build_request(mac, dhcp_codec.DISCOVER)
+            p.options.append((dhcp_codec.OPT_PARAM_REQ_LIST, bytes([1, 3, 6, 51, 54])))
+            return packets.udp_packet(mac, b"\xff" * 6, 0, 0xFFFFFFFF, 68, 67,
+                                      p.encode().ljust(320, b"\x00"))
+
+        # DISCOVER #1: misses on device -> PASS -> slow path -> OFFER injected
+        ring.rx_push(discover(), from_access=True)
+        n = engine.process_ring(ring)
+        assert n == 1
+        assert engine.stats.passed == 1
+        got = ring.tx_pop()
+        assert got is not None
+        offer, _ = got
+        parsed = dhcp_codec.decode(packets.decode(offer).payload)
+        assert parsed.msg_type == dhcp_codec.OFFER
+
+        # REQUEST via slow path installs the fast-path entry
+        req = dhcp_codec.build_request(mac, dhcp_codec.REQUEST)
+        req.options.append((dhcp_codec.OPT_REQUESTED_IP, parsed.yiaddr.to_bytes(4, 'big')))
+        req.options.append((dhcp_codec.OPT_SERVER_ID,
+                            packets.decode(offer).src_ip.to_bytes(4, "big")))
+        ring.rx_push(packets.udp_packet(mac, b"\xff" * 6, 0, 0xFFFFFFFF, 68, 67,
+                                        req.encode().ljust(320, b"\x00")))
+        engine.process_ring(ring)
+        ack, _ = ring.tx_pop()
+        assert dhcp_codec.decode(packets.decode(ack).payload).msg_type == dhcp_codec.ACK
+
+        # DISCOVER #2: answered ON DEVICE (TX verdict, no slow path)
+        before_passed = engine.stats.passed
+        ring.rx_push(discover(), from_access=True)
+        engine.process_ring(ring)
+        assert engine.stats.tx == 1
+        assert engine.stats.passed == before_passed
+        offer2, _ = ring.tx_pop()
+        assert dhcp_codec.decode(packets.decode(offer2).payload).msg_type == dhcp_codec.OFFER
+        ring.close()
