@@ -393,6 +393,57 @@ def run_demo(subscriber_count: int = 3, out=None, clock=time.time) -> dict:
     return results
 
 
+def run_loadtest(args) -> int:
+    """Build a self-contained engine + slow-path stack and load-test it
+    (the dhcp-loadtest CLI role; validation gating per main.go:90-93)."""
+    import ipaddress
+
+    from bng_tpu.control.dhcp_server import DHCPServer
+    from bng_tpu.control.nat import NATManager
+    from bng_tpu.control.pool import Pool, PoolManager
+    from bng_tpu.loadtest import BenchmarkConfig, DHCPBenchmark, result_json
+    from bng_tpu.runtime.engine import Engine
+    from bng_tpu.runtime.tables import FastPathTables
+    from bng_tpu.utils.net import ip_to_u32, parse_mac
+
+    net = ipaddress.ip_network(args.pool_cidr)
+    server_ip = int(net.network_address + 1)
+    server_mac = parse_mac("02:aa:bb:cc:dd:01")
+    # size the subscriber table for the MAC working set at <50% load
+    sub_nb = 1 << max(10, (args.macs // 2).bit_length())
+    fastpath = FastPathTables(sub_nbuckets=sub_nb, vlan_nbuckets=1 << 10,
+                              cid_nbuckets=1 << 10, max_pools=16, stash=256)
+    fastpath.set_server_config(server_mac, server_ip)
+    pools = PoolManager(fastpath)
+    pools.add_pool(Pool(pool_id=1, network=int(net.network_address),
+                        prefix_len=net.prefixlen, gateway=server_ip,
+                        dns_primary=ip_to_u32("1.1.1.1"), lease_time=86400))
+    nat = NATManager(public_ips=[ip_to_u32("203.0.113.1")],
+                     sessions_nbuckets=256, sub_nat_nbuckets=64)
+    server = DHCPServer(server_mac, server_ip, pools, fastpath_tables=fastpath)
+    engine = Engine(fastpath, nat, batch_size=args.batch_size,
+                    slow_path=server.handle_frame)
+
+    cfg = BenchmarkConfig(
+        batch_size=args.batch_size, duration_s=args.duration,
+        warmup_s=args.warmup, unique_macs=args.macs,
+        enable_renewals=args.renewals, renewal_ratio=args.renewal_ratio,
+        rps_limit=args.rps)
+    bench = DHCPBenchmark(engine, cfg, log=lambda s: print(s, file=sys.stderr))
+    res = bench.run()
+
+    if args.json_out:
+        print(result_json(res))
+    else:
+        print(res.summary())
+    if args.validate:
+        failures = res.meets_targets(cfg)
+        for f in failures:
+            print(f"TARGET FAILED: {f}", file=sys.stderr)
+        return 1 if failures else 0
+    return 0
+
+
 # ---------------------------------------------------------------------------
 # entry points
 # ---------------------------------------------------------------------------
@@ -442,6 +493,27 @@ def main(argv: list[str] | None = None) -> int:
     statsp = sub.add_parser("stats", help="print stats for a built app")
     _add_run_flags(statsp)
 
+    # dhcp-loadtest parity (test/load/cmd/dhcp-loadtest/main.go:27-40)
+    loadp = sub.add_parser("loadtest", help="DHCP load test against the "
+                           "device pipeline + slow path")
+    loadp.add_argument("--duration", type=float, default=10.0,
+                       help="measured duration, seconds")
+    loadp.add_argument("--warmup", type=float, default=1.0,
+                       help="warmup duration, seconds (excluded)")
+    loadp.add_argument("--batch-size", type=int, default=256,
+                       help="lanes per device batch (the concurrency knob)")
+    loadp.add_argument("--macs", type=int, default=10_000,
+                       help="unique MAC cardinality (steers fast/slow split)")
+    loadp.add_argument("--rps", type=int, default=0,
+                       help="target requests/sec (0 = unlimited)")
+    loadp.add_argument("--renewals", default=True,
+                       action=argparse.BooleanOptionalAction)
+    loadp.add_argument("--renewal-ratio", type=float, default=0.8)
+    loadp.add_argument("--pool-cidr", default="10.0.0.0/16")
+    loadp.add_argument("--json", action="store_true", dest="json_out")
+    loadp.add_argument("--validate", action="store_true",
+                       help="exit non-zero if performance targets not met")
+
     sub.add_parser("version", help="print version")
 
     args = parser.parse_args(argv)
@@ -452,6 +524,8 @@ def main(argv: list[str] | None = None) -> int:
     if args.command == "demo":
         run_demo(args.subscribers)
         return 0
+    if args.command == "loadtest":
+        return run_loadtest(args)
     if args.command in ("run", "stats"):
         app = BNGApp(_config_from_args(args))
         try:

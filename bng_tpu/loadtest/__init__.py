@@ -1,0 +1,6 @@
+from bng_tpu.loadtest.harness import (  # noqa: F401
+    BenchmarkConfig,
+    BenchmarkResult,
+    DHCPBenchmark,
+    result_json,
+)

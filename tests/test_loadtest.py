@@ -1,0 +1,99 @@
+"""Load harness: warmup populates the cache, measurement steers the
+fast/slow split by MAC cardinality, targets gate (test/load parity)."""
+
+import numpy as np
+
+from bng_tpu.control.dhcp_server import DHCPServer
+from bng_tpu.control.nat import NATManager
+from bng_tpu.control.pool import Pool, PoolManager
+from bng_tpu.loadtest import BenchmarkConfig, BenchmarkResult, DHCPBenchmark
+from bng_tpu.runtime.engine import Engine
+from bng_tpu.runtime.tables import FastPathTables
+from bng_tpu.utils.net import ip_to_u32
+
+SERVER_MAC = bytes.fromhex("02aabbccdd01")
+SERVER_IP = ip_to_u32("10.0.0.1")
+
+
+def build_engine(batch=32):
+    fastpath = FastPathTables(sub_nbuckets=512, vlan_nbuckets=64,
+                              cid_nbuckets=64, max_pools=16)
+    fastpath.set_server_config(SERVER_MAC, SERVER_IP)
+    pools = PoolManager(fastpath)
+    pools.add_pool(Pool(pool_id=1, network=ip_to_u32("10.0.0.0"),
+                        prefix_len=16, gateway=SERVER_IP,
+                        dns_primary=ip_to_u32("1.1.1.1"), lease_time=86400))
+    nat = NATManager(public_ips=[ip_to_u32("203.0.113.1")],
+                     sessions_nbuckets=256, sub_nat_nbuckets=64)
+    server = DHCPServer(SERVER_MAC, SERVER_IP, pools, fastpath_tables=fastpath)
+    return Engine(fastpath, nat, batch_size=batch,
+                  slow_path=server.handle_frame)
+
+
+class TestWarmup:
+    def test_warmup_leases_all_macs(self):
+        engine = build_engine()
+        cfg = BenchmarkConfig(batch_size=32, unique_macs=48, warmup_s=60.0)
+        bench = DHCPBenchmark(engine, cfg)
+        leased = bench.warmup()
+        assert leased == 48
+        # every lease landed in the device cache
+        assert engine.fastpath.sub.count == 48
+
+
+class TestMeasurement:
+    def test_renewals_hit_fast_path(self):
+        engine = build_engine()
+        cfg = BenchmarkConfig(batch_size=32, unique_macs=32, warmup_s=60.0,
+                              duration_s=0.5, renewal_ratio=1.0)
+        bench = DHCPBenchmark(engine, cfg)
+        res = bench.run()
+        assert res.requests > 0
+        assert res.responses > 0
+        # all measured traffic targets leased MACs -> device cache hits
+        assert res.cache_hit_rate > 0.95
+        assert res.fastpath_hits > 0
+        assert res.latency_p99_us >= res.latency_p50_us > 0
+
+    def test_cold_macs_go_slow_path(self):
+        engine = build_engine()
+        # no renewals and a much larger MAC space than the warmup covers
+        cfg = BenchmarkConfig(batch_size=32, unique_macs=256, warmup_s=0.0,
+                              duration_s=0.3, enable_renewals=False)
+        bench = DHCPBenchmark(engine, cfg)
+        res = bench.run()
+        assert res.slowpath_hits > 0
+        # server answered the slow-path lanes
+        assert res.responses > 0
+
+
+class TestTargets:
+    def test_meets_targets_gating(self):
+        cfg = BenchmarkConfig()
+        good = BenchmarkResult(rps=60_000, latency_p99_us=5_000,
+                               cache_hit_rate=0.97)
+        assert good.meets_targets(cfg) == []
+        bad = BenchmarkResult(rps=10_000, latency_p99_us=50_000,
+                              cache_hit_rate=0.5)
+        failures = bad.meets_targets(cfg)
+        assert len(failures) == 3
+
+    def test_result_serializes(self):
+        from bng_tpu.loadtest import result_json
+
+        res = BenchmarkResult(rps=1.0)
+        assert '"rps": 1.0' in result_json(res)
+
+
+class TestCLI:
+    def test_loadtest_subcommand(self, capsys):
+        from bng_tpu.cli import main
+
+        rc = main(["loadtest", "--duration", "0.2", "--warmup", "5",
+                   "--batch-size", "32", "--macs", "32", "--json"])
+        assert rc == 0
+        out = capsys.readouterr().out
+        import json
+
+        data = json.loads(out)
+        assert data["requests"] > 0
