@@ -411,8 +411,11 @@ def run_loadtest(args) -> int:
     server_mac = parse_mac("02:aa:bb:cc:dd:01")
     # size the subscriber table for the MAC working set at <50% load
     sub_nb = 1 << max(10, (args.macs // 2).bit_length())
+    # update_slots must cover a full warmup batch of inserts per step or
+    # the device cache lags the host table and renewals miss spuriously
     fastpath = FastPathTables(sub_nbuckets=sub_nb, vlan_nbuckets=1 << 10,
-                              cid_nbuckets=1 << 10, max_pools=16, stash=256)
+                              cid_nbuckets=1 << 10, max_pools=16, stash=256,
+                              update_slots=max(256, 2 * args.batch_size))
     fastpath.set_server_config(server_mac, server_ip)
     pools = PoolManager(fastpath)
     pools.add_pool(Pool(pool_id=1, network=int(net.network_address),

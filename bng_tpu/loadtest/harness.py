@@ -128,10 +128,12 @@ class DHCPBenchmark:
 
     def __init__(self, engine, cfg: BenchmarkConfig | None = None,
                  clock: Callable[[], float] = time.perf_counter,
+                 sleep: Callable[[float], None] = time.sleep,
                  log: Callable[[str], None] | None = None):
         self.engine = engine
         self.cfg = cfg or BenchmarkConfig()
         self.clock = clock
+        self.sleep = sleep  # injected with clock so RPS pacing stays consistent
         self.log = log or (lambda s: None)
         self._rng = np.random.default_rng(self.cfg.seed)
         self._macs = [
@@ -149,8 +151,7 @@ class DHCPBenchmark:
 
     def _renew_request(self, mac: bytes, ip: int, server_ip: int, xid: int) -> bytes:
         # RENEW: unicast REQUEST with ciaddr set (RFC 2131 §4.3.2)
-        p = dhcp_codec.build_request(mac, dhcp_codec.REQUEST, xid=xid)
-        p.ciaddr = ip
+        p = dhcp_codec.build_request(mac, dhcp_codec.REQUEST, xid=xid, ciaddr=ip)
         p.options.append((dhcp_codec.OPT_PARAM_REQ_LIST, bytes([1, 3, 6, 51, 54])))
         return packets.udp_packet(mac, b"\xff" * 6, ip, server_ip, 68, 67,
                                   p.encode().ljust(320, b"\x00"))
@@ -158,11 +159,9 @@ class DHCPBenchmark:
     def _full_request(self, mac: bytes, offer_frame: bytes, xid: int) -> bytes:
         od = packets.decode(offer_frame)
         offer = dhcp_codec.decode(od.payload)
-        p = dhcp_codec.build_request(mac, dhcp_codec.REQUEST, xid=xid)
-        p.options.append((dhcp_codec.OPT_REQUESTED_IP, offer.yiaddr.to_bytes(4, "big")))
-        p.options.append((dhcp_codec.OPT_SERVER_ID, od.src_ip.to_bytes(4, "big")))
+        p = dhcp_codec.build_request(mac, dhcp_codec.REQUEST, xid=xid,
+                                     requested_ip=offer.yiaddr, server_id=od.src_ip)
         p.options.append((dhcp_codec.OPT_PARAM_REQ_LIST, bytes([1, 3, 6, 51, 54])))
-        self._leased[mac] = offer.yiaddr
         return packets.udp_packet(mac, b"\xff" * 6, 0, 0xFFFFFFFF, 68, 67,
                                   p.encode().ljust(320, b"\x00"))
 
@@ -181,12 +180,24 @@ class DHCPBenchmark:
             res = self.engine.process(frames)
             offers = {lane: f for lane, f in res["slow"] if f is not None}
             offers.update({lane: f for lane, f in res["tx"]})
-            req_frames = []
+            req_frames, req_macs = [], []
             for k, m in enumerate(chunk):
                 if k in offers:
                     req_frames.append(self._full_request(m, offers[k], xid + k))
+                    req_macs.append(m)
             if req_frames:
-                self.engine.process(req_frames)
+                # a lease only counts once the server ACKs it — NAK'd or
+                # dropped REQUESTs must not become renewal targets
+                res2 = self.engine.process(req_frames)
+                acks = {lane: f for lane, f in res2["slow"] if f is not None}
+                acks.update({lane: f for lane, f in res2["tx"]})
+                for lane, m in enumerate(req_macs):
+                    f = acks.get(lane)
+                    if f is None:
+                        continue
+                    rep = dhcp_codec.decode(packets.decode(f).payload)
+                    if rep.msg_type == dhcp_codec.ACK:
+                        self._leased[m] = rep.yiaddr
             xid += 2 * B
             i += B
         return len(self._leased)
@@ -241,7 +252,7 @@ class DHCPBenchmark:
                 expected = res.requests / cfg.rps_limit
                 ahead = expected - (self.clock() - t0)
                 if ahead > 0:
-                    time.sleep(min(ahead, 0.1))
+                    self.sleep(min(ahead, 0.1))
 
         res.duration_s = self.clock() - t0
         res.rps = res.requests / res.duration_s if res.duration_s else 0.0

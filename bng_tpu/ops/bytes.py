@@ -42,28 +42,22 @@ def bytes_at(pkt, offs, n: int):
     return jnp.take_along_axis(pkt, idx, axis=1)
 
 
-def set_u8(buf, col: int, val):
-    """Set a static column to per-lane byte values."""
-    return buf.at[:, col].set(val.astype(jnp.uint8))
+# Per-lane writes are SELECTS, not scatters: a scatter with per-lane
+# column indices serializes on TPU (row-at-a-time dynamic-update-slice),
+# while a broadcast compare + where is one fused VPU pass over [B, L].
+# An n-byte field costs one pass; consecutive field writes fuse.
 
 
-def set_const(buf, col: int, val: int):
-    return buf.at[:, col].set(jnp.uint8(val))
-
-
-def set_be16(buf, col: int, val):
-    buf = set_u8(buf, col, (val >> 8) & 0xFF)
-    return set_u8(buf, col + 1, val & 0xFF)
-
-
-def set_be32(buf, col: int, val):
-    buf = set_be16(buf, col, (val >> 16) & 0xFFFF)
-    return set_be16(buf, col + 2, val & 0xFFFF)
-
-
-def set_bytes(buf, col: int, vals):
-    """Set a static range of columns to [B, n] uint8 values."""
-    return buf.at[:, col : col + vals.shape[1]].set(vals.astype(jnp.uint8))
+def _select_write(pkt, offs, val, nbytes: int, mask=None):
+    """Write an nbytes big-endian field at per-lane offsets via select."""
+    col = jnp.arange(pkt.shape[1], dtype=jnp.int32)[None, :]
+    rel = col - _off(offs)[:, None]  # [B, L] position within the field
+    inb = (rel >= 0) & (rel < nbytes)
+    if mask is not None:
+        inb = inb & mask[:, None]
+    sh = jnp.clip((nbytes - 1 - rel) * 8, 0, 31).astype(jnp.uint32)
+    byte = (val.astype(jnp.uint32)[:, None] >> sh) & 0xFF
+    return jnp.where(inb, byte.astype(pkt.dtype), pkt)
 
 
 def scatter_u8_at(pkt, offs, val):
@@ -72,33 +66,54 @@ def scatter_u8_at(pkt, offs, val):
     Used by NAT44 where a few fields are rewritten at VLAN/IHL-dependent
     offsets (bpf/nat44.c:752-801).
     """
-    idx = jnp.clip(_off(offs), 0, pkt.shape[1] - 1)
-    rows = jnp.arange(pkt.shape[0], dtype=jnp.int32)
-    return pkt.at[rows, idx].set(val.astype(jnp.uint8))
+    return _select_write(pkt, offs, val, 1)
 
 
 def scatter_be16_at(pkt, offs, val):
-    pkt = scatter_u8_at(pkt, offs, (val >> 8) & 0xFF)
-    return scatter_u8_at(pkt, offs + 1, val & 0xFF)
+    return _select_write(pkt, offs, val, 2)
 
 
 def scatter_be32_at(pkt, offs, val):
-    pkt = scatter_be16_at(pkt, offs, (val >> 16) & 0xFFFF)
-    return scatter_be16_at(pkt, offs + 2, val & 0xFFFF)
+    return _select_write(pkt, offs, val, 4)
 
 
 def scatter_u8_at_masked(pkt, offs, val, mask):
     """Masked per-lane byte write: lanes with mask=False keep old bytes."""
-    old = u8_at(pkt, offs)
-    new = jnp.where(mask, val, old)
-    return scatter_u8_at(pkt, offs, new)
+    return _select_write(pkt, offs, val, 1, mask)
 
 
 def scatter_be16_at_masked(pkt, offs, val, mask):
-    pkt = scatter_u8_at_masked(pkt, offs, (val >> 8) & 0xFF, mask)
-    return scatter_u8_at_masked(pkt, offs + 1, val & 0xFF, mask)
+    return _select_write(pkt, offs, val, 2, mask)
 
 
 def scatter_be32_at_masked(pkt, offs, val, mask):
-    pkt = scatter_be16_at_masked(pkt, offs, (val >> 16) & 0xFFFF, mask)
-    return scatter_be16_at_masked(pkt, offs + 2, val & 0xFFFF, mask)
+    return _select_write(pkt, offs, val, 4, mask)
+
+
+# ---- segment builders (compose-by-concatenation path) ----
+# Building a reply by chaining .at[:, col].set(...) creates one
+# dynamic-update-slice per field — dozens of serial buffer copies. Instead
+# build [B, n] byte segments and concatenate once.
+
+
+def const_seg(Bsz: int, *vals: int):
+    """[B, len(vals)] uint8 segment of per-batch constants."""
+    row = jnp.asarray(vals, dtype=jnp.uint8)
+    return jnp.broadcast_to(row[None, :], (Bsz, len(vals)))
+
+
+def be16_seg(val):
+    """[B] value -> [B, 2] big-endian bytes."""
+    v = val.astype(jnp.uint32)
+    return jnp.stack([(v >> 8) & 0xFF, v & 0xFF], axis=1).astype(jnp.uint8)
+
+
+def be32_seg(val):
+    v = val.astype(jnp.uint32)
+    return jnp.stack(
+        [(v >> 24) & 0xFF, (v >> 16) & 0xFF, (v >> 8) & 0xFF, v & 0xFF], axis=1
+    ).astype(jnp.uint8)
+
+
+def u8_seg(val):
+    return val.astype(jnp.uint8)[:, None]

@@ -296,74 +296,68 @@ def dhcp_fastpath(
     canon_total = 14 + ip_len
     out_len = canon_total + parsed.vlan_offset.astype(jnp.uint32)
 
-    # --- canonical reply compose (static offsets) ---
-    canon = jnp.zeros((Bsz, CANON_LEN), dtype=jnp.uint8)
-    canon = B_.set_bytes(canon, 0, dst_mac)
-    canon = B_.set_be16(canon, 6, server_mac_hi * jnp.ones_like(flags))
-    canon = B_.set_be32(canon, 8, server_mac_lo * jnp.ones_like(flags))
-    canon = B_.set_be16(canon, 12, jnp.full((Bsz,), 0x0800, dtype=jnp.uint32))
-    # IPv4
-    ip0 = _ETH
-    canon = B_.set_const(canon, ip0 + 0, 0x45)
-    canon = B_.set_be16(canon, ip0 + 2, ip_len)
-    canon = B_.set_const(canon, ip0 + 8, 64)  # TTL :735/:750
-    canon = B_.set_const(canon, ip0 + 9, 17)
+    # --- canonical reply compose ---
+    # One concatenation of [B, n] segments instead of ~60 chained
+    # .at[].set() updates: each set() is a dynamic-update-slice (a serial
+    # read-modify-write of the whole buffer); concat is a single kernel.
     ip_csum = ipv4_header_checksum([
         jnp.full((Bsz,), 0x4500, dtype=jnp.uint32), ip_len,
         jnp.zeros((Bsz,), dtype=jnp.uint32), jnp.zeros((Bsz,), dtype=jnp.uint32),
         jnp.full((Bsz,), (64 << 8) | 17, dtype=jnp.uint32), jnp.zeros((Bsz,), dtype=jnp.uint32),
         server_ip >> 16, server_ip & 0xFFFF, ip_dst >> 16, ip_dst & 0xFFFF,
     ])
-    canon = B_.set_be16(canon, ip0 + 10, ip_csum)
-    canon = B_.set_be32(canon, ip0 + 12, server_ip)
-    canon = B_.set_be32(canon, ip0 + 16, ip_dst)
-    # UDP (checksum 0: legal for IPv4, matches :741/:755)
-    u0 = ip0 + _IP
-    canon = B_.set_be16(canon, u0 + 0, jnp.full((Bsz,), DHCP_SERVER_PORT, dtype=jnp.uint32))
-    canon = B_.set_be16(canon, u0 + 2, udp_dst.astype(jnp.uint32))
-    canon = B_.set_be16(canon, u0 + 4, udp_len)
-    # BOOTP fixed
-    d0 = u0 + _UDP
-    canon = B_.set_const(canon, d0 + 0, BOOTREPLY)  # :759
-    canon = B_.set_const(canon, d0 + 1, 1)
-    canon = B_.set_const(canon, d0 + 2, 6)
-    # hops=0 (:760)
-    canon = B_.set_bytes(canon, d0 + 4, xid_b)
-    canon = B_.set_bytes(canon, d0 + 8, secs_b)
-    canon = B_.set_be16(canon, d0 + 10, flags)
-    canon = B_.set_be32(canon, d0 + 12, ciaddr)
-    canon = B_.set_be32(canon, d0 + 16, assign[:, AV_IP])  # yiaddr :761
-    canon = B_.set_be32(canon, d0 + 20, server_ip)  # siaddr :762
-    canon = B_.set_bytes(canon, d0 + 24, giaddr_b)
-    canon = B_.set_bytes(canon, d0 + 28, chaddr_b)
-    # sname/file zeroed by construction (:765-766)
-    canon = B_.set_be32(canon, d0 + 236, jnp.full((Bsz,), DHCP_MAGIC, dtype=jnp.uint32))
+    ones = jnp.ones_like(flags)
+    canon = jnp.concatenate([
+        # Ethernet
+        dst_mac,                                     # 0: dst MAC
+        B_.be16_seg(server_mac_hi * ones),           # 6: src MAC (server)
+        B_.be32_seg(server_mac_lo * ones),
+        B_.const_seg(Bsz, 0x08, 0x00),               # 12: ethertype IPv4
+        # IPv4 (TTL=64, proto=UDP; :735/:750)
+        B_.const_seg(Bsz, 0x45, 0x00),               # 14: ver/ihl, tos
+        B_.be16_seg(ip_len),                         # 16: total length
+        B_.const_seg(Bsz, 0, 0, 0, 0, 64, 17),       # 18: id, frag, ttl, proto
+        B_.be16_seg(ip_csum),                        # 24: header checksum
+        B_.be32_seg(server_ip),                      # 26: src IP
+        B_.be32_seg(ip_dst),                         # 30: dst IP
+        # UDP (checksum 0: legal for IPv4, matches :741/:755)
+        B_.const_seg(Bsz, 0, DHCP_SERVER_PORT),      # 34: src port 67
+        B_.be16_seg(udp_dst),                        # 36: dst port
+        B_.be16_seg(udp_len),                        # 38: length
+        B_.const_seg(Bsz, 0, 0),                     # 40: checksum
+        # BOOTP (:759-766)
+        B_.const_seg(Bsz, BOOTREPLY, 1, 6, 0),       # 42: op, htype, hlen, hops
+        xid_b,                                       # 46
+        secs_b,                                      # 50
+        B_.be16_seg(flags),                          # 52
+        B_.be32_seg(ciaddr),                         # 54
+        B_.be32_seg(assign[:, AV_IP]),               # 58: yiaddr :761
+        B_.be32_seg(server_ip),                      # 62: siaddr :762
+        giaddr_b,                                    # 66
+        chaddr_b,                                    # 70: chaddr (16B)
+        jnp.zeros((Bsz, 192), dtype=jnp.uint8),      # 86: sname/file
+        B_.be32_seg(jnp.full((Bsz,), DHCP_MAGIC, dtype=jnp.uint32)),  # 278
+    ], axis=1)
 
-    # options: head segment [B, 27]
-    head = jnp.zeros((Bsz, _OPT_HEAD), dtype=jnp.uint8)
-    head = B_.set_const(head, 0, 53); head = B_.set_const(head, 1, 1)
-    head = B_.set_u8(head, 2, reply_type)
-    head = B_.set_const(head, 3, 54); head = B_.set_const(head, 4, 4)
-    head = B_.set_be32(head, 5, server_ip)
-    head = B_.set_const(head, 9, 51); head = B_.set_const(head, 10, 4)
-    head = B_.set_be32(head, 11, lease_t)
-    head = B_.set_const(head, 15, 1); head = B_.set_const(head, 16, 4)
-    head = B_.set_be32(head, 17, mask32)
-    head = B_.set_const(head, 21, 3); head = B_.set_const(head, 22, 4)
-    head = B_.set_be32(head, 23, gateway)
+    # options: head segment [B, 27] (order 53,54,51,1,3 — :519-602)
+    head = jnp.concatenate([
+        B_.const_seg(Bsz, 53, 1), B_.u8_seg(reply_type),
+        B_.const_seg(Bsz, 54, 4), B_.be32_seg(server_ip),
+        B_.const_seg(Bsz, 51, 4), B_.be32_seg(lease_t),
+        B_.const_seg(Bsz, 1, 4), B_.be32_seg(mask32),
+        B_.const_seg(Bsz, 3, 4), B_.be32_seg(gateway),
+    ], axis=1)
     # dns segment [B, 10]
-    dns = jnp.zeros((Bsz, _OPT_DNS_MAX), dtype=jnp.uint8)
-    dns = B_.set_const(dns, 0, 6)
-    dns = B_.set_u8(dns, 1, jnp.where(dns2 == 0, 4, 8))
-    dns = B_.set_be32(dns, 2, dns1)
-    dns = B_.set_be32(dns, 6, dns2)
+    dns = jnp.concatenate([
+        B_.const_seg(Bsz, 6), B_.u8_seg(jnp.where(dns2 == 0, 4, 8)),
+        B_.be32_seg(dns1), B_.be32_seg(dns2),
+    ], axis=1)
     # tail segment [B, 13]
-    tail = jnp.zeros((Bsz, _OPT_TAIL), dtype=jnp.uint8)
-    tail = B_.set_const(tail, 0, 58); tail = B_.set_const(tail, 1, 4)
-    tail = B_.set_be32(tail, 2, t1)
-    tail = B_.set_const(tail, 6, 59); tail = B_.set_const(tail, 7, 4)
-    tail = B_.set_be32(tail, 8, t2)
-    tail = B_.set_const(tail, 12, 255)
+    tail = jnp.concatenate([
+        B_.const_seg(Bsz, 58, 4), B_.be32_seg(t1),
+        B_.const_seg(Bsz, 59, 4), B_.be32_seg(t2),
+        B_.const_seg(Bsz, 255),
+    ], axis=1)
 
     # compose options area [B, _OPT_MAX]: head is fixed-offset; dns and tail
     # shift with dns_sz, handled by two index-arithmetic gathers
@@ -382,7 +376,7 @@ def dhcp_fastpath(
             jnp.where(oj < opt_len[:, None], tail_g, 0),
         ),
     )
-    canon = canon.at[:, d0 + 240 : d0 + 240 + _OPT_MAX].set(opt_area.astype(jnp.uint8))
+    canon = jnp.concatenate([canon, opt_area.astype(jnp.uint8)], axis=1)
 
     # --- final compose with VLAN reinsertion ---
     canon_L = jnp.zeros((Bsz, L), dtype=jnp.uint8).at[:, :CANON_LEN].set(canon)

@@ -273,10 +273,13 @@ class Engine:
             else:
                 self.stats.passed += 1
                 reply = None
-                if punt[i]:
-                    self._punt_new_flow(frames[i], int(now))
-                elif self.slow_path is not None:
-                    reply = self.slow_path(frames[i])
+                try:
+                    if punt[i]:
+                        self._punt_new_flow(frames[i], int(now))
+                    elif self.slow_path is not None:
+                        reply = self.slow_path(frames[i])
+                except Exception:  # noqa: BLE001 — slow path is untrusted input
+                    self.stats.slow_errors += 1
                 out["slow"].append((i, reply))
             if viol[i] and self.violation_sink is not None:
                 self.violation_sink(i, frames[i])
