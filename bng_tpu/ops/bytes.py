@@ -18,7 +18,7 @@ PKT_LEN = 512  # static packet slot size
 
 
 def _off(offs):
-    return offs.astype(jnp.int32)
+    return jnp.asarray(offs).astype(jnp.int32)
 
 
 def u8_at(pkt, offs):

@@ -40,6 +40,13 @@ from bng_tpu.ops.dhcp import (
     DHCPGeom,
     DHCPTables,
 )
+from bng_tpu.ops.pppoe import (
+    PPPOE_WORDS,
+    PS_IP,
+    PS_MAC_HI,
+    PS_MAC_LO,
+    PS_SESSION_ID,
+)
 from bng_tpu.ops.table import HostTable, TableGeom, TableUpdate, apply_update
 from bng_tpu.utils.net import mac_to_u64, split_u64
 
@@ -196,3 +203,46 @@ class FastPathTables:
 
     def dirty_count(self) -> int:
         return self.sub.dirty_count() + self.vlan.dirty_count() + self.cid.dirty_count()
+
+
+class PPPoEFastPathTables:
+    """Host side of the device PPPoE session tables (ops.pppoe).
+
+    The PPPoE control plane (control.pppoe.server) negotiates sessions in
+    userspace; established sessions are published here so session-stage
+    DATA frames decap/encap on device. session_up/session_down plug
+    directly into PPPoEServer's on_open/on_close hooks — the same
+    slow-path-populates-cache shape as DHCP's updateFastPathCache
+    (pkg/dhcp/server.go:1057-1097).
+    """
+
+    def __init__(self, nbuckets: int = 1 << 12, stash: int = 64,
+                 update_slots: int = 128):
+        self.by_sid = HostTable(nbuckets, key_words=1, val_words=PPPOE_WORDS,
+                                stash=stash, name="pppoe_by_sid")
+        self.by_ip = HostTable(nbuckets, key_words=1, val_words=PPPOE_WORDS,
+                               stash=stash, name="pppoe_by_ip")
+        self.geom = TableGeom(nbuckets, stash)
+        self.update_slots = update_slots
+
+    def session_up(self, sess) -> None:
+        """on_open hook: publish an OPEN session to the device tables."""
+        row = np.zeros((PPPOE_WORDS,), dtype=np.uint32)
+        row[PS_SESSION_ID] = sess.session_id
+        row[PS_MAC_HI] = int.from_bytes(sess.client_mac[:2], "big")
+        row[PS_MAC_LO] = int.from_bytes(sess.client_mac[2:], "big")
+        row[PS_IP] = sess.assigned_ip or 0
+        self.by_sid.insert([sess.session_id], row)
+        if sess.assigned_ip:
+            self.by_ip.insert([sess.assigned_ip], row)
+
+    def session_down(self, event) -> None:
+        """on_close hook (takes the server's TeardownEvent)."""
+        sess = getattr(event, "session", event)
+        self.by_sid.delete([sess.session_id])
+        if sess.assigned_ip:
+            self.by_ip.delete([sess.assigned_ip])
+
+    def make_updates(self):
+        return (self.by_sid.make_update(self.update_slots),
+                self.by_ip.make_update(self.update_slots))
