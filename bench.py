@@ -11,6 +11,13 @@ Prints ONE JSON line:
 vs_baseline: the north star is >=100 Mpps on a v5e-8 (BASELINE.md) =
 12.5 Mpps/chip; >1.0 beats the target share for one chip.
 
+`--config N` runs one of the five BASELINE.json configs instead:
+  1 DHCP slow path (control plane only, CPU)     [req/s]
+  2 NAT44 conntrack, 100k concurrent flows       [Mpps]
+  3 QoS token bucket, 10k subscribers            [Mpps]
+  4 PPPoE + QinQ encap/decap batch               [Mpps]
+  5 Full sharded pipeline over all devices       [Mpps]
+
 Env knobs: BNG_BENCH_BATCH, BNG_BENCH_STEPS, BNG_BENCH_SUBS, BNG_BENCH_FLOWS.
 """
 
@@ -180,5 +187,308 @@ def main() -> None:
     }))
 
 
+def _timed_loop(step, args, steps, batch):
+    """Compile, warm, time; returns (mpps, p50_us, p99_us, compile_s)."""
+    import jax
+
+    t_c = time.time()
+    out = step(*args)
+    jax.block_until_ready(out)
+    compile_s = time.time() - t_c
+    lat = []
+    t0 = time.time()
+    for _ in range(steps):
+        t1 = time.perf_counter()
+        out = step(*args)
+        jax.block_until_ready(out)
+        lat.append(time.perf_counter() - t1)
+    dt = time.time() - t0
+    lat_us = np.asarray(lat) * 1e6
+    return (steps * batch / dt / 1e6, float(np.percentile(lat_us, 50)),
+            float(np.percentile(lat_us, 99)), compile_s)
+
+
+def _emit(metric, value, unit, baseline, **extra):
+    print(json.dumps({"metric": metric, "value": round(value, 3), "unit": unit,
+                      "vs_baseline": round(value / baseline, 4), **extra}))
+
+
+def config1_dhcp_slowpath():
+    """BASELINE config 1: DHCP standalone slow path, 1k MACs, CPU-only.
+
+    Reference target: 50k req/s combined; slow-path share is the control
+    plane's ceiling (README Performance table: <10ms P99 slow path).
+    """
+    from bng_tpu.control import dhcp_codec, packets
+    from bng_tpu.control.dhcp_server import DHCPServer
+    from bng_tpu.control.pool import Pool, PoolManager
+    from bng_tpu.utils.net import ip_to_u32
+
+    smac = bytes.fromhex("02aabbccdd01")
+    sip = ip_to_u32("10.0.1.1")
+    pools = PoolManager(None)
+    pools.add_pool(Pool(pool_id=1, network=ip_to_u32("10.0.1.0"), prefix_len=24,
+                        gateway=sip, dns_primary=ip_to_u32("1.1.1.1"),
+                        lease_time=3600))
+    server = DHCPServer(smac, sip, pools)
+    macs = [(0x02B1 << 32 | i).to_bytes(6, "big") for i in range(200)]
+
+    def discover(mac, xid):
+        p = dhcp_codec.build_request(mac, dhcp_codec.DISCOVER, xid=xid)
+        return packets.udp_packet(mac, b"\xff" * 6, 0, 0xFFFFFFFF, 68, 67,
+                                  p.encode().ljust(320, b"\x00"))
+
+    n = 0
+    lat = []
+    t0 = time.perf_counter()
+    deadline = t0 + float(os.environ.get("BNG_BENCH_SECS", 5))
+    xid = 1
+    while time.perf_counter() < deadline:
+        mac = macs[n % len(macs)]
+        t1 = time.perf_counter()
+        reply = server.handle_frame(discover(mac, xid))
+        lat.append(time.perf_counter() - t1)
+        assert reply is not None
+        n += 1
+        xid += 1
+    dt = time.perf_counter() - t0
+    lat_us = np.asarray(lat) * 1e6
+    _emit("DHCP slow-path req/s (config 1)", n / dt, "req/s", 50_000.0,
+          p50_us=round(float(np.percentile(lat_us, 50)), 1),
+          p99_us=round(float(np.percentile(lat_us, 99)), 1), requests=n)
+
+
+def _nat_fixture(n_flows, B, L=512):
+    from bng_tpu.control import packets
+    from bng_tpu.control.nat import NATManager
+    from bng_tpu.utils.net import ip_to_u32
+
+    now = 1_753_000_000
+    sess_nb = 1 << max(10, (n_flows * 2 // 4).bit_length())
+    nat = NATManager(public_ips=[ip_to_u32("203.0.113.1") + i for i in range(64)],
+                     ports_per_subscriber=64, sessions_nbuckets=sess_nb,
+                     sub_nat_nbuckets=sess_nb, stash=256)
+    n_subs = max(1, n_flows // 4)
+    flows = []
+    for i in range(n_flows):
+        sub_i = i % n_subs
+        src = (10 << 24) | (sub_i + 2)
+        if i < n_subs:
+            nat.allocate_nat(src, now)
+        dst = ip_to_u32("93.184.0.0") + (i // n_subs)
+        sport = 20000 + (i // n_subs)
+        if nat.handle_new_flow(src, dst, sport, 443, 17, 100, now) is not None:
+            flows.append((src, dst, sport))
+    rng = np.random.default_rng(7)
+    pkt = np.zeros((B, L), dtype=np.uint8)
+    length = np.zeros((B,), dtype=np.uint32)
+    for row in range(B):
+        src, dst, sport = flows[int(rng.integers(len(flows)))]
+        f = packets.udp_packet(b"\x02" * 6, b"\x04" * 6, src, dst, sport, 443,
+                               b"x" * 180)
+        pkt[row, : len(f)] = np.frombuffer(f, dtype=np.uint8)
+        length[row] = len(f)
+    return nat, pkt, length, now
+
+
+def config2_nat44(on_tpu):
+    """BASELINE config 2: NAT44 conntrack at 100k concurrent flows."""
+    import jax
+    import jax.numpy as jnp
+
+    from bng_tpu.ops.nat44 import nat44_kernel
+    from bng_tpu.ops.parse import parse_batch
+
+    B = int(os.environ.get("BNG_BENCH_BATCH", 8192 if on_tpu else 256))
+    STEPS = int(os.environ.get("BNG_BENCH_STEPS", 100 if on_tpu else 5))
+    N = int(os.environ.get("BNG_BENCH_FLOWS", 100_000 if on_tpu else 2_000))
+    nat, pkt, length, now = _nat_fixture(N, B)
+    tables = nat.device_tables()
+    pkt_d = jax.device_put(jnp.asarray(pkt))
+    len_d = jax.device_put(jnp.asarray(length))
+
+    @jax.jit
+    def step(tables, pkt, ln):
+        par = parse_batch(pkt, ln)
+        res = nat44_kernel(pkt, ln, par, tables, nat.geom, jnp.uint32(now))
+        return res.out_pkt, res.translated, res.stats
+
+    mpps, p50, p99, cs = _timed_loop(step, (tables, pkt_d, len_d), STEPS, B)
+    _emit("NAT44 Mpps @100k flows (config 2)", mpps, "Mpps", 12.5,
+          batch=B, flows=N, p50_us=round(p50, 1), p99_us=round(p99, 1),
+          compile_s=round(cs, 1))
+
+
+def config3_qos(on_tpu):
+    """BASELINE config 3: per-subscriber token bucket, 10k subscribers."""
+    import jax
+    import jax.numpy as jnp
+
+    from bng_tpu.ops.qos import qos_kernel
+    from bng_tpu.runtime.engine import QoSTables
+
+    B = int(os.environ.get("BNG_BENCH_BATCH", 8192 if on_tpu else 256))
+    STEPS = int(os.environ.get("BNG_BENCH_STEPS", 100 if on_tpu else 5))
+    N = int(os.environ.get("BNG_BENCH_SUBS", 10_000 if on_tpu else 1_000))
+    qos = QoSTables(nbuckets=1 << max(10, (N * 2 // 4).bit_length()))
+    for i in range(N):
+        qos.set_subscriber((10 << 24) | (i + 2), down_bps=100_000_000,
+                           up_bps=20_000_000)
+    rng = np.random.default_rng(9)
+    ips = ((10 << 24) + 2 + rng.integers(0, N, size=B)).astype(np.uint32)
+    lens = np.full((B,), 900, dtype=np.uint32)
+    table = qos.up.device_state()
+    active = jnp.ones((B,), dtype=bool)
+
+    @jax.jit
+    def step(table, ips, lens):
+        res = qos_kernel(ips, lens, active, table, qos.geom, jnp.uint32(1))
+        return res.allowed, res.table
+
+    mpps, p50, p99, cs = _timed_loop(
+        step, (table, jnp.asarray(ips), jnp.asarray(lens)), STEPS, B)
+    _emit("QoS token-bucket Mpps @10k subs (config 3)", mpps, "Mpps", 12.5,
+          batch=B, subscribers=N, p50_us=round(p50, 1), p99_us=round(p99, 1),
+          compile_s=round(cs, 1))
+
+
+def config4_pppoe(on_tpu):
+    """BASELINE config 4: PPPoE + QinQ encap/decap batched on device."""
+    import jax
+    import jax.numpy as jnp
+
+    from bng_tpu.control import packets
+    from bng_tpu.control.pppoe import codec
+    from bng_tpu.ops import pppoe as P
+    from bng_tpu.ops.parse import parse_batch
+    from bng_tpu.ops.table import HostTable, TableGeom
+    from bng_tpu.utils.net import ip_to_u32
+
+    B = int(os.environ.get("BNG_BENCH_BATCH", 8192 if on_tpu else 256))
+    STEPS = int(os.environ.get("BNG_BENCH_STEPS", 100 if on_tpu else 5))
+    N = int(os.environ.get("BNG_BENCH_SUBS", 10_000 if on_tpu else 1_000))
+    nb = 1 << max(10, (N * 2 // 4).bit_length())
+    by_sid = HostTable(nb, 1, P.PPPOE_WORDS, stash=128, name="sid")
+    geom = TableGeom(nb, 128)
+    for i in range(N):
+        mac = (0x02B2 << 32 | i).to_bytes(6, "big")
+        row = np.zeros((P.PPPOE_WORDS,), dtype=np.uint32)
+        row[P.PS_SESSION_ID] = i + 1
+        row[P.PS_MAC_HI] = int.from_bytes(mac[:2], "big")
+        row[P.PS_MAC_LO] = int.from_bytes(mac[2:], "big")
+        row[P.PS_IP] = (10 << 24) | (i + 2)
+        by_sid.insert([i + 1], row)
+    rng = np.random.default_rng(11)
+    pkt = np.zeros((B, 512), dtype=np.uint8)
+    length = np.zeros((B,), dtype=np.uint32)
+    ac = bytes.fromhex("02aabbccdd01")
+    for rowi in range(B):
+        i = int(rng.integers(N))
+        mac = (0x02B2 << 32 | i).to_bytes(6, "big")
+        ip_pkt = packets.udp_packet(mac, ac, (10 << 24) | (i + 2),
+                                    ip_to_u32("8.8.8.8"), 5000, 53,
+                                    b"d" * 160)[14:]
+        ppp = codec.ppp_frame(P.PPP_IPV4, ip_pkt)
+        pppoe = codec.PPPoEPacket(code=0, session_id=i + 1, payload=ppp).encode()
+        f = codec.eth_frame(ac, mac, codec.ETH_PPPOE_SESSION, pppoe,
+                            vlans=[100, (i % 4000) + 1])
+        pkt[rowi, : len(f)] = np.frombuffer(f, dtype=np.uint8)
+        length[rowi] = len(f)
+    tab = by_sid.device_state()
+
+    @jax.jit
+    def step(tab, pkt, ln):
+        par = parse_batch(pkt, ln)
+        res = P.pppoe_decap(pkt, ln, par.vlan_offset, par.ethertype, tab, geom)
+        return res.out_pkt, res.done, res.stats
+
+    mpps, p50, p99, cs = _timed_loop(
+        step, (tab, jnp.asarray(pkt), jnp.asarray(length)), STEPS, B)
+    _emit("PPPoE+QinQ decap Mpps (config 4)", mpps, "Mpps", 12.5,
+          batch=B, sessions=N, p50_us=round(p50, 1), p99_us=round(p99, 1),
+          compile_s=round(cs, 1))
+
+
+def config5_sharded(on_tpu):
+    """BASELINE config 5: full pipeline sharded over every visible device."""
+    import jax
+
+    from bng_tpu.control import dhcp_codec, packets
+    from bng_tpu.parallel.sharded import ShardedCluster
+    from bng_tpu.utils.net import ip_to_u32
+
+    n = len(jax.devices())
+    now = 1_753_000_000
+    B_per = int(os.environ.get("BNG_BENCH_BATCH", 8192 if on_tpu else 128))
+    STEPS = int(os.environ.get("BNG_BENCH_STEPS", 100 if on_tpu else 5))
+    N = int(os.environ.get("BNG_BENCH_SUBS", 100_000 if on_tpu else 1_000))
+    cl = ShardedCluster(n, batch_per_shard=B_per)
+    cl.set_server_config_all(bytes.fromhex("02aabbccdd01"), ip_to_u32("10.0.0.1"))
+    n_pools = max(1, (N >> 16) + 1)
+    for pid in range(n_pools):
+        cl.add_pool_all(pid + 1, ip_to_u32(f"10.{pid}.0.0") & 0xFFFF0000, 16,
+                        ip_to_u32("10.0.0.1"), lease_time=86400)
+    _mark(f"config5: inserting {N} subscribers over {n} shards...")
+    macs = []
+    for i in range(N):
+        mac = (0x02B5 << 32 | i).to_bytes(6, "big")
+        cl.add_subscriber(mac, pool_id=(i >> 16) + 1, ip=(10 << 24) | (i + 2),
+                          lease_expiry=now + 86400)
+        macs.append(mac)
+    cl.sync_tables()
+    B = n * cl.b
+    rng = np.random.default_rng(13)
+    pkt = np.zeros((B, 512), dtype=np.uint8)
+    length = np.zeros((B,), dtype=np.uint32)
+    for row in range(B):
+        mac = macs[int(rng.integers(len(macs)))]
+        p = dhcp_codec.build_request(mac, dhcp_codec.DISCOVER, xid=0x2000 + row)
+        p.options.append((dhcp_codec.OPT_PARAM_REQ_LIST, bytes([1, 3, 6, 51, 54])))
+        f = packets.udp_packet(mac, b"\xff" * 6, 0, 0xFFFFFFFF, 68, 67,
+                               p.encode().ljust(300, b"\x00"))
+        pkt[row, : len(f)] = np.frombuffer(f, dtype=np.uint8)
+        length[row] = len(f)
+    fa = np.ones((B,), dtype=bool)
+
+    _mark(f"config5: compiling sharded step over {n} device(s)...")
+    t_c = time.time()
+    out = cl.step(pkt, length, fa, now, 0)
+    compile_s = time.time() - t_c
+    t0 = time.time()
+    for k in range(STEPS):
+        out = cl.step(pkt, length, fa, now + k + 1, 0)
+    dt = time.time() - t0
+    mpps = STEPS * B / dt / 1e6
+    hit = int(out["dhcp_stats"][1])  # ST_HIT
+    _emit(f"Sharded DHCP Mpps over {n} dev (config 5)", mpps, "Mpps",
+          12.5 * n, devices=n, batch=B, subscribers=N,
+          hits_per_step=hit, compile_s=round(compile_s, 1))
+
+
+def main_dispatch() -> None:
+    import argparse
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--config", type=int, default=0,
+                    help="BASELINE.json config number (1-5); 0 = headline mix")
+    args = ap.parse_args()
+    if args.config == 1:
+        config1_dhcp_slowpath()
+        return
+    import jax
+
+    on_tpu = jax.devices()[0].platform not in ("cpu",)
+    if args.config == 2:
+        config2_nat44(on_tpu)
+    elif args.config == 3:
+        config3_qos(on_tpu)
+    elif args.config == 4:
+        config4_pppoe(on_tpu)
+    elif args.config == 5:
+        config5_sharded(on_tpu)
+    else:
+        main()
+
+
 if __name__ == "__main__":
-    main()
+    main_dispatch()

@@ -93,9 +93,14 @@ def pppoe_decap(
     ver_type = B_.u8_at(pkt, ph)
     code = B_.u8_at(pkt, ph + 1)
     session_id = B_.be16_at(pkt, ph + 2)
+    plen = B_.be16_at(pkt, ph + 4)  # PPPoE payload length (PPP proto + data)
     ppp_proto = B_.be16_at(pkt, ph + 6)
 
-    well_formed = is_sess & hdr_ok & (ver_type == 0x11) & (code == 0)
+    # length-field validation parity with codec.PPPoEPacket.decode: the
+    # declared payload must fit the frame (frames may carry Ethernet
+    # padding beyond it) and must at least hold the PPP protocol word
+    plen_ok = (plen >= 2) & ((ph + 6).astype(jnp.uint32) + plen <= length)
+    well_formed = is_sess & hdr_ok & (ver_type == 0x11) & (code == 0) & plen_ok
     # Only IPv4 data decaps on device for now: the encap direction is
     # IPv4-keyed (by_ip), so v6 PPP data punts to the host v6 stack to
     # keep the two directions symmetric (and src_ip_hint meaningful).
@@ -122,7 +127,9 @@ def pppoe_decap(
     out = _shift_bytes(pkt, jnp.where(ok, PPPOE_HDR, 0).astype(jnp.int32), ok, et_off)
     inner_et = jnp.where(ppp_proto == PPP_IPV4, ETH_P_IP, ETH_P_IPV6)
     out = B_.scatter_be16_at_masked(out, et_off, inner_et, ok)
-    out_len = jnp.where(ok, length - PPPOE_HDR, length)
+    # inner frame = L2 up to ethertype (et_off+2) + IP bytes (plen-2);
+    # trailing Ethernet padding past the declared payload is dropped
+    out_len = jnp.where(ok, et_off.astype(jnp.uint32) + plen, length)
 
     stats = jnp.zeros((PPPOE_NSTATS,), dtype=jnp.uint32)
     stats = stats.at[PST_DECAP].add(jnp.sum(ok, dtype=jnp.uint32))

@@ -59,7 +59,7 @@ def ipv4_udp_payload():
 def pppoe_data_frame(vlans=None, sid=SID, proto=P.PPP_IPV4):
     ip = ipv4_udp_payload()
     ppp = codec.ppp_frame(proto, ip)
-    pppoe = bytes([0x11, 0x00]) + sid.to_bytes(2, "big") + len(ppp).to_bytes(2, "big") + ppp
+    pppoe = codec.PPPoEPacket(code=0, session_id=sid, payload=ppp).encode()
     return codec.eth_frame(AC_MAC, CLIENT_MAC, codec.ETH_PPPOE_SESSION, pppoe,
                            vlans=vlans)
 
@@ -100,7 +100,7 @@ class TestDecap:
         by_sid, _ = session_tables()
         ip = ipv4_udp_payload()
         ppp = codec.ppp_frame(P.PPP_IPV4, ip)
-        pppoe = bytes([0x11, 0x00]) + SID.to_bytes(2, "big") + len(ppp).to_bytes(2, "big") + ppp
+        pppoe = codec.PPPoEPacket(code=0, session_id=SID, payload=ppp).encode()
         frame = codec.eth_frame(AC_MAC, bytes.fromhex("02dead00beef"),
                                 codec.ETH_PPPOE_SESSION, pppoe)
         pkt, ln = batch([frame])
@@ -112,7 +112,7 @@ class TestDecap:
     def test_lcp_control_punts(self):
         by_sid, _ = session_tables()
         lcp = codec.ppp_frame(0xC021, b"\x09\x01\x00\x08\x00\x00\x00\x00")
-        pppoe = bytes([0x11, 0x00]) + SID.to_bytes(2, "big") + len(lcp).to_bytes(2, "big") + lcp
+        pppoe = codec.PPPoEPacket(code=0, session_id=SID, payload=lcp).encode()
         frame = codec.eth_frame(AC_MAC, CLIENT_MAC, codec.ETH_PPPOE_SESSION, pppoe)
         pkt, ln = batch([frame])
         par = parse_batch(pkt, ln)
@@ -250,8 +250,7 @@ class TestControlPlaneIntegration:
         ip_pkt = packets.udp_packet(cli.mac, AC_MAC, cli.ip,
                                     ip_to_u32("8.8.8.8"), 5000, 53, b"dns?")[14:]
         ppp = codec.ppp_frame(P.PPP_IPV4, ip_pkt)
-        pppoe = (bytes([0x11, 0x00]) + cli.session_id.to_bytes(2, "big")
-                 + len(ppp).to_bytes(2, "big") + ppp)
+        pppoe = (codec.PPPoEPacket(code=0, session_id=cli.session_id, payload=ppp).encode())
         frame = codec.eth_frame(AC_MAC, cli.mac, codec.ETH_PPPOE_SESSION, pppoe)
 
         pkt, ln = batch([frame])
