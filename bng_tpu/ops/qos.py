@@ -23,6 +23,7 @@ role). Timestamps are µs with wrap-safe uint32 arithmetic.
 
 from __future__ import annotations
 
+import os
 from typing import NamedTuple
 
 import jax
@@ -42,6 +43,76 @@ QOS_NSTATS = 4
 
 # QoS has a single table per direction; its geometry IS a TableGeom
 QoSGeom = TableGeom
+
+# Same-bucket aggregation strategy:
+#   "sort"   — stable argsort + segment cumsum (works on every backend)
+#   "pallas" — MXU tiled equality-matmul kernel (ops.pallas_qos); on CPU
+#              it runs in interpret mode (tests), on TPU compiled
+#   "auto"   — pallas on TPU, sort elsewhere
+# Default from BNG_QOS_PREFIX; "sort" until the pallas path has been
+# timed on hardware (flip to "auto" once it wins).
+PREFIX_IMPL = os.environ.get("BNG_QOS_PREFIX", "sort")
+
+
+def _prefix_consumed(limited, slot, lens_u, avail):
+    """Returns (allowed, consumed_f32, is_head) using the configured impl.
+
+    allowed: sequential-TBF admission per lane (arrival = lane order);
+    consumed: admitted bytes of the lane's bucket (valid on limited lanes);
+    is_head: first limited lane of each bucket in the batch.
+    """
+    Bsz = slot.shape[0]
+    impl = PREFIX_IMPL
+    if impl == "auto":
+        # Mosaic lowering is TPU-only; every other backend gets the sort
+        impl = "pallas" if jax.default_backend() == "tpu" else "sort"
+
+    # lanes without a limit get unique negative ids -> group with nobody
+    slot_eff = jnp.where(limited, slot, -1 - jnp.arange(Bsz, dtype=jnp.int32))
+
+    if impl == "pallas":
+        from bng_tpu.ops.pallas_qos import seg_prefix_total
+
+        # NOTE: f32 matmul accumulation is exact only below 2^24 bytes
+        # per bucket per batch (the sort path's u32 cumsum is exact to
+        # 2^32); a single bucket attempting >16.7MB in one batch can
+        # flip a boundary admission vs the sort/eBPF reference.
+        interp = jax.default_backend() in ("cpu",)
+        lens_f = lens_u.astype(jnp.float32)
+        cum_incl, _ = seg_prefix_total(slot_eff, lens_f, interpret=interp,
+                                       compute="prefix")
+        allowed = ~limited | (cum_incl <= avail)
+        admitted = jnp.where(allowed & limited, lens_f, 0.0)
+        _, consumed = seg_prefix_total(slot_eff, admitted, interpret=interp,
+                                       compute="total")
+        is_head = limited & (cum_incl <= lens_f)  # no earlier same-bucket lane
+        return allowed, consumed, is_head
+
+    # ---- sort path (original implementation) ----
+    order = jnp.argsort(slot_eff, stable=True)
+    s_sorted = slot_eff[order]
+    lens_sorted = lens_u[order]
+    avail_sorted = avail[order]
+    limited_sorted = limited[order]
+
+    csum = jnp.cumsum(lens_sorted)
+    is_head_sorted = jnp.concatenate(
+        [jnp.ones((1,), dtype=bool), s_sorted[1:] != s_sorted[:-1]])
+    seg_id = jnp.cumsum(is_head_sorted.astype(jnp.int32)) - 1
+    seg_base = jax.lax.cummax(jnp.where(is_head_sorted, csum - lens_sorted, 0))
+    cum_incl_sorted = csum - seg_base
+    avail_int = jnp.clip(avail_sorted, 0.0, 4.0e9).astype(jnp.uint32)
+    allowed_sorted = ~limited_sorted | (cum_incl_sorted <= avail_int)
+
+    admitted_sorted = jnp.where(allowed_sorted & limited_sorted, lens_sorted, 0)
+    seg_totals = jax.ops.segment_sum(admitted_sorted, seg_id, num_segments=Bsz)
+    consumed_sorted = seg_totals[seg_id]
+
+    inv = jnp.zeros((Bsz,), dtype=jnp.int32).at[order].set(
+        jnp.arange(Bsz, dtype=jnp.int32))
+    return (allowed_sorted[inv],
+            consumed_sorted[inv].astype(jnp.float32),
+            (is_head_sorted & limited_sorted)[inv] & limited)
 
 
 class QoSResult(NamedTuple):
@@ -86,47 +157,14 @@ def qos_kernel(
     refill = elapsed_us * (rate_bps / 8.0) * jnp.float32(1e-6)
     avail = jnp.minimum(tokens.astype(jnp.float32) + refill, burst.astype(jnp.float32))
 
-    # --- sort-based segment prefix sum over same-slot lanes ---
-    # O(B log B) and O(B) memory (an equality-matrix/MXU variant is O(B^2)
-    # bytes — 268MB at B=8192 — which swamps HBM bandwidth). A stable sort
-    # groups same-bucket lanes while preserving lane order, so the
-    # sequential TBF admission order survives.
-    # integer byte accounting: an f32 cumsum loses integer exactness past
-    # 2^24 total batch bytes (8k jumbo-frame lanes), flipping boundary
-    # admissions — uint32 is exact to 4GB per batch
+    # --- same-bucket aggregation (sequential TBF admission per lane) ---
+    # impl-pluggable: stable-sort segment cumsum (u32-exact to 4GB per
+    # batch), or the Pallas MXU equality-matmul kernel (ops.pallas_qos,
+    # f32-exact to 2^24 bytes per bucket per batch) — see PREFIX_IMPL.
     lens_u = pkt_len.astype(jnp.uint32)
-    slot_eff = jnp.where(limited, res.slot, -1 - jnp.arange(Bsz, dtype=jnp.int32))
-    order = jnp.argsort(slot_eff, stable=True)
-    s_sorted = slot_eff[order]
-    lens_sorted = lens_u[order]
-    avail_sorted = avail[order]
-    limited_sorted = limited[order]
-
-    csum = jnp.cumsum(lens_sorted)
-    is_head = jnp.concatenate([jnp.ones((1,), dtype=bool), s_sorted[1:] != s_sorted[:-1]])
-    seg_id = jnp.cumsum(is_head.astype(jnp.int32)) - 1  # dense segment rank
-    # bytes consumed before each segment starts: carry the head's base forward
-    seg_base = jax.lax.cummax(jnp.where(is_head, csum - lens_sorted, 0))
-    cum_incl_sorted = csum - seg_base  # attempted bytes up to & incl me, in my bucket
-    # floor(avail) in uint32 keeps the admission compare fully integral
-    avail_int = jnp.clip(avail_sorted, 0.0, 4.0e9).astype(jnp.uint32)
-    allowed_sorted = ~limited_sorted | (cum_incl_sorted <= avail_int)
-
-    # per-bucket admitted-byte totals -> token writeback
-    admitted_sorted = jnp.where(allowed_sorted & limited_sorted, lens_sorted, 0)
-    seg_totals = jax.ops.segment_sum(admitted_sorted, seg_id, num_segments=Bsz)
-    consumed_sorted = seg_totals[seg_id]
-    new_tokens_sorted = jnp.clip(avail_sorted - consumed_sorted.astype(jnp.float32), 0.0,
-                                 burst[order].astype(jnp.float32))
-
-    # unsort lane-wise results
-    inv = jnp.zeros((Bsz,), dtype=jnp.int32).at[order].set(jnp.arange(Bsz, dtype=jnp.int32))
-    allowed = allowed_sorted[inv]
+    allowed, consumed, first = _prefix_consumed(limited, res.slot, lens_u, avail)
     dropped = limited & ~allowed
-    new_tokens = new_tokens_sorted[inv]
-
-    # the head lane of each bucket writes the new state (no conflicts)
-    first = (is_head & limited_sorted)[inv] & limited
+    new_tokens = jnp.clip(avail - consumed, 0.0, burst.astype(jnp.float32))
     S = table.vals.shape[0]
     wslot = jnp.where(first, res.slot, S).astype(jnp.int32)
     vals = table.vals.at[wslot, QV_TOKENS].set(new_tokens.astype(jnp.uint32), mode="drop")

@@ -25,6 +25,14 @@ if "xla_force_host_platform_device_count" not in flags:
 import jax
 
 jax.config.update("jax_platforms", "cpu")
+# Preload pallas (and its checkify dependency) while the full platform
+# registry is intact: its import registers "tpu" lowering rules, which
+# fails with "unknown platform" once the factories below are dropped.
+try:
+    import jax.experimental.pallas  # noqa: F401
+    import jax.experimental.pallas.tpu  # noqa: F401
+except Exception:  # pragma: no cover - pallas optional on exotic jaxlibs
+    pass
 try:
     import jax._src.xla_bridge as _xb
 

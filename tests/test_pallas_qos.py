@@ -1,0 +1,105 @@
+"""Pallas segmented-prefix kernel vs the sort-based reference.
+
+Interpret mode on CPU (the compile-and-lower gate of SURVEY.md §4.3 —
+the TPU analog of loading eBPF programs through the verifier): same
+inputs, bit-identical admission decisions between the two impls.
+"""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+import bng_tpu.ops.qos as qos_mod
+from bng_tpu.ops.pallas_qos import LANE_TILE, seg_prefix_total
+from bng_tpu.ops.qos import qos_kernel
+from bng_tpu.runtime.engine import QoSTables
+
+
+def ref_prefix_total(slot, vec):
+    """O(B^2) numpy reference."""
+    B = len(slot)
+    pref = np.zeros((B,), dtype=np.float64)
+    tot = np.zeros((B,), dtype=np.float64)
+    for i in range(B):
+        same = slot == slot[i]
+        pref[i] = vec[: i + 1][same[: i + 1]].sum()
+        tot[i] = vec[same].sum()
+    return pref, tot
+
+
+class TestSegPrefixTotal:
+    @pytest.mark.parametrize("B", [64, LANE_TILE, 3 * LANE_TILE, 1000])
+    def test_matches_reference(self, B):
+        rng = np.random.default_rng(B)
+        slot = rng.integers(0, max(2, B // 8), size=B).astype(np.int32)
+        vec = rng.integers(64, 1500, size=B).astype(np.float32)
+        pref, tot = seg_prefix_total(jnp.asarray(slot), jnp.asarray(vec),
+                                     interpret=True)
+        ref_p, ref_t = ref_prefix_total(slot, vec)
+        np.testing.assert_allclose(np.asarray(pref), ref_p, rtol=0, atol=0)
+        np.testing.assert_allclose(np.asarray(tot), ref_t, rtol=0, atol=0)
+
+    def test_unique_negative_ids_never_group(self):
+        B = 128
+        slot = -1 - np.arange(B, dtype=np.int32)
+        vec = np.full((B,), 100.0, dtype=np.float32)
+        pref, tot = seg_prefix_total(jnp.asarray(slot), jnp.asarray(vec),
+                                     interpret=True)
+        np.testing.assert_array_equal(np.asarray(pref), vec)
+        np.testing.assert_array_equal(np.asarray(tot), vec)
+
+
+class TestQoSImplParity:
+    def _run(self, impl, ips, lens, qos):
+        old = qos_mod.PREFIX_IMPL
+        qos_mod.PREFIX_IMPL = impl
+        try:
+            res = qos_kernel(jnp.asarray(ips), jnp.asarray(lens),
+                             jnp.ones((len(ips),), dtype=bool),
+                             qos.up.device_state(), qos.geom, jnp.uint32(1))
+            return (np.asarray(res.allowed), np.asarray(res.dropped),
+                    np.asarray(res.table.vals), np.asarray(res.stats))
+        finally:
+            qos_mod.PREFIX_IMPL = old
+
+    def test_sort_and_pallas_agree(self):
+        B = 512
+        qos = QoSTables(nbuckets=256)
+        n_subs = 16
+        for i in range(n_subs):
+            # tiny buckets so some lanes drop mid-batch
+            qos.set_subscriber((10 << 24) | (i + 2), down_bps=8_000_000,
+                               up_bps=8_000_000, up_burst=3000, down_burst=3000)
+        rng = np.random.default_rng(0)
+        ips = ((10 << 24) + 2 + rng.integers(0, n_subs * 2, size=B)).astype(np.uint32)
+        lens = rng.integers(100, 1500, size=B).astype(np.uint32)
+
+        a_sort = self._run("sort", ips, lens, qos)
+        qos2 = QoSTables(nbuckets=256)
+        for i in range(n_subs):
+            qos2.set_subscriber((10 << 24) | (i + 2), down_bps=8_000_000,
+                                up_bps=8_000_000, up_burst=3000, down_burst=3000)
+        a_pal = self._run("pallas", ips, lens, qos2)
+
+        np.testing.assert_array_equal(a_sort[0], a_pal[0])  # allowed
+        np.testing.assert_array_equal(a_sort[1], a_pal[1])  # dropped
+        np.testing.assert_array_equal(a_sort[2], a_pal[2])  # token state
+        np.testing.assert_array_equal(a_sort[3], a_pal[3])  # stats
+
+    def test_pallas_sequential_order_within_bucket(self):
+        # one bucket, tokens for exactly 2 packets: lanes 0,1 pass, 2+ drop
+        qos = QoSTables(nbuckets=64)
+        qos.set_subscriber(0x0A000002, down_bps=8_000, up_bps=8_000,
+                           up_burst=2000, down_burst=2000)
+        old = qos_mod.PREFIX_IMPL
+        qos_mod.PREFIX_IMPL = "pallas"
+        try:
+            ips = np.full((8,), 0x0A000002, dtype=np.uint32)
+            lens = np.full((8,), 1000, dtype=np.uint32)
+            res = qos_kernel(jnp.asarray(ips), jnp.asarray(lens),
+                             jnp.ones((8,), dtype=bool),
+                             qos.up.device_state(), qos.geom, jnp.uint32(1))
+            allowed = np.asarray(res.allowed)
+            assert list(allowed) == [True, True] + [False] * 6
+        finally:
+            qos_mod.PREFIX_IMPL = old
