@@ -35,7 +35,7 @@ def _mark(msg: str) -> None:
     print(f"[bench {time.strftime('%H:%M:%S')}] {msg}", file=sys.stderr, flush=True)
 
 
-def main() -> None:
+def main(on_tpu: bool) -> None:
     import jax
     import jax.numpy as jnp
 
@@ -46,14 +46,13 @@ def main() -> None:
     from bng_tpu.runtime.tables import FastPathTables
     from bng_tpu.utils.net import ip_to_u32
 
-    _mark("jax imported; initializing device...")
     dev = jax.devices()[0]
     _mark(f"device: {dev}")
-    on_tpu = dev.platform not in ("cpu",)
     B = int(os.environ.get("BNG_BENCH_BATCH", 8192 if on_tpu else 512))
     STEPS = int(os.environ.get("BNG_BENCH_STEPS", 200 if on_tpu else 10))
-    N_SUBS = int(os.environ.get("BNG_BENCH_SUBS", 100_000 if on_tpu else 2_000))
-    N_FLOWS = int(os.environ.get("BNG_BENCH_FLOWS", 100_000 if on_tpu else 2_000))
+    # reference scale: maps sized for 1M subscribers (bpf/maps.h:10)
+    N_SUBS = int(os.environ.get("BNG_BENCH_SUBS", 1_000_000 if on_tpu else 2_000))
+    N_FLOWS = int(os.environ.get("BNG_BENCH_FLOWS", 1_000_000 if on_tpu else 2_000))
     L = 512
     now = 1_753_000_000
 
@@ -71,29 +70,17 @@ def main() -> None:
                     ip_to_u32("8.8.8.8"), 86400)
 
     macs = np.arange(N_SUBS, dtype=np.uint64) + 0x02AA00000000
-    _mark(f"inserting {N_SUBS} subscribers...")
-    for i in range(N_SUBS):
-        ip = (10 << 24) | (i + 2)
-        fp.add_subscriber(int(macs[i]), pool_id=(i >> 16) + 1, ip=ip,
-                          lease_expiry=now + 86400)
+    _mark(f"bulk-inserting {N_SUBS} subscribers...")
+    idx = np.arange(N_SUBS, dtype=np.uint64)
+    fp.add_subscribers_bulk(
+        macs, pool_ids=(idx >> np.uint64(16)).astype(np.uint32) + 1,
+        ips=((10 << 24) + 2 + idx).astype(np.uint32),
+        lease_expiries=np.uint32(now + 86400))
 
-    sess_nb = 1 << max(10, (N_FLOWS * 2 // 4).bit_length())
-    nat = NATManager(public_ips=[ip_to_u32("203.0.113.1") + i for i in range(64)],
-                     ports_per_subscriber=64,
-                     sessions_nbuckets=sess_nb, sub_nat_nbuckets=sub_nb, stash=256)
     n_nat_subs = min(N_SUBS, max(1, N_FLOWS // 4))  # ~4 flows per subscriber
-    _mark(f"creating {N_FLOWS} NAT flows...")
-    flows = []
-    for i in range(N_FLOWS):
-        sub_i = i % n_nat_subs
-        src_ip = (10 << 24) | (sub_i + 2)
-        if sub_i == i:  # first flow of this subscriber
-            nat.allocate_nat(src_ip, now)
-        dst_ip = ip_to_u32("93.184.0.0") + (i // n_nat_subs)
-        sport = 20000 + (i // n_nat_subs)
-        got = nat.handle_new_flow(src_ip, dst_ip, sport, 443, 17, 100, now)
-        if got is not None:
-            flows.append((src_ip, dst_ip, sport))
+    _mark(f"bulk-creating {N_FLOWS} NAT flows for {n_nat_subs} subscribers...")
+    nat, flows = _build_nat_flows(N_FLOWS, n_nat_subs, now,
+                                  sub_nat_nbuckets=sub_nb)
     qos = QoSTables(nbuckets=1 << 10)
     spoof = AntispoofTables(nbuckets=1 << 10)
 
@@ -122,7 +109,7 @@ def main() -> None:
             f = packets.udp_packet(mac, b"\xff" * 6, 0, 0xFFFFFFFF, 68, 67,
                                    p.encode().ljust(300, b"\x00"))
         else:
-            src_ip, dst_ip, sport = flows[int(rng.integers(len(flows)))]
+            src_ip, dst_ip, sport = (int(x) for x in flows[int(rng.integers(len(flows)))])
             f = packets.udp_packet(b"\x02" * 6, b"\x04" * 6, src_ip, dst_ip,
                                    sport, 443, b"x" * 180)
         pkt[row, : len(f)] = np.frombuffer(f, dtype=np.uint8)
@@ -169,6 +156,44 @@ def main() -> None:
     lat_us = np.array(lat) * 1e6
     p50, p99 = float(np.percentile(lat_us, 50)), float(np.percentile(lat_us, 99))
 
+    # ---- OFFER latency at small batch (true per-batch percentiles) ----
+    # The p99-OFFER target (<50us @1M subs, BASELINE.json) is a tail metric:
+    # measure the wall-time distribution of small all-DISCOVER batches — every
+    # OFFER in a batch has latency <= that batch's wall time. The reference's
+    # harness measures real percentiles (test/load/dhcp_benchmark.go:96-103).
+    B_LAT = int(os.environ.get("BNG_BENCH_LAT_BATCH", 256 if on_tpu else 64))
+    LAT_STEPS = int(os.environ.get("BNG_BENCH_LAT_STEPS", 400 if on_tpu else 20))
+    _mark(f"latency mode: compiling B={B_LAT} all-DISCOVER batch...")
+    lpkt = np.zeros((B_LAT, L), dtype=np.uint8)
+    llen = np.zeros((B_LAT,), dtype=np.uint32)
+    for row in range(B_LAT):
+        i = int(rng.integers(N_SUBS))
+        mac = int(macs[i]).to_bytes(8, "big")[2:]
+        p = dhcp_codec.build_request(mac, dhcp_codec.DISCOVER, xid=0x9000 + row)
+        p.options.append((dhcp_codec.OPT_PARAM_REQ_LIST, bytes([1, 3, 6, 51, 54])))
+        f = packets.udp_packet(mac, b"\xff" * 6, 0, 0xFFFFFFFF, 68, 67,
+                               p.encode().ljust(300, b"\x00"))
+        lpkt[row, : len(f)] = np.frombuffer(f, dtype=np.uint8)
+        llen[row] = len(f)
+    lpkt_d = jax.device_put(jnp.asarray(lpkt))
+    llen_d = jax.device_put(jnp.asarray(llen))
+    lfa_d = jax.device_put(jnp.ones((B_LAT,), dtype=bool))
+    tables, lverdict, _, _ = step(tables, lpkt_d, llen_d, lfa_d,
+                                  jnp.uint32(now), jnp.uint32(0))
+    lverdict.block_until_ready()
+    llat = []
+    for k in range(LAT_STEPS):
+        t1 = time.perf_counter()
+        tables, lverdict, _, _ = step(tables, lpkt_d, llen_d, lfa_d,
+                                      jnp.uint32(now + k), jnp.uint32(k))
+        lverdict.block_until_ready()
+        llat.append(time.perf_counter() - t1)
+    llat_us = np.array(llat) * 1e6
+    offer_p50 = float(np.percentile(llat_us, 50))
+    offer_p99 = float(np.percentile(llat_us, 99))
+    offer_hits = int((np.asarray(lverdict) == 2).sum())
+
+    extra = dict(_DIAG)
     print(json.dumps({
         "metric": "Mpps/chip DHCP+NAT44 fast path",
         "value": round(mpps, 3),
@@ -177,13 +202,18 @@ def main() -> None:
         "batch": B,
         "steps": STEPS,
         "subscribers": N_SUBS,
-        "flows": len(flows),
+        "flows": int(len(flows)),
         "fastpath_hit_rate": round(hit_rate, 4),
         "batch_latency_p50_us": round(p50, 1),
         "batch_latency_p99_us": round(p99, 1),
+        "offer_p50_us": round(offer_p50, 1),
+        "offer_p99_us": round(offer_p99, 1),
+        "offer_latency_batch": B_LAT,
+        "offer_hits": offer_hits,
         "device": str(dev),
         "compile_s": round(compile_s, 1),
         "setup_s": round(setup_s, 1),
+        **extra,
     }))
 
 
@@ -208,9 +238,14 @@ def _timed_loop(step, args, steps, batch):
             float(np.percentile(lat_us, 99)), compile_s)
 
 
+# merged into every emitted JSON line: backend-fallback diagnostics etc.
+_DIAG: dict = {}
+
+
 def _emit(metric, value, unit, baseline, **extra):
     print(json.dumps({"metric": metric, "value": round(value, 3), "unit": unit,
-                      "vs_baseline": round(value / baseline, 4), **extra}))
+                      "vs_baseline": round(value / baseline, 4), **extra,
+                      **_DIAG}))
 
 
 def config1_dhcp_slowpath():
@@ -258,32 +293,46 @@ def config1_dhcp_slowpath():
           p99_us=round(float(np.percentile(lat_us, 99)), 1), requests=n)
 
 
-def _nat_fixture(n_flows, B, L=512):
-    from bng_tpu.control import packets
+def _build_nat_flows(n_flows, n_subs, now, sub_nat_nbuckets=None):
+    """Shared NAT+flows construction for the headline mix and config 2.
+
+    Sizes the public-IP pool to actually hold n_subs port blocks
+    ((65535-1024+1)//64 = 1008 64-port blocks per public IP), bulk-allocates
+    blocks, and bulk-creates ~4 flows/subscriber. Returns (nat, flows[K,3])
+    and records any allocation shortfall in _DIAG.
+    """
     from bng_tpu.control.nat import NATManager
     from bng_tpu.utils.net import ip_to_u32
 
-    now = 1_753_000_000
     sess_nb = 1 << max(10, (n_flows * 2 // 4).bit_length())
-    nat = NATManager(public_ips=[ip_to_u32("203.0.113.1") + i for i in range(64)],
+    n_pub = max(4, -(-n_subs // 1008) + 1)
+    nat = NATManager(public_ips=[ip_to_u32("203.0.113.1") + i for i in range(n_pub)],
                      ports_per_subscriber=64, sessions_nbuckets=sess_nb,
-                     sub_nat_nbuckets=sess_nb, stash=256)
-    n_subs = max(1, n_flows // 4)
-    flows = []
-    for i in range(n_flows):
-        sub_i = i % n_subs
-        src = (10 << 24) | (sub_i + 2)
-        if i < n_subs:
-            nat.allocate_nat(src, now)
-        dst = ip_to_u32("93.184.0.0") + (i // n_subs)
-        sport = 20000 + (i // n_subs)
-        if nat.handle_new_flow(src, dst, sport, 443, 17, 100, now) is not None:
-            flows.append((src, dst, sport))
+                     sub_nat_nbuckets=sub_nat_nbuckets or sess_nb, stash=256)
+    fi = np.arange(n_flows, dtype=np.int64)
+    src_ips = ((10 << 24) + 2 + fi % n_subs).astype(np.uint32)
+    dst_ips = (ip_to_u32("93.184.0.0") + fi // n_subs).astype(np.uint32)
+    sports = (20000 + fi // n_subs).astype(np.uint32)
+    made = nat.bulk_allocate_nat(np.unique(src_ips), now)
+    _, _, ok = nat.bulk_flows(src_ips, dst_ips, sports,
+                              np.uint32(443), np.uint32(17), 100, now)
+    flows = np.stack([src_ips, dst_ips, sports], axis=1)[ok]
+    if made < n_subs or len(flows) < n_flows:
+        _DIAG["nat_blocks_allocated"] = made
+        _DIAG["nat_flow_shortfall"] = int(n_flows - len(flows))
+    return nat, flows
+
+
+def _nat_fixture(n_flows, B, L=512):
+    from bng_tpu.control import packets
+
+    now = 1_753_000_000
+    nat, flows = _build_nat_flows(n_flows, max(1, n_flows // 4), now)
     rng = np.random.default_rng(7)
     pkt = np.zeros((B, L), dtype=np.uint8)
     length = np.zeros((B,), dtype=np.uint32)
     for row in range(B):
-        src, dst, sport = flows[int(rng.integers(len(flows)))]
+        src, dst, sport = (int(x) for x in flows[int(rng.integers(len(flows)))])
         f = packets.udp_packet(b"\x02" * 6, b"\x04" * 6, src, dst, sport, 443,
                                b"x" * 180)
         pkt[row, : len(f)] = np.frombuffer(f, dtype=np.uint8)
@@ -465,29 +514,105 @@ def config5_sharded(on_tpu):
           hits_per_step=hit, compile_s=round(compile_s, 1))
 
 
+_CONFIG_METRICS = {
+    0: ("Mpps/chip DHCP+NAT44 fast path", "Mpps"),
+    1: ("DHCP slow-path req/s (config 1)", "req/s"),
+    2: ("NAT44 Mpps @100k flows (config 2)", "Mpps"),
+    3: ("QoS token-bucket Mpps @10k subs (config 3)", "Mpps"),
+    4: ("PPPoE+QinQ decap Mpps (config 4)", "Mpps"),
+    5: ("Sharded DHCP Mpps (config 5)", "Mpps"),
+}
+
+
+def _error_line(config: int, err: str) -> str:
+    metric, unit = _CONFIG_METRICS.get(config, _CONFIG_METRICS[0])
+    return json.dumps({"metric": metric, "value": 0.0, "unit": unit,
+                       "vs_baseline": 0.0, "config": config,
+                       "error": err, **_DIAG})
+
+
+def _child_dispatch(config: int) -> None:
+    """Run one benchmark config in this process (the supervised child)."""
+    try:
+        if config == 1:
+            config1_dhcp_slowpath()
+            return
+
+        # Guarded backend init (never crash): probe the axon TPU plugin in a
+        # subprocess with a timeout; on failure, fall back to a hermetic CPU
+        # backend and record the diagnostic in the JSON line. Round 1 shipped
+        # both failure modes as artifacts (BENCH_r01 rc=1, MULTICHIP rc=124).
+        from bng_tpu.utils.jaxenv import guarded_backend
+
+        _mark("probing accelerator availability...")
+        platform, err = guarded_backend(
+            tries=int(os.environ.get("BNG_BENCH_PROBE_TRIES", 2)),
+            probe_timeout_s=float(os.environ.get("BNG_BENCH_PROBE_TIMEOUT", 150)),
+        )
+        on_tpu = platform not in ("cpu",)
+        _mark(f"backend: {platform}" + (f" (fallback: {err})" if err else ""))
+        if err:
+            _DIAG["backend_fallback"] = "cpu"
+            _DIAG["backend_error"] = err
+        if config == 2:
+            config2_nat44(on_tpu)
+        elif config == 3:
+            config3_qos(on_tpu)
+        elif config == 4:
+            config4_pppoe(on_tpu)
+        elif config == 5:
+            config5_sharded(on_tpu)
+        else:
+            main(on_tpu)
+    except Exception as e:  # never leave the driver a bare stack trace
+        import traceback
+
+        traceback.print_exc(file=sys.stderr)
+        print(_error_line(config, f"{type(e).__name__}: {e}"))
+        sys.exit(0)
+
+
 def main_dispatch() -> None:
+    """Supervisor: run the benchmark in a killable child process.
+
+    A SIGALRM watchdog cannot interrupt a hang inside native PJRT init (the
+    axon plugin blocks in C while the chip is claimed), so the only robust
+    "never hang" guard is process-level: re-exec this script as a child with
+    a hard timeout, forward its output, and synthesize an error JSON line if
+    it dies or stalls. BNG_BENCH_CHILD=1 marks the child.
+    """
     import argparse
+    import subprocess
 
     ap = argparse.ArgumentParser()
     ap.add_argument("--config", type=int, default=0,
                     help="BASELINE.json config number (1-5); 0 = headline mix")
     args = ap.parse_args()
-    if args.config == 1:
-        config1_dhcp_slowpath()
-        return
-    import jax
 
-    on_tpu = jax.devices()[0].platform not in ("cpu",)
-    if args.config == 2:
-        config2_nat44(on_tpu)
-    elif args.config == 3:
-        config3_qos(on_tpu)
-    elif args.config == 4:
-        config4_pppoe(on_tpu)
-    elif args.config == 5:
-        config5_sharded(on_tpu)
-    else:
-        main()
+    if os.environ.get("BNG_BENCH_CHILD") == "1":
+        _child_dispatch(args.config)
+        return
+
+    timeout_s = float(os.environ.get("BNG_BENCH_TIMEOUT", 2400))
+    env = dict(os.environ)
+    env["BNG_BENCH_CHILD"] = "1"
+    try:
+        res = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), *sys.argv[1:]],
+            env=env, timeout=timeout_s, stdout=subprocess.PIPE, text=True)
+        out = (res.stdout or "").strip()
+        # forward the child's final JSON line (its stderr already streamed)
+        json_lines = [ln for ln in out.splitlines() if ln.startswith("{")]
+        if json_lines:
+            print(json_lines[-1])
+        else:
+            print(_error_line(args.config,
+                              f"child rc={res.returncode}, no JSON emitted"))
+    except subprocess.TimeoutExpired:
+        print(_error_line(args.config,
+                          f"benchmark child timed out after {timeout_s:.0f}s"))
+    except Exception as e:  # pragma: no cover - spawn failure
+        print(_error_line(args.config, f"supervisor error: {type(e).__name__}: {e}"))
 
 
 if __name__ == "__main__":

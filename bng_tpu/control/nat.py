@@ -188,6 +188,171 @@ class NATManager:
             return block
         return None  # pool exhausted
 
+    def bulk_allocate_nat(self, private_ips, now: int = 0) -> int:
+        """Carve port blocks for many subscribers at once (1M-scale build).
+
+        Same carving policy as allocate_nat (round-robin public IPs,
+        sequential blocks, free-list reuse) but assembles all subscriber_nat
+        rows and installs them with one vectorized bulk_insert instead of a
+        per-key Python cuckoo walk. Skips per-block compliance logging —
+        this is the bench/restore path, not live allocation. Returns the
+        number of blocks created.
+        """
+        fresh = [int(ip) for ip in private_ips if int(ip) not in self.blocks]
+        if not fresh:
+            return 0
+        n = self.ports_per_subscriber
+        keys = np.zeros((len(fresh), 1), dtype=np.uint32)
+        rows = np.zeros((len(fresh), SUBNAT_WORDS), dtype=np.uint32)
+        made = 0
+        for i, priv in enumerate(fresh):
+            block = None
+            for _ in range(len(self.public_ips)):
+                pub_ip = self.public_ips[self._ip_round_robin % len(self.public_ips)]
+                if self._free_blocks[pub_ip]:
+                    start = self._free_blocks[pub_ip].pop()
+                else:
+                    start = self._next_block[pub_ip]
+                    if start + n - 1 > self.port_range[1]:
+                        self._ip_round_robin += 1
+                        continue
+                    self._next_block[pub_ip] = start + n
+                block = {
+                    "public_ip": pub_ip, "port_start": start,
+                    "port_end": start + n - 1, "next_port": start,
+                    "subscriber_id": self._sub_id_seq, "private_ip": priv,
+                }
+                self._sub_id_seq += 1
+                break
+            if block is None:
+                break  # pool exhausted; remaining rows stay zero and are trimmed
+            self.blocks[priv] = block
+            keys[made, 0] = priv
+            rows[made, BV_PUBLIC_IP] = block["public_ip"]
+            rows[made, BV_PORT_START] = block["port_start"]
+            rows[made, BV_PORT_END] = block["port_end"]
+            rows[made, BV_NEXT_PORT] = block["next_port"]
+            rows[made, BV_IN_USE] = 0
+            rows[made, BV_SUB_ID] = block["subscriber_id"]
+            made += 1
+        if made:
+            self.sub_nat.bulk_insert(keys[:made], rows[:made])
+        return made
+
+    def bulk_flows(self, src_ips, dst_ips, src_ports, dst_ports, protos,
+                   pkt_len: int, now: int):
+        """Vectorized session+reverse build for bench-scale flow setup.
+
+        Requires blocks already allocated for every src_ip (allocate_nat /
+        bulk_allocate_nat) and 5-tuples unique within the batch and fresh.
+        Under FLAG_EIM (RFC 4787 endpoint-independent mapping), flows
+        sharing an internal endpoint (src_ip, src_port, proto) share ONE
+        external mapping — existing EIM mappings are reused and refcounted,
+        new endpoints get sequential ports from the subscriber's block.
+        Without FLAG_EIM, each flow gets its own port (plain NAPT).
+        Parity probing (RFC 4787 port parity) is the live slow path's job
+        (handle_new_flow).
+
+        Returns (nat_ips, nat_ports, ok) arrays; ok=False lanes had no
+        block or an exhausted block.
+        """
+        src_ips = np.atleast_1d(np.asarray(src_ips, dtype=np.uint32))
+        nf = len(src_ips)
+        dst_ips = np.broadcast_to(np.asarray(dst_ips, dtype=np.uint32), (nf,))
+        src_ports = np.broadcast_to(np.asarray(src_ports, dtype=np.uint32), (nf,))
+        dst_ports = np.broadcast_to(np.asarray(dst_ports, dtype=np.uint32), (nf,))
+        protos = np.broadcast_to(np.asarray(protos, dtype=np.uint32), (nf,))
+        dstp = np.where(protos == PROTO_ICMP, 0, dst_ports).astype(np.uint32)
+
+        def _assign_sequential(ips_arr):
+            """Per-subscriber sequential port assignment for `ips_arr` units.
+
+            Returns (nat_ip, nat_port, ok) per unit and advances next_port.
+            """
+            nu = len(ips_arr)
+            uq, inv = np.unique(ips_arr, return_inverse=True)
+            blks = [self.blocks.get(int(ip)) for ip in uq]
+            has = np.array([b is not None for b in blks], dtype=bool)
+            pub = np.array([b["public_ip"] if b else 0 for b in blks], dtype=np.uint32)
+            pend = np.array([b["port_end"] if b else 0 for b in blks], dtype=np.int64)
+            pnext = np.array([b["next_port"] if b else 0 for b in blks], dtype=np.int64)
+            counts = np.bincount(inv, minlength=len(uq))
+            order = np.argsort(inv, kind="stable")
+            group_starts = np.concatenate(([0], np.cumsum(counts)[:-1]))
+            ranks = np.empty((nu,), dtype=np.int64)
+            ranks[order] = np.arange(nu) - np.repeat(group_starts, counts)
+            port = pnext[inv] + ranks
+            u_ok = has[inv] & (port <= pend[inv])
+            u_ip = np.where(u_ok, pub[inv], 0).astype(np.uint32)
+            u_port = np.where(u_ok, port, 0).astype(np.uint32)
+            for i, b in enumerate(blks):  # advance counters per subscriber
+                if b is not None and counts[i]:
+                    b["next_port"] = int(min(pnext[i] + counts[i], pend[i] + 1))
+            return u_ip, u_port, u_ok
+
+        if self.flags & FLAG_EIM:
+            # one external mapping per unique internal endpoint
+            ep = np.stack([src_ips, src_ports, protos], axis=1)
+            uq_ep, ep_inv = np.unique(ep, axis=0, return_inverse=True)
+            n_ep = len(uq_ep)
+            ep_ip = np.zeros((n_ep,), dtype=np.uint32)
+            ep_port = np.zeros((n_ep,), dtype=np.uint32)
+            reused = np.zeros((n_ep,), dtype=bool)
+            for j in range(n_ep):
+                m = self.eim.get((int(uq_ep[j, 0]), int(uq_ep[j, 1]), int(uq_ep[j, 2])))
+                if m is not None:
+                    reused[j] = True
+                    ep_ip[j], ep_port[j] = m[0], m[1]
+            ep_ok = reused.copy()
+            new_j = np.nonzero(~reused)[0]
+            if len(new_j):
+                n_ip, n_port, n_ok = _assign_sequential(uq_ep[new_j, 0])
+                ep_ip[new_j], ep_port[new_j], ep_ok[new_j] = n_ip, n_port, n_ok
+            nat_ip = ep_ip[ep_inv]
+            nat_port = ep_port[ep_inv]
+            ok = ep_ok[ep_inv]
+            # refcount bookkeeping per endpoint
+            ep_counts = np.bincount(ep_inv, minlength=n_ep)
+            for j in range(n_ep):
+                if not ep_ok[j]:
+                    continue
+                k = (int(uq_ep[j, 0]), int(uq_ep[j, 1]), int(uq_ep[j, 2]))
+                if reused[j]:
+                    self.eim[k][2] += int(ep_counts[j])
+                else:
+                    self.eim[k] = [int(ep_ip[j]), int(ep_port[j]), int(ep_counts[j])]
+                    self._ext_ports[(int(ep_ip[j]), int(ep_port[j]), k[2])] = k
+        else:
+            nat_ip, nat_port, ok = _assign_sequential(src_ips)
+
+        sel = np.nonzero(ok)[0]
+        if len(sel):
+            skey = np.stack(
+                [src_ips, dst_ips,
+                 ((src_ports & 0xFFFF) << np.uint32(16)) | (dstp & 0xFFFF),
+                 protos], axis=1).astype(np.uint32)
+            rows = np.zeros((nf, SESSION_WORDS), dtype=np.uint32)
+            rows[:, SV_NAT_IP] = nat_ip
+            rows[:, SV_NAT_PORT] = nat_port
+            rows[:, SV_ORIG_IP] = src_ips
+            rows[:, SV_ORIG_PORT] = src_ports
+            rows[:, SV_DEST_IP] = dst_ips
+            rows[:, SV_DEST_PORT] = dstp
+            rows[:, SV_CREATED] = now
+            rows[:, SV_LAST_SEEN] = now
+            rows[:, SV_STATE] = NAT_STATE_NEW
+            rows[:, SV_PROTO] = protos
+            rows[:, SV_PKTS_OUT] = 1
+            rows[:, SV_BYTES_OUT] = pkt_len
+            self.sessions.bulk_insert(skey[sel], rows[sel])
+            r_src = np.where(protos == PROTO_ICMP, 0, dstp).astype(np.uint32)
+            rkey = np.stack(
+                [dst_ips, nat_ip,
+                 ((r_src & 0xFFFF) << np.uint32(16)) | (nat_port & 0xFFFF),
+                 protos], axis=1).astype(np.uint32)
+            self.reverse.bulk_insert(rkey[sel], skey[sel])
+        return nat_ip, nat_port, ok
+
     def release_nat(self, private_ip: int, now: int = 0) -> bool:
         block = self.blocks.pop(private_ip, None)
         if block is None:

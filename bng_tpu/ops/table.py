@@ -240,6 +240,7 @@ class HostTable:
         self.used = np.zeros((S,), dtype=np.uint32)
         self.count = 0
         self._dirty: set[int] = set()
+        self._dirty_all = False  # set by large bulk_insert: full resync needed
         self._rng = np.random.default_rng(0xB46)
 
     # -- hashing (must match device_lookup exactly) --
@@ -314,6 +315,66 @@ class HostTable:
             self._place(slot, old_key, old_val)
         raise RuntimeError(f"table {self.name!r} full (count={self.count})")
 
+    def bulk_insert(self, keys: np.ndarray, vals: np.ndarray) -> None:
+        """Vectorized batch insert for initial table builds (1M-entry scale).
+
+        The per-key `insert` path is a Python loop — fine for slow-path
+        churn (hundreds/sec), infeasible for building the reference-scale
+        1M-subscriber table (bpf/maps.h:10). This places a whole batch with
+        8 vectorized passes (2 buckets x 4 ways, first-wins conflict
+        resolution via np.unique) and falls back to the cuckoo-kick path
+        only for the residue whose candidate slots were all taken (<1% at
+        the sizing rule of ~50% load).
+
+        Keys must be unique within the batch and not already present
+        (bulk = initial build / bulk restore, not upsert). After a large
+        bulk insert the dirty set is abandoned: call device_state() for a
+        full upload, as startup does anyway.
+        """
+        keys = np.ascontiguousarray(np.asarray(keys, dtype=np.uint32).reshape(-1, self.K))
+        vals = np.ascontiguousarray(np.asarray(vals, dtype=np.uint32).reshape(-1, self.V))
+        n = len(keys)
+        if n == 0:
+            return
+        words = [keys[:, k] for k in range(self.K)]
+        m = np.uint32(self.nbuckets - 1)
+        b1 = (hash_words(words, SEED1) & m).astype(np.int64)
+        b2 = (hash_words(words, SEED2) & m).astype(np.int64)
+
+        unplaced = np.ones((n,), dtype=bool)
+        placed_slots: list[np.ndarray] = []
+        for side in (b1, b2):
+            for w in range(WAYS):
+                idxs = np.nonzero(unplaced)[0]
+                if len(idxs) == 0:
+                    break
+                slot = side[idxs] * WAYS + w
+                free = self.used[slot] == 0
+                idxs, slot = idxs[free], slot[free]
+                if len(idxs) == 0:
+                    continue
+                # first-wins per slot within this pass
+                uq_slot, first = np.unique(slot, return_index=True)
+                take = idxs[first]
+                self.keys[uq_slot] = keys[take]
+                self.vals[uq_slot] = vals[take]
+                self.used[uq_slot] = 1
+                unplaced[take] = False
+                placed_slots.append(uq_slot)
+        self.count += sum(len(s) for s in placed_slots)
+
+        residue = np.nonzero(unplaced)[0]
+        for i in residue:  # cuckoo-kick / stash path for the stragglers
+            self.insert(keys[i], vals[i])
+
+        # dirty tracking: a large bulk build invalidates bounded-delta sync
+        if n > self.stash:
+            self._dirty.clear()
+            self._dirty_all = True
+        else:
+            for s in placed_slots:
+                self._dirty.update(int(x) for x in s)
+
     def delete(self, key) -> bool:
         key = np.asarray(key, dtype=np.uint32).reshape(self.K)
         s = self._find_slot(key)
@@ -346,6 +407,7 @@ class HostTable:
     def device_state(self) -> TableState:
         """Full upload (startup / resync)."""
         self._dirty.clear()
+        self._dirty_all = False
         return TableState(
             keys=jnp.asarray(self.keys),
             vals=jnp.asarray(self.vals),
@@ -353,7 +415,7 @@ class HostTable:
         )
 
     def dirty_count(self) -> int:
-        return len(self._dirty)
+        return self.S if self._dirty_all else len(self._dirty)
 
     def make_update(self, max_slots: int) -> TableUpdate:
         """Drain up to max_slots dirty slots into a fixed-size TableUpdate.
@@ -361,6 +423,10 @@ class HostTable:
         Remaining dirty slots stay queued for the next batch (bounded
         host->HBM traffic per step, like bounded map-update syscalls).
         """
+        if self._dirty_all:
+            raise RuntimeError(
+                f"table {self.name!r}: bulk_insert invalidated delta sync; "
+                "call device_state() for a full upload first")
         take = sorted(self._dirty)[:max_slots]
         for s in take:
             self._dirty.discard(s)

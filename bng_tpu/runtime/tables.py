@@ -125,6 +125,31 @@ class FastPathTables:
         lo, hi = split_u64(key)
         self.sub.insert([hi, lo], self._assignment(pool_id, ip, lease_expiry, vlan_id, client_class, flags))
 
+    def add_subscribers_bulk(self, macs_u64, pool_ids, ips, lease_expiries,
+                             vlan_ids=0, client_classes=0, flags=0) -> None:
+        """Vectorized batch insert for reference-scale table builds.
+
+        The reference sizes subscriber maps for 1M entries
+        (/root/reference/bpf/maps.h:10); a per-subscriber Python insert loop
+        makes that infeasible, so the bench/restore path assembles key/value
+        arrays and hands them to HostTable.bulk_insert (8 vectorized
+        placement passes). MACs must be unique and not already present.
+        Follow with device_tables() for a full upload.
+        """
+        macs_u64 = np.asarray(macs_u64, dtype=np.uint64)
+        n = len(macs_u64)
+        keys = np.zeros((n, 2), dtype=np.uint32)
+        keys[:, 0] = (macs_u64 >> np.uint64(32)).astype(np.uint32)  # hi
+        keys[:, 1] = (macs_u64 & np.uint64(0xFFFFFFFF)).astype(np.uint32)  # lo
+        vals = np.zeros((n, ASSIGN_WORDS), dtype=np.uint32)
+        vals[:, AV_POOL_ID] = pool_ids
+        vals[:, AV_IP] = ips
+        vals[:, AV_VLAN] = vlan_ids
+        vals[:, AV_CLASS] = client_classes
+        vals[:, AV_LEASE_EXP] = lease_expiries
+        vals[:, AV_FLAGS] = flags
+        self.sub.bulk_insert(keys, vals)
+
     def remove_subscriber(self, mac) -> bool:
         key = mac_to_u64(mac) if not isinstance(mac, int) else mac
         lo, hi = split_u64(key)

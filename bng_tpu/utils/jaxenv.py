@@ -1,0 +1,152 @@
+"""Environment-proof JAX backend initialization for driver entry points.
+
+The container's sitecustomize registers an `axon` PJRT plugin in every
+interpreter.  Initializing it contends for the single real TPU chip: when
+another process holds the claim (or the tunnel is down) `jax.devices()`
+either raises UNAVAILABLE or *hangs* indefinitely.  Round 1 shipped both
+failure modes as driver artifacts (BENCH_r01 rc=1, MULTICHIP_r01 rc=124).
+
+Two guards, mirroring tests/conftest.py:
+
+- ``force_cpu(n_devices)``: hermetically pin this process to the CPU
+  backend with an ``n_devices``-device virtual mesh and drop every non-cpu
+  PJRT factory so nothing can touch the chip.  Used by
+  ``__graft_entry__.dryrun_multichip`` and test runs.
+
+- ``guarded_backend(...)``: probe accelerator availability in a *subprocess*
+  with a hard timeout (a hung in-process PJRT init cannot be interrupted),
+  retry a bounded number of times, and on final failure force CPU and
+  return the diagnostic.  Used by ``bench.py`` so the driver always gets a
+  JSON line — a measured TPU number when the chip is reachable, a
+  CPU-fallback number plus ``"error"`` diagnostics when it is not.
+
+Reference analog: the XDP attach ladder driver->generic->error in
+/root/reference/pkg/ebpf/loader.go:294-315 — always degrade, never crash.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import time
+
+_PROBE_SRC = (
+    "import jax; d = jax.devices(); "
+    "import jax.numpy as jnp; jnp.zeros((8,)).block_until_ready(); "
+    "print(d[0].platform, len(d))"
+)
+
+
+def _ensure_host_device_count(n_devices: int) -> None:
+    import re
+
+    flags = os.environ.get("XLA_FLAGS", "")
+    opt = "--xla_force_host_platform_device_count"
+    m = re.search(rf"{opt}=(\d+)", flags)
+    if m is None:
+        os.environ["XLA_FLAGS"] = f"{flags} {opt}={n_devices}".strip()
+    elif int(m.group(1)) < n_devices:
+        os.environ["XLA_FLAGS"] = flags.replace(m.group(0), f"{opt}={n_devices}")
+
+
+def force_cpu(n_devices: int = 8) -> None:
+    """Pin this process to a hermetic CPU backend with a virtual mesh.
+
+    Safe to call multiple times.  Must run before the first real backend
+    initialization; sitecustomize importing jax is fine (config is updated
+    live and the non-cpu PJRT factories are dropped, so a stray request
+    fails loudly instead of hanging on the chip claim).
+    """
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    _ensure_host_device_count(n_devices)
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    # Preload pallas while the platform registry is intact: its import
+    # registers "tpu" lowering rules, which fails once factories are gone.
+    try:
+        import jax.experimental.pallas  # noqa: F401
+        import jax.experimental.pallas.tpu  # noqa: F401
+    except Exception:  # pragma: no cover - pallas optional on exotic jaxlibs
+        pass
+    try:
+        import jax._src.xla_bridge as _xb
+
+        for _name in list(getattr(_xb, "_backend_factories", {})):
+            if _name != "cpu":
+                _xb._backend_factories.pop(_name, None)
+    except Exception:  # pragma: no cover - best effort
+        pass
+
+
+def probe_accelerator(timeout_s: float = 120.0) -> tuple[str, str]:
+    """Probe backend availability in a subprocess with a hard timeout.
+
+    Returns ``(platform, "")`` on success (e.g. ``("tpu", "")``) or
+    ``("", diagnostic)`` on failure.  The subprocess inherits the default
+    environment (axon plugin active) so it exercises exactly the init path
+    the current process would take.
+    """
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)  # let the plugin pick the accelerator
+    try:
+        res = subprocess.run(
+            [sys.executable, "-c", _PROBE_SRC],
+            capture_output=True, text=True, timeout=timeout_s, env=env,
+        )
+    except subprocess.TimeoutExpired:
+        return "", f"probe timed out after {timeout_s:.0f}s (chip held or tunnel down)"
+    except Exception as e:  # pragma: no cover - spawn failure
+        return "", f"probe spawn failed: {e!r}"
+    if res.returncode != 0:
+        tail = (res.stderr or res.stdout or "").strip().splitlines()[-3:]
+        return "", f"probe rc={res.returncode}: " + " | ".join(tail)
+    out = (res.stdout or "").strip().split()
+    return (out[0] if out else "unknown"), ""
+
+
+def guarded_backend(
+    prefer_accelerator: bool = True,
+    tries: int = 2,
+    probe_timeout_s: float = 120.0,
+    retry_sleep_s: float = 10.0,
+    cpu_devices: int = 8,
+) -> tuple[str, str]:
+    """Initialize a usable JAX backend without ever hanging or crashing.
+
+    Returns ``(platform, error)``.  ``error`` is non-empty when the
+    accelerator was wanted but unreachable and CPU fallback was taken.
+    """
+    if not prefer_accelerator or os.environ.get("JAX_PLATFORMS") == "cpu":
+        force_cpu(cpu_devices)
+        return "cpu", ""
+    err = ""
+    for attempt in range(tries):
+        if attempt:
+            time.sleep(retry_sleep_s)
+        platform, err = probe_accelerator(probe_timeout_s)
+        if platform:
+            # Probe succeeded; in-process init should follow the same path.
+            # A SIGALRM watchdog closes (best-effort) the race window where
+            # the chip is claimed between probe exit and our init — the
+            # exact hang this module exists to prevent.
+            import signal
+
+            import jax
+
+            def _timeout(_sig, _frm):
+                raise TimeoutError("in-process backend init watchdog fired")
+
+            old = signal.signal(signal.SIGALRM, _timeout)
+            signal.alarm(int(probe_timeout_s) + 30)
+            try:
+                return jax.devices()[0].platform, ""
+            except Exception as e:  # raced: chip claimed between probe and init
+                err = f"in-process init failed after OK probe: {e!r}"
+            finally:
+                signal.alarm(0)
+                signal.signal(signal.SIGALRM, old)
+    force_cpu(cpu_devices)
+    return "cpu", err

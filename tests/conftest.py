@@ -5,39 +5,13 @@ multi-chip sharding paths (bng_tpu.parallel) need >1 device. Mirrors the
 reference's strategy of running everything against stub platform backends
 (SURVEY.md §4.6: _linux.go/_stub.go pairs, nil-safe loader).
 
-The container's sitecustomize registers an `axon` PJRT plugin for the one
-real TPU chip in every interpreter; initializing it contends for the chip
-and can block test runs while another process holds the claim. Tests force
-JAX_PLATFORMS=cpu *and* drop the axon backend factory before any backend
-initialization so pytest never touches the chip.
+The actual guard (force JAX_PLATFORMS=cpu, virtual device count, drop the
+axon PJRT factory so nothing can touch the chip) lives in
+bng_tpu.utils.jaxenv.force_cpu — the same helper the driver entry points
+use. Keep the logic there; this file just invokes it before any backend
+initialization.
 """
 
-import os
+from bng_tpu.utils.jaxenv import force_cpu
 
-os.environ["JAX_PLATFORMS"] = "cpu"
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"
-
-# sitecustomize has already imported jax with JAX_PLATFORMS=axon, so the env
-# var alone is too late — update the live config and drop the axon factory
-# so nothing can touch the chip (a stray request fails loudly, never hangs).
-import jax
-
-jax.config.update("jax_platforms", "cpu")
-# Preload pallas (and its checkify dependency) while the full platform
-# registry is intact: its import registers "tpu" lowering rules, which
-# fails with "unknown platform" once the factories below are dropped.
-try:
-    import jax.experimental.pallas  # noqa: F401
-    import jax.experimental.pallas.tpu  # noqa: F401
-except Exception:  # pragma: no cover - pallas optional on exotic jaxlibs
-    pass
-try:
-    import jax._src.xla_bridge as _xb
-
-    for _name in list(getattr(_xb, "_backend_factories", {})):
-        if _name != "cpu":
-            _xb._backend_factories.pop(_name, None)
-except Exception:  # pragma: no cover - best effort; jax_platforms=cpu remains
-    pass
+force_cpu(8)
