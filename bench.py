@@ -380,9 +380,8 @@ def config3_qos(on_tpu):
     STEPS = int(os.environ.get("BNG_BENCH_STEPS", 100 if on_tpu else 5))
     N = int(os.environ.get("BNG_BENCH_SUBS", 10_000 if on_tpu else 1_000))
     qos = QoSTables(nbuckets=1 << max(10, (N * 2 // 4).bit_length()))
-    for i in range(N):
-        qos.set_subscriber((10 << 24) | (i + 2), down_bps=100_000_000,
-                           up_bps=20_000_000)
+    qos.bulk_set_subscribers(((10 << 24) + 2 + np.arange(N)).astype(np.uint32),
+                             down_bps=100_000_000, up_bps=20_000_000)
     rng = np.random.default_rng(9)
     ips = ((10 << 24) + 2 + rng.integers(0, N, size=B)).astype(np.uint32)
     lens = np.full((B,), 900, dtype=np.uint32)
@@ -531,10 +530,34 @@ def _error_line(config: int, err: str) -> str:
                        "error": err, **_DIAG})
 
 
-def _child_dispatch(config: int) -> None:
+def _run_lowering_gate(strict: bool) -> None:
+    """TPU-lowering pre-step (verifier-harness analog; see runtime/verify.py).
+
+    strict=True (--verify-lowering): emit a JSON verdict line, exit 1 on any
+    failure. strict=False (auto pre-step before the headline): record
+    failures in the diag fields and continue.
+    """
+    from bng_tpu.runtime.verify import verify_tpu_lowering
+
+    _mark("TPU-lowering gate: compiling hot programs for the TPU target...")
+    results = verify_tpu_lowering(verbose=True)
+    failures = [n for n, e in results if e is not None]
+    if strict:
+        print(json.dumps({
+            "metric": "TPU-lowering gate", "value": float(len(failures) == 0),
+            "unit": "pass", "vs_baseline": float(len(failures) == 0),
+            "checked": [n for n, _ in results], "failures": failures,
+        }))
+        sys.exit(1 if failures else 0)
+    if failures:
+        _DIAG["lowering_failures"] = failures
+        _mark(f"lowering gate FAILURES (continuing): {failures}")
+
+
+def _child_dispatch(config: int, verify_lowering: bool = False) -> None:
     """Run one benchmark config in this process (the supervised child)."""
     try:
-        if config == 1:
+        if config == 1 and not verify_lowering:
             config1_dhcp_slowpath()
             return
 
@@ -554,6 +577,14 @@ def _child_dispatch(config: int) -> None:
         if err:
             _DIAG["backend_fallback"] = "cpu"
             _DIAG["backend_error"] = err
+        if verify_lowering:
+            if not on_tpu:
+                print(json.dumps({
+                    "metric": "TPU-lowering gate", "value": 0.0, "unit": "pass",
+                    "vs_baseline": 0.0, "error": "no TPU attached", **_DIAG}))
+                sys.exit(1)
+            _run_lowering_gate(strict=True)
+            return
         if config == 2:
             config2_nat44(on_tpu)
         elif config == 3:
@@ -563,13 +594,17 @@ def _child_dispatch(config: int) -> None:
         elif config == 5:
             config5_sharded(on_tpu)
         else:
+            if on_tpu and os.environ.get("BNG_SKIP_LOWERING_GATE") != "1":
+                _run_lowering_gate(strict=False)
             main(on_tpu)
     except Exception as e:  # never leave the driver a bare stack trace
         import traceback
 
         traceback.print_exc(file=sys.stderr)
         print(_error_line(config, f"{type(e).__name__}: {e}"))
-        sys.exit(0)
+        # bench runs degrade to an error JSON line (rc 0: the driver wants a
+        # line, not a crash); the CI gate must fail loudly instead
+        sys.exit(1 if verify_lowering else 0)
 
 
 def main_dispatch() -> None:
@@ -587,10 +622,12 @@ def main_dispatch() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--config", type=int, default=0,
                     help="BASELINE.json config number (1-5); 0 = headline mix")
+    ap.add_argument("--verify-lowering", action="store_true",
+                    help="run the TPU-lowering gate only (CI pre-step; rc=1 on failure)")
     args = ap.parse_args()
 
     if os.environ.get("BNG_BENCH_CHILD") == "1":
-        _child_dispatch(args.config)
+        _child_dispatch(args.config, verify_lowering=args.verify_lowering)
         return
 
     timeout_s = float(os.environ.get("BNG_BENCH_TIMEOUT", 2400))
@@ -608,11 +645,17 @@ def main_dispatch() -> None:
         else:
             print(_error_line(args.config,
                               f"child rc={res.returncode}, no JSON emitted"))
+        if args.verify_lowering:  # CI pre-step: propagate the gate verdict
+            sys.exit(res.returncode)
     except subprocess.TimeoutExpired:
         print(_error_line(args.config,
                           f"benchmark child timed out after {timeout_s:.0f}s"))
+        if args.verify_lowering:  # a gate that never ran is a failed gate
+            sys.exit(1)
     except Exception as e:  # pragma: no cover - spawn failure
         print(_error_line(args.config, f"supervisor error: {type(e).__name__}: {e}"))
+        if args.verify_lowering:
+            sys.exit(1)
 
 
 if __name__ == "__main__":

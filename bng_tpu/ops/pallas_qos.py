@@ -42,6 +42,7 @@ except (ImportError, NotImplementedError):  # pragma: no cover - env specific
     _VMEM = None
 
 LANE_TILE = 256  # rows per grid cell; [256, 256] eq tiles feed the MXU
+SUBLANES = 8  # Mosaic tiling: rank>=2 blocks need (8k, 128m) trailing dims
 
 
 def _block(shape, index_map):
@@ -52,6 +53,10 @@ def _block(shape, index_map):
 
 def _seg_kernel(slot_i_ref, slot_j_ref, vec_ref, pref_ref, tot_ref,
                 *, want_prefix: bool, want_total: bool):
+    # refs are (1, SUBLANES, T): each tile's lane vector replicated across
+    # 8 sublanes so the block's trailing dims are Mosaic-legal (8, 256) —
+    # a (1, T) block is rejected ("block shape ... divisible by 8 and 128",
+    # the round-2 lowering failure). Row 0 carries the data.
     i = pl.program_id(0)
     j = pl.program_id(1)
 
@@ -60,15 +65,15 @@ def _seg_kernel(slot_i_ref, slot_j_ref, vec_ref, pref_ref, tot_ref,
         pref_ref[:] = jnp.zeros_like(pref_ref)
         tot_ref[:] = jnp.zeros_like(tot_ref)
 
-    T = pref_ref.shape[1]
-    slots_i = slot_i_ref[0, :]
-    slots_j = slot_j_ref[0, :]
-    vec_j = vec_ref[0, :]
+    T = pref_ref.shape[2]
+    slots_i = slot_i_ref[0, 0, :]
+    slots_j = slot_j_ref[0, 0, :]
+    vec_j = vec_ref[0, 0, :]
     eq = (slots_i[:, None] == slots_j[None, :]).astype(jnp.float32)
     contrib = jnp.dot(eq, vec_j[:, None],
                       preferred_element_type=jnp.float32)[:, 0]
     if want_total:
-        tot_ref[0, :] = tot_ref[0, :] + contrib
+        tot_ref[0, 0, :] = tot_ref[0, 0, :] + contrib
 
     if want_prefix:
         # prefix: blocks left of the diagonal contribute fully; the
@@ -76,7 +81,7 @@ def _seg_kernel(slot_i_ref, slot_j_ref, vec_ref, pref_ref, tot_ref,
         # the block)
         @pl.when(j < i)
         def _():
-            pref_ref[0, :] = pref_ref[0, :] + contrib
+            pref_ref[0, 0, :] = pref_ref[0, 0, :] + contrib
 
         @pl.when(j == i)
         def _():
@@ -85,7 +90,7 @@ def _seg_kernel(slot_i_ref, slot_j_ref, vec_ref, pref_ref, tot_ref,
             tri = jnp.where(col <= row, eq, 0.0)
             pref = jnp.dot(tri, vec_j[:, None],
                            preferred_element_type=jnp.float32)[:, 0]
-            pref_ref[0, :] = pref_ref[0, :] + pref
+            pref_ref[0, 0, :] = pref_ref[0, 0, :] + pref
 
 
 @functools.partial(jax.jit, static_argnames=("interpret", "compute"))
@@ -112,8 +117,9 @@ def seg_prefix_total(slot: jax.Array, vec: jax.Array, interpret: bool = False,
         slot = jnp.concatenate([slot, pad_ids])
         vec = jnp.concatenate([vec, jnp.zeros((Bp - B,), dtype=jnp.float32)])
 
-    slot2d = slot.reshape(nt, T)
-    vec2d = vec.reshape(nt, T)
+    # lane vectors replicated across 8 sublanes for Mosaic-legal blocks
+    slot3d = jnp.broadcast_to(slot.reshape(nt, 1, T), (nt, SUBLANES, T))
+    vec3d = jnp.broadcast_to(vec.reshape(nt, 1, T), (nt, SUBLANES, T))
 
     kernel = functools.partial(_seg_kernel,
                                want_prefix=compute in ("prefix", "both"),
@@ -122,18 +128,18 @@ def seg_prefix_total(slot: jax.Array, vec: jax.Array, interpret: bool = False,
         kernel,
         grid=(nt, nt),
         in_specs=[
-            _block((1, T), lambda i, j: (i, 0)),
-            _block((1, T), lambda i, j: (j, 0)),
-            _block((1, T), lambda i, j: (j, 0)),
+            _block((1, SUBLANES, T), lambda i, j: (i, 0, 0)),
+            _block((1, SUBLANES, T), lambda i, j: (j, 0, 0)),
+            _block((1, SUBLANES, T), lambda i, j: (j, 0, 0)),
         ],
         out_specs=[
-            _block((1, T), lambda i, j: (i, 0)),
-            _block((1, T), lambda i, j: (i, 0)),
+            _block((1, SUBLANES, T), lambda i, j: (i, 0, 0)),
+            _block((1, SUBLANES, T), lambda i, j: (i, 0, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((nt, T), jnp.float32),
-            jax.ShapeDtypeStruct((nt, T), jnp.float32),
+            jax.ShapeDtypeStruct((nt, SUBLANES, T), jnp.float32),
+            jax.ShapeDtypeStruct((nt, SUBLANES, T), jnp.float32),
         ],
         interpret=interpret,
-    )(slot2d, slot2d, vec2d)
-    return pref.reshape(Bp)[:B], tot.reshape(Bp)[:B]
+    )(slot3d, slot3d, vec3d)
+    return pref[:, 0, :].reshape(Bp)[:B], tot[:, 0, :].reshape(Bp)[:B]

@@ -46,6 +46,7 @@ from bng_tpu.ops.nat44 import (
 )
 from bng_tpu.ops.parse import parse_batch
 from bng_tpu.ops.qos import QOS_NSTATS, QoSGeom, qos_kernel
+from bng_tpu.ops.qtable import QTableState
 from bng_tpu.ops.table import TableState
 
 VERDICT_PASS, VERDICT_DROP, VERDICT_TX, VERDICT_FWD = 0, 1, 2, 3
@@ -56,8 +57,8 @@ class PipelineTables(NamedTuple):
 
     dhcp: DHCPTables
     nat: NATTables
-    qos_up: TableState  # keyed by src ip (upload; qos_ingress map role)
-    qos_down: TableState  # keyed by dst ip (download; qos_egress map role)
+    qos_up: QTableState  # keyed by src ip (upload; qos_ingress map role)
+    qos_down: QTableState  # keyed by dst ip (download; qos_egress map role)
     spoof: TableState
     spoof_ranges: jax.Array  # [R, 2]
     spoof_config: jax.Array  # [2]

@@ -29,10 +29,11 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
-from bng_tpu.ops.parse import Parsed
-from bng_tpu.ops.table import TableGeom, TableState, lookup
+from bng_tpu.ops.qtable import QTableGeom, QTableState, qlookup
 
-# token_bucket value words (parity: qos_ratelimit.c:24-31)
+# token_bucket value words (parity: qos_ratelimit.c:24-31) — retained as
+# the logical field order; physically the fields live in packed bucket
+# rows + flat token arrays (see ops/qtable.py for the layout rationale)
 (QV_RATE_BPS_LO, QV_RATE_BPS_HI, QV_BURST, QV_TOKENS, QV_LAST_US, QV_PRIORITY) = range(6)
 QOS_WORDS = 8
 
@@ -41,8 +42,8 @@ QOS_WORDS = 8
 QOS_NSTATS = 4
 
 
-# QoS has a single table per direction; its geometry IS a TableGeom
-QoSGeom = TableGeom
+# QoS table geometry is the packed-bucket table's
+QoSGeom = QTableGeom
 
 # Same-bucket aggregation strategy:
 #   "sort"   — stable argsort + segment cumsum (works on every backend)
@@ -77,7 +78,10 @@ def _prefix_consumed(limited, slot, lens_u, avail):
         # per bucket per batch (the sort path's u32 cumsum is exact to
         # 2^32); a single bucket attempting >16.7MB in one batch can
         # flip a boundary admission vs the sort/eBPF reference.
-        interp = jax.default_backend() in ("cpu",)
+        # Mosaic lowering is TPU-only: every other backend (cpu, gpu, ...)
+        # runs interpret mode (ADVICE r1: a GPU backend must not try to
+        # compile the Mosaic kernel).
+        interp = jax.default_backend() != "tpu"
         lens_f = lens_u.astype(jnp.float32)
         cum_incl, _ = seg_prefix_total(slot_eff, lens_f, interpret=interp,
                                        compute="prefix")
@@ -119,7 +123,7 @@ class QoSResult(NamedTuple):
     allowed: jax.Array  # [B] bool (True also for no-policy lanes)
     dropped: jax.Array  # [B] bool (policy present and bucket empty)
     priority: jax.Array  # [B] uint32 (skb->priority parity, :166)
-    table: TableState  # updated token state
+    table: QTableState  # updated token state
     stats: jax.Array  # [QOS_NSTATS] uint32
 
 
@@ -127,35 +131,31 @@ def qos_kernel(
     ip_key: jax.Array,  # [B] uint32 — dst_ip for download, src_ip for upload
     pkt_len: jax.Array,  # [B] uint32
     active: jax.Array,  # [B] bool — lanes subject to this QoS direction
-    table: TableState,
-    geom: TableGeom,
+    table: QTableState,
+    geom: QTableGeom,
     now_us: jax.Array,  # uint32 scalar, wraps
 ) -> QoSResult:
     # qos is the only device-side *writer* of its table: the token/timestamp
-    # writeback below scatters into the LOCAL table at res.slot, which under
+    # writeback below scatters into the LOCAL arrays at res.slot, which under
     # a sharded geometry would be an owner-local slot — silent corruption.
     # QoS tables are chip-local by design (subscriber traffic affinity).
     if geom.axis is not None and geom.n_shards > 1:
         raise ValueError("qos_kernel requires a chip-local table (geom.axis=None); "
                          "QoS state is placed by subscriber affinity, not hash-sharding")
     Bsz = ip_key.shape[0]
-    res = lookup(table, ip_key[:, None], geom)
+    res = qlookup(table, ip_key, geom)
     has_policy = res.found & active
-    rate_lo = res.vals[:, QV_RATE_BPS_LO]
-    rate_hi = res.vals[:, QV_RATE_BPS_HI]
     # rate==0 means unlimited (qos_ratelimit.c:79-80)
-    limited = has_policy & ((rate_lo | rate_hi) != 0)
+    limited = has_policy & ((res.rate_lo | res.rate_hi) != 0)
 
-    burst = res.vals[:, QV_BURST]
-    tokens = res.vals[:, QV_TOKENS]
-    last_us = res.vals[:, QV_LAST_US]
+    burst_f = res.burst.astype(jnp.float32)
 
     # refill (f32 math: |err| ~1e-7 relative, fine for shaping):
     # bytes/sec = rate_bps / 8; refill = elapsed_us * Bps / 1e6
-    elapsed_us = (now_us - last_us).astype(jnp.float32)  # uint32 wrap-safe diff
-    rate_bps = rate_lo.astype(jnp.float32) + rate_hi.astype(jnp.float32) * jnp.float32(2.0**32)
+    elapsed_us = (now_us - res.last_us).astype(jnp.float32)  # uint32 wrap-safe diff
+    rate_bps = res.rate_lo.astype(jnp.float32) + res.rate_hi.astype(jnp.float32) * jnp.float32(2.0**32)
     refill = elapsed_us * (rate_bps / 8.0) * jnp.float32(1e-6)
-    avail = jnp.minimum(tokens.astype(jnp.float32) + refill, burst.astype(jnp.float32))
+    avail = jnp.minimum(res.tokens + refill, burst_f)
 
     # --- same-bucket aggregation (sequential TBF admission per lane) ---
     # impl-pluggable: stable-sort segment cumsum (u32-exact to 4GB per
@@ -164,13 +164,14 @@ def qos_kernel(
     lens_u = pkt_len.astype(jnp.uint32)
     allowed, consumed, first = _prefix_consumed(limited, res.slot, lens_u, avail)
     dropped = limited & ~allowed
-    new_tokens = jnp.clip(avail - consumed, 0.0, burst.astype(jnp.float32))
-    S = table.vals.shape[0]
+    new_tokens = jnp.clip(avail - consumed, 0.0, burst_f)
+    S = table.tokens.shape[0]
     wslot = jnp.where(first, res.slot, S).astype(jnp.int32)
-    vals = table.vals.at[wslot, QV_TOKENS].set(new_tokens.astype(jnp.uint32), mode="drop")
-    vals = vals.at[wslot, QV_LAST_US].set(jnp.broadcast_to(now_us, (Bsz,)).astype(jnp.uint32), mode="drop")
+    tokens = table.tokens.at[wslot].set(new_tokens, mode="drop")
+    last_us = table.last_us.at[wslot].set(
+        jnp.broadcast_to(now_us, (Bsz,)).astype(jnp.uint32), mode="drop")
 
-    priority = jnp.where(has_policy, res.vals[:, QV_PRIORITY], 0)
+    priority = jnp.where(has_policy, res.priority, 0)
 
     stats = jnp.zeros((QOS_NSTATS,), dtype=jnp.uint32)
     counted = has_policy  # stats only update when a policy exists (:149-162)
@@ -183,20 +184,6 @@ def qos_kernel(
         allowed=allowed,
         dropped=dropped,
         priority=priority,
-        table=table._replace(vals=vals),
+        table=table._replace(tokens=tokens, last_us=last_us),
         stats=stats,
     )
-
-
-def make_bucket_row(rate_bps: int, burst_bytes: int, priority: int, start_full: bool = True):
-    """Host-side helper: token_bucket row for table insert."""
-    import numpy as np
-
-    v = np.zeros((QOS_WORDS,), dtype=np.uint32)
-    v[QV_RATE_BPS_LO] = rate_bps & 0xFFFFFFFF
-    v[QV_RATE_BPS_HI] = (rate_bps >> 32) & 0xFFFFFFFF
-    v[QV_BURST] = burst_bytes
-    v[QV_TOKENS] = burst_bytes if start_full else 0
-    v[QV_LAST_US] = 0
-    v[QV_PRIORITY] = priority
-    return v

@@ -45,12 +45,16 @@ from bng_tpu.ops.pipeline import (
     VERDICT_TX,
     pipeline_step,
 )
-from bng_tpu.ops.qos import QOS_NSTATS, QOS_WORDS, make_bucket_row
+from bng_tpu.ops.qos import QOS_NSTATS
 from bng_tpu.ops.antispoof import ANTISPOOF_WORDS
+from bng_tpu.ops.qtable import HostQTable, QTableGeom, apply_qupdate
 from bng_tpu.ops.table import HostTable, TableGeom, apply_update
 from bng_tpu.runtime.tables import FastPathTables, apply_fastpath_updates
 
-PKT_SLOT = 512
+# default per-lane packet slot: a full MTU frame (1500) + headroom for
+# QinQ/PPPoE encap, like the reference's XDP frame slot. Engines that only
+# ever see control traffic may shrink it (bench uses 512-byte slots).
+PKT_SLOT = 1536
 
 
 def _apply_all_updates(tables: PipelineTables, upd) -> PipelineTables:
@@ -58,8 +62,8 @@ def _apply_all_updates(tables: PipelineTables, upd) -> PipelineTables:
     return PipelineTables(
         dhcp=apply_fastpath_updates(tables.dhcp, fp_upd),
         nat=apply_nat_updates(tables.nat, nat_upd),
-        qos_up=apply_update(tables.qos_up, qup),
-        qos_down=apply_update(tables.qos_down, qdown),
+        qos_up=apply_qupdate(tables.qos_up, qup),
+        qos_down=apply_qupdate(tables.qos_down, qdown),
         spoof=apply_update(tables.spoof, sp_upd),
         spoof_ranges=sp_ranges,
         spoof_config=sp_config,
@@ -94,9 +98,11 @@ class QoSTables:
     """Host side of the two QoS maps (pkg/qos/manager.go:167-246 role)."""
 
     def __init__(self, nbuckets: int = 1 << 12, stash: int = 64, update_slots: int = 128):
-        self.up = HostTable(nbuckets, 1, QOS_WORDS, stash=stash, name="qos_ingress")
-        self.down = HostTable(nbuckets, 1, QOS_WORDS, stash=stash, name="qos_egress")
-        self.geom = TableGeom(nbuckets, stash)
+        # stash accepted for signature compat; the packed table has none
+        # (capacity policy: size nbuckets >= subscribers/2, resize on full)
+        self.up = HostQTable(nbuckets, name="qos_ingress")
+        self.down = HostQTable(nbuckets, name="qos_egress")
+        self.geom = QTableGeom(nbuckets)
         self.update_slots = update_slots
 
     def set_subscriber(self, ip: int, down_bps: int, up_bps: int,
@@ -105,12 +111,23 @@ class QoSTables:
         # burst default: 1.25s at rate /8 -> bytes (manager.go burst calc role)
         down_burst = down_burst if down_burst is not None else max(int(down_bps / 8 * 1.25), 1500)
         up_burst = up_burst if up_burst is not None else max(int(up_bps / 8 * 1.25), 1500)
-        self.down.insert([ip], make_bucket_row(down_bps, down_burst, priority))
-        self.up.insert([ip], make_bucket_row(up_bps, up_burst, priority))
+        self.down.insert(ip, down_bps, down_burst, priority)
+        self.up.insert(ip, up_bps, up_burst, priority)
+
+    def bulk_set_subscribers(self, ips, down_bps: int, up_bps: int) -> None:
+        """Vectorized install for table builds at the 1M-subscriber scale."""
+        ips = np.asarray(ips, dtype=np.uint32)
+        down_burst = max(int(down_bps / 8 * 1.25), 1500)
+        up_burst = max(int(up_bps / 8 * 1.25), 1500)
+        n = len(ips)
+        self.down.bulk_insert(ips, np.full(n, down_bps, np.uint64),
+                              np.full(n, down_burst, np.uint32))
+        self.up.bulk_insert(ips, np.full(n, up_bps, np.uint64),
+                            np.full(n, up_burst, np.uint32))
 
     def remove_subscriber(self, ip: int) -> None:
-        self.down.delete([ip])
-        self.up.delete([ip])
+        self.down.delete(ip)
+        self.up.delete(ip)
 
 
 class AntispoofTables:
@@ -176,6 +193,7 @@ class Engine:
         qos: QoSTables | None = None,
         antispoof: AntispoofTables | None = None,
         batch_size: int = 256,
+        pkt_slot: int = PKT_SLOT,
         slow_path: Callable[[bytes], bytes | None] | None = None,
         violation_sink: Callable[[int, bytes], None] | None = None,
         clock: Callable[[], float] = time.time,
@@ -185,6 +203,7 @@ class Engine:
         self.qos = qos or QoSTables()
         self.antispoof = antispoof or AntispoofTables()
         self.B = batch_size
+        self.L = pkt_slot
         self.slow_path = slow_path
         self.violation_sink = violation_sink
         self.clock = clock
@@ -234,12 +253,16 @@ class Engine:
         now_s = np.uint32(int(now))
         now_us = np.uint32(int(now * 1e6) & 0xFFFFFFFF)
 
-        pkt = np.zeros((self.B, PKT_SLOT), dtype=np.uint8)
+        pkt = np.zeros((self.B, self.L), dtype=np.uint8)
         length = np.zeros((self.B,), dtype=np.uint32)
         for i, f in enumerate(frames):
-            n = min(len(f), PKT_SLOT)
-            pkt[i, :n] = np.frombuffer(f[:n], dtype=np.uint8)
-            length[i] = n
+            if len(f) > self.L:
+                # never truncate silently: a clipped frame would be shaped
+                # and NAT-accounted at the wrong length and TX'd corrupt
+                raise ValueError(
+                    f"frame of {len(f)} bytes exceeds engine pkt_slot {self.L}")
+            pkt[i, : len(f)] = np.frombuffer(f, dtype=np.uint8)
+            length[i] = len(f)
         if isinstance(from_access, bool):
             fa = np.full((self.B,), from_access, dtype=bool)
         else:
@@ -311,7 +334,7 @@ class Engine:
         to the slow ring — drained here into the slow-path handlers, the
         XDP_PASS delivery). Returns the number of frames processed.
         """
-        pkt = np.zeros((self.B, PKT_SLOT), dtype=np.uint8)
+        pkt = np.zeros((self.B, self.L), dtype=np.uint8)
         length = np.zeros((self.B,), dtype=np.uint32)
         flags = np.zeros((self.B,), dtype=np.uint32)
         n = ring.assemble(pkt, length, flags)

@@ -1,0 +1,186 @@
+"""Packed QoS table: host mirror <-> device lookup agreement.
+
+Mirrors tests/test_table.py's strategy for the generic cuckoo table
+(SURVEY.md §4: map tests are host/device agreement tests) for the
+bucket-packed layout of ops/qtable.py.
+"""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from bng_tpu.ops.qtable import (
+    HostQTable, QTableGeom, WAYS, apply_qupdate, qlookup,
+)
+
+
+def _mk(nbuckets=256, n=100, seed=0):
+    t = HostQTable(nbuckets, name="t")
+    rng = np.random.default_rng(seed)
+    ips = rng.choice(1 << 24, size=n, replace=False).astype(np.uint32) + 1
+    for i, ip in enumerate(ips):
+        t.insert(int(ip), rate_bps=1_000_000 + i, burst=3000 + i, priority=i % 8)
+    return t, ips
+
+
+class TestHostMirror:
+    def test_insert_lookup_roundtrip(self):
+        t, ips = _mk()
+        for i, ip in enumerate(ips):
+            got = t.lookup(int(ip))
+            assert got is not None
+            assert got["rate_bps"] == 1_000_000 + i
+            assert got["burst"] == 3000 + i
+            assert got["priority"] == i % 8
+            assert got["tokens"] == float(3000 + i)
+
+    def test_update_in_place_reseeds_tokens(self):
+        t, ips = _mk()
+        ip = int(ips[0])
+        s0 = t.lookup(ip)["slot"]
+        t.insert(ip, rate_bps=5, burst=99, priority=1, start_full=False)
+        got = t.lookup(ip)
+        assert got["slot"] == s0  # same slot, config replaced
+        assert got["rate_bps"] == 5
+        assert got["tokens"] == 0.0
+        assert t.count == len(ips)  # not double-counted
+
+    def test_delete(self):
+        t, ips = _mk()
+        assert t.delete(int(ips[3]))
+        assert t.lookup(int(ips[3])) is None
+        assert not t.delete(int(ips[3]))
+        assert t.count == len(ips) - 1
+
+    def test_64bit_rate_split(self):
+        t = HostQTable(64)
+        t.insert(42, rate_bps=10_000_000_000, burst=1 << 30)
+        assert t.lookup(42)["rate_bps"] == 10_000_000_000
+
+    def test_full_table_raises_and_rolls_back(self):
+        t = HostQTable(2)  # 8 slots
+        installed = []
+        with pytest.raises(RuntimeError, match="full"):
+            for ip in range(1, 1000):
+                t.insert(ip, rate_bps=1, burst=1)
+                installed.append(ip)
+        # every successfully-installed policy must still resolve
+        for ip in installed:
+            assert t.lookup(ip) is not None, ip
+
+
+class TestDeviceLookup:
+    def test_agreement_with_host(self):
+        t, ips = _mk(n=200, seed=1)
+        st = t.device_state()
+        g = QTableGeom(t.nbuckets)
+        rng = np.random.default_rng(2)
+        miss = rng.integers(1 << 24, 1 << 25, size=50).astype(np.uint32)
+        q = np.concatenate([ips, miss])
+        res = qlookup(st, jnp.asarray(q), g)
+        found = np.asarray(res.found)
+        assert found[: len(ips)].all()
+        assert not found[len(ips):].any()
+        for i, ip in enumerate(ips):
+            h = t.lookup(int(ip))
+            assert int(np.asarray(res.slot)[i]) == h["slot"]
+            assert int(np.asarray(res.burst)[i]) == h["burst"]
+            got_rate = int(np.asarray(res.rate_lo)[i]) | (int(np.asarray(res.rate_hi)[i]) << 32)
+            assert got_rate == h["rate_bps"]
+            assert float(np.asarray(res.tokens)[i]) == h["tokens"]
+
+    def test_update_drain_matches_full_upload(self):
+        t, ips = _mk(n=60, seed=3)
+        st = t.device_state()  # clears dirty
+        # mutate: one delete, one update, one fresh insert
+        t.delete(int(ips[0]))
+        t.insert(int(ips[1]), rate_bps=777, burst=888, priority=3)
+        t.insert(0xDEAD, rate_bps=9, burst=10)
+        assert t.dirty_count() > 0
+        while t.dirty_count():
+            st = apply_qupdate(st, t.make_update(4))
+        ref = t.device_state()
+        np.testing.assert_array_equal(np.asarray(st.rows), np.asarray(ref.rows))
+        np.testing.assert_array_equal(np.asarray(st.last_us), np.asarray(ref.last_us))
+        # tokens: drained slots seeded; untouched slots keep device values
+        q = np.asarray([ips[1], 0xDEAD], dtype=np.uint32)
+        res = qlookup(st, jnp.asarray(q), QTableGeom(t.nbuckets))
+        assert np.asarray(res.found).all()
+        assert float(np.asarray(res.tokens)[0]) == 888.0
+        assert float(np.asarray(res.tokens)[1]) == 10.0
+
+    def test_update_does_not_clobber_sibling_tokens(self):
+        """Device-authoritative tokens of other ways survive a row rescatter."""
+        t = HostQTable(1)  # single bucket: all entries are siblings
+        a = t.insert(1, rate_bps=1000, burst=100)
+        st = t.device_state()
+        # device drains subscriber 1's tokens to 7.0
+        st = st._replace(tokens=st.tokens.at[a].set(7.0))
+        t.insert(2, rate_bps=2000, burst=200)  # same bucket, new way
+        while t.dirty_count():
+            st = apply_qupdate(st, t.make_update(2))
+        res = qlookup(st, jnp.asarray(np.asarray([1, 2], dtype=np.uint32)),
+                      QTableGeom(1))
+        assert float(np.asarray(res.tokens)[0]) == 7.0  # preserved
+        assert float(np.asarray(res.tokens)[1]) == 200.0  # seeded
+
+
+class TestBulkInsert:
+    def test_bulk_matches_serial(self):
+        rng = np.random.default_rng(7)
+        n = 5000
+        ips = rng.choice(1 << 26, size=n, replace=False).astype(np.uint32) + 1
+        rates = rng.integers(1_000_000, 100_000_000, size=n).astype(np.uint64)
+        bursts = rng.integers(1500, 1 << 20, size=n).astype(np.uint32)
+        t = HostQTable(1 << 12)
+        t.bulk_insert(ips, rates, bursts)
+        assert t.count == n
+        st = t.device_state()
+        res = qlookup(st, jnp.asarray(ips), QTableGeom(t.nbuckets))
+        assert np.asarray(res.found).all()
+        np.testing.assert_array_equal(np.asarray(res.burst), bursts)
+        got_rate = np.asarray(res.rate_lo).astype(np.uint64) | (
+            np.asarray(res.rate_hi).astype(np.uint64) << np.uint64(32))
+        np.testing.assert_array_equal(got_rate, rates)
+
+    def test_small_bulk_stays_on_delta_path(self):
+        """A <=256-entry bulk insert must reach the device via make_update
+        (code-review r3 finding: vectorized placements skipped dirty marks)."""
+        t = HostQTable(1 << 8)
+        st = t.device_state()
+        ips = (np.arange(100) + 1).astype(np.uint32)
+        t.bulk_insert(ips, np.full(100, 5, np.uint64), np.full(100, 1500, np.uint32))
+        assert t.dirty_count() > 0 and not t._dirty_all
+        while t.dirty_count():
+            st = apply_qupdate(st, t.make_update(16))
+        res = qlookup(st, jnp.asarray(ips), QTableGeom(t.nbuckets))
+        assert np.asarray(res.found).all()
+        np.testing.assert_array_equal(np.asarray(res.tokens), 1500.0)
+
+    def test_two_ways_same_bucket_both_reseed(self):
+        """Two policy changes in one bucket between drains both re-seed
+        (code-review r3 finding: dict held only the latest slot)."""
+        t = HostQTable(1)  # everything shares bucket 0
+        t.insert(1, rate_bps=8, burst=111)
+        t.insert(2, rate_bps=8, burst=222)
+        st = t.device_state()
+        # device token state diverges, then both policies are re-installed
+        st = st._replace(tokens=st.tokens.at[:].set(3.0))
+        t.insert(1, rate_bps=8, burst=111)
+        t.insert(2, rate_bps=8, burst=222)
+        while t.dirty_count():
+            st = apply_qupdate(st, t.make_update(4))
+        res = qlookup(st, jnp.asarray(np.asarray([1, 2], dtype=np.uint32)),
+                      QTableGeom(1))
+        assert float(np.asarray(res.tokens)[0]) == 111.0
+        assert float(np.asarray(res.tokens)[1]) == 222.0
+
+    def test_bulk_invalidates_delta_sync(self):
+        t = HostQTable(1 << 10)
+        ips = (np.arange(2000) + 1).astype(np.uint32)
+        t.bulk_insert(ips, np.full(2000, 1, np.uint64), np.full(2000, 1500, np.uint32))
+        with pytest.raises(RuntimeError, match="full upload"):
+            t.make_update(8)
+        t.device_state()  # resync
+        t.insert(99999, rate_bps=1, burst=1)
+        assert t.dirty_count() == 1

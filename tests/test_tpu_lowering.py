@@ -1,0 +1,25 @@
+"""TPU-lowering gate (auto-skips off-TPU).
+
+The round-2 smoking gun: ops/pallas_qos passed its interpret-mode suite
+while Mosaic rejected its block shapes on real hardware. This gate
+AOT-compiles every hot program for the attached TPU so a kernel that
+cannot lower can never ship green again. CI: `python bench.py
+--verify-lowering` runs the same checks.
+"""
+
+import jax
+import pytest
+
+from bng_tpu.runtime.verify import verify_tpu_lowering
+
+pytestmark = pytest.mark.skipif(
+    jax.default_backend() != "tpu",
+    reason="TPU-lowering gate needs a real TPU target (Mosaic is TPU-only)",
+)
+
+
+def test_all_hot_programs_lower_for_tpu():
+    results = verify_tpu_lowering(verbose=True)
+    failures = [(n, e) for n, e in results if e is not None]
+    assert not failures, "TPU lowering failures:\n" + "\n".join(
+        f"--- {n} ---\n{e}" for n, e in failures)
