@@ -60,7 +60,11 @@ class BNGConfig:
     portal_port: int = 8080
     # HA
     ha_role: str = ""  # "", "active", "standby"
-    ha_peer: str = ""
+    ha_peer: str = ""  # active's cluster URL (http://host:port) for standbys
+    # clustering (control/cluster_http.py wire)
+    cluster_listen: str = ""  # "host:port" ("" = no listener; port 0 = any)
+    store_mode: str = "memory"  # memory | read | write (control/crdt.py)
+    store_peers: list = dataclasses.field(default_factory=list)  # peer URLs
     # BGP
     bgp_enabled: bool = False
     bgp_local_as: int = 65000
@@ -107,6 +111,7 @@ class BNGApp:
         self.config = config
         self.clock = clock
         self._cleanup = []
+        self._last_sync = 0.0
         self.components: dict[str, object] = {}
         self._build()
 
@@ -263,12 +268,42 @@ class BNGApp:
                 c["ha"] = ActiveSyncer(store)
                 c["ha_role"] = Role.ACTIVE
             else:
-                # transport to the active peer is wired by the operator
-                # (cfg.ha_peer); a disconnected standby retries with backoff.
-                def _no_peer():
-                    raise ConnectionError(f"HA peer unreachable: {cfg.ha_peer}")
-                c["ha"] = StandbySyncer(store, transport=_no_peer)
+                if cfg.ha_peer.startswith("http"):
+                    # real wire: full sync + SSE deltas from the active's
+                    # cluster listener (control/cluster_http.py)
+                    from bng_tpu.control.cluster_http import HTTPActiveProxy
+
+                    def _peer():
+                        return HTTPActiveProxy(
+                            cfg.ha_peer,
+                            on_stream_end=lambda: c["ha"].disconnect())
+                else:
+                    def _peer():
+                        raise ConnectionError(
+                            f"HA peer unreachable: {cfg.ha_peer}")
+                c["ha"] = StandbySyncer(store, transport=_peer)
                 c["ha_role"] = Role.STANDBY
+
+        # 11b. replicated store + cluster listener (pkg/nexus CLSet modes)
+        if cfg.store_mode != "memory" or cfg.store_peers:
+            from bng_tpu.control.crdt import DistributedStore
+            from bng_tpu.control.cluster_http import HTTPStorePeer
+
+            cstore = c["cluster_store"] = DistributedStore(
+                cfg.node_id, mode=cfg.store_mode, clock=self.clock)
+            for url in cfg.store_peers:
+                cstore.add_peer(HTTPStorePeer(url))
+        if cfg.cluster_listen:
+            from bng_tpu.control.cluster_http import ClusterServer
+
+            host, _, port = cfg.cluster_listen.rpartition(":")
+            srv = ClusterServer(host or "127.0.0.1", int(port or 0))
+            if cfg.ha_role == "active":
+                srv.mount_ha(c["ha"])
+            if "cluster_store" in c:
+                srv.mount_store(c["cluster_store"])
+            c["cluster_server"] = srv.start()
+            self._on_close(srv.close)
 
         # 12. BGP (main.go:884-940) — executor supplied by operator; stub here
         if cfg.bgp_enabled:
@@ -297,6 +332,20 @@ class BNGApp:
             except Exception:
                 pass
         self._cleanup.clear()
+
+    def tick(self, now: float | None = None) -> None:
+        """Periodic cluster maintenance: standby reconnects (backoff) and
+        CRDT anti-entropy. The run loop calls this once a second; the
+        anti-entropy round honors the store's sync_interval (a full-digest
+        exchange per peer per second would be pure waste at scale)."""
+        now = now if now is not None else self.clock()
+        ha = self.components.get("ha")
+        if ha is not None and hasattr(ha, "tick"):  # StandbySyncer only
+            ha.tick(now)
+        cstore = self.components.get("cluster_store")
+        if cstore is not None and now - self._last_sync >= cstore.sync_interval:
+            self._last_sync = now
+            cstore.tick()
 
     def stats(self) -> dict:
         out = {"version": __version__, "node_id": self.config.node_id}
@@ -543,8 +592,12 @@ def main(argv: list[str] | None = None) -> int:
                 collector.start()
                 port = collector.serve_http(app.config.metrics_port)
                 print(f"metrics on :{port}/metrics", file=sys.stderr)
+            srv = app.components.get("cluster_server")
+            if srv is not None:
+                print(f"cluster on {srv.url}", file=sys.stderr)
             while True:
                 time.sleep(1)
+                app.tick()
         except KeyboardInterrupt:
             return 0
         finally:

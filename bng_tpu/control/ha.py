@@ -109,7 +109,14 @@ class ActiveSyncer:
             self._replay.pop(0)
         self.stats["changes"] += 1
         for cb in list(self._subscribers):
-            cb(ch)
+            # a broken replica sink must never take down the active's
+            # session-write path; the subscriber is dropped and will
+            # full-resync on reconnect
+            try:
+                cb(ch)
+            except Exception:
+                if cb in self._subscribers:
+                    self._subscribers.remove(cb)
 
     def full_sync(self) -> tuple[list[SessionState], int]:
         """GET /sessions role: snapshot + high-water seq."""

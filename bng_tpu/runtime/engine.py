@@ -225,7 +225,30 @@ class Engine:
         # table shapes share one compile (tests build many engines)
         self._step = _pipeline_jit(self.geom)
 
+    def resync_tables(self) -> None:
+        """Full device re-upload after a bulk host-table build.
+
+        A large bulk_insert abandons bounded-delta tracking (_dirty_all);
+        this refreshes every device table from the host mirrors so the
+        next step proceeds. Device-authoritative state written since the
+        last upload (QoS tokens, NAT/session counters) resets to the host
+        view — bulk installs are a provisioning-time operation."""
+        self.tables = PipelineTables(
+            dhcp=self.fastpath.device_tables(),
+            nat=self.nat.device_tables(),
+            qos_up=self.qos.up.device_state(),
+            qos_down=self.qos.down.device_state(),
+            spoof=self.antispoof.bindings.device_state(),
+            spoof_ranges=jnp.asarray(self.antispoof.ranges),
+            spoof_config=jnp.asarray(self.antispoof.config),
+        )
+
     def _drain_updates(self):
+        # a bulk build on a live engine must not brick the step loop: detect
+        # the abandoned-delta state and fall back to a full upload
+        if (getattr(self.qos.up, "_dirty_all", False)
+                or getattr(self.qos.down, "_dirty_all", False)):
+            self.resync_tables()
         return (
             self.fastpath.make_updates(),
             self.nat.make_updates(),

@@ -152,3 +152,59 @@ class TestMain:
                      "--no-nat-enabled"]) == 0
         st = json.loads(capsys.readouterr().out)
         assert st["node_id"] == "edge-7"
+
+
+class TestClusteredRun:
+    """Two real `bng-tpu run` processes clustering over HTTP (the round-2
+    verdict's done-criterion for real transports)."""
+
+    def test_active_process_serves_standby_and_failover(self):
+        import re
+        import subprocess
+        import sys
+        import time
+
+        from bng_tpu.control.cluster_http import HTTPActiveProxy
+        from bng_tpu.control.ha import InMemorySessionStore, StandbySyncer
+
+        import os
+
+        env = {k: v for k, v in os.environ.items()
+               if k != "PALLAS_AXON_POOL_IPS"}  # child must never claim the TPU
+        env["JAX_PLATFORMS"] = "cpu"
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "bng_tpu.cli", "run",
+             "--ha-role", "active", "--cluster-listen", "127.0.0.1:0",
+             "--no-metrics-enabled", "--no-nat-enabled",
+             "--no-dhcpv6-enabled", "--no-slaac-enabled"],
+            stderr=subprocess.PIPE, text=True, env=env)
+        try:
+            url = None
+            t0 = time.time()
+            while time.time() - t0 < 60:
+                line = proc.stderr.readline()
+                m = re.search(r"cluster on (http://\S+)", line or "")
+                if m:
+                    url = m.group(1)
+                    break
+            assert url, "active never announced its cluster listener"
+
+            store = InMemorySessionStore()
+            standby = StandbySyncer(store, transport=lambda: HTTPActiveProxy(
+                url, on_stream_end=lambda: standby.disconnect()))
+            standby.tick(now=0.0)
+            assert standby.connected  # full sync from the other process
+            assert standby.stats["full_syncs"] == 1
+
+            # active process dies -> stream ends -> standby reconnect loop
+            proc.terminate()
+            proc.wait(timeout=10)
+            t0 = time.time()
+            while standby.connected and time.time() - t0 < 10:
+                time.sleep(0.05)
+            assert not standby.connected
+            standby.tick(now=5.0)  # retry fails, backoff continues
+            assert not standby.connected
+        finally:
+            if proc.poll() is None:
+                proc.kill()
