@@ -236,19 +236,11 @@ class ClusterServer:
             # -- SSE (sync.go:304 handleSessionStream) --
             def _stream(self, since: int) -> None:
                 ha = outer.ha
-                # always consult replay — even at since=0: a standby that
-                # full-synced a FRESH active (seq 0) must still receive the
-                # deltas that landed between its sync GET and this connect
-                replay = ha.replay_since(since)
-                if replay is None:
-                    return self._json(410, {"error": "gap"})
-                self.send_response(200)
-                self.send_header("Content-Type", "text/event-stream")
-                self.send_header("Cache-Control", "no-cache")
-                self.end_headers()
-                # subscribe BEFORE replaying so no delta can fall between
-                # the replay snapshot and the live stream; the seq filter
-                # drops the overlap (in-process subscribe has no such gap)
+                # ORDER MATTERS: subscribe FIRST, take the replay snapshot
+                # SECOND. A delta pushed between the two lands in the live
+                # queue (and possibly also in the replay); the seq filter
+                # below dedups the overlap. Snapshot-then-subscribe would
+                # silently lose exactly that window (code-review r3).
                 ch_q: "queue.Queue[HAChange]" = queue.Queue(maxsize=4096)
                 overflow = threading.Event()
 
@@ -262,6 +254,17 @@ class ClusterServer:
                         overflow.set()
 
                 cancel = ha.subscribe(enqueue)
+                # always consult replay — even at since=0: a standby that
+                # full-synced a FRESH active (seq 0) must still receive the
+                # deltas that landed between its sync GET and this connect
+                replay = ha.replay_since(since)
+                if replay is None:
+                    cancel()
+                    return self._json(410, {"error": "gap"})
+                self.send_response(200)
+                self.send_header("Content-Type", "text/event-stream")
+                self.send_header("Cache-Control", "no-cache")
+                self.end_headers()
                 last_seq = since
                 idle = 0.0
                 try:

@@ -157,8 +157,17 @@ def pppoe_encap(
     dst_ip: jax.Array,  # [B] from parse — downstream subscriber IP
     by_ip: TableState,  # session table keyed by subscriber IP
     geom: TableGeom,
+    server_mac: jax.Array | None = None,  # [2] uint32 (hi16, lo32) AC MAC
 ) -> PPPoEResult:
-    """Add PPPoE+PPP framing to downstream IPv4 data for PPPoE subscribers."""
+    """Add PPPoE+PPP framing to downstream IPv4 data for PPPoE subscribers.
+
+    server_mac: the access concentrator's own MAC, written as the L2
+    source of every encapsulated frame (the reference builds downstream
+    frames with src=serverMAC, pkg/pppoe/server.go BuildEthernetFrame;
+    without it the frame would carry the upstream router's source MAC —
+    round-1 ADVICE finding). None leaves the source bytes untouched for
+    callers that pre-stamp frames.
+    """
     Bsz, L = pkt.shape
     length = length.astype(jnp.uint32)
     et_off = 12 + vlan_offset
@@ -179,6 +188,12 @@ def pppoe_encap(
     # rewrite L2 dest to the subscriber MAC from the session row
     out = B_.scatter_be16_at_masked(out, jnp.zeros_like(et_off), res.vals[:, PS_MAC_HI], ok)
     out = B_.scatter_be32_at_masked(out, jnp.zeros_like(et_off) + 2, res.vals[:, PS_MAC_LO], ok)
+    if server_mac is not None:
+        # ...and L2 source to the AC's MAC (src of all downstream frames)
+        src_hi = jnp.broadcast_to(server_mac[0], (Bsz,)).astype(jnp.uint32)
+        src_lo = jnp.broadcast_to(server_mac[1], (Bsz,)).astype(jnp.uint32)
+        out = B_.scatter_be16_at_masked(out, jnp.zeros_like(et_off) + 6, src_hi, ok)
+        out = B_.scatter_be32_at_masked(out, jnp.zeros_like(et_off) + 8, src_lo, ok)
     out_len = jnp.where(ok, length + PPPOE_HDR, length)
 
     stats = jnp.zeros((PPPOE_NSTATS,), dtype=jnp.uint32)

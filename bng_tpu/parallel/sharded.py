@@ -53,7 +53,18 @@ def make_mesh(n_devices: int) -> Mesh:
 
 
 def _sharded_geom(geom: PipelineGeom, n: int) -> PipelineGeom:
-    """Mark the DHCP lookup tables as hash-sharded over the mesh axis."""
+    """Mark the DHCP lookup tables as hash-sharded over the mesh axis.
+
+    PUNT-SAFETY INVARIANT: only tables whose device-miss path falls
+    through to an authoritative slow path may be sharded. The bounded
+    all-to-all exchange punts overflow lanes as found=False
+    (ops/table.py sharded_lookup); for the DHCP tables that turns a
+    skew-overflowed DISCOVER into a slow-path request the host server
+    answers from its authoritative state — degraded latency, never
+    wrong behavior. Do NOT shard tables where found=False changes the
+    verdict (antispoof would drop, QoS would unshape): keep those
+    chip-local by subscriber affinity (qos_kernel enforces this for
+    itself)."""
     dhcp = geom.dhcp._replace(
         sub=geom.dhcp.sub._replace(axis=AXIS, n_shards=n),
         vlan=geom.dhcp.vlan._replace(axis=AXIS, n_shards=n),

@@ -244,20 +244,28 @@ class Engine:
         )
 
     def _drain_updates(self):
-        # a bulk build on a live engine must not brick the step loop: detect
-        # the abandoned-delta state and fall back to a full upload
-        if (getattr(self.qos.up, "_dirty_all", False)
-                or getattr(self.qos.down, "_dirty_all", False)):
+        def drain():
+            return (
+                self.fastpath.make_updates(),
+                self.nat.make_updates(),
+                self.qos.up.make_update(self.qos.update_slots),
+                self.qos.down.make_update(self.qos.update_slots),
+                self.antispoof.bindings.make_update(self.antispoof.update_slots),
+                jnp.asarray(self.antispoof.ranges),
+                jnp.asarray(self.antispoof.config),
+            )
+
+        # A bulk build on a live engine must not brick the step loop: ANY
+        # delta-synced host table (qos, nat, dhcp fastpath, antispoof)
+        # whose bulk_insert abandoned dirty tracking raises here; answer
+        # with one full re-upload and drain again (now-clean).
+        try:
+            return drain()
+        except RuntimeError as e:
+            if "full upload" not in str(e):
+                raise
             self.resync_tables()
-        return (
-            self.fastpath.make_updates(),
-            self.nat.make_updates(),
-            self.qos.up.make_update(self.qos.update_slots),
-            self.qos.down.make_update(self.qos.update_slots),
-            self.antispoof.bindings.make_update(self.antispoof.update_slots),
-            jnp.asarray(self.antispoof.ranges),
-            jnp.asarray(self.antispoof.config),
-        )
+            return drain()
 
     def process(
         self,

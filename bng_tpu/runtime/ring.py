@@ -155,6 +155,15 @@ class NativeRing:
         self.frame_size = frame_size
         self.depth = depth
 
+    @property
+    def umem_ptr(self):
+        """Raw UMEM base pointer — the AF_XDP registration area (xsk.py)."""
+        return self._lib.bng_ring_umem(self._h)
+
+    @property
+    def umem_size(self) -> int:
+        return self._lib.bng_ring_umem_size(self._h)
+
     def close(self) -> None:
         if self._h:
             self._lib.bng_ring_destroy(self._h)

@@ -1,0 +1,173 @@
+"""AF_XDP socket ladder — the wire attach path with graceful fallback.
+
+Role parity: pkg/ebpf/loader.go:294-315 attaches XDP driver-mode first,
+falls back to generic mode, then to a stub on dev machines. Here the
+rungs are AF_XDP bind modes feeding the TPU dataplane's UMEM
+(native/bngxsk.cpp):
+
+    zerocopy  NIC DMA straight into the bngring UMEM (production NICs)
+    copy      generic AF_XDP, one kernel copy (veth/dev kernels)
+    memory    no AF_XDP (containers without CAP_NET_RAW, CI, macOS):
+              the in-memory bngring alone — synthetic sources and the
+              wire pump keep the same API
+
+`open_wire(ring, ifname)` walks the ladder and reports which rung it
+landed on; every consumer keeps working on any rung.
+"""
+
+from __future__ import annotations
+
+import ctypes as C
+import os
+import subprocess
+import threading
+from dataclasses import dataclass
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC_DIR = os.path.join(os.path.dirname(os.path.dirname(_HERE)), "native")
+_SO_PATH = os.path.join(_HERE, "libbngxsk.so")
+
+MODE_ZEROCOPY = "zerocopy"
+MODE_COPY = "copy"
+MODE_MEMORY = "memory"
+
+_ERRS = {
+    -1: "socket(AF_XDP) failed (kernel support / CAP_NET_RAW)",
+    -2: "UMEM registration rejected",
+    -3: "ring setsockopts failed",
+    -4: "ring mmap failed",
+    -5: "interface not found",
+    -6: "bind failed in both zerocopy and copy modes",
+}
+
+_lib = None
+_lib_lock = threading.Lock()
+
+
+def _build_so() -> str | None:
+    src = os.path.join(_SRC_DIR, "bngxsk.cpp")
+    if not os.path.exists(src):
+        return None
+    if os.path.exists(_SO_PATH) and os.path.getmtime(_SO_PATH) >= os.path.getmtime(src):
+        return _SO_PATH
+    cmd = ["g++", "-O2", "-g", "-Wall", "-fPIC", "-std=c++17", "-shared",
+           "-o", _SO_PATH, src]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+    except (OSError, subprocess.SubprocessError):
+        return None
+    return _SO_PATH
+
+
+def load_native():
+    """Load (building if needed) the xsk library, or None off-Linux."""
+    global _lib
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        path = _build_so()
+        if path is None:
+            return None
+        try:
+            lib = C.CDLL(path)
+        except OSError:
+            return None
+        lib.bng_xsk_probe.restype = C.c_int
+        lib.bng_xsk_probe.argtypes = []
+        lib.bng_xsk_open.restype = C.c_void_p
+        lib.bng_xsk_open.argtypes = [C.c_char_p, C.c_uint32, C.c_void_p,
+                                     C.c_uint64, C.c_uint32, C.c_uint32,
+                                     C.POINTER(C.c_int)]
+        lib.bng_xsk_mode.restype = C.c_int
+        lib.bng_xsk_mode.argtypes = [C.c_void_p]
+        lib.bng_xsk_fd.restype = C.c_int
+        lib.bng_xsk_fd.argtypes = [C.c_void_p]
+        lib.bng_xsk_close.argtypes = [C.c_void_p]
+        for name in ("bng_xsk_fill", "bng_xsk_tx"):
+            fn = getattr(lib, name)
+            fn.restype = C.c_uint32
+        lib.bng_xsk_fill.argtypes = [C.c_void_p, C.POINTER(C.c_uint64), C.c_uint32]
+        lib.bng_xsk_rx.restype = C.c_uint32
+        lib.bng_xsk_rx.argtypes = [C.c_void_p, C.POINTER(C.c_uint64),
+                                   C.POINTER(C.c_uint32), C.c_uint32]
+        lib.bng_xsk_tx.argtypes = [C.c_void_p, C.POINTER(C.c_uint64),
+                                   C.POINTER(C.c_uint32), C.c_uint32]
+        lib.bng_xsk_complete.restype = C.c_uint32
+        lib.bng_xsk_complete.argtypes = [C.c_void_p, C.POINTER(C.c_uint64),
+                                         C.c_uint32]
+        _lib = lib
+        return _lib
+
+
+def probe() -> str:
+    """Cheapest rung check: can this kernel/container create an AF_XDP
+    socket at all? (One syscall, no interface required.)"""
+    lib = load_native()
+    if lib is None:
+        return MODE_MEMORY
+    mode = lib.bng_xsk_probe()
+    return MODE_MEMORY if mode == 2 else MODE_COPY
+
+
+@dataclass
+class WireAttachment:
+    """Result of walking the attach ladder."""
+
+    mode: str  # zerocopy | copy | memory
+    xsk: "XskSocket | None"  # None on the memory rung
+    detail: str = ""
+
+
+class XskSocket:
+    """A bound AF_XDP socket over a NativeRing's UMEM."""
+
+    def __init__(self, lib, handle, ring):
+        self._lib = lib
+        self._h = handle
+        self.ring = ring  # keeps the UMEM alive
+        self.mode = MODE_ZEROCOPY if lib.bng_xsk_mode(handle) == 0 else MODE_COPY
+
+    @property
+    def fd(self) -> int:
+        return self._lib.bng_xsk_fd(self._h)
+
+    def close(self) -> None:
+        if self._h is not None:
+            self._lib.bng_xsk_close(self._h)
+            self._h = None
+
+    def __del__(self):  # pragma: no cover
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+def open_wire(ring, ifname: str = "", queue: int = 0,
+              ring_size: int = 2048) -> WireAttachment:
+    """Walk the attach ladder for `ring` (a NativeRing or PyRing).
+
+    With a NativeRing and a usable NIC queue this binds AF_XDP over the
+    ring's UMEM (zerocopy, then copy). Anything else lands on the memory
+    rung: the in-memory ring keeps serving the same assemble/complete API
+    (the reference's stub rung, loader.go:312-315).
+    """
+    if not ifname:
+        return WireAttachment(MODE_MEMORY, None, "no interface requested")
+    lib = load_native()
+    if lib is None:
+        return WireAttachment(MODE_MEMORY, None, "no native xsk library")
+    umem = getattr(ring, "umem_ptr", None)
+    if umem is None:
+        return WireAttachment(MODE_MEMORY, None,
+                              "ring has no native UMEM (PyRing)")
+    err = C.c_int(0)
+    h = lib.bng_xsk_open(ifname.encode(), queue, umem,
+                         ring.umem_size, ring.frame_size, ring_size,
+                         C.byref(err))
+    if not h:
+        detail = _ERRS.get(err.value, f"error {err.value}")
+        return WireAttachment(MODE_MEMORY, None,
+                              f"AF_XDP open on {ifname!r} failed: {detail}")
+    sock = XskSocket(lib, h, ring)
+    return WireAttachment(sock.mode, sock, f"bound {ifname}:{queue}")

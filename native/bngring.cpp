@@ -17,14 +17,16 @@ namespace {
 
 inline bool is_pow2(uint32_t v) { return v != 0 && (v & (v - 1)) == 0; }
 
-/* One SPSC descriptor ring. */
+/* One SPSC descriptor ring. Producer-side and consumer-side state live on
+ * separate cache lines (the if_xdp.h discipline): without the padding
+ * every publish invalidates the opposite core's line. */
 struct Ring {
   bng_desc *entries = nullptr;
   uint32_t mask = 0;
-  std::atomic<uint32_t> prod{0};
-  std::atomic<uint32_t> cons{0};
-  uint32_t cached_prod = 0; /* consumer's view */
+  alignas(64) std::atomic<uint32_t> prod{0};
   uint32_t cached_cons = 0; /* producer's view */
+  alignas(64) std::atomic<uint32_t> cons{0};
+  uint32_t cached_prod = 0; /* consumer's view */
 
   bool init(uint32_t depth) {
     entries = static_cast<bng_desc *>(calloc(depth, sizeof(bng_desc)));
@@ -63,6 +65,86 @@ struct Ring {
   }
 };
 
+/* Bounded MPMC ring (Vyukov per-slot-sequence queue) for the FILL pool.
+ *
+ * Unlike the directional rings, frame alloc/free crosses every thread in
+ * the deployment: the wire thread allocates (rx_reserve) and recycles
+ * rx-full rejects, the engine thread frees drops in batch_complete and
+ * allocates in tx_inject, and the slow-path thread recycles after
+ * slow_pop. An SPSC cursor pair corrupts under that pattern (round-1
+ * ADVICE finding); per-slot sequence numbers make every push/pop a CAS
+ * claim + independent publish, safe from any thread. */
+struct MpmcRing {
+  /* cells padded to a cache line and the two cursors on separate lines
+   * (Vyukov's own layout): three threads hammer this ring at frame rate,
+   * and false sharing would serialize the CAS claims */
+  struct alignas(64) Cell {
+    std::atomic<uint32_t> seq{0};
+    bng_desc d{};
+  };
+  Cell *cells = nullptr;
+  uint32_t mask = 0;
+  alignas(64) std::atomic<uint32_t> prod{0};
+  alignas(64) std::atomic<uint32_t> cons{0};
+
+  bool init(uint32_t depth) {
+    cells = new (std::nothrow) Cell[depth];
+    if (!cells) return false;
+    for (uint32_t i = 0; i < depth; i++)
+      cells[i].seq.store(i, std::memory_order_relaxed);
+    mask = depth - 1;
+    return true;
+  }
+  void fini() { delete[] cells; }
+
+  bool push(const bng_desc &d) {
+    uint32_t pos = prod.load(std::memory_order_relaxed);
+    for (;;) {
+      Cell &c = cells[pos & mask];
+      uint32_t seq = c.seq.load(std::memory_order_acquire);
+      int32_t dif = static_cast<int32_t>(seq - pos);
+      if (dif == 0) {
+        if (prod.compare_exchange_weak(pos, pos + 1,
+                                       std::memory_order_relaxed)) {
+          c.d = d;
+          c.seq.store(pos + 1, std::memory_order_release);
+          return true;
+        }
+      } else if (dif < 0) {
+        return false; /* full */
+      } else {
+        pos = prod.load(std::memory_order_relaxed);
+      }
+    }
+  }
+
+  bool pop(bng_desc *out) {
+    uint32_t pos = cons.load(std::memory_order_relaxed);
+    for (;;) {
+      Cell &c = cells[pos & mask];
+      uint32_t seq = c.seq.load(std::memory_order_acquire);
+      int32_t dif = static_cast<int32_t>(seq - (pos + 1));
+      if (dif == 0) {
+        if (cons.compare_exchange_weak(pos, pos + 1,
+                                       std::memory_order_relaxed)) {
+          *out = c.d;
+          c.seq.store(pos + mask + 1, std::memory_order_release);
+          return true;
+        }
+      } else if (dif < 0) {
+        return false; /* empty */
+      } else {
+        pos = cons.load(std::memory_order_relaxed);
+      }
+    }
+  }
+
+  uint32_t pending() const {
+    return prod.load(std::memory_order_acquire) -
+           cons.load(std::memory_order_acquire);
+  }
+};
+
 } // namespace
 
 struct bng_ring {
@@ -71,7 +153,7 @@ struct bng_ring {
   uint32_t frame_size = 0;
   uint32_t nframes = 0;
 
-  Ring fill; /* free frames (addr only) */
+  MpmcRing fill; /* free frames (addr only) — any-thread alloc/free */
   Ring rx;   /* wire -> engine */
   Ring tx;   /* engine TX verdicts -> wire (same port) */
   Ring fwd;  /* engine FWD verdicts -> wire (other port) */
@@ -95,8 +177,13 @@ bng_ring *bng_ring_create(uint32_t nframes, uint32_t frame_size,
   r->frame_size = frame_size;
   r->nframes = nframes;
   r->umem_size = static_cast<uint64_t>(nframes) * frame_size;
-  /* 64B alignment: cache-line friendly staging copies */
-  r->umem = static_cast<uint8_t *>(aligned_alloc(64, r->umem_size));
+  /* PAGE alignment, size rounded to a page multiple: AF_XDP's
+   * XDP_UMEM_REG requires a page-aligned area (bngxsk.cpp registers this
+   * exact buffer), aligned_alloc requires size % alignment == 0, and a
+   * page is trivially cache-line aligned for the staging copies. */
+  const uint64_t page = 4096;
+  uint64_t alloc_size = (r->umem_size + page - 1) & ~(page - 1);
+  r->umem = static_cast<uint8_t *>(aligned_alloc(page, alloc_size));
   bool ok = r->umem && r->fill.init(nframes) && r->rx.init(depth) &&
             r->tx.init(depth) && r->fwd.init(depth) && r->slow.init(depth);
   r->inflight_cap = depth;

@@ -9,10 +9,25 @@
  *   NIC/driver -> UMEM frames -> RX ring -> batch assembler -> [B,L] buffer
  *       -> (TPU pipeline, Python/JAX) -> verdicts -> TX/forward/slow rings
  *
- * Layout mirrors AF_XDP (if_xdp.h): one UMEM frame area + four
- * single-producer/single-consumer descriptor rings (fill, rx, tx,
- * completion), plus two verdict-side rings (forward, slow/punt). Rings are
- * lock-free SPSC with acquire/release ordering, power-of-two sized.
+ * Layout mirrors AF_XDP (if_xdp.h): one UMEM frame area + descriptor
+ * rings, power-of-two sized, lock-free.
+ *
+ * THREADING CONTRACT. The directional rings are SPSC — exactly one thread
+ * per side:
+ *
+ *     ring   producer side                 consumer side
+ *     rx     wire thread (rx_submit/push)  engine thread (batch_assemble)
+ *     tx     engine thread (complete,      wire thread (tx_pop, wire_pump)
+ *            tx_inject)
+ *     fwd    engine thread (complete)      wire thread (fwd_pop, wire_pump)
+ *     slow   engine thread (complete)      slow-path thread (slow_pop)
+ *
+ * The FILL pool is the exception: frame alloc/free crosses all three
+ * threads (wire allocates + recycles rx-full rejects; engine frees drops
+ * and allocates for tx_inject; slow-path recycles after slow_pop), so it
+ * is a bounded MPMC ring (per-slot sequence numbers) and every API is
+ * fill-safe from any thread. Single-threaded drivers (the Python engine
+ * loop, tests) trivially satisfy the contract.
  *
  * The batch assembler writes frames into a caller-provided contiguous
  * [B, slot] buffer — the same buffer handed to jax.device_put — so the

@@ -14,7 +14,8 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 from bng_tpu.control import dhcp_codec, packets
-from bng_tpu.ops.table import HostTable, TableGeom, device_lookup, lookup, shard_owner
+from bng_tpu.ops.table import (HostTable, TableGeom, device_lookup,
+                               exchange_capacity, lookup, shard_owner)
 from bng_tpu.parallel.hashring import (
     hashring_allocate,
     rendezvous_owner,
@@ -218,3 +219,159 @@ class TestShardedCluster:
         d = dhcp_codec.decode(packets.decode(raw).payload)
         assert d.msg_type == dhcp_codec.OFFER
         assert d.yiaddr == ip_to_u32("10.0.0.99")
+
+
+class TestShardedExchangeCapacity:
+    """Round-1 ask #7: the exchange reserves O(b/N * factor) per
+    destination, not the O(b) worst case; overflow lanes punt."""
+
+    def test_balanced_batch_never_punts(self):
+        mesh = make_mesh(N)
+        rng = np.random.default_rng(11)
+        shards = [HostTable(nbuckets=64, key_words=2, val_words=4)
+                  for _ in range(N)]
+        keys = rng.integers(0, 2**32, size=(400, 2), dtype=np.uint32)
+        keys = np.unique(keys, axis=0)[:256]
+        for i, k in enumerate(keys):
+            o = int(shard_owner([k[0:1], k[1:2]], N)[0])
+            shards[o].insert(k, [i, 0, 0, 0])
+        g = TableGeom(nbuckets=64, stash=64, axis=AXIS, n_shards=N,
+                      capacity_factor=2.0)
+        b = 32
+        qs = np.broadcast_to(keys[:b], (N, b, 2)).reshape(N * b, 2).copy()
+
+        def local(tabs1, q):
+            tabs = jax.tree.map(lambda x: x[0], tabs1)
+            r = lookup(tabs, q, g)
+            return r.found, r.punted
+
+        f = jax.jit(jax.shard_map(
+            local, mesh=mesh, in_specs=(P(AXIS), P(AXIS)),
+            out_specs=(P(AXIS), P(AXIS)), check_vma=False))
+        found, punted = f(
+            jax.tree.map(lambda *xs: jnp.stack(xs),
+                         *[s.device_state() for s in shards]),
+            jnp.asarray(qs))
+        # a hash-balanced batch fits within factor-2 capacity: no punts
+        assert not np.asarray(punted).any()
+        assert np.asarray(found).all()
+
+    def test_pathological_skew_punts_not_corrupts(self):
+        """Every lane targeting ONE shard: capacity C lanes resolve, the
+        rest punt (found=False, punted=True) — never wrong values."""
+        mesh = make_mesh(N)
+        shards = [HostTable(nbuckets=64, key_words=2, val_words=4)
+                  for _ in range(N)]
+        # craft keys that all hash to the same owner shard
+        rng = np.random.default_rng(12)
+        same_owner = []
+        want = None
+        while len(same_owner) < 32:
+            k = rng.integers(0, 2**32, size=(2,), dtype=np.uint32)
+            o = int(shard_owner([k[0:1], k[1:2]], N)[0])
+            if want is None:
+                want = o
+            if o == want:
+                same_owner.append(k)
+        keys = np.stack(same_owner)
+        for i, k in enumerate(keys):
+            shards[want].insert(k, [i, 0, 0, 0])
+        g = TableGeom(nbuckets=64, stash=64, axis=AXIS, n_shards=N,
+                      capacity_factor=2.0)
+        b = 32
+        C = exchange_capacity(b, g)
+        assert C < b  # the punt path must actually be exercised
+        qs = np.broadcast_to(keys, (N, b, 2)).reshape(N * b, 2).copy()
+
+        def local(tabs1, q):
+            tabs = jax.tree.map(lambda x: x[0], tabs1)
+            r = lookup(tabs, q, g)
+            return r.found, r.punted, r.vals[:, 0]
+
+        f = jax.jit(jax.shard_map(
+            local, mesh=mesh, in_specs=(P(AXIS), P(AXIS)),
+            out_specs=(P(AXIS), P(AXIS), P(AXIS)), check_vma=False))
+        found, punted, v0 = f(
+            jax.tree.map(lambda *xs: jnp.stack(xs),
+                         *[s.device_state() for s in shards]),
+            jnp.asarray(qs))
+        found = np.asarray(found).reshape(N, b)
+        punted = np.asarray(punted).reshape(N, b)
+        v0 = np.asarray(v0).reshape(N, b)
+        for shard in range(N):
+            # first C lanes (arrival order) resolve correctly...
+            assert found[shard, :C].all()
+            assert not punted[shard, :C].any()
+            assert v0[shard, :C].tolist() == list(range(C))
+            # ...the overflow punts cleanly
+            assert punted[shard, C:].all()
+            assert not found[shard, C:].any()
+
+    def test_factor_n_reproduces_worst_case_exchange(self):
+        """capacity_factor >= N -> C = b: the exact never-punt exchange."""
+        g = TableGeom(nbuckets=64, stash=64, axis=AXIS, n_shards=N,
+                      capacity_factor=float(N))
+        b = 32
+        C = exchange_capacity(b, g)
+        assert C == b
+
+
+class TestSkewDegradesToSlowPath:
+    """The punt-safety invariant end-to-end: DISCOVERs beyond one shard's
+    exchange capacity become slow-path lanes (the authoritative DHCP
+    server's job), never drops or wrong replies."""
+
+    SERVER_MAC = bytes.fromhex("02aabbccdd01")
+    SERVER_IP = ip_to_u32("10.0.0.1")
+    T0 = 1_753_000_000
+
+    def test_overflowed_discovers_go_slow_not_dropped(self):
+        cl = ShardedCluster(N, batch_per_shard=32)
+        cl.set_server_config_all(self.SERVER_MAC, self.SERVER_IP)
+        cl.add_pool_all(1, ip_to_u32("10.0.0.0"), 24, self.SERVER_IP,
+                        lease_time=3600)
+
+        # 24 subscribers whose MAC keys ALL hash to one owner shard
+        same, owner = [], None
+        i = 0
+        while len(same) < 24:
+            mac = bytes.fromhex(f"02c0ffee{i:04x}")
+            o = cl.dhcp_sub_shard(mac)
+            if owner is None:
+                owner = o
+            if o == owner:
+                same.append(mac)
+            i += 1
+        for j, mac in enumerate(same):
+            cl.add_subscriber(mac, pool_id=1, ip=ip_to_u32(f"10.0.1.{j + 1}"),
+                              lease_expiry=self.T0 + 600)
+        cl.sync_tables()
+
+        # land every DISCOVER on a chip that is NOT the owner: all 24 MAC
+        # lookups route to `owner`, whose capacity is C < 24
+        g = cl.geom.dhcp.sub._replace(axis=AXIS, n_shards=N)
+        C = exchange_capacity(cl.b, g)
+        assert C < len(same), (C, len(same))
+
+        chip = (owner + 1) % N
+        B = N * cl.b
+        pkt = np.zeros((B, 512), dtype=np.uint8)
+        length = np.zeros((B,), dtype=np.uint32)
+        for j, mac in enumerate(same):
+            p = dhcp_codec.build_request(mac, dhcp_codec.DISCOVER)
+            p.options.append((dhcp_codec.OPT_PARAM_REQ_LIST,
+                              bytes([1, 3, 6, 51, 54])))
+            f = packets.udp_packet(mac, b"\xff" * 6, 0, 0xFFFFFFFF, 68, 67,
+                                   p.encode().ljust(320, b"\x00"))
+            row = chip * cl.b + j
+            pkt[row, : len(f)] = np.frombuffer(f, dtype=np.uint8)
+            length[row] = len(f)
+
+        out = cl.step(pkt, length, np.ones((B,), dtype=bool), self.T0, 0)
+        lanes = slice(chip * cl.b, chip * cl.b + len(same))
+        v = out["verdict"][lanes]
+        n_tx = int((v == 2).sum())
+        n_slow = int((v == 0).sum())
+        assert n_tx == C, (n_tx, C)  # capacity lanes answered on device
+        assert n_slow == len(same) - C  # overflow degrades to slow path
+        assert int((v == 1).sum()) == 0  # and NOTHING is dropped

@@ -178,6 +178,26 @@ class TestEncap:
         d = packets.decode(out)
         assert d.dst_ip == CLIENT_IP and d.payload == down[14 + 28 :]
 
+    def test_encap_stamps_server_src_mac(self):
+        """Downstream frames must carry the AC's MAC as L2 source, not the
+        upstream router's (round-1 ADVICE finding)."""
+        by_sid, by_ip = session_tables()
+        router_mac = bytes.fromhex("02ee00000001")
+        down = packets.udp_packet(router_mac, CLIENT_MAC, ip_to_u32("8.8.8.8"),
+                                  CLIENT_IP, 53, 40000, b"s" * 12)
+        pkt, ln = batch([down])
+        par = parse_batch(pkt, ln)
+        ac_hi = int.from_bytes(AC_MAC[:2], "big")
+        ac_lo = int.from_bytes(AC_MAC[2:], "big")
+        res = P.pppoe_encap(pkt, ln, par.vlan_offset, par.ethertype,
+                            par.dst_ip, by_ip.device_state(), TableGeom(64, 8),
+                            server_mac=jnp.asarray([ac_hi, ac_lo],
+                                                   dtype=jnp.uint32))
+        assert bool(res.done[0])
+        out = bytes(np.asarray(res.out_pkt)[0][: int(res.out_len[0])])
+        dst, src, et, _ = codec.parse_eth(out)
+        assert dst == CLIENT_MAC and src == AC_MAC
+
     def test_non_pppoe_subscriber_untouched(self):
         by_sid, by_ip = session_tables()
         down = packets.udp_packet(AC_MAC, CLIENT_MAC, ip_to_u32("8.8.8.8"),
