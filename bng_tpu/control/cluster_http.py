@@ -254,20 +254,22 @@ class ClusterServer:
                         overflow.set()
 
                 cancel = ha.subscribe(enqueue)
-                # always consult replay — even at since=0: a standby that
-                # full-synced a FRESH active (seq 0) must still receive the
-                # deltas that landed between its sync GET and this connect
-                replay = ha.replay_since(since)
-                if replay is None:
-                    cancel()
-                    return self._json(410, {"error": "gap"})
-                self.send_response(200)
-                self.send_header("Content-Type", "text/event-stream")
-                self.send_header("Cache-Control", "no-cache")
-                self.end_headers()
+                # everything from here runs under the finally that cancels
+                # the subscription — a client that dies during the header
+                # write must not leak its callback on the active
                 last_seq = since
                 idle = 0.0
                 try:
+                    # always consult replay — even at since=0: a standby
+                    # that full-synced a FRESH active (seq 0) must still
+                    # receive deltas from the sync-to-connect window
+                    replay = ha.replay_since(since)
+                    if replay is None:
+                        return self._json(410, {"error": "gap"})
+                    self.send_response(200)
+                    self.send_header("Content-Type", "text/event-stream")
+                    self.send_header("Cache-Control", "no-cache")
+                    self.end_headers()
                     for ch in replay or []:
                         self._emit(ch)
                         last_seq = max(last_seq, ch.seq)

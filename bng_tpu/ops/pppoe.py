@@ -157,7 +157,7 @@ def pppoe_encap(
     dst_ip: jax.Array,  # [B] from parse — downstream subscriber IP
     by_ip: TableState,  # session table keyed by subscriber IP
     geom: TableGeom,
-    server_mac: jax.Array | None = None,  # [2] uint32 (hi16, lo32) AC MAC
+    server_mac: jax.Array | None,  # [2] uint32 (hi16, lo32) AC MAC — REQUIRED
 ) -> PPPoEResult:
     """Add PPPoE+PPP framing to downstream IPv4 data for PPPoE subscribers.
 
@@ -165,8 +165,9 @@ def pppoe_encap(
     source of every encapsulated frame (the reference builds downstream
     frames with src=serverMAC, pkg/pppoe/server.go BuildEthernetFrame;
     without it the frame would carry the upstream router's source MAC —
-    round-1 ADVICE finding). None leaves the source bytes untouched for
-    callers that pre-stamp frames.
+    round-1 ADVICE finding). Deliberately has NO default: an integrator
+    must either thread the AC MAC or pass None explicitly to declare the
+    frames are pre-stamped upstream.
     """
     Bsz, L = pkt.shape
     length = length.astype(jnp.uint32)

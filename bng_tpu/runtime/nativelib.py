@@ -1,0 +1,60 @@
+"""Shared build-on-demand loader for the in-tree C++ libraries.
+
+One implementation of the compile/mtime-cache/CDLL/lock dance for every
+native module (bngring, bngxsk, ...): the reference gets this from its
+Makefile + cgo; here the .so is compiled from source on first use so the
+package works from a plain checkout, and falls back to None (callers
+degrade to their Python/stub paths) when no toolchain exists.
+"""
+
+from __future__ import annotations
+
+import ctypes as C
+import os
+import subprocess
+import threading
+from typing import Callable
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+SRC_DIR = os.path.join(os.path.dirname(os.path.dirname(_HERE)), "native")
+
+_libs: dict[str, object] = {}
+_lock = threading.Lock()
+
+
+def _build(src: str, so_path: str) -> str | None:
+    if not os.path.exists(src):
+        return None
+    if (os.path.exists(so_path)
+            and os.path.getmtime(so_path) >= os.path.getmtime(src)):
+        return so_path
+    cmd = ["g++", "-O2", "-g", "-Wall", "-fPIC", "-std=c++17", "-shared",
+           "-o", so_path, src]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+    except (OSError, subprocess.SubprocessError):
+        return None
+    return so_path
+
+
+def load(src_name: str, configure: Callable[[C.CDLL], None]):
+    """Load (building if stale) native/<src_name>.cpp as a CDLL.
+
+    configure(lib) declares argtypes/restypes once. Returns the cached
+    CDLL, or None when the source/toolchain is unavailable.
+    """
+    with _lock:
+        if src_name in _libs:
+            return _libs[src_name]
+        src = os.path.join(SRC_DIR, f"{src_name}.cpp")
+        so_path = os.path.join(_HERE, f"lib{src_name}.so")
+        path = _build(src, so_path)
+        if path is None:
+            return None
+        try:
+            lib = C.CDLL(path)
+        except OSError:
+            return None
+        configure(lib)
+        _libs[src_name] = lib
+        return lib

@@ -15,16 +15,11 @@ build.
 from __future__ import annotations
 
 import ctypes as C
-import os
-import subprocess
-import threading
 from collections import deque
 
 import numpy as np
 
-_HERE = os.path.dirname(os.path.abspath(__file__))
-_SRC_DIR = os.path.join(os.path.dirname(os.path.dirname(_HERE)), "native")
-_SO_PATH = os.path.join(_HERE, "libbngring.so")
+from bng_tpu.runtime import nativelib
 
 FLAG_FROM_ACCESS = 0x1
 
@@ -55,81 +50,53 @@ class Desc(C.Structure):
     ]
 
 
-_lib = None
-_lib_lock = threading.Lock()
-
-
-def _build_so() -> str | None:
-    src = os.path.join(_SRC_DIR, "bngring.cpp")
-    if not os.path.exists(src):
-        return None
-    if os.path.exists(_SO_PATH) and os.path.getmtime(_SO_PATH) >= os.path.getmtime(src):
-        return _SO_PATH
-    cmd = ["g++", "-O2", "-g", "-Wall", "-fPIC", "-std=c++17", "-shared",
-           "-o", _SO_PATH, src]
-    try:
-        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
-    except (OSError, subprocess.SubprocessError):
-        return None
-    return _SO_PATH
+def _configure(lib: C.CDLL) -> None:
+    lib.bng_ring_create.restype = C.c_void_p
+    lib.bng_ring_create.argtypes = [C.c_uint32, C.c_uint32, C.c_uint32]
+    lib.bng_ring_destroy.argtypes = [C.c_void_p]
+    lib.bng_ring_umem.restype = C.POINTER(C.c_uint8)
+    lib.bng_ring_umem.argtypes = [C.c_void_p]
+    lib.bng_ring_umem_size.restype = C.c_uint64
+    lib.bng_ring_umem_size.argtypes = [C.c_void_p]
+    lib.bng_ring_frame_size.restype = C.c_uint32
+    lib.bng_ring_frame_size.argtypes = [C.c_void_p]
+    lib.bng_ring_rx_push.restype = C.c_int
+    lib.bng_ring_rx_push.argtypes = [C.c_void_p, C.POINTER(C.c_uint8),
+                                     C.c_uint32, C.c_uint32]
+    lib.bng_batch_assemble.restype = C.c_uint32
+    lib.bng_batch_assemble.argtypes = [
+        C.c_void_p, C.POINTER(C.c_uint8), C.POINTER(C.c_uint32),
+        C.POINTER(C.c_uint32), C.c_uint32, C.c_uint32]
+    lib.bng_ring_tx_inject.restype = C.c_int
+    lib.bng_ring_tx_inject.argtypes = [C.c_void_p, C.POINTER(C.c_uint8),
+                                       C.c_uint32, C.c_uint32]
+    lib.bng_batch_complete.restype = C.c_int
+    lib.bng_batch_complete.argtypes = [
+        C.c_void_p, C.POINTER(C.c_uint8), C.POINTER(C.c_uint8),
+        C.POINTER(C.c_uint32), C.c_uint32, C.c_uint32]
+    for name in ("tx", "fwd", "slow"):
+        fn = getattr(lib, f"bng_ring_{name}_pop")
+        fn.restype = C.c_int
+        fn.argtypes = [C.c_void_p, C.POINTER(C.c_uint8), C.c_uint32,
+                       C.POINTER(C.c_uint32)]
+    for name in ("rx_pending", "tx_pending", "fwd_pending",
+                 "slow_pending", "free_frames"):
+        fn = getattr(lib, f"bng_ring_{name}")
+        fn.restype = C.c_uint32
+        fn.argtypes = [C.c_void_p]
+    lib.bng_ring_get_stats.argtypes = [C.c_void_p, C.POINTER(RingStats)]
+    lib.bng_wire_pump.restype = C.c_int
+    lib.bng_wire_pump.argtypes = [C.c_void_p, C.c_void_p, C.c_uint32]
+    for name in ("desc_size", "desc_addr_off", "desc_len_off",
+                 "desc_flags_off", "stats_size", "version"):
+        fn = getattr(lib, f"bng_abi_{name}")
+        fn.restype = C.c_uint32
+        fn.argtypes = []
 
 
 def load_native():
     """Load (building if needed) the native library, or None."""
-    global _lib
-    with _lib_lock:
-        if _lib is not None:
-            return _lib
-        path = _build_so()
-        if path is None:
-            return None
-        try:
-            lib = C.CDLL(path)
-        except OSError:
-            return None
-        lib.bng_ring_create.restype = C.c_void_p
-        lib.bng_ring_create.argtypes = [C.c_uint32, C.c_uint32, C.c_uint32]
-        lib.bng_ring_destroy.argtypes = [C.c_void_p]
-        lib.bng_ring_umem.restype = C.POINTER(C.c_uint8)
-        lib.bng_ring_umem.argtypes = [C.c_void_p]
-        lib.bng_ring_umem_size.restype = C.c_uint64
-        lib.bng_ring_umem_size.argtypes = [C.c_void_p]
-        lib.bng_ring_frame_size.restype = C.c_uint32
-        lib.bng_ring_frame_size.argtypes = [C.c_void_p]
-        lib.bng_ring_rx_push.restype = C.c_int
-        lib.bng_ring_rx_push.argtypes = [C.c_void_p, C.POINTER(C.c_uint8),
-                                         C.c_uint32, C.c_uint32]
-        lib.bng_batch_assemble.restype = C.c_uint32
-        lib.bng_batch_assemble.argtypes = [
-            C.c_void_p, C.POINTER(C.c_uint8), C.POINTER(C.c_uint32),
-            C.POINTER(C.c_uint32), C.c_uint32, C.c_uint32]
-        lib.bng_ring_tx_inject.restype = C.c_int
-        lib.bng_ring_tx_inject.argtypes = [C.c_void_p, C.POINTER(C.c_uint8),
-                                           C.c_uint32, C.c_uint32]
-        lib.bng_batch_complete.restype = C.c_int
-        lib.bng_batch_complete.argtypes = [
-            C.c_void_p, C.POINTER(C.c_uint8), C.POINTER(C.c_uint8),
-            C.POINTER(C.c_uint32), C.c_uint32, C.c_uint32]
-        for name in ("tx", "fwd", "slow"):
-            fn = getattr(lib, f"bng_ring_{name}_pop")
-            fn.restype = C.c_int
-            fn.argtypes = [C.c_void_p, C.POINTER(C.c_uint8), C.c_uint32,
-                           C.POINTER(C.c_uint32)]
-        for name in ("rx_pending", "tx_pending", "fwd_pending",
-                     "slow_pending", "free_frames"):
-            fn = getattr(lib, f"bng_ring_{name}")
-            fn.restype = C.c_uint32
-            fn.argtypes = [C.c_void_p]
-        lib.bng_ring_get_stats.argtypes = [C.c_void_p, C.POINTER(RingStats)]
-        lib.bng_wire_pump.restype = C.c_int
-        lib.bng_wire_pump.argtypes = [C.c_void_p, C.c_void_p, C.c_uint32]
-        for name in ("desc_size", "desc_addr_off", "desc_len_off",
-                     "desc_flags_off", "stats_size", "version"):
-            fn = getattr(lib, f"bng_abi_{name}")
-            fn.restype = C.c_uint32
-            fn.argtypes = []
-        _lib = lib
-        return _lib
+    return nativelib.load("bngring", _configure)
 
 
 def _u8p(arr: np.ndarray):

@@ -18,14 +18,9 @@ landed on; every consumer keeps working on any rung.
 from __future__ import annotations
 
 import ctypes as C
-import os
-import subprocess
-import threading
 from dataclasses import dataclass
 
-_HERE = os.path.dirname(os.path.abspath(__file__))
-_SRC_DIR = os.path.join(os.path.dirname(os.path.dirname(_HERE)), "native")
-_SO_PATH = os.path.join(_HERE, "libbngxsk.so")
+from bng_tpu.runtime import nativelib
 
 MODE_ZEROCOPY = "zerocopy"
 MODE_COPY = "copy"
@@ -40,63 +35,34 @@ _ERRS = {
     -6: "bind failed in both zerocopy and copy modes",
 }
 
-_lib = None
-_lib_lock = threading.Lock()
-
-
-def _build_so() -> str | None:
-    src = os.path.join(_SRC_DIR, "bngxsk.cpp")
-    if not os.path.exists(src):
-        return None
-    if os.path.exists(_SO_PATH) and os.path.getmtime(_SO_PATH) >= os.path.getmtime(src):
-        return _SO_PATH
-    cmd = ["g++", "-O2", "-g", "-Wall", "-fPIC", "-std=c++17", "-shared",
-           "-o", _SO_PATH, src]
-    try:
-        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
-    except (OSError, subprocess.SubprocessError):
-        return None
-    return _SO_PATH
+def _configure(lib: C.CDLL) -> None:
+    lib.bng_xsk_probe.restype = C.c_int
+    lib.bng_xsk_probe.argtypes = []
+    lib.bng_xsk_open.restype = C.c_void_p
+    lib.bng_xsk_open.argtypes = [C.c_char_p, C.c_uint32, C.c_void_p,
+                                 C.c_uint64, C.c_uint32, C.c_uint32,
+                                 C.POINTER(C.c_int)]
+    lib.bng_xsk_mode.restype = C.c_int
+    lib.bng_xsk_mode.argtypes = [C.c_void_p]
+    lib.bng_xsk_fd.restype = C.c_int
+    lib.bng_xsk_fd.argtypes = [C.c_void_p]
+    lib.bng_xsk_close.argtypes = [C.c_void_p]
+    lib.bng_xsk_fill.restype = C.c_uint32
+    lib.bng_xsk_fill.argtypes = [C.c_void_p, C.POINTER(C.c_uint64), C.c_uint32]
+    lib.bng_xsk_rx.restype = C.c_uint32
+    lib.bng_xsk_rx.argtypes = [C.c_void_p, C.POINTER(C.c_uint64),
+                               C.POINTER(C.c_uint32), C.c_uint32]
+    lib.bng_xsk_tx.restype = C.c_uint32
+    lib.bng_xsk_tx.argtypes = [C.c_void_p, C.POINTER(C.c_uint64),
+                               C.POINTER(C.c_uint32), C.c_uint32]
+    lib.bng_xsk_complete.restype = C.c_uint32
+    lib.bng_xsk_complete.argtypes = [C.c_void_p, C.POINTER(C.c_uint64),
+                                     C.c_uint32]
 
 
 def load_native():
     """Load (building if needed) the xsk library, or None off-Linux."""
-    global _lib
-    with _lib_lock:
-        if _lib is not None:
-            return _lib
-        path = _build_so()
-        if path is None:
-            return None
-        try:
-            lib = C.CDLL(path)
-        except OSError:
-            return None
-        lib.bng_xsk_probe.restype = C.c_int
-        lib.bng_xsk_probe.argtypes = []
-        lib.bng_xsk_open.restype = C.c_void_p
-        lib.bng_xsk_open.argtypes = [C.c_char_p, C.c_uint32, C.c_void_p,
-                                     C.c_uint64, C.c_uint32, C.c_uint32,
-                                     C.POINTER(C.c_int)]
-        lib.bng_xsk_mode.restype = C.c_int
-        lib.bng_xsk_mode.argtypes = [C.c_void_p]
-        lib.bng_xsk_fd.restype = C.c_int
-        lib.bng_xsk_fd.argtypes = [C.c_void_p]
-        lib.bng_xsk_close.argtypes = [C.c_void_p]
-        for name in ("bng_xsk_fill", "bng_xsk_tx"):
-            fn = getattr(lib, name)
-            fn.restype = C.c_uint32
-        lib.bng_xsk_fill.argtypes = [C.c_void_p, C.POINTER(C.c_uint64), C.c_uint32]
-        lib.bng_xsk_rx.restype = C.c_uint32
-        lib.bng_xsk_rx.argtypes = [C.c_void_p, C.POINTER(C.c_uint64),
-                                   C.POINTER(C.c_uint32), C.c_uint32]
-        lib.bng_xsk_tx.argtypes = [C.c_void_p, C.POINTER(C.c_uint64),
-                                   C.POINTER(C.c_uint32), C.c_uint32]
-        lib.bng_xsk_complete.restype = C.c_uint32
-        lib.bng_xsk_complete.argtypes = [C.c_void_p, C.POINTER(C.c_uint64),
-                                         C.c_uint32]
-        _lib = lib
-        return _lib
+    return nativelib.load("bngxsk", _configure)
 
 
 def probe() -> str:

@@ -142,7 +142,8 @@ class TestEncap:
         pkt, ln = batch([down])
         par = parse_batch(pkt, ln)
         res = P.pppoe_encap(pkt, ln, par.vlan_offset, par.ethertype,
-                            par.dst_ip, by_ip.device_state(), TableGeom(64, 8))
+                            par.dst_ip, by_ip.device_state(), TableGeom(64, 8),
+                            server_mac=None)
         assert bool(res.done[0])
         out = bytes(np.asarray(res.out_pkt)[0][: int(res.out_len[0])])
         assert len(out) == len(down) + P.PPPOE_HDR
@@ -164,7 +165,8 @@ class TestEncap:
         pkt, ln = batch([down])
         par = parse_batch(pkt, ln)
         enc = P.pppoe_encap(pkt, ln, par.vlan_offset, par.ethertype,
-                            par.dst_ip, by_ip.device_state(), TableGeom(64, 8))
+                            par.dst_ip, by_ip.device_state(), TableGeom(64, 8),
+                            server_mac=None)
         # upstream direction: client sends the encapped frame back
         # (swap MACs so the session-MAC check passes)
         eframe = bytearray(np.asarray(enc.out_pkt)[0][: int(enc.out_len[0])])
@@ -205,7 +207,8 @@ class TestEncap:
         pkt, ln = batch([down])
         par = parse_batch(pkt, ln)
         res = P.pppoe_encap(pkt, ln, par.vlan_offset, par.ethertype,
-                            par.dst_ip, by_ip.device_state(), TableGeom(64, 8))
+                            par.dst_ip, by_ip.device_state(), TableGeom(64, 8),
+                            server_mac=None)
         assert not bool(res.done[0])
         assert int(res.out_len[0]) == len(down)
         assert bytes(np.asarray(res.out_pkt)[0][: len(down)]) == down
