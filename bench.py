@@ -159,6 +159,25 @@ def main(on_tpu: bool) -> None:
     lat_us = np.array(lat) * 1e6
     p50, p99 = float(np.percentile(lat_us, 50)), float(np.percentile(lat_us, 99))
 
+    # ---- optional op-level profile of the steady-state step ----
+    profile_top = None
+    if os.environ.get("BNG_BENCH_PROFILE") == "1":
+        from bng_tpu.utils.profiling import format_report, profile_op_times
+
+        _mark("profiling 10 steady-state steps...")
+        state = {"t": tables}
+
+        def once():
+            state["t"], v, _, _ = step(state["t"], pkt_d, len_d, fa_d,
+                                       jnp.uint32(now), jnp.uint32(0))
+            return v
+
+        rep = profile_op_times(once, iters=10)
+        tables = state["t"]
+        _mark("\n" + format_report(rep))
+        profile_top = [{"op": o.name, "us": round(o.us_per_iter, 1)}
+                       for o in rep.ops[:8]]
+
     # ---- OFFER latency at small batch (true per-batch percentiles) ----
     # The p99-OFFER target (<50us @1M subs, BASELINE.json) is a tail metric:
     # measure the wall-time distribution of small all-DISCOVER batches — every
@@ -241,7 +260,7 @@ def main(on_tpu: bool) -> None:
         }
 
     extra = dict(_DIAG)
-    print(json.dumps({
+    line = {
         "metric": "Mpps/chip DHCP+NAT44 fast path",
         "value": round(mpps, 3),
         "unit": "Mpps",
@@ -258,11 +277,14 @@ def main(on_tpu: bool) -> None:
         "offer_latency_batch": B_LAT,
         "offer_hits": offer_hits,
         "latency_curve": curve,
+        **({"profile_top_ops": profile_top} if profile_top else {}),
         "device": str(dev),
         "compile_s": round(compile_s, 1),
         "setup_s": round(setup_s, 1),
         **extra,
-    }))
+    }
+    print(json.dumps(line))
+    _persist(line)
 
 
 def _timed_loop(step, args, steps, batch, carry: bool = False):
@@ -298,10 +320,25 @@ def _timed_loop(step, args, steps, batch, carry: bool = False):
 _DIAG: dict = {}
 
 
+def _persist(line: dict) -> None:
+    """Append every bench result to bench_runs.jsonl (r2 ADVICE: per-config
+    measurements must live in artifacts, not review prose)."""
+    path = os.environ.get("BNG_BENCH_LOG",
+                          os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                       "bench_runs.jsonl"))
+    try:
+        with open(path, "a") as f:
+            f.write(json.dumps({"ts": time.strftime("%Y-%m-%dT%H:%M:%S"),
+                                **line}) + "\n")
+    except OSError:
+        pass  # read-only checkout: stdout still carries the result
+
+
 def _emit(metric, value, unit, baseline, **extra):
-    print(json.dumps({"metric": metric, "value": round(value, 3), "unit": unit,
-                      "vs_baseline": round(value / baseline, 4), **extra,
-                      **_DIAG}))
+    line = {"metric": metric, "value": round(value, 3), "unit": unit,
+            "vs_baseline": round(value / baseline, 4), **extra, **_DIAG}
+    print(json.dumps(line))
+    _persist(line)
 
 
 def config1_dhcp_slowpath():
