@@ -76,6 +76,9 @@ class BNGConfig:
     dhcpv6_enabled: bool = True
     dhcpv6_prefix: str = "2001:db8:1::/64"
     slaac_enabled: bool = True
+    # logging (main.go:1398-1418 zap production config role)
+    log_level: str = "info"
+    log_format: str = "json"  # json | console
     # misc
     node_id: str = "bng0"
 
@@ -120,6 +123,11 @@ class BNGApp:
 
     def _build(self) -> None:
         import ipaddress
+
+        from bng_tpu.utils import structlog
+
+        structlog.setup(self.config.log_level, self.config.log_format)
+        self.log = structlog.get_logger("app", node_id=self.config.node_id)
 
         from bng_tpu.control import walledgarden as wg
         from bng_tpu.control.dhcp_server import DHCPServer
@@ -248,6 +256,8 @@ class BNGApp:
             fastpath=fastpath, nat=nat, qos=qos, antispoof=c["antispoof"],
             batch_size=cfg.batch_size, slow_path=dhcp.handle_frame,
             clock=self.clock)
+        self.log.info("engine built", batch_size=cfg.batch_size,
+                      nat=cfg.nat_enabled, qos=cfg.qos_enabled)
 
         # 10. DHCPv6 + SLAAC (main.go:1063-1180)
         if cfg.dhcpv6_enabled:
@@ -266,6 +276,7 @@ class BNGApp:
             store = c["ha_store"] = InMemorySessionStore()
             if cfg.ha_role == "active":
                 c["ha"] = ActiveSyncer(store)
+                self.log.info("ha role active")
                 c["ha_role"] = Role.ACTIVE
             else:
                 if cfg.ha_peer.startswith("http"):
@@ -282,6 +293,7 @@ class BNGApp:
                         raise ConnectionError(
                             f"HA peer unreachable: {cfg.ha_peer}")
                 c["ha"] = StandbySyncer(store, transport=_peer)
+                self.log.info("ha role standby", peer=cfg.ha_peer)
                 c["ha_role"] = Role.STANDBY
 
         # 11b. replicated store + cluster listener (pkg/nexus CLSet modes)
@@ -303,6 +315,8 @@ class BNGApp:
             if "cluster_store" in c:
                 srv.mount_store(c["cluster_store"])
             c["cluster_server"] = srv.start()
+            self.log.info("cluster listener up", url=srv.url,
+                          ha=bool(srv.ha), store=srv.store is not None)
             self._on_close(srv.close)
 
         # 12. BGP (main.go:884-940) — executor supplied by operator; stub here

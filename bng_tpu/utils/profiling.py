@@ -46,23 +46,35 @@ def profile_op_times(fn: Callable[[], object], iters: int = 10,
     """Run fn() `iters` times under the profiler; aggregate device ops.
 
     fn should be pre-compiled (call it once before) so the trace holds
-    steady-state executions, not compilation.
+    steady-state executions, not compilation. With no explicit trace_dir
+    the raw trace (tens of MB for a big pipeline) is parsed and DELETED —
+    pass trace_dir to keep it for tensorboard.
     """
+    import shutil
+
     import jax
 
+    keep = trace_dir is not None
     trace_dir = trace_dir or tempfile.mkdtemp(prefix="bng-prof-")
-    with jax.profiler.trace(trace_dir):
-        out = None
-        for _ in range(iters):
-            out = fn()
-        jax.block_until_ready(out)
+    try:
+        with jax.profiler.trace(trace_dir):
+            out = None
+            for _ in range(iters):
+                out = fn()
+            jax.block_until_ready(out)
 
-    traces = sorted(glob.glob(
-        os.path.join(trace_dir, "plugins", "profile", "*", "*.trace.json.gz")))
-    if not traces:
-        return ProfileReport(0.0, 0.0, [], trace_dir)
-    with gzip.open(traces[-1]) as f:
-        tr = json.load(f)
+        traces = sorted(glob.glob(
+            os.path.join(trace_dir, "plugins", "profile", "*",
+                         "*.trace.json.gz")))
+        if not traces:
+            return ProfileReport(0.0, 0.0, [],
+                                 trace_dir if keep else "(discarded)")
+        with gzip.open(traces[-1]) as f:
+            tr = json.load(f)
+    finally:
+        if not keep:
+            shutil.rmtree(trace_dir, ignore_errors=True)
+            trace_dir = "(discarded)"
     ev = tr.get("traceEvents", [])
     pids = {e["pid"]: e["args"].get("name", "") for e in ev
             if e.get("ph") == "M" and e.get("name") == "process_name"}

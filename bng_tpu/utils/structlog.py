@@ -1,0 +1,109 @@
+"""Structured JSON logging — the zap parity layer (SURVEY §5).
+
+The reference logs structured JSON everywhere (zap production config,
+cmd/bng/main.go:1398-1418): machine-parseable lines with bound fields
+(component, subscriber, mac, ...). Python stdlib logging gets the same
+shape here:
+
+    log = get_logger("dhcp", component="dhcp-server")
+    log.info("lease allocated", mac="02:..:42", ip="10.0.0.9", pool=1)
+
+  -> {"ts": "2026-07-30T00:00:00.123Z", "level": "info",
+      "logger": "dhcp", "msg": "lease allocated",
+      "component": "dhcp-server", "mac": "02:..:42", ...}
+
+`setup(level=..., fmt="json"|"console")` configures the root once (CLI
+flags --log-level/--log-format); libraries call get_logger() and never
+configure handlers themselves (the zap discipline).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import sys
+import time
+from typing import IO
+
+_CONFIGURED = False
+
+
+class JSONFormatter(logging.Formatter):
+    def format(self, record: logging.LogRecord) -> str:
+        line = {
+            "ts": time.strftime("%Y-%m-%dT%H:%M:%S",
+                                time.gmtime(record.created))
+            + f".{int(record.msecs):03d}Z",
+            "level": record.levelname.lower(),
+            "logger": record.name,
+            "msg": record.getMessage(),
+        }
+        line.update(getattr(record, "bng_fields", {}))
+        if record.exc_info:
+            line["exc"] = self.formatException(record.exc_info)
+        return json.dumps(line)
+
+
+class ConsoleFormatter(logging.Formatter):
+    def format(self, record: logging.LogRecord) -> str:
+        fields = getattr(record, "bng_fields", {})
+        tail = "".join(f" {k}={v}" for k, v in fields.items())
+        return (f"{time.strftime('%H:%M:%S', time.gmtime(record.created))} "
+                f"{record.levelname:<5} {record.name}: "
+                f"{record.getMessage()}{tail}")
+
+
+class BoundLogger:
+    """A logger with bound fields; per-call kwargs become JSON fields."""
+
+    def __init__(self, logger: logging.Logger, fields: dict):
+        self._logger = logger
+        self._fields = fields
+
+    def bind(self, **fields) -> "BoundLogger":
+        return BoundLogger(self._logger, {**self._fields, **fields})
+
+    def _log(self, level: int, msg: str, kw: dict) -> None:
+        if self._logger.isEnabledFor(level):
+            exc_info = kw.pop("exc_info", None)
+            self._logger.log(level, msg, exc_info=exc_info,
+                             extra={"bng_fields": {**self._fields, **kw}})
+
+    def debug(self, msg: str, **kw) -> None:
+        self._log(logging.DEBUG, msg, kw)
+
+    def info(self, msg: str, **kw) -> None:
+        self._log(logging.INFO, msg, kw)
+
+    def warning(self, msg: str, **kw) -> None:
+        self._log(logging.WARNING, msg, kw)
+
+    def error(self, msg: str, **kw) -> None:
+        self._log(logging.ERROR, msg, kw)
+
+
+def setup(level: str = "info", fmt: str = "json",
+          stream: IO | None = None, force: bool = False) -> None:
+    """Configure the root 'bng' logger.
+
+    First explicit configuration wins (the zap discipline: the operator's
+    sink is not clobbered by a library's later convenience call) — a
+    repeat call without `stream`/`force` only adjusts the level.
+    """
+    global _CONFIGURED
+    root = logging.getLogger("bng")
+    root.setLevel(getattr(logging, level.upper(), logging.INFO))
+    if _CONFIGURED and not force and stream is None:
+        return
+    handler = logging.StreamHandler(stream or sys.stderr)
+    handler.setFormatter(JSONFormatter() if fmt == "json" else ConsoleFormatter())
+    root.handlers[:] = [handler]
+    root.propagate = False
+    _CONFIGURED = True
+
+
+def get_logger(name: str, **fields) -> BoundLogger:
+    """Namespaced logger under 'bng.'; safe before setup() (lazy default)."""
+    if not _CONFIGURED:
+        setup()
+    return BoundLogger(logging.getLogger(f"bng.{name}"), fields)
