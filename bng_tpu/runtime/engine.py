@@ -208,6 +208,9 @@ class Engine:
         self.violation_sink = violation_sink
         self.clock = clock
         self.stats = EngineStats()
+        self._inflight = None  # pipelined ring mode (process_ring_pipelined)
+        self._stage_bufs = [None, None]  # ping-pong staging (lazy alloc)
+        self._stage_idx = 0
 
         self.geom = PipelineGeom(
             dhcp=fastpath.geom, nat=nat.geom, qos=self.qos.geom, spoof=self.antispoof.geom
@@ -339,20 +342,28 @@ class Engine:
                 self.violation_sink(i, frames[i])
         return out
 
-    def _run_step(self, pkt, length, fa, now_s, now_us) -> PipelineResult:
-        """Invoke the jitted step and fold device stats into host counters
-        (shared by process/process_ring — one copy of the timestamp/stats
-        discipline)."""
+    def _dispatch_step(self, pkt, length, fa, now_s, now_us) -> PipelineResult:
+        """Enqueue one jitted step (async — outputs are futures). The table
+        state threads immediately; callers force outputs when they need
+        them (sync path: right away; pipelined path: one batch later)."""
         res: PipelineResult = self._step(
             self.tables, self._drain_updates(), jnp.asarray(pkt), jnp.asarray(length),
             jnp.asarray(fa), now_s, now_us,
         )
         self.tables = res.tables
         self.stats.batches += 1
+        return res
+
+    def _fold_stats(self, res: PipelineResult) -> None:
         self.stats.dhcp += np.asarray(res.dhcp_stats, dtype=np.uint64)
         self.stats.nat += np.asarray(res.nat_stats, dtype=np.uint64)
         self.stats.qos += np.asarray(res.qos_stats, dtype=np.uint64)
         self.stats.spoof += np.asarray(res.spoof_stats, dtype=np.uint64)
+
+    def _run_step(self, pkt, length, fa, now_s, now_us) -> PipelineResult:
+        """Dispatch + fold (the synchronous step both process paths use)."""
+        res = self._dispatch_step(pkt, length, fa, now_s, now_us)
+        self._fold_stats(res)
         return res
 
     def process_ring(self, ring, now: float | None = None) -> int:
@@ -365,6 +376,10 @@ class Engine:
         to the slow ring — drained here into the slow-path handlers, the
         XDP_PASS delivery). Returns the number of frames processed.
         """
+        if self._inflight is not None:
+            # a pipelined batch holds one of the ring's assemble windows;
+            # retire it or the sync path would starve (assemble -> 0)
+            self.flush_pipeline(ring)
         pkt = np.zeros((self.B, self.L), dtype=np.uint8)
         length = np.zeros((self.B,), dtype=np.uint32)
         flags = np.zeros((self.B,), dtype=np.uint32)
@@ -377,6 +392,12 @@ class Engine:
         fa = (flags & 0x1) != 0
 
         res = self._run_step(pkt, length, fa, now_s, now_us)
+        self._apply_ring_verdicts(ring, res, pkt, length, n, now)
+        return n
+
+    def _apply_ring_verdicts(self, ring, res: PipelineResult, pkt, length,
+                             n: int, now: float) -> None:
+        """Force the step's outputs and demux verdicts back to the ring."""
         vv = np.asarray(res.verdict)[:n]
         out_pkt = np.asarray(res.out_pkt)
         out_len = np.asarray(res.out_len).astype(np.uint32)
@@ -415,6 +436,64 @@ class Engine:
                         ring.tx_inject(reply, from_access=(fl & 0x1) != 0)
             except Exception:  # noqa: BLE001 — slow path is untrusted input
                 self.stats.slow_errors += 1
+
+    def _staging(self, idx: int):
+        """Ping-pong staging buffers (allocated once; the in-flight batch
+        owns one while the next assembles into the other)."""
+        if self._stage_bufs[idx] is None:
+            self._stage_bufs[idx] = (
+                np.zeros((self.B, self.L), dtype=np.uint8),
+                np.zeros((self.B,), dtype=np.uint32),
+                np.zeros((self.B,), dtype=np.uint32),
+            )
+        return self._stage_bufs[idx]
+
+    def process_ring_pipelined(self, ring, now: float | None = None) -> int:
+        """Double-buffered ring loop: dispatch batch k+1, THEN retire k.
+
+        The SURVEY §7 'hard parts' dispatch design. Per call: assemble the
+        next batch into the idle ping-pong buffer and dispatch it (the
+        device starts immediately), then force + demux the PREVIOUS
+        batch's verdicts — so host demux work overlaps device execution.
+        Requires ring backends that tolerate two outstanding
+        assemble..complete windows (bngring MAX_INFLIGHT=2; complete()
+        retires FIFO, matching this loop's order). Per-batch latency grows
+        by one batch window; call flush_pipeline() before reading final
+        state (shutdown/tests). Returns frames retired this call.
+        """
+        now = now if now is not None else self.clock()
+        prev = self._inflight
+        self._inflight = None
+
+        # 1. feed the device first: assemble into the buffer prev is NOT using
+        idx = 1 - self._stage_idx
+        pkt, length, flags = self._staging(idx)
+        n = ring.assemble(pkt, length, flags)
+        if n:
+            now_s = np.uint32(int(now))
+            now_us = np.uint32(int(now * 1e6) & 0xFFFFFFFF)
+            res = self._dispatch_step(pkt, length, (flags & 0x1) != 0,
+                                      now_s, now_us)
+            self._inflight = (res, pkt, length, n, now)
+            self._stage_idx = idx
+
+        # 2. retire the previous batch while the device runs the new one
+        retired = 0
+        if prev is not None:
+            res_p, pkt_p, len_p, n_p, now_p = prev
+            self._apply_ring_verdicts(ring, res_p, pkt_p, len_p, n_p, now_p)
+            self._fold_stats(res_p)
+            retired = n_p
+        return retired
+
+    def flush_pipeline(self, ring) -> int:
+        """Retire any in-flight pipelined batch (shutdown/test barrier)."""
+        if self._inflight is None:
+            return 0
+        res, pkt, length, n, now = self._inflight
+        self._inflight = None
+        self._apply_ring_verdicts(ring, res, pkt, length, n, now)
+        self._fold_stats(res)
         return n
 
     def _punt_new_flow(self, frame: bytes, now: int) -> None:

@@ -240,7 +240,7 @@ class PyRing:
         self._tx: deque[tuple[bytes, int]] = deque()
         self._fwd: deque[tuple[bytes, int]] = deque()
         self._slow: deque[tuple[bytes, int]] = deque()
-        self._inflight: list[tuple[bytes, int]] = []
+        self._inflight: list[list[tuple[bytes, int]]] = []  # FIFO of batches
         self._stats = {k: 0 for k, _ in RingStats._fields_}
 
     def close(self) -> None:
@@ -265,11 +265,14 @@ class PyRing:
         self._stats["tx"] += 1
         return True
 
+    MAX_INFLIGHT = 2  # two assemble..complete windows (double buffering)
+
     def assemble(self, out: np.ndarray, out_len: np.ndarray,
                  out_flags: np.ndarray) -> int:
-        if self._inflight:
+        if len(self._inflight) >= self.MAX_INFLIGHT:
             return 0
         B, slot = out.shape
+        batch = []
         n = 0
         while n < B and self._rx:
             frame, fl = self._rx.popleft()
@@ -279,17 +282,21 @@ class PyRing:
             out[n] = row
             out_len[n] = copy
             out_flags[n] = fl
-            self._inflight.append((frame, fl))
+            batch.append((frame, fl))
             n += 1
+        if n:
+            self._inflight.append(batch)
         self._stats["rx"] += n
         return n
 
     def complete(self, verdict: np.ndarray, out: np.ndarray,
                  out_len: np.ndarray, n: int) -> None:
-        if n != len(self._inflight):
+        # retires the OLDEST outstanding batch (FIFO, like the C side)
+        if not self._inflight or n != len(self._inflight[0]):
             raise RuntimeError("batch_complete: n mismatch")
+        batch = self._inflight.pop(0)
         for i in range(n):
-            frame, fl = self._inflight[i]
+            frame, fl = batch[i]
             v = int(verdict[i])
             if v in (VERDICT_TX, VERDICT_FWD):
                 payload = bytes(out[i, : int(out_len[i])])
@@ -306,7 +313,6 @@ class PyRing:
             else:
                 self._stats["tx_full"] += 1
                 self._free += 1
-        self._inflight = []
 
     def _pop(self, q: deque):
         if not q:

@@ -159,9 +159,16 @@ struct bng_ring {
   Ring fwd;  /* engine FWD verdicts -> wire (other port) */
   Ring slow; /* engine PASS verdicts -> slow path */
 
-  /* in-flight batch (assemble..complete window) */
-  bng_desc *inflight = nullptr;
-  uint32_t inflight_n = 0;
+  /* in-flight batches (assemble..complete windows). TWO slots so a
+   * double-buffered engine can assemble+dispatch batch k+1 before
+   * completing batch k — the device then always has work enqueued while
+   * the host demuxes verdicts (SURVEY §7 dispatch design). complete()
+   * retires strictly FIFO. */
+  static constexpr uint32_t MAX_INFLIGHT = 2;
+  bng_desc *inflight[MAX_INFLIGHT] = {nullptr, nullptr};
+  uint32_t inflight_n[MAX_INFLIGHT] = {0, 0};
+  uint32_t inflight_head = 0; /* oldest outstanding batch */
+  uint32_t inflight_count = 0;
   uint32_t inflight_cap = 0;
 
   bng_ring_stats stats{};
@@ -187,8 +194,10 @@ bng_ring *bng_ring_create(uint32_t nframes, uint32_t frame_size,
   bool ok = r->umem && r->fill.init(nframes) && r->rx.init(depth) &&
             r->tx.init(depth) && r->fwd.init(depth) && r->slow.init(depth);
   r->inflight_cap = depth;
-  r->inflight = static_cast<bng_desc *>(calloc(depth, sizeof(bng_desc)));
-  ok = ok && r->inflight;
+  for (uint32_t i = 0; i < bng_ring::MAX_INFLIGHT; i++) {
+    r->inflight[i] = static_cast<bng_desc *>(calloc(depth, sizeof(bng_desc)));
+    ok = ok && r->inflight[i];
+  }
   if (!ok) {
     bng_ring_destroy(r);
     return nullptr;
@@ -209,7 +218,7 @@ void bng_ring_destroy(bng_ring *r) {
   r->tx.fini();
   r->fwd.fini();
   r->slow.fini();
-  free(r->inflight);
+  for (uint32_t i = 0; i < bng_ring::MAX_INFLIGHT; i++) free(r->inflight[i]);
   free(r->umem);
   delete r;
 }
@@ -261,8 +270,10 @@ int bng_ring_rx_push(bng_ring *r, const uint8_t *data, uint32_t len,
 uint32_t bng_batch_assemble(bng_ring *r, uint8_t *out, uint32_t *out_len,
                             uint32_t *out_flags, uint32_t max_batch,
                             uint32_t slot) {
-  if (r->inflight_n != 0) return 0; /* previous batch not completed */
+  if (r->inflight_count >= bng_ring::MAX_INFLIGHT) return 0; /* windows full */
   if (max_batch > r->inflight_cap) max_batch = r->inflight_cap;
+  uint32_t tail =
+      (r->inflight_head + r->inflight_count) % bng_ring::MAX_INFLIGHT;
   uint32_t n = 0;
   bng_desc d;
   while (n < max_batch && r->rx.pop(&d)) {
@@ -272,10 +283,12 @@ uint32_t bng_batch_assemble(bng_ring *r, uint8_t *out, uint32_t *out_len,
       memset(out + static_cast<size_t>(n) * slot + copy, 0, slot - copy);
     out_len[n] = copy;
     out_flags[n] = d.flags;
-    r->inflight[n] = d;
+    r->inflight[tail][n] = d;
     n++;
   }
-  r->inflight_n = n;
+  if (n == 0) return 0; /* empty assemble opens no window */
+  r->inflight_n[tail] = n;
+  r->inflight_count++;
   r->stats.rx += n;
   return n;
 }
@@ -283,9 +296,13 @@ uint32_t bng_batch_assemble(bng_ring *r, uint8_t *out, uint32_t *out_len,
 int bng_batch_complete(bng_ring *r, const uint8_t *verdict,
                        const uint8_t *out, const uint32_t *out_len,
                        uint32_t n, uint32_t slot) {
-  if (n != r->inflight_n || n > r->inflight_cap) return -1;
+  /* retires the OLDEST outstanding batch; n must match its size */
+  uint32_t head = r->inflight_head;
+  if (r->inflight_count == 0 || n != r->inflight_n[head] ||
+      n > r->inflight_cap)
+    return -1;
   for (uint32_t i = 0; i < n; i++) {
-    bng_desc d = r->inflight[i];
+    bng_desc d = r->inflight[head][i];
     uint8_t v = verdict[i];
     if (v == BNG_VERDICT_TX || v == BNG_VERDICT_FWD) {
       /* device rewrote the packet: copy staged bytes back over the frame */
@@ -315,7 +332,9 @@ int bng_batch_complete(bng_ring *r, const uint8_t *verdict,
       r->fill.push(d);
     }
   }
-  r->inflight_n = 0;
+  r->inflight_n[head] = 0;
+  r->inflight_head = (head + 1) % bng_ring::MAX_INFLIGHT;
+  r->inflight_count--;
   return 0;
 }
 

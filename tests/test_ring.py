@@ -133,17 +133,35 @@ class TestRingBasics:
         assert frame == b"reply" * 4 and (fl & 1) == 1
         r.close()
 
-    def test_assemble_requires_complete(self, ring_cls):
+    def test_two_inflight_windows_fifo(self, ring_cls):
+        """Double buffering: two assemble..complete windows may be open
+        (the pipelined engine's contract); a third is refused; complete()
+        retires strictly FIFO."""
         r = ring_cls(nframes=8, frame_size=128, depth=8)
-        r.rx_push(b"a" * 32)
-        out = np.zeros((4, 64), dtype=np.uint8)
-        ln = np.zeros((4,), dtype=np.uint32)
+        out1 = np.zeros((4, 64), dtype=np.uint8)
+        out2 = np.zeros((4, 64), dtype=np.uint8)
+        ln1 = np.zeros((4,), dtype=np.uint32)
+        ln2 = np.zeros((4,), dtype=np.uint32)
         fl = np.zeros((4,), dtype=np.uint32)
-        assert r.assemble(out, ln, fl) == 1
+
+        r.rx_push(b"a" * 32)
+        assert r.assemble(out1, ln1, fl) == 1  # window 1
         r.rx_push(b"b" * 32)
-        assert r.assemble(out, ln, fl) == 0  # in-flight batch blocks
-        r.complete(np.array([1], dtype=np.uint8), out, ln, 1)
-        assert r.assemble(out, ln, fl) == 1
+        r.rx_push(b"c" * 32)
+        assert r.assemble(out2, ln2, fl) == 2  # window 2 (double buffer)
+        r.rx_push(b"d" * 32)
+        assert r.assemble(out1, ln1, fl) == 0  # third window refused
+
+        # FIFO: the first complete retires window 1 (the 1-frame batch);
+        # PASS it so the original bytes prove which batch retired
+        r.complete(np.array([0], dtype=np.uint8), out1, ln1, 1)
+        frame, _ = r.slow_pop()
+        assert frame == b"a" * 32
+        r.complete(np.array([0, 0], dtype=np.uint8), out2, ln2, 2)
+        assert r.slow_pop()[0] == b"b" * 32
+        assert r.slow_pop()[0] == b"c" * 32
+        # both windows closed: assemble works again
+        assert r.assemble(out1, ln1, fl) == 1
         r.close()
 
 
@@ -240,6 +258,48 @@ class TestRingEngine:
         offer2, _ = ring.tx_pop()
         assert dhcp_codec.decode(packets.decode(offer2).payload).msg_type == dhcp_codec.OFFER
         ring.close()
+
+
+    def test_pipelined_ring_loop_matches_sync(self, ring_cls):
+        """Double-buffered dispatch: same verdicts, one-call delay, stats
+        identical after flush (SURVEY §7 dispatch design)."""
+        from bng_tpu.control import dhcp_codec, packets
+
+        ring = ring_cls(nframes=64, frame_size=1024, depth=32)
+        engine, server = self._stack(ring)
+        mac = bytes.fromhex("02c0ffee0010")
+
+        def discover(xid):
+            p = dhcp_codec.build_request(mac, dhcp_codec.DISCOVER, xid=xid)
+            p.options.append((dhcp_codec.OPT_PARAM_REQ_LIST,
+                              bytes([1, 3, 6, 51, 54])))
+            return packets.udp_packet(mac, b"\xff" * 6, 0, 0xFFFFFFFF, 68, 67,
+                                      p.encode().ljust(320, b"\x00"))
+
+        # call 1: dispatches, retires nothing (pipe filling)
+        ring.rx_push(discover(1), from_access=True)
+        assert engine.process_ring_pipelined(ring) == 0
+        assert ring.tx_pop() is None  # verdicts not applied yet
+
+        # call 2: retires batch 1 (slow-path OFFER appears), dispatches #2
+        ring.rx_push(discover(2), from_access=True)
+        assert engine.process_ring_pipelined(ring) == 1
+        offer, _ = ring.tx_pop()
+        parsed = dhcp_codec.decode(packets.decode(offer).payload)
+        assert parsed.msg_type == dhcp_codec.OFFER
+
+        # flush retires the tail batch
+        assert engine.flush_pipeline(ring) == 1
+        offer2, _ = ring.tx_pop()
+        assert dhcp_codec.decode(
+            packets.decode(offer2).payload).msg_type == dhcp_codec.OFFER
+        assert engine.flush_pipeline(ring) == 0  # idempotent
+        assert engine.stats.passed == 2 and engine.stats.batches == 2
+
+        # empty calls are cheap no-ops
+        assert engine.process_ring_pipelined(ring) == 0
+        ring.close()
+
 
 
 class TestFillPoolConcurrency:
