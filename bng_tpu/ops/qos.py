@@ -92,31 +92,52 @@ def _prefix_consumed(limited, slot, lens_u, avail):
         is_head = limited & (cum_incl <= lens_f)  # no earlier same-bucket lane
         return allowed, consumed, is_head
 
-    # ---- sort path (original implementation) ----
+    # ---- sort path ----
+    # Narrow (1-word-per-index) gathers are the measured TPU pathology
+    # (PERF_NOTES.md §2), so the permutation moves ONE packed [B,4] row
+    # per lane instead of four scalar gathers, and the unsort is ONE
+    # packed row scatter instead of an inverse-permutation + three
+    # gathers. tests/test_hlo_structure.py pins these counts.
     order = jnp.argsort(slot_eff, stable=True)
-    s_sorted = slot_eff[order]
-    lens_sorted = lens_u[order]
-    avail_sorted = avail[order]
-    limited_sorted = limited[order]
+    avail_int = jnp.clip(avail, 0.0, 4.0e9).astype(jnp.uint32)
+    packed = jnp.stack(
+        [slot_eff.astype(jnp.uint32), lens_u, avail_int,
+         limited.astype(jnp.uint32)], axis=1)  # [B, 4]
+    ps = packed[order]
+    s_sorted = ps[:, 0].astype(jnp.int32)
+    lens_sorted = ps[:, 1]
+    avail_sorted = ps[:, 2]
+    limited_sorted = ps[:, 3] != 0
 
     csum = jnp.cumsum(lens_sorted)
     is_head_sorted = jnp.concatenate(
         [jnp.ones((1,), dtype=bool), s_sorted[1:] != s_sorted[:-1]])
-    seg_id = jnp.cumsum(is_head_sorted.astype(jnp.int32)) - 1
+    is_last_sorted = jnp.concatenate(
+        [s_sorted[1:] != s_sorted[:-1], jnp.ones((1,), dtype=bool)])
     seg_base = jax.lax.cummax(jnp.where(is_head_sorted, csum - lens_sorted, 0))
     cum_incl_sorted = csum - seg_base
-    avail_int = jnp.clip(avail_sorted, 0.0, 4.0e9).astype(jnp.uint32)
-    allowed_sorted = ~limited_sorted | (cum_incl_sorted <= avail_int)
+    allowed_sorted = ~limited_sorted | (cum_incl_sorted <= avail_sorted)
 
+    # consumed = admitted bytes of the lane's whole segment, computed
+    # without segment_sum's scatter/gather pair: admitted cumsum is
+    # non-decreasing, so a reverse cummin over (segment-last -> its
+    # cumsum, else +inf) fills every lane with ITS segment end's value
     admitted_sorted = jnp.where(allowed_sorted & limited_sorted, lens_sorted, 0)
-    seg_totals = jax.ops.segment_sum(admitted_sorted, seg_id, num_segments=Bsz)
-    consumed_sorted = seg_totals[seg_id]
+    adm_csum = jnp.cumsum(admitted_sorted)
+    seg_end = jax.lax.cummin(
+        jnp.where(is_last_sorted, adm_csum, jnp.uint32(0xFFFFFFFF)),
+        reverse=True)
+    adm_base = jax.lax.cummax(
+        jnp.where(is_head_sorted, adm_csum - admitted_sorted, 0))
+    consumed_sorted = seg_end - adm_base
 
-    inv = jnp.zeros((Bsz,), dtype=jnp.int32).at[order].set(
-        jnp.arange(Bsz, dtype=jnp.int32))
-    return (allowed_sorted[inv],
-            consumed_sorted[inv].astype(jnp.float32),
-            (is_head_sorted & limited_sorted)[inv] & limited)
+    res_sorted = jnp.stack(
+        [allowed_sorted.astype(jnp.uint32), consumed_sorted,
+         (is_head_sorted & limited_sorted).astype(jnp.uint32)], axis=1)
+    res = jnp.zeros((Bsz, 3), dtype=jnp.uint32).at[order].set(res_sorted)
+    return (res[:, 0] != 0,
+            res[:, 1].astype(jnp.float32),
+            (res[:, 2] != 0) & limited)
 
 
 class QoSResult(NamedTuple):

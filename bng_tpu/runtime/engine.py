@@ -377,9 +377,10 @@ class Engine:
         XDP_PASS delivery). Returns the number of frames processed.
         """
         if self._inflight is not None:
-            # a pipelined batch holds one of the ring's assemble windows;
-            # retire it or the sync path would starve (assemble -> 0)
-            self.flush_pipeline(ring)
+            # a pipelined batch holds one of its ring's assemble windows;
+            # retire it (against the ring it came from — not necessarily
+            # this one) or the sync path would starve (assemble -> 0)
+            self.flush_pipeline()
         pkt = np.zeros((self.B, self.L), dtype=np.uint8)
         length = np.zeros((self.B,), dtype=np.uint32)
         flags = np.zeros((self.B,), dtype=np.uint32)
@@ -465,36 +466,49 @@ class Engine:
         prev = self._inflight
         self._inflight = None
 
-        # 1. feed the device first: assemble into the buffer prev is NOT using
-        idx = 1 - self._stage_idx
-        pkt, length, flags = self._staging(idx)
-        n = ring.assemble(pkt, length, flags)
-        if n:
-            now_s = np.uint32(int(now))
-            now_us = np.uint32(int(now * 1e6) & 0xFFFFFFFF)
-            res = self._dispatch_step(pkt, length, (flags & 0x1) != 0,
-                                      now_s, now_us)
-            self._inflight = (res, pkt, length, n, now)
-            self._stage_idx = idx
-
-        # 2. retire the previous batch while the device runs the new one
-        retired = 0
-        if prev is not None:
-            res_p, pkt_p, len_p, n_p, now_p = prev
-            self._apply_ring_verdicts(ring, res_p, pkt_p, len_p, n_p, now_p)
-            self._fold_stats(res_p)
-            retired = n_p
+        try:
+            # 1. feed the device first: assemble into the buffer prev is
+            # NOT using, so its frames stay intact until retirement
+            idx = 1 - self._stage_idx
+            pkt, length, flags = self._staging(idx)
+            n = ring.assemble(pkt, length, flags)
+            if n:
+                now_s = np.uint32(int(now))
+                now_us = np.uint32(int(now * 1e6) & 0xFFFFFFFF)
+                try:
+                    res = self._dispatch_step(pkt, length, (flags & 0x1) != 0,
+                                              now_s, now_us)
+                except BaseException:
+                    # fail closed: the assemble opened a ring window — drop
+                    # the frames so it closes, or both windows wedge forever
+                    ring.complete(np.full((n,), VERDICT_DROP, dtype=np.uint8),
+                                  pkt, length, n)
+                    raise
+                self._inflight = (ring, res, pkt, length, n, now)
+                self._stage_idx = idx
+        finally:
+            # 2. retire the previous batch (even if dispatch raised) while
+            # the device runs the new one
+            retired = self._retire(prev)
         return retired
 
-    def flush_pipeline(self, ring) -> int:
-        """Retire any in-flight pipelined batch (shutdown/test barrier)."""
-        if self._inflight is None:
+    def _retire(self, entry) -> int:
+        """Apply a pipelined batch's verdicts to the ring it came from."""
+        if entry is None:
             return 0
-        res, pkt, length, n, now = self._inflight
-        self._inflight = None
+        ring, res, pkt, length, n, now = entry
         self._apply_ring_verdicts(ring, res, pkt, length, n, now)
         self._fold_stats(res)
         return n
+
+    def flush_pipeline(self, ring=None) -> int:
+        """Retire any in-flight pipelined batch (shutdown/test barrier).
+
+        The batch retires against the ring it was assembled from; the
+        optional argument is accepted for call-site symmetry only."""
+        entry = self._inflight
+        self._inflight = None
+        return self._retire(entry)
 
     def _punt_new_flow(self, frame: bytes, now: int) -> None:
         """Device egress-miss: create the session host-side (packet 1 of a

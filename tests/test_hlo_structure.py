@@ -1,0 +1,98 @@
+"""Structural HLO regression tests — the op-shape contracts perf relies on.
+
+The round-2/3 QoS bottleneck was invisible to every behavioral test: the
+kernel was correct but its probe lowered to sixteen 1-word-wide gathers
+(~7ns/element serialized on v5e) instead of two wide row gathers. These
+tests pin the STRUCTURE of the lowered programs (StableHLO, backend
+independent) so a refactor that quietly reintroduces a narrow-gather
+probe or a gather explosion fails CI — PERF_NOTES.md §2 has the numbers.
+"""
+
+import re
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+
+def _stablehlo(fn, *args) -> str:
+    return jax.jit(fn).lower(*args).as_text()
+
+
+def _count(pattern: str, text: str) -> int:
+    return len(re.findall(pattern, text))
+
+
+class TestQoSLookupShape:
+    def _lowered(self):
+        from bng_tpu.ops.qos import qos_kernel
+        from bng_tpu.runtime.engine import QoSTables
+
+        qos = QoSTables(nbuckets=1 << 10)
+        for i in range(64):
+            qos.set_subscriber((10 << 24) | (i + 2), down_bps=1_000_000,
+                               up_bps=1_000_000)
+        table = qos.up.device_state()
+        B = 1024
+        ips = jnp.asarray(((10 << 24) + 2 + np.arange(B) % 64).astype(np.uint32))
+        lens = jnp.full((B,), 900, dtype=jnp.uint32)
+        active = jnp.ones((B,), dtype=bool)
+        return _stablehlo(
+            lambda t, i, l: qos_kernel(i, l, active, t, qos.geom,
+                                       jnp.uint32(1)),
+            table, ips, lens)
+
+    def test_probe_is_wide_row_gathers(self):
+        """The packed probe: both rows[b] gathers carry full 32-word rows
+        (slice_sizes = [1,32]) — the narrow [S,1]/[S] probe must not come
+        back."""
+        hlo = self._lowered()
+        # every gather whose operand is the [NB,32] rows array must take
+        # whole rows: "slice_sizes = array<i64: 1, 32>" in stablehlo syntax
+        row_gathers = _count(r"slice_sizes = array<i64: 1, 32>", hlo)
+        assert row_gathers == 2, f"expected 2 packed-row gathers, got {row_gathers}"
+
+    def test_total_gather_budget(self):
+        """Whole-kernel gather budget (currently 6: 2 packed-row probes,
+        1 sorted-operand pack row, 1 way-select, 2 token/last scalars).
+        The r2 kernel had 16 narrow probe gathers alone; hold the line."""
+        hlo = self._lowered()
+        total = _count(r'"stablehlo\.gather"', hlo)  # ops, not attrs
+        assert total <= 8, f"gather explosion: {total} gathers in qos_kernel"
+
+    def test_scatter_budget(self):
+        """Currently 7: 1 packed-row unsort, 2 token/last writebacks,
+        4 scalar stats adds."""
+        hlo = self._lowered()
+        scatters = _count(r'"stablehlo\.scatter"', hlo)
+        assert scatters <= 8, f"unexpected scatter count: {scatters}"
+
+
+class TestShardedExchangeShape:
+    def test_two_collectives_per_lookup(self):
+        """The sharded lookup must stay exactly two all-to-alls (request +
+        packed response) — a third collective means someone unpacked the
+        response path (3x ICI latency)."""
+        from jax.sharding import PartitionSpec as P
+
+        from bng_tpu.ops.table import HostTable, TableGeom, lookup
+        from bng_tpu.parallel.sharded import AXIS, make_mesh
+
+        N = 4
+        mesh = make_mesh(N)
+        t = HostTable(nbuckets=64, key_words=2, val_words=4)
+        g = TableGeom(nbuckets=64, stash=64, axis=AXIS, n_shards=N)
+        st = jax.tree.map(lambda *xs: jnp.stack(xs),
+                          *[t.device_state() for _ in range(N)])
+        q = jnp.zeros((N * 32, 2), dtype=jnp.uint32)
+
+        def local(tabs1, q):
+            tabs = jax.tree.map(lambda x: x[0], tabs1)
+            r = lookup(tabs, q, g)
+            return r.found, r.vals
+
+        f = jax.shard_map(local, mesh=mesh, in_specs=(P(AXIS), P(AXIS)),
+                          out_specs=(P(AXIS), P(AXIS)), check_vma=False)
+        hlo = _stablehlo(f, st, q)
+        n_a2a = _count(r"all_to_all", hlo)
+        assert n_a2a == 2, f"expected 2 all_to_alls, got {n_a2a}"
