@@ -76,6 +76,10 @@ class BNGConfig:
     dhcpv6_enabled: bool = True
     dhcpv6_prefix: str = "2001:db8:1::/64"
     slaac_enabled: bool = True
+    # wire (AF_XDP attach ladder; runtime/xsk.py)
+    wire_if: str = ""  # NIC to bind AF_XDP on ("" = in-memory ring only)
+    wire_queue: int = 0
+    synthetic_subs: int = 0  # >0: generate DISCOVER/data traffic (smoke)
     # logging (main.go:1398-1418 zap production config role)
     log_level: str = "info"
     log_format: str = "json"  # json | console
@@ -115,6 +119,7 @@ class BNGApp:
         self.clock = clock
         self._cleanup = []
         self._last_sync = 0.0
+        self._syn_i = 0
         self.components: dict[str, object] = {}
         self._build()
 
@@ -319,6 +324,28 @@ class BNGApp:
                           ha=bool(srv.ha), store=srv.store is not None)
             self._on_close(srv.close)
 
+        # 11c. the wire: packet ring + AF_XDP attach ladder (the XDP-attach
+        # role, loader.go:294-315). Always build the ring when a wire or
+        # synthetic source is requested; the attach mode is whatever rung
+        # the environment supports (zerocopy -> copy -> in-memory).
+        if cfg.wire_if or cfg.synthetic_subs:
+            from bng_tpu.runtime import xsk as xsk_mod
+            from bng_tpu.runtime.ring import make_ring
+
+            ring = c["ring"] = make_ring(frame_size=2048)
+            att = xsk_mod.open_wire(ring, ifname=cfg.wire_if,
+                                    queue=cfg.wire_queue)
+            c["wire_attachment"] = att
+            self.log.info("wire attach", mode=att.mode,
+                          interface=cfg.wire_if or "(none)",
+                          detail=att.detail)
+            # LIFO shutdown: flush the pipelined batch (needs the ring),
+            # then detach the socket, then free the ring/UMEM
+            self._on_close(ring.close)
+            if att.xsk is not None:
+                self._on_close(att.xsk.close)
+            self._on_close(lambda: c["engine"].flush_pipeline())
+
         # 12. BGP (main.go:884-940) — executor supplied by operator; stub here
         if cfg.bgp_enabled:
             from bng_tpu.control.routing import BGPConfig, BGPController
@@ -346,6 +373,36 @@ class BNGApp:
             except Exception:
                 pass
         self._cleanup.clear()
+
+    def drive_once(self) -> int:
+        """One dataplane beat: feed the synthetic source (if configured)
+        and run a double-buffered engine step over the ring. Returns
+        frames retired (the run loop sleeps when this stays 0)."""
+        ring = self.components.get("ring")
+        if ring is None:
+            return 0
+        if self.config.synthetic_subs:
+            self._push_synthetic(ring)
+        return self.components["engine"].process_ring_pipelined(ring)
+
+    def _push_synthetic(self, ring, per_beat: int = 16) -> None:
+        """Rotating-MAC DISCOVER source (the loadtest generator's role,
+        here for `bng-tpu run --synthetic-subs N` smoke runs)."""
+        from bng_tpu.control import dhcp_codec, packets
+
+        n_subs = self.config.synthetic_subs
+        for _ in range(per_beat):
+            i = self._syn_i % n_subs
+            self._syn_i += 1
+            mac = (0x02B70000 << 16 | i).to_bytes(6, "big")
+            p = dhcp_codec.build_request(mac, dhcp_codec.DISCOVER,
+                                         xid=self._syn_i & 0xFFFFFFFF)
+            p.options.append((dhcp_codec.OPT_PARAM_REQ_LIST,
+                              bytes([1, 3, 6, 51, 54])))
+            f = packets.udp_packet(mac, b"\xff" * 6, 0, 0xFFFFFFFF, 68, 67,
+                                   p.encode().ljust(320, b"\x00"))
+            if not ring.rx_push(f, from_access=True):
+                break  # ring full: back off until the engine drains
 
     def tick(self, now: float | None = None) -> None:
         """Periodic cluster maintenance: standby reconnects (backoff) and
@@ -609,9 +666,18 @@ def main(argv: list[str] | None = None) -> int:
             srv = app.components.get("cluster_server")
             if srv is not None:
                 print(f"cluster on {srv.url}", file=sys.stderr)
+            # main loop: busy-drive the ring when one exists, 1 Hz
+            # cluster maintenance either way
+            has_ring = app.components.get("ring") is not None
+            last_tick = 0.0
             while True:
-                time.sleep(1)
-                app.tick()
+                moved = app.drive_once()
+                now_t = time.time()
+                if now_t - last_tick >= 1.0:
+                    last_tick = now_t
+                    app.tick(now_t)
+                if moved == 0:
+                    time.sleep(0.001 if has_ring else 1.0)
         except KeyboardInterrupt:
             return 0
         finally:

@@ -479,8 +479,12 @@ class Engine:
                     res = self._dispatch_step(pkt, length, (flags & 0x1) != 0,
                                               now_s, now_us)
                 except BaseException:
-                    # fail closed: the assemble opened a ring window — drop
-                    # the frames so it closes, or both windows wedge forever
+                    # fail closed: the assemble opened a ring window that
+                    # must not wedge. complete() retires FIFO, so the
+                    # previous batch's (older) window must retire FIRST —
+                    # dropping into it would mis-complete prev's frames.
+                    self._retire(prev)
+                    prev = None
                     ring.complete(np.full((n,), VERDICT_DROP, dtype=np.uint8),
                                   pkt, length, n)
                     raise

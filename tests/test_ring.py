@@ -302,6 +302,55 @@ class TestRingEngine:
 
 
 
+    def test_pipelined_dispatch_failure_fails_closed(self, ring_cls):
+        """Dispatch dying mid-pipeline: the previous batch's verdicts
+        still apply (FIFO retire first), the new window closes via DROP,
+        and the ring stays fully usable (code-review r3 finding)."""
+        from bng_tpu.control import dhcp_codec, packets
+
+        ring = ring_cls(nframes=64, frame_size=1024, depth=32)
+        engine, server = self._stack(ring)
+        mac = bytes.fromhex("02c0ffee0011")
+
+        def discover(xid):
+            p = dhcp_codec.build_request(mac, dhcp_codec.DISCOVER, xid=xid)
+            p.options.append((dhcp_codec.OPT_PARAM_REQ_LIST,
+                              bytes([1, 3, 6, 51, 54])))
+            return packets.udp_packet(mac, b"\xff" * 6, 0, 0xFFFFFFFF, 68, 67,
+                                      p.encode().ljust(320, b"\x00"))
+
+        ring.rx_push(discover(1), from_access=True)
+        assert engine.process_ring_pipelined(ring) == 0  # batch A in flight
+
+        real_dispatch = engine._dispatch_step
+
+        def boom(*a, **k):
+            raise RuntimeError("synthetic device error")
+
+        engine._dispatch_step = boom
+        ring.rx_push(discover(2), from_access=True)
+        import pytest as _pytest
+
+        with _pytest.raises(RuntimeError, match="synthetic"):
+            engine.process_ring_pipelined(ring)  # batch B dispatch dies
+        engine._dispatch_step = real_dispatch
+
+        # batch A's OFFER still arrived (retired before the fail-close)
+        got = ring.tx_pop()
+        assert got is not None
+        assert dhcp_codec.decode(
+            packets.decode(got[0]).payload).msg_type == dhcp_codec.OFFER
+        # batch B was dropped fail-closed; no window leaked: ring drives on
+        assert engine._inflight is None
+        ring.rx_push(discover(3), from_access=True)
+        assert engine.process_ring_pipelined(ring) == 0
+        assert engine.flush_pipeline() == 1
+        assert ring.tx_pop() is not None  # DISCOVER #3 answered
+        assert ring.free_frames() > 0
+        ring.close()
+
+
+
 class TestFillPoolConcurrency:
     """The fill pool is MPMC (Vyukov per-slot sequences): wire, engine and
     slow-path threads all alloc/free frames concurrently (round-1 ADVICE:
