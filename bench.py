@@ -17,6 +17,7 @@ vs_baseline: the north star is >=100 Mpps on a v5e-8 (BASELINE.md) =
   3 QoS token bucket, 10k subscribers            [Mpps]
   4 PPPoE + QinQ encap/decap batch               [Mpps]
   5 Full sharded pipeline over all devices       [Mpps]
+  6 DHCP fast path standalone, 1M subscribers    [Mpps] (diagnostic)
 
 Env knobs: BNG_BENCH_BATCH, BNG_BENCH_STEPS, BNG_BENCH_SUBS, BNG_BENCH_FLOWS.
 """
@@ -34,6 +35,40 @@ import numpy as np
 
 def _mark(msg: str) -> None:
     print(f"[bench {time.strftime('%H:%M:%S')}] {msg}", file=sys.stderr, flush=True)
+
+
+
+def _build_dhcp_tables(N: int, now: int, stash: int = 256):
+    """Subscriber fastpath tables at scale + the MAC array (shared by the
+    headline and config 6 — one copy of the sizing/pool/bulk rules)."""
+    from bng_tpu.runtime.tables import FastPathTables
+    from bng_tpu.utils.net import ip_to_u32
+
+    sub_nb = 1 << max(10, (N * 2 // 4).bit_length())  # ~50% load, 4-way
+    fp = FastPathTables(sub_nbuckets=sub_nb, vlan_nbuckets=1 << 10,
+                        cid_nbuckets=1 << 10, max_pools=64, stash=stash)
+    fp.set_server_config(bytes.fromhex("02aabbccdd01"), ip_to_u32("10.0.0.1"))
+    for pid in range(max(1, (N >> 16) + 1)):  # /16 pools to hold N addresses
+        fp.add_pool(pid + 1, ip_to_u32(f"10.{pid}.0.0") & 0xFFFF0000, 16,
+                    ip_to_u32("10.0.0.1"), ip_to_u32("1.1.1.1"),
+                    ip_to_u32("8.8.8.8"), 86400)
+    macs = np.arange(N, dtype=np.uint64) + 0x02AA00000000
+    idx = np.arange(N, dtype=np.uint64)
+    fp.add_subscribers_bulk(
+        macs, pool_ids=(idx >> np.uint64(16)).astype(np.uint32) + 1,
+        ips=((10 << 24) + 2 + idx).astype(np.uint32),
+        lease_expiries=np.uint32(now + 86400))
+    return fp, macs, sub_nb
+
+
+def _discover_row(mac_u64: int, xid: int) -> bytes:
+    from bng_tpu.control import dhcp_codec, packets
+
+    mac = int(mac_u64).to_bytes(8, "big")[2:]
+    p = dhcp_codec.build_request(mac, dhcp_codec.DISCOVER, xid=xid)
+    p.options.append((dhcp_codec.OPT_PARAM_REQ_LIST, bytes([1, 3, 6, 51, 54])))
+    return packets.udp_packet(mac, b"\xff" * 6, 0, 0xFFFFFFFF, 68, 67,
+                              p.encode().ljust(300, b"\x00"))
 
 
 def main(on_tpu: bool) -> None:
@@ -58,25 +93,8 @@ def main(on_tpu: bool) -> None:
     now = 1_753_000_000
 
     t_setup = time.time()
-    # ---- tables at scale ----
-    sub_nb = 1 << max(10, (N_SUBS * 2 // 4).bit_length())  # ~50% load, 4-way
-    fp = FastPathTables(sub_nbuckets=sub_nb, vlan_nbuckets=1 << 10,
-                        cid_nbuckets=1 << 10, max_pools=64, stash=256)
-    fp.set_server_config(bytes.fromhex("02aabbccdd01"), ip_to_u32("10.0.0.1"))
-    # /16 pools to hold N_SUBS addresses
-    n_pools = max(1, (N_SUBS >> 16) + 1)
-    for pid in range(n_pools):
-        fp.add_pool(pid + 1, ip_to_u32(f"10.{pid}.0.0") & 0xFFFF0000, 16,
-                    ip_to_u32("10.0.0.1"), ip_to_u32("1.1.1.1"),
-                    ip_to_u32("8.8.8.8"), 86400)
-
-    macs = np.arange(N_SUBS, dtype=np.uint64) + 0x02AA00000000
     _mark(f"bulk-inserting {N_SUBS} subscribers...")
-    idx = np.arange(N_SUBS, dtype=np.uint64)
-    fp.add_subscribers_bulk(
-        macs, pool_ids=(idx >> np.uint64(16)).astype(np.uint32) + 1,
-        ips=((10 << 24) + 2 + idx).astype(np.uint32),
-        lease_expiries=np.uint32(now + 86400))
+    fp, macs, sub_nb = _build_dhcp_tables(N_SUBS, now)
 
     n_nat_subs = min(N_SUBS, max(1, N_FLOWS // 4))  # ~4 flows per subscriber
     _mark(f"bulk-creating {N_FLOWS} NAT flows for {n_nat_subs} subscribers...")
@@ -102,13 +120,7 @@ def main(on_tpu: bool) -> None:
     n_dhcp = B // 5
     for row in range(B):
         if row < n_dhcp:
-            i = int(rng.integers(N_SUBS))
-            mac = int(macs[i]).to_bytes(8, "big")[2:]
-            p = dhcp_codec.build_request(mac, dhcp_codec.DISCOVER,
-                                         xid=0x1000 + row)
-            p.options.append((dhcp_codec.OPT_PARAM_REQ_LIST, bytes([1, 3, 6, 51, 54])))
-            f = packets.udp_packet(mac, b"\xff" * 6, 0, 0xFFFFFFFF, 68, 67,
-                                   p.encode().ljust(300, b"\x00"))
+            f = _discover_row(macs[int(rng.integers(N_SUBS))], 0x1000 + row)
         else:
             src_ip, dst_ip, sport = (int(x) for x in flows[int(rng.integers(len(flows)))])
             f = packets.udp_packet(b"\x02" * 6, b"\x04" * 6, src_ip, dst_ip,
@@ -572,6 +584,59 @@ def config4_pppoe(on_tpu):
           compile_s=round(cs, 1))
 
 
+def config6_dhcp_fastpath(on_tpu):
+    """Diagnostic: the device DHCP fast path STANDALONE at headline scale
+    (parse + 3-tier lookup + OFFER compose, no NAT/QoS/antispoof).
+
+    Never measured in isolation before round 3 — if its probe carries the
+    narrow-gather pathology at the full table size (PERF_NOTES §2), this
+    config names it without the rest of the pipeline in the way.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from bng_tpu.ops.dhcp import ST_HIT, dhcp_fastpath
+    from bng_tpu.ops.parse import parse_batch
+
+    B = int(os.environ.get("BNG_BENCH_BATCH", 8192 if on_tpu else 256))
+    STEPS = int(os.environ.get("BNG_BENCH_STEPS", 100 if on_tpu else 5))
+    N = int(os.environ.get("BNG_BENCH_SUBS", 1_000_000 if on_tpu else 2_000))
+    now = 1_753_000_000
+    L = 512
+
+    _mark(f"config6: bulk-inserting {N} subscribers...")
+    fp, macs, _ = _build_dhcp_tables(N, now)
+    tables = fp.device_tables()
+
+    rng = np.random.default_rng(21)
+    pkt = np.zeros((B, L), dtype=np.uint8)
+    length = np.zeros((B,), dtype=np.uint32)
+    for row in range(B):
+        f = _discover_row(macs[int(rng.integers(N))], row + 1)
+        pkt[row, : len(f)] = np.frombuffer(f, dtype=np.uint8)
+        length[row] = len(f)
+    pkt_d = jax.device_put(jnp.asarray(pkt))
+    len_d = jax.device_put(jnp.asarray(length))
+
+    @jax.jit
+    def step(tables, pkt, ln):
+        par = parse_batch(pkt, ln)
+        res = dhcp_fastpath(pkt, ln, par, tables, fp.geom, jnp.uint32(now))
+        # out_pkt MUST be an output or XLA DCEs the OFFER compose —
+        # the very work this diagnostic exists to measure
+        return res.is_reply, res.out_pkt, res.out_len, res.stats
+
+    # sanity: every DISCOVER must hit, or this benchmarks the miss path
+    is_reply, _, _, stats = jax.block_until_ready(step(tables, pkt_d, len_d))
+    hit_rate = float(np.asarray(is_reply).sum()) / B
+    assert hit_rate > 0.99, f"fastpath hit rate {hit_rate} — table build broken"
+
+    mpps, p50, p99, cs = _timed_loop(step, (tables, pkt_d, len_d), STEPS, B)
+    _emit("DHCP fastpath Mpps standalone (config 6)", mpps, "Mpps", 12.5,
+          batch=B, subscribers=N, hit_rate=round(hit_rate, 4),
+          p50_us=round(p50, 1), p99_us=round(p99, 1), compile_s=round(cs, 1))
+
+
 def config5_sharded(on_tpu):
     """BASELINE config 5: full pipeline sharded over every visible device."""
     import jax
@@ -635,6 +700,7 @@ _CONFIG_METRICS = {
     3: ("QoS token-bucket Mpps @10k subs (config 3)", "Mpps"),
     4: ("PPPoE+QinQ decap Mpps (config 4)", "Mpps"),
     5: ("Sharded DHCP Mpps (config 5)", "Mpps"),
+    6: ("DHCP fastpath Mpps standalone (config 6)", "Mpps"),
 }
 
 
@@ -708,6 +774,8 @@ def _child_dispatch(config: int, verify_lowering: bool = False) -> None:
             config4_pppoe(on_tpu)
         elif config == 5:
             config5_sharded(on_tpu)
+        elif config == 6:
+            config6_dhcp_fastpath(on_tpu)
         else:
             if on_tpu and os.environ.get("BNG_SKIP_LOWERING_GATE") != "1":
                 _run_lowering_gate(strict=False)
@@ -736,7 +804,7 @@ def main_dispatch() -> None:
 
     ap = argparse.ArgumentParser()
     ap.add_argument("--config", type=int, default=0,
-                    help="BASELINE.json config number (1-5); 0 = headline mix")
+                    help="BASELINE.json config number (1-6); 0 = headline mix")
     ap.add_argument("--verify-lowering", action="store_true",
                     help="run the TPU-lowering gate only (CI pre-step; rc=1 on failure)")
     args = ap.parse_args()
