@@ -79,7 +79,8 @@ def _check_pipeline() -> None:
     fp = FastPathTables(sub_nbuckets=1 << 10, vlan_nbuckets=256,
                         cid_nbuckets=256, max_pools=4, stash=64)
     fp.set_server_config(bytes.fromhex("02aabbccdd01"), ip_to_u32("10.0.0.1"))
-    nat = NATManager(sub_nbuckets=1 << 10)
+    nat = NATManager(public_ips=[ip_to_u32("203.0.113.1")],
+                     sub_nat_nbuckets=1 << 10)
     qos = QoSTables(nbuckets=256)
     spoof = AntispoofTables(nbuckets=256)
     geom = PipelineGeom(dhcp=fp.geom, nat=nat.geom, qos=qos.geom, spoof=spoof.geom)
@@ -115,23 +116,33 @@ def _check_sharded() -> None:
     cl.step(pkt, ln, fa, 1, 1)
 
 
-CHECKS: list[tuple[str, Callable[[], None]]] = [
-    ("qos_kernel[sort]", lambda: _check_qos("sort")),
-    ("qos_kernel[pallas]", lambda: _check_qos("pallas")),
-    ("pallas_seg_prefix_total", _check_pallas_raw),
-    ("fused_pipeline_step", _check_pipeline),
-    ("sharded_step", _check_sharded),
+# (name, check, tpu_only).  tpu_only checks force real Mosaic lowering and
+# cannot run elsewhere; the rest also run on CPU so the *harness itself*
+# (table constructors, kernel signatures) is exercised by the plain test
+# suite — round 3 found the gate broken by NATManager API drift that the
+# auto-skip had hidden.
+CHECKS: list[tuple[str, Callable[[], None], bool]] = [
+    ("qos_kernel[sort]", lambda: _check_qos("sort"), False),
+    ("qos_kernel[pallas]", lambda: _check_qos("pallas"), True),
+    ("pallas_seg_prefix_total", _check_pallas_raw, True),
+    ("fused_pipeline_step", _check_pipeline, False),
+    ("sharded_step", _check_sharded, False),
 ]
 
 
-def verify_tpu_lowering(verbose: bool = True) -> list[tuple[str, str | None]]:
-    """Compile every hot program for the attached TPU.
+def verify_tpu_lowering(verbose: bool = True,
+                        tpu: bool = True) -> list[tuple[str, str | None]]:
+    """Compile every hot program for the attached backend.
 
+    tpu=False (CPU test suite) skips the Mosaic-only checks but still
+    compiles everything else, catching harness/API drift off-hardware.
     Returns [(name, None | error_string)]. Raises nothing; callers decide
     (pytest asserts, bench exits non-zero).
     """
     results: list[tuple[str, str | None]] = []
-    for name, check in CHECKS:
+    for name, check, tpu_only in CHECKS:
+        if tpu_only and not tpu:
+            continue
         try:
             check()
             results.append((name, None))

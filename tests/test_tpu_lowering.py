@@ -12,14 +12,23 @@ import pytest
 
 from bng_tpu.runtime.verify import verify_tpu_lowering
 
-pytestmark = pytest.mark.skipif(
-    jax.default_backend() != "tpu",
-    reason="TPU-lowering gate needs a real TPU target (Mosaic is TPU-only)",
-)
+_ON_TPU = jax.default_backend() == "tpu"
 
 
+@pytest.mark.skipif(not _ON_TPU, reason="Mosaic lowering needs a real TPU")
 def test_all_hot_programs_lower_for_tpu():
     results = verify_tpu_lowering(verbose=True)
     failures = [(n, e) for n, e in results if e is not None]
     assert not failures, "TPU lowering failures:\n" + "\n".join(
+        f"--- {n} ---\n{e}" for n, e in failures)
+
+
+@pytest.mark.skipif(_ON_TPU, reason="redundant on TPU: the full gate runs")
+def test_gate_harness_compiles_on_any_backend():
+    """The non-Mosaic checks must compile everywhere, so harness API drift
+    (round 3: a stale NATManager signature broke the gate itself) is caught
+    by the plain CPU suite, not discovered on the bench chip."""
+    results = verify_tpu_lowering(verbose=False, tpu=False)
+    failures = [(n, e) for n, e in results if e is not None]
+    assert not failures, "gate harness failures:\n" + "\n".join(
         f"--- {n} ---\n{e}" for n, e in failures)
