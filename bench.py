@@ -61,10 +61,10 @@ def _build_dhcp_tables(N: int, now: int, stash: int = 256):
     return fp, macs, sub_nb
 
 
-def _discover_row(mac_u64: int, xid: int) -> bytes:
+def _discover_row(mac_u64: int | bytes, xid: int) -> bytes:
     from bng_tpu.control import dhcp_codec, packets
 
-    mac = int(mac_u64).to_bytes(8, "big")[2:]
+    mac = mac_u64 if isinstance(mac_u64, bytes) else int(mac_u64).to_bytes(8, "big")[2:]
     p = dhcp_codec.build_request(mac, dhcp_codec.DISCOVER, xid=xid)
     p.options.append((dhcp_codec.OPT_PARAM_REQ_LIST, bytes([1, 3, 6, 51, 54])))
     return packets.udp_packet(mac, b"\xff" * 6, 0, 0xFFFFFFFF, 68, 67,
@@ -487,10 +487,16 @@ def config2_nat44(on_tpu):
 
 
 def config3_qos(on_tpu):
-    """BASELINE config 3: per-subscriber token bucket, 10k subscribers."""
+    """BASELINE config 3: per-subscriber token bucket, 10k subscribers.
+
+    Times BOTH same-bucket-aggregation impls (sort path and the Pallas MXU
+    equality-matmul) unless BNG_QOS_PREFIX pins one, emits the winner as
+    the headline value and the loser in the diagnostics — so a round-end
+    unattended run picks the right kernel and records the evidence."""
     import jax
     import jax.numpy as jnp
 
+    import bng_tpu.ops.qos as qos_mod
     from bng_tpu.ops.qos import qos_kernel
     from bng_tpu.runtime.engine import QoSTables
 
@@ -503,20 +509,43 @@ def config3_qos(on_tpu):
     rng = np.random.default_rng(9)
     ips = ((10 << 24) + 2 + rng.integers(0, N, size=B)).astype(np.uint32)
     lens = np.full((B,), 900, dtype=np.uint32)
-    table = qos.up.device_state()
     active = jnp.ones((B,), dtype=bool)
 
-    @functools.partial(jax.jit, donate_argnums=(0,))
-    def step(table, ips, lens):
-        res = qos_kernel(ips, lens, active, table, qos.geom, jnp.uint32(1))
-        return res.table, res.allowed
+    pinned = os.environ.get("BNG_QOS_PREFIX")
+    impls = [pinned] if pinned else (["sort", "pallas"] if on_tpu else ["sort"])
+    results = {}
+    for impl in impls:
+        old = qos_mod.PREFIX_IMPL
+        qos_mod.PREFIX_IMPL = impl
+        try:
+            @functools.partial(jax.jit, donate_argnums=(0,))
+            def step(table, ips, lens):
+                res = qos_kernel(ips, lens, active, table, qos.geom,
+                                 jnp.uint32(1))
+                return res.table, res.allowed
 
-    mpps, p50, p99, cs = _timed_loop(
-        step, (table, jnp.asarray(ips), jnp.asarray(lens)), STEPS, B,
-        carry=True)
+            table = qos.up.device_state()
+            results[impl] = _timed_loop(
+                step, (table, jnp.asarray(ips), jnp.asarray(lens)), STEPS, B,
+                carry=True)
+            _mark(f"config3[{impl}]: {results[impl][0]:.3f} Mpps "
+                  f"(p50 {results[impl][1]:.1f}us)")
+        except Exception as e:  # one impl failing must not sink the other
+            _mark(f"config3[{impl}] failed: {type(e).__name__}: {e}")
+            _DIAG[f"qos_{impl}_error"] = f"{type(e).__name__}: {e}"
+        finally:
+            qos_mod.PREFIX_IMPL = old
+    if not results:
+        raise RuntimeError("both QoS impls failed")
+    best = max(results, key=lambda k: results[k][0])
+    for impl, (mpps, p50, p99, cs) in results.items():
+        if impl != best:
+            _DIAG[f"qos_{impl}_mpps"] = round(mpps, 3)
+            _DIAG[f"qos_{impl}_p50_us"] = round(p50, 1)
+    mpps, p50, p99, cs = results[best]
     _emit("QoS token-bucket Mpps @10k subs (config 3)", mpps, "Mpps", 12.5,
-          batch=B, subscribers=N, p50_us=round(p50, 1), p99_us=round(p99, 1),
-          compile_s=round(cs, 1))
+          batch=B, subscribers=N, impl=best, p50_us=round(p50, 1),
+          p99_us=round(p99, 1), compile_s=round(cs, 1))
 
 
 def config4_pppoe(on_tpu):
@@ -637,7 +666,6 @@ def config5_sharded(on_tpu):
     """BASELINE config 5: full pipeline sharded over every visible device."""
     import jax
 
-    from bng_tpu.control import dhcp_codec, packets
     from bng_tpu.parallel.sharded import ShardedCluster
     from bng_tpu.utils.net import ip_to_u32
 
@@ -665,11 +693,7 @@ def config5_sharded(on_tpu):
     pkt = np.zeros((B, 512), dtype=np.uint8)
     length = np.zeros((B,), dtype=np.uint32)
     for row in range(B):
-        mac = macs[int(rng.integers(len(macs)))]
-        p = dhcp_codec.build_request(mac, dhcp_codec.DISCOVER, xid=0x2000 + row)
-        p.options.append((dhcp_codec.OPT_PARAM_REQ_LIST, bytes([1, 3, 6, 51, 54])))
-        f = packets.udp_packet(mac, b"\xff" * 6, 0, 0xFFFFFFFF, 68, 67,
-                               p.encode().ljust(300, b"\x00"))
+        f = _discover_row(macs[int(rng.integers(len(macs)))], 0x2000 + row)
         pkt[row, : len(f)] = np.frombuffer(f, dtype=np.uint8)
         length[row] = len(f)
     fa = np.ones((B,), dtype=bool)

@@ -29,12 +29,10 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
-from bng_tpu.ops.qtable import QTableGeom, QTableState, qlookup
+from bng_tpu.ops.qtable import QTableGeom, QTableState, qlookup, write_token_rows
 
-# token_bucket value words (parity: qos_ratelimit.c:24-31) — retained as
-# the logical field order; physically the fields live in packed bucket
-# rows + flat token arrays (see ops/qtable.py for the layout rationale)
-(QV_RATE_BPS_LO, QV_RATE_BPS_HI, QV_BURST, QV_TOKENS, QV_LAST_US, QV_PRIORITY) = range(6)
+# token_bucket fields (parity: qos_ratelimit.c:24-31) live in the packed
+# 8-word way rows of ops/qtable.py (policy + token state in one row)
 QOS_WORDS = 8
 
 # stats (parity: struct qos_stats, qos_ratelimit.c:53-58)
@@ -94,15 +92,17 @@ def _prefix_consumed(limited, slot, lens_u, avail):
 
     # ---- sort path ----
     # Narrow (1-word-per-index) gathers are the measured TPU pathology
-    # (PERF_NOTES.md §2), so the permutation moves ONE packed [B,4] row
-    # per lane instead of four scalar gathers, and the unsort is ONE
-    # packed row scatter instead of an inverse-permutation + three
-    # gathers. tests/test_hlo_structure.py pins these counts.
+    # (PERF_NOTES.md §2; >=8-word rows gather at full speed), so the
+    # permutation moves ONE packed [B,8] row per lane instead of four
+    # scalar gathers, and the unsort is ONE packed row scatter instead of
+    # an inverse-permutation + three gathers. tests/test_hlo_structure.py
+    # pins these counts.
     order = jnp.argsort(slot_eff, stable=True)
     avail_int = jnp.clip(avail, 0.0, 4.0e9).astype(jnp.uint32)
+    zero = jnp.zeros_like(lens_u)
     packed = jnp.stack(
         [slot_eff.astype(jnp.uint32), lens_u, avail_int,
-         limited.astype(jnp.uint32)], axis=1)  # [B, 4]
+         limited.astype(jnp.uint32), zero, zero, zero, zero], axis=1)  # [B, 8]
     ps = packed[order]
     s_sorted = ps[:, 0].astype(jnp.int32)
     lens_sorted = ps[:, 1]
@@ -131,10 +131,12 @@ def _prefix_consumed(limited, slot, lens_u, avail):
         jnp.where(is_head_sorted, adm_csum - admitted_sorted, 0))
     consumed_sorted = seg_end - adm_base
 
+    zs = jnp.zeros_like(consumed_sorted)
     res_sorted = jnp.stack(
         [allowed_sorted.astype(jnp.uint32), consumed_sorted,
-         (is_head_sorted & limited_sorted).astype(jnp.uint32)], axis=1)
-    res = jnp.zeros((Bsz, 3), dtype=jnp.uint32).at[order].set(res_sorted)
+         (is_head_sorted & limited_sorted).astype(jnp.uint32),
+         zs, zs, zs, zs, zs], axis=1)  # [B, 8] — wide unsort scatter
+    res = jnp.zeros((Bsz, 8), dtype=jnp.uint32).at[order].set(res_sorted)
     return (res[:, 0] != 0,
             res[:, 1].astype(jnp.float32),
             (res[:, 2] != 0) & limited)
@@ -186,11 +188,11 @@ def qos_kernel(
     allowed, consumed, first = _prefix_consumed(limited, res.slot, lens_u, avail)
     dropped = limited & ~allowed
     new_tokens = jnp.clip(avail - consumed, 0.0, burst_f)
-    S = table.tokens.shape[0]
+    S = table.rows.shape[0]
     wslot = jnp.where(first, res.slot, S).astype(jnp.int32)
-    tokens = table.tokens.at[wslot].set(new_tokens, mode="drop")
-    last_us = table.last_us.at[wslot].set(
-        jnp.broadcast_to(now_us, (Bsz,)).astype(jnp.uint32), mode="drop")
+    # head lanes rewrite their whole way row (one wide [B,8] scatter —
+    # no scalar token/timestamp scatters; see qtable.write_token_rows)
+    new_table = write_token_rows(table, wslot, res.row, new_tokens, now_us)
 
     priority = jnp.where(has_policy, res.priority, 0)
 
@@ -205,6 +207,6 @@ def qos_kernel(
         allowed=allowed,
         dropped=dropped,
         priority=priority,
-        table=table._replace(tokens=tokens, last_us=last_us),
+        table=new_table,
         stats=stats,
     )

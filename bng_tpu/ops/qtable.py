@@ -9,20 +9,24 @@ and occupancy as [S]. For the QoS table K=1, so a probe compiles to many
 That one layout artifact made the QoS kernel the bottleneck of the whole
 dataplane (VERDICT r2: 0.114 Mpps standalone, 65ms fixed cost).
 
-So the QoS table packs each 4-way bucket into ONE 32-word row:
+So the QoS table is **way-granular**: every 4-way bucket is four
+consecutive 8-word rows, and ALL of a subscriber's state — policy AND
+mutable token state — lives in its one row:
 
-    rows[nbuckets, 32] u32 —  way-major, 8 words per way:
+    rows[nbuckets*4, 8] u32:
         +0 key (subscriber ip)   +1 flags (bit0 = used)
-        +2 rate_lo  +3 rate_hi   +4 burst  +5 priority  +6/+7 pad
+        +2 rate_lo  +3 rate_hi   +4 burst  +5 priority
+        +6 tokens (f32 bitcast)  +7 last_us
 
-A lookup is exactly two [B, 32] row gathers (bucket 1, bucket 2) plus
-branch-free lane compares — the narrow-gather shape never appears.
-Mutable token state lives beside it in flat arrays (device-authoritative,
-written by the QoS kernel's scatter):
+A lookup is exactly two [B, 32] row gathers (rows viewed [nbuckets, 32]:
+bucket 1, bucket 2) plus branch-free lane compares — tokens included, no
+separate narrow token gather. The QoS kernel's token writeback is ONE
+wide [B, 8] row scatter (the head lane of each bucket rewrites its whole
+way row: policy words unchanged, +6/+7 updated). Host policy sync is a
+wide [U, 8] row scatter at changed slots only, so sibling ways' device-
+authoritative tokens are never touched by an update.
 
-    tokens[nbuckets*4] f32, last_us[nbuckets*4] u32
-
-Parity: the packed row carries the same fields as the reference's
+Parity: the row carries the same fields as the reference's
 ``struct token_bucket`` (bpf/qos_ratelimit.c:24-31); the host mirror
 plays pkg/qos/manager.go's role (install/remove policies, single writer).
 Cuckoo relocation happens host-side exactly like ops/table.py; a
@@ -43,36 +47,39 @@ import jax.numpy as jnp
 from bng_tpu.ops.hashing import SEED1, SEED2, hash_words
 
 WAYS = 4
-SLOT_W = 8  # words per way in the packed row
-ROW_W = WAYS * SLOT_W  # 32
+SLOT_W = 8  # words per way row
+ROW_W = WAYS * SLOT_W  # 32 — the probe gather width
 MAX_KICKS = 128
 
-# word offsets within a way's 8-word slice
-(QW_KEY, QW_FLAGS, QW_RATE_LO, QW_RATE_HI, QW_BURST, QW_PRIORITY) = range(6)
+# word offsets within a way row
+(QW_KEY, QW_FLAGS, QW_RATE_LO, QW_RATE_HI, QW_BURST, QW_PRIORITY,
+ QW_TOKENS, QW_LAST_US) = range(8)
 FLAG_USED = np.uint32(1)
 
 
-class QTableState(NamedTuple):
-    """Device arrays (a pytree; rows are host-written, tokens device-written)."""
+def _f2u(v: float) -> int:
+    return int(np.array(v, dtype=np.float32).view(np.uint32))
 
-    rows: jax.Array  # [NB, 32] uint32 packed policy rows
-    tokens: jax.Array  # [NB*4] float32 current tokens
-    last_us: jax.Array  # [NB*4] uint32 last refill timestamp
+
+def _u2f(u: int) -> float:
+    return float(np.array(u, dtype=np.uint32).view(np.float32))
+
+
+class QTableState(NamedTuple):
+    """Device array (a pytree of one leaf; host writes policy rows, the
+    QoS kernel writes token state — both as wide row scatters)."""
+
+    rows: jax.Array  # [NB*4, 8] uint32 packed way rows
 
 
 class QTableUpdate(NamedTuple):
-    """Bounded dirty-bucket scatter (host -> device policy sync).
+    """Bounded dirty-slot scatter (host -> device policy sync).
 
-    bidx >= NB rows are dropped padding. Token/timestamp writes apply only
-    to `slot` (the slot whose policy changed); sibling ways keep their
-    device-side token state.
-    """
+    slot >= NB*4 rows are dropped padding. Only changed slots are written,
+    so sibling ways keep their device-side token state untouched."""
 
-    bidx: jax.Array  # [U] int32 bucket index
-    rows: jax.Array  # [U, 32] uint32 full replacement rows
-    slot: jax.Array  # [U, WAYS] int32 global slots to re-seed, or >=NB*4 (skip)
-    tokens: jax.Array  # [U, WAYS] float32
-    last_us: jax.Array  # [U, WAYS] uint32
+    slot: jax.Array  # [U] int32 global slot indices
+    rows: jax.Array  # [U, 8] uint32 full replacement way rows
 
 
 class QTableGeom(NamedTuple):
@@ -88,6 +95,7 @@ class QTableGeom(NamedTuple):
 class QLookup(NamedTuple):
     found: jax.Array  # [B] bool
     slot: jax.Array  # [B] int32 global slot (valid where found)
+    row: jax.Array  # [B, 8] uint32 the selected way row (stale where not found)
     rate_lo: jax.Array  # [B] uint32
     rate_hi: jax.Array  # [B] uint32
     burst: jax.Array  # [B] uint32
@@ -97,12 +105,8 @@ class QLookup(NamedTuple):
 
 
 def apply_qupdate(state: QTableState, upd: QTableUpdate) -> QTableState:
-    """Scatter dirty buckets + changed-slot token resets (inside jit)."""
-    return QTableState(
-        rows=state.rows.at[upd.bidx].set(upd.rows, mode="drop"),
-        tokens=state.tokens.at[upd.slot].set(upd.tokens, mode="drop"),
-        last_us=state.last_us.at[upd.slot].set(upd.last_us, mode="drop"),
-    )
+    """Scatter dirty way rows (inside jit) — one wide row scatter."""
+    return QTableState(rows=state.rows.at[upd.slot].set(upd.rows, mode="drop"))
 
 
 def qlookup(state: QTableState, ip: jax.Array, g: QTableGeom) -> QLookup:
@@ -115,8 +119,9 @@ def qlookup(state: QTableState, ip: jax.Array, g: QTableGeom) -> QLookup:
     b1 = (hash_words([ip], SEED1) & mask).astype(jnp.int32)
     b2 = (hash_words([ip], SEED2) & mask).astype(jnp.int32)
 
-    r1 = state.rows[b1]  # [B, 32] — the fast gather shape
-    r2 = state.rows[b2]
+    wide = state.rows.reshape(g.nbuckets, ROW_W)
+    r1 = wide[b1]  # [B, 32] — the fast gather shape
+    r2 = wide[b2]
     cand = jnp.concatenate(
         [r1.reshape(Bsz, WAYS, SLOT_W), r2.reshape(Bsz, WAYS, SLOT_W)], axis=1
     )  # [B, 2W, 8]
@@ -126,7 +131,11 @@ def qlookup(state: QTableState, ip: jax.Array, g: QTableGeom) -> QLookup:
     )  # [B, 2W]
     found = jnp.any(match, axis=1)
     first = jnp.argmax(match, axis=1)  # [B] in [0, 2W)
-    sel = jnp.take_along_axis(cand, first[:, None, None], axis=1)[:, 0]  # [B, 8]
+    # way select as a one-hot masked sum (pure VPU) — the take_along_axis
+    # form lowered to a 65µs in-context gather on v5e (PERF_NOTES §2)
+    onehot = jnp.arange(2 * WAYS, dtype=jnp.int32)[None, :] == first[:, None]
+    sel = jnp.sum(jnp.where(onehot[:, :, None], cand, 0), axis=1,
+                  dtype=jnp.uint32)  # [B, 8]
 
     bucket = jnp.where(first < WAYS, b1, b2)
     slot = bucket * WAYS + (first % WAYS)
@@ -134,22 +143,40 @@ def qlookup(state: QTableState, ip: jax.Array, g: QTableGeom) -> QLookup:
     return QLookup(
         found=found,
         slot=slot,
+        row=sel,
         rate_lo=sel[:, QW_RATE_LO],
         rate_hi=sel[:, QW_RATE_HI],
         burst=sel[:, QW_BURST],
         priority=sel[:, QW_PRIORITY],
-        tokens=state.tokens[slot],
-        last_us=state.last_us[slot],
+        tokens=jax.lax.bitcast_convert_type(sel[:, QW_TOKENS], jnp.float32),
+        last_us=sel[:, QW_LAST_US],
     )
+
+
+def write_token_rows(state: QTableState, wslot: jax.Array, row: jax.Array,
+                     tokens: jax.Array, now_us: jax.Array) -> QTableState:
+    """Device-side token writeback: head lanes rewrite their way row with
+    updated +6/+7 — one wide [B, 8] row scatter, no scalar scatters.
+
+    wslot: [B] int32, >= NB*4 where the lane must not write (dropped).
+    row: [B, 8] the looked-up way rows (policy words are rewritten with
+    the values read this same step — the host applies updates between
+    steps, so the sequencing is linear and nothing can be clobbered).
+    """
+    Bsz = wslot.shape[0]
+    tok_u = jax.lax.bitcast_convert_type(tokens.astype(jnp.float32), jnp.uint32)
+    now_b = jnp.broadcast_to(now_us, (Bsz,)).astype(jnp.uint32)
+    new_row = jnp.concatenate(
+        [row[:, :QW_TOKENS], tok_u[:, None], now_b[:, None]], axis=1)
+    return QTableState(rows=state.rows.at[wslot].set(new_row, mode="drop"))
 
 
 class HostQTable:
     """Host-authoritative mirror (numpy, single writer) of one QoS table.
 
     Same role as ops/table.py:HostTable (pkg/ebpf loader map-CRUD), with
-    bucket-granular dirty tracking: a policy change marks its bucket dirty
-    and the whole 32-word row is rescattered (policy data is tiny and
-    host-owned); token state is re-seeded only for the changed slot.
+    slot-granular dirty tracking: a policy change marks its way row dirty
+    and the whole 8-word row (config + re-seeded tokens) is rescattered.
     """
 
     def __init__(self, nbuckets: int, name: str = ""):
@@ -158,12 +185,9 @@ class HostQTable:
         self.nbuckets = nbuckets
         self.S = nbuckets * WAYS
         self.name = name
-        self.rows = np.zeros((nbuckets, ROW_W), dtype=np.uint32)
-        self.tokens = np.zeros((self.S,), dtype=np.float32)
-        self.last_us = np.zeros((self.S,), dtype=np.uint32)
+        self.rows = np.zeros((self.S, SLOT_W), dtype=np.uint32)
         self.count = 0
-        # dirty buckets; value = set of slots whose tokens must be re-seeded
-        self._dirty: dict[int, set[int]] = {}
+        self._dirty: set[int] = set()
         self._dirty_all = False
         self._rng = np.random.default_rng(0xB46)
 
@@ -173,31 +197,27 @@ class HostQTable:
         m = np.uint32(self.nbuckets - 1)
         return int((hash_words([k], SEED1) & m)[0]), int((hash_words([k], SEED2) & m)[0])
 
-    def _way(self, b: int, w: int) -> np.ndarray:
-        return self.rows[b, w * SLOT_W : (w + 1) * SLOT_W]
-
-    def _find(self, ip: int) -> tuple[int, int] | None:
+    def _find(self, ip: int) -> int | None:
         b1, b2 = self._buckets(ip)
         for b in (b1, b2):
             for w in range(WAYS):
-                s = self._way(b, w)
+                s = self.rows[b * WAYS + w]
                 if (s[QW_FLAGS] & 1) and int(s[QW_KEY]) == (ip & 0xFFFFFFFF):
-                    return b, w
+                    return b * WAYS + w
         return None
 
-    def _place(self, b: int, w: int, ip: int, rate_bps: int, burst: int,
+    def _place(self, slot: int, ip: int, rate_bps: int, burst: int,
                priority: int, start_full: bool) -> int:
-        s = self._way(b, w)
+        s = self.rows[slot]
         s[QW_KEY] = ip & 0xFFFFFFFF
         s[QW_FLAGS] = 1
         s[QW_RATE_LO] = rate_bps & 0xFFFFFFFF
         s[QW_RATE_HI] = (rate_bps >> 32) & 0xFFFFFFFF
         s[QW_BURST] = burst
         s[QW_PRIORITY] = priority
-        slot = b * WAYS + w
-        self.tokens[slot] = float(burst if start_full else 0)
-        self.last_us[slot] = 0
-        self._dirty.setdefault(b, set()).add(slot)
+        s[QW_TOKENS] = _f2u(float(burst if start_full else 0))
+        s[QW_LAST_US] = 0
+        self._dirty.add(slot)
         return slot
 
     def insert(self, ip: int, rate_bps: int, burst: int, priority: int = 0,
@@ -205,65 +225,58 @@ class HostQTable:
         """Install or update a policy. Returns the global slot index."""
         hit = self._find(ip)
         if hit is not None:  # update config in place; re-seed tokens
-            b, w = hit
-            return self._place(b, w, ip, rate_bps, burst, priority, start_full)
+            return self._place(hit, ip, rate_bps, burst, priority, start_full)
 
         cur = (ip, rate_bps, burst, priority, start_full)
-        moves: list[tuple[int, int, np.ndarray, float, int]] = []
+        moves: list[tuple[int, np.ndarray]] = []
         for _ in range(MAX_KICKS):
             b1, b2 = self._buckets(cur[0])
             for b in (b1, b2):
                 for w in range(WAYS):
-                    if not (self._way(b, w)[QW_FLAGS] & 1):
-                        self._place(b, w, *cur)
+                    if not (self.rows[b * WAYS + w][QW_FLAGS] & 1):
+                        self._place(b * WAYS + w, *cur)
                         self.count += 1
                         hit = self._find(ip)
                         assert hit is not None
-                        return hit[0] * WAYS + hit[1]
+                        return hit
             # both buckets full -> evict a random way; relocated entries
             # refill to full burst (host can't read device tokens)
             b = b1 if self._rng.integers(2) == 0 else b2
             w = int(self._rng.integers(WAYS))
-            s = self._way(b, w).copy()
             slot = b * WAYS + w
-            moves.append((b, w, s, float(self.tokens[slot]), int(self.last_us[slot])))
+            s = self.rows[slot].copy()
+            moves.append((slot, s))
             ev_rate = int(s[QW_RATE_LO]) | (int(s[QW_RATE_HI]) << 32)
-            self._place(b, w, *cur)
+            self._place(slot, *cur)
             cur = (int(s[QW_KEY]), ev_rate, int(s[QW_BURST]), int(s[QW_PRIORITY]), True)
 
-        for b, w, s, tok, last in reversed(moves):  # roll back, keep old entries
-            self.rows[b, w * SLOT_W : (w + 1) * SLOT_W] = s
-            self.tokens[b * WAYS + w] = tok
-            self.last_us[b * WAYS + w] = last
-            self._dirty.setdefault(b, set()).add(b * WAYS + w)
+        for slot, s in reversed(moves):  # roll back, keep old entries
+            self.rows[slot] = s
+            self._dirty.add(slot)
         raise RuntimeError(
             f"qos table {self.name!r} full (count={self.count}, "
             f"nbuckets={self.nbuckets}); size buckets >= subscribers/2")
 
     def delete(self, ip: int) -> bool:
-        hit = self._find(ip)
-        if hit is None:
+        slot = self._find(ip)
+        if slot is None:
             return False
-        b, w = hit
-        self._way(b, w)[:] = 0
-        self.tokens[b * WAYS + w] = 0.0
-        self.last_us[b * WAYS + w] = 0
+        self.rows[slot] = 0
         self.count -= 1
-        self._dirty.setdefault(b, set()).add(b * WAYS + w)
+        self._dirty.add(slot)
         return True
 
     def lookup(self, ip: int) -> dict | None:
-        hit = self._find(ip)
-        if hit is None:
+        slot = self._find(ip)
+        if slot is None:
             return None
-        b, w = hit
-        s = self._way(b, w)
+        s = self.rows[slot]
         return {
-            "slot": b * WAYS + w,
+            "slot": slot,
             "rate_bps": int(s[QW_RATE_LO]) | (int(s[QW_RATE_HI]) << 32),
             "burst": int(s[QW_BURST]),
             "priority": int(s[QW_PRIORITY]),
-            "tokens": float(self.tokens[b * WAYS + w]),
+            "tokens": _u2f(int(s[QW_TOKENS])),
         }
 
     def bulk_insert(self, ips: np.ndarray, rates_bps: np.ndarray,
@@ -283,7 +296,7 @@ class HostQTable:
         b1 = (hash_words([ips], SEED1) & m).astype(np.int64)
         b2 = (hash_words([ips], SEED2) & m).astype(np.int64)
 
-        flags = self.rows[:, QW_FLAGS::SLOT_W]  # [NB, WAYS] view
+        flags = self.rows[:, QW_FLAGS].reshape(self.nbuckets, WAYS)
         unplaced = np.ones((n,), dtype=bool)
         for side in (b1, b2):
             for w in range(WAYS):
@@ -297,21 +310,21 @@ class HostQTable:
                     continue
                 uq_b, firsti = np.unique(bb, return_index=True)
                 take = idxs[firsti]
-                base = w * SLOT_W
-                self.rows[uq_b, base + QW_KEY] = ips[take]
-                self.rows[uq_b, base + QW_FLAGS] = 1
-                self.rows[uq_b, base + QW_RATE_LO] = (rates[take] & 0xFFFFFFFF).astype(np.uint32)
-                self.rows[uq_b, base + QW_RATE_HI] = (rates[take] >> 32).astype(np.uint32)
-                self.rows[uq_b, base + QW_BURST] = bursts[take]
-                self.rows[uq_b, base + QW_PRIORITY] = prios[take]
                 slots = uq_b * WAYS + w
-                self.tokens[slots] = bursts[take].astype(np.float32) if start_full else 0.0
-                self.last_us[slots] = 0
+                self.rows[slots, QW_KEY] = ips[take]
+                self.rows[slots, QW_FLAGS] = 1
+                self.rows[slots, QW_RATE_LO] = (rates[take] & 0xFFFFFFFF).astype(np.uint32)
+                self.rows[slots, QW_RATE_HI] = (rates[take] >> 32).astype(np.uint32)
+                self.rows[slots, QW_BURST] = bursts[take]
+                self.rows[slots, QW_PRIORITY] = prios[take]
+                self.rows[slots, QW_TOKENS] = (
+                    bursts[take].astype(np.float32).view(np.uint32)
+                    if start_full else _f2u(0.0))
+                self.rows[slots, QW_LAST_US] = 0
                 unplaced[take] = False
                 self.count += len(take)
                 if n <= 256:  # small batches stay on the bounded-delta path
-                    for bkt, s in zip(uq_b, slots):
-                        self._dirty.setdefault(int(bkt), set()).add(int(s))
+                    self._dirty.update(int(s) for s in slots)
 
         for i in np.nonzero(unplaced)[0]:  # cuckoo-kick residue
             self.insert(int(ips[i]), int(rates[i]), int(bursts[i]), int(prios[i]),
@@ -325,40 +338,24 @@ class HostQTable:
     def device_state(self) -> QTableState:
         self._dirty.clear()
         self._dirty_all = False
-        return QTableState(
-            rows=jnp.asarray(self.rows),
-            tokens=jnp.asarray(self.tokens),
-            last_us=jnp.asarray(self.last_us),
-        )
+        return QTableState(rows=jnp.asarray(self.rows))
 
     def dirty_count(self) -> int:
-        return self.nbuckets if self._dirty_all else len(self._dirty)
+        return self.S if self._dirty_all else len(self._dirty)
 
-    def make_update(self, max_buckets: int) -> QTableUpdate:
-        """Drain up to max_buckets dirty buckets (bounded host->HBM traffic)."""
+    def make_update(self, max_slots: int) -> QTableUpdate:
+        """Drain up to max_slots dirty way rows (bounded host->HBM traffic)."""
         if self._dirty_all:
             raise RuntimeError(
                 f"qos table {self.name!r}: bulk_insert invalidated delta sync; "
                 "call device_state() for a full upload first")
-        take = sorted(self._dirty)[:max_buckets]
-        slot_sets = [self._dirty.pop(b) for b in take]
+        take = sorted(self._dirty)[:max_slots]
+        self._dirty.difference_update(take)
         n = len(take)
-        bidx = np.full((max_buckets,), self.nbuckets, dtype=np.int32)
-        rows = np.zeros((max_buckets, ROW_W), dtype=np.uint32)
-        slot = np.full((max_buckets, WAYS), self.S, dtype=np.int32)
-        tok = np.zeros((max_buckets, WAYS), dtype=np.float32)
-        last = np.zeros((max_buckets, WAYS), dtype=np.uint32)
+        slot = np.full((max_slots,), self.S, dtype=np.int32)
+        rows = np.zeros((max_slots, SLOT_W), dtype=np.uint32)
         if n:
-            bs = np.asarray(take, dtype=np.int32)
-            bidx[:n] = bs
-            rows[:n] = self.rows[bs]
-            for i, ss in enumerate(slot_sets):
-                for j, s in enumerate(sorted(ss)[:WAYS]):
-                    slot[i, j] = s
-                    tok[i, j] = self.tokens[s]
-                    last[i, j] = self.last_us[s]
-        return QTableUpdate(
-            bidx=jnp.asarray(bidx), rows=jnp.asarray(rows),
-            slot=jnp.asarray(slot), tokens=jnp.asarray(tok),
-            last_us=jnp.asarray(last),
-        )
+            ss = np.asarray(take, dtype=np.int32)
+            slot[:n] = ss
+            rows[:n] = self.rows[ss]
+        return QTableUpdate(slot=jnp.asarray(slot), rows=jnp.asarray(rows))

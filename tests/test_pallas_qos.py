@@ -58,7 +58,7 @@ class TestQoSImplParity:
                              jnp.ones((len(ips),), dtype=bool),
                              qos.up.device_state(), qos.geom, jnp.uint32(1))
             return (np.asarray(res.allowed), np.asarray(res.dropped),
-                    np.asarray(res.table.tokens), np.asarray(res.stats))
+                    np.asarray(res.table.rows), np.asarray(res.stats))
         finally:
             qos_mod.PREFIX_IMPL = old
 
