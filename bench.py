@@ -133,6 +133,10 @@ def _pick_qos_impl(on_tpu: bool) -> str:
     ips = ((10 << 24) + 2 + rng.integers(0, 4096, size=B)).astype(np.uint32)
     lens = np.full((B,), 900, dtype=np.uint32)
     timing = _race_qos_impls(qos, ips, lens, 30, ("sort", "pallas"))
+    # the probe ran at its own geometry (B=8192, 2^12 buckets, 30 steps) —
+    # re-key its diagnostics so they cannot read as headline measurements
+    for k in [k for k in _DIAG if k.startswith("qos_")]:
+        _DIAG[f"probe_{k}"] = _DIAG.pop(k)
     if not timing:
         return qos_mod.PREFIX_IMPL  # both probes failed: keep the default
     best = max(timing, key=lambda k: timing[k][0])

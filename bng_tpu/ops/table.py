@@ -38,29 +38,49 @@ WAYS = 4  # slots per bucket; one bucket = one contiguous gather
 MAX_KICKS = 128  # bounded cuckoo eviction walk (host side)
 
 
+def way_stride(key_words: int) -> int:
+    """Words per way in the packed probe rows: key words + used flag,
+    rounded up to a multiple of 8 — narrow (<8-word) gathers serialize at
+    ~7ns/element on v5e while >=8-word row gathers run at full speed
+    (PERF_NOTES §2; same finding drove ops/qtable.py)."""
+    return ((key_words + 1 + 7) // 8) * 8
+
+
 class TableState(NamedTuple):
     """Device-side table arrays (a pytree; all uint32).
 
-    keys: [S, K]  key words; S = nbuckets*WAYS + stash
-    vals: [S, V]  value words
-    used: [S]     1 = occupied, 0 = free
+    The probe data (keys + used) is bucket-packed: one [WAYS*KW]-word row
+    per bucket, KW = way_stride(K), each way carrying its K key words then
+    the used flag at word K. A probe is two wide row gathers — the
+    narrow per-way key/used gathers of rounds 1-2 never appear. The host
+    is the single writer of krows/stash_rows, so updates scatter whole
+    bucket rows with no clobber hazard; vals keeps per-slot granularity
+    because device kernels write it (NAT session accounting).
+
+    krows:      [NB, WAYS*KW]  packed bucket probe rows
+    stash_rows: [stash, KW]    packed stash probe rows
+    vals:       [S, V]         value words; S = NB*WAYS + stash
     """
 
-    keys: jax.Array
+    krows: jax.Array
+    stash_rows: jax.Array
     vals: jax.Array
-    used: jax.Array
 
 
 class TableUpdate(NamedTuple):
-    """A bounded batch of dirty slots to scatter into a TableState.
+    """A bounded batch of dirty rows/slots to scatter into a TableState.
 
-    idx rows >= S (out of bounds) are dropped by the scatter — padding.
+    Index rows >= the target's length are dropped by the scatter (padding).
+    A dirty slot's whole bucket row rides along (the host mirror knows all
+    four ways), value updates stay slot-granular.
     """
 
-    idx: jax.Array  # [U] int32
-    keys: jax.Array  # [U, K] uint32
+    bidx: jax.Array  # [U] int32 bucket indices
+    brows: jax.Array  # [U, WAYS*KW] uint32 replacement bucket rows
+    sidx: jax.Array  # [U] int32 stash-local indices
+    srows: jax.Array  # [U, KW] uint32 replacement stash rows
+    idx: jax.Array  # [U] int32 global slots (val updates)
     vals: jax.Array  # [U, V] uint32
-    used: jax.Array  # [U] uint32
 
 
 class LookupResult(NamedTuple):
@@ -109,11 +129,12 @@ def shard_owner(query_words, n_shards: int):
 
 
 def apply_update(state: TableState, upd: TableUpdate) -> TableState:
-    """Scatter dirty slots into the device table (inside jit, donated)."""
+    """Scatter dirty rows into the device table (inside jit, donated) —
+    three wide row scatters (bucket rows, stash rows, value rows)."""
     return TableState(
-        keys=state.keys.at[upd.idx].set(upd.keys, mode="drop"),
+        krows=state.krows.at[upd.bidx].set(upd.brows, mode="drop"),
+        stash_rows=state.stash_rows.at[upd.sidx].set(upd.srows, mode="drop"),
         vals=state.vals.at[upd.idx].set(upd.vals, mode="drop"),
-        used=state.used.at[upd.idx].set(upd.used, mode="drop"),
     )
 
 
@@ -195,38 +216,36 @@ def sharded_lookup(state: TableState, query: jax.Array, g: TableGeom) -> LookupR
 
 
 def device_lookup(state: TableState, query: jax.Array, nbuckets: int, stash: int) -> LookupResult:
-    """Branch-free batched lookup: 2 bucket gathers + stash broadcast.
+    """Branch-free batched lookup: 2 wide bucket-row gathers + stash
+    broadcast + 1 value-row gather — no narrow gathers anywhere.
 
     query: [B, K] uint32 key words.
     """
     B, K = query.shape
-    V = state.vals.shape[1]
+    KW = state.stash_rows.shape[1]
     words = [query[:, k] for k in range(K)]
     mask = np.uint32(nbuckets - 1)
-    b1 = hash_words(words, SEED1) & mask
-    b2 = hash_words(words, SEED2) & mask
+    b1 = (hash_words(words, SEED1) & mask).astype(jnp.int32)
+    b2 = (hash_words(words, SEED2) & mask).astype(jnp.int32)
 
-    def probe_bucket(b):
-        # slots of bucket b: [B, WAYS]
-        slots = (b[:, None] * WAYS + jnp.arange(WAYS, dtype=b.dtype)).astype(jnp.int32)
-        k = state.keys[slots]  # [B, WAYS, K]
-        u = state.used[slots]  # [B, WAYS]
-        eq = jnp.all(k == query[:, None, :], axis=-1) & (u != 0)
-        return slots, eq
-
-    s1, m1 = probe_bucket(b1)
-    s2, m2 = probe_bucket(b2)
-
-    cand_slots = jnp.concatenate([s1, s2], axis=1)  # [B, 2W]
-    cand_match = jnp.concatenate([m1, m2], axis=1)
+    r1 = state.krows[b1]  # [B, WAYS*KW] — the fast gather shape
+    r2 = state.krows[b2]
+    cand = jnp.concatenate(
+        [r1.reshape(B, WAYS, KW), r2.reshape(B, WAYS, KW)], axis=1
+    )  # [B, 2W, KW]
+    cand_match = jnp.all(cand[:, :, :K] == query[:, None, :], axis=-1) & (
+        cand[:, :, K] != 0
+    )  # [B, 2W]
+    ways = jnp.arange(WAYS, dtype=jnp.int32)[None, :]
+    cand_slots = jnp.concatenate(
+        [b1[:, None] * WAYS + ways, b2[:, None] * WAYS + ways], axis=1
+    )  # [B, 2W]
 
     if stash > 0:
         base = nbuckets * WAYS
-        stash_keys = jax.lax.dynamic_slice_in_dim(state.keys, base, stash, axis=0)
-        stash_used = jax.lax.dynamic_slice_in_dim(state.used, base, stash, axis=0)
-        sm = jnp.all(stash_keys[None, :, :] == query[:, None, :], axis=-1) & (
-            stash_used[None, :] != 0
-        )  # [B, S]
+        sm = jnp.all(state.stash_rows[None, :, :K] == query[:, None, :], axis=-1) & (
+            state.stash_rows[None, :, K] != 0
+        )  # [B, stash]
         s_slots = jnp.broadcast_to(
             base + jnp.arange(stash, dtype=jnp.int32)[None, :], sm.shape
         )
@@ -235,7 +254,10 @@ def device_lookup(state: TableState, query: jax.Array, nbuckets: int, stash: int
 
     found = jnp.any(cand_match, axis=1)
     first = jnp.argmax(cand_match, axis=1)
-    slot = jnp.take_along_axis(cand_slots, first[:, None], axis=1)[:, 0]
+    # slot select as a one-hot masked sum (VPU) — take_along_axis lowers
+    # to an in-context gather (65µs at B=8192, PERF_NOTES §2)
+    onehot = jnp.arange(cand_slots.shape[1], dtype=jnp.int32)[None, :] == first[:, None]
+    slot = jnp.sum(jnp.where(onehot, cand_slots, 0), axis=1)
     vals = jnp.where(found[:, None], state.vals[slot], 0)
     return LookupResult(found=found, slot=slot, vals=vals)
 
@@ -254,6 +276,7 @@ class HostTable:
             raise ValueError("nbuckets must be a power of two")
         self.nbuckets = nbuckets
         self.K = key_words
+        self.KW = way_stride(key_words)
         self.V = val_words
         self.stash = stash
         self.name = name
@@ -428,14 +451,44 @@ class HostTable:
         return True
 
     # -- device synchronization --
+    def _pack_bucket_rows(self, buckets: np.ndarray,
+                          mask_dirty: bool = False) -> np.ndarray:
+        """Packed [len(buckets), WAYS*KW] probe rows from the host mirror.
+
+        mask_dirty=True (partial drains): ways whose slot is STILL dirty
+        get used=0 in the row — a half-drained bucket must not expose a
+        sibling whose value row has not shipped yet (it would read as a
+        hit with stale/zero vals; a temporary miss just takes the slow
+        path, which is the correct conservative behavior)."""
+        nb = len(buckets)
+        rows = np.zeros((nb, WAYS * self.KW), dtype=np.uint32)
+        r3 = rows.reshape(nb, WAYS, self.KW)
+        slots = buckets[:, None] * WAYS + np.arange(WAYS)[None, :]  # [nb, WAYS]
+        r3[:, :, : self.K] = self.keys[slots]
+        used = self.used[slots]
+        if mask_dirty and self._dirty:
+            still_dirty = np.isin(slots, np.fromiter(self._dirty, dtype=np.int64,
+                                                     count=len(self._dirty)))
+            used = np.where(still_dirty, 0, used)
+        r3[:, :, self.K] = used
+        return rows
+
+    def _pack_stash_rows(self, sidx: np.ndarray) -> np.ndarray:
+        """Packed [len(sidx), KW] stash probe rows (sidx is stash-local)."""
+        rows = np.zeros((len(sidx), self.KW), dtype=np.uint32)
+        g = self.nbuckets * WAYS + sidx
+        rows[:, : self.K] = self.keys[g]
+        rows[:, self.K] = self.used[g]
+        return rows
+
     def device_state(self) -> TableState:
         """Full upload (startup / resync)."""
         self._dirty.clear()
         self._dirty_all = False
         return TableState(
-            keys=jnp.asarray(self.keys),
+            krows=jnp.asarray(self._pack_bucket_rows(np.arange(self.nbuckets))),
+            stash_rows=jnp.asarray(self._pack_stash_rows(np.arange(self.stash))),
             vals=jnp.asarray(self.vals),
-            used=jnp.asarray(self.used),
         )
 
     def dirty_count(self) -> int:
@@ -446,27 +499,44 @@ class HostTable:
 
         Remaining dirty slots stay queued for the next batch (bounded
         host->HBM traffic per step, like bounded map-update syscalls).
-        """
+        A drained bucket slot carries its whole (current) bucket row with
+        still-dirty siblings masked used=0 (their vals have not shipped —
+        see _pack_bucket_rows); each sibling rewrites the row on its own
+        drain."""
         if self._dirty_all:
             raise RuntimeError(
                 f"table {self.name!r}: bulk_insert invalidated delta sync; "
                 "call device_state() for a full upload first")
         take = sorted(self._dirty)[:max_slots]
-        for s in take:
-            self._dirty.discard(s)
+        self._dirty.difference_update(take)
+        base = self.nbuckets * WAYS
+        b_take = sorted({s // WAYS for s in take if s < base})
+        s_take = [s - base for s in take if s >= base]
+
+        U = max_slots
+        bidx = np.full((U,), self.nbuckets, dtype=np.int32)  # NB = dropped
+        brows = np.zeros((U, WAYS * self.KW), dtype=np.uint32)
+        sidx = np.full((U,), self.stash, dtype=np.int32)
+        srows = np.zeros((U, self.KW), dtype=np.uint32)
+        idx = np.full((U,), self.S, dtype=np.int32)
+        vv = np.zeros((U, self.V), dtype=np.uint32)
+        if b_take:
+            bs = np.asarray(b_take, dtype=np.int32)
+            bidx[: len(bs)] = bs
+            brows[: len(bs)] = self._pack_bucket_rows(bs, mask_dirty=True)
+        if s_take:
+            ss = np.asarray(s_take, dtype=np.int32)
+            sidx[: len(ss)] = ss
+            srows[: len(ss)] = self._pack_stash_rows(ss)
         n = len(take)
-        idx = np.full((max_slots,), self.S, dtype=np.int32)  # S = dropped
-        kk = np.zeros((max_slots, self.K), dtype=np.uint32)
-        vv = np.zeros((max_slots, self.V), dtype=np.uint32)
-        uu = np.zeros((max_slots,), dtype=np.uint32)
         if n:
             ts = np.asarray(take, dtype=np.int32)
             idx[:n] = ts
-            kk[:n] = self.keys[ts]
             vv[:n] = self.vals[ts]
-            uu[:n] = self.used[ts]
         return TableUpdate(
-            idx=jnp.asarray(idx), keys=jnp.asarray(kk), vals=jnp.asarray(vv), used=jnp.asarray(uu)
+            bidx=jnp.asarray(bidx), brows=jnp.asarray(brows),
+            sidx=jnp.asarray(sidx), srows=jnp.asarray(srows),
+            idx=jnp.asarray(idx), vals=jnp.asarray(vv),
         )
 
     def lookup_batch_host(self, queries: np.ndarray) -> np.ndarray:

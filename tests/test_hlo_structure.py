@@ -77,6 +77,39 @@ class TestQoSLookupShape:
         assert scatters <= 6, f"unexpected scatter count: {scatters}"
 
 
+class TestDHCPFastpathShape:
+    def test_table_probes_are_wide_row_gathers(self):
+        """All three fast-path table probes (sub K=2, vlan K=1, cid K=8)
+        must gather packed bucket rows: 4x [1,32] (sub+vlan, KW=8) and
+        2x [1,64] (cid, KW=16). The 18 narrow key/used gathers of the
+        unpacked layout must not come back."""
+        from bng_tpu.ops.dhcp import dhcp_fastpath
+        from bng_tpu.ops.parse import parse_batch
+        from bng_tpu.runtime.tables import FastPathTables
+        from bng_tpu.utils.net import ip_to_u32
+
+        fp = FastPathTables(sub_nbuckets=256, vlan_nbuckets=64,
+                            cid_nbuckets=64, max_pools=16)
+        fp.set_server_config(bytes.fromhex("02aabbccdd01"), ip_to_u32("10.0.0.1"))
+        tables = fp.device_tables()
+        B, L = 256, 512
+        pkt = jnp.zeros((B, L), dtype=jnp.uint8)
+        ln = jnp.full((B,), 300, dtype=jnp.uint32)
+
+        def step(tables, pkt, ln):
+            par = parse_batch(pkt, ln)
+            res = dhcp_fastpath(pkt, ln, par, tables, fp.geom, jnp.uint32(1))
+            return res.is_reply, res.out_pkt, res.out_len
+
+        hlo = _stablehlo(step, tables, pkt, ln)
+        assert _count(r"slice_sizes = array<i64: 1, 32>", hlo) == 4
+        assert _count(r"slice_sizes = array<i64: 1, 64>", hlo) == 2
+        # per-lane packet-byte reads ([1,1]) are fine; whole-column
+        # table-probe gathers ([S,1] operands) are the serialized shape
+        narrow_1d = _count(r"slice_sizes = array<i64: 1>(?!,)", hlo)
+        assert narrow_1d == 0, f"{narrow_1d} 1-D narrow gathers"
+
+
 class TestShardedExchangeShape:
     def test_two_collectives_per_lookup(self):
         """The sharded lookup must stay exactly two all-to-alls (request +

@@ -14,6 +14,30 @@ import jax.numpy as jnp
 from bng_tpu.ops.table import HostTable, device_lookup, apply_update, WAYS
 
 
+class TestPartialDrain:
+    def test_half_drained_bucket_hides_undrained_sibling(self):
+        """A partial drain must not expose a still-queued sibling insert as
+        a hit with stale/zero vals (code-review r3 repro): the sibling
+        reads as a MISS until its own drain ships its value row."""
+        t = HostTable(1, key_words=1, val_words=2, stash=0, name="pd")
+        state = t.device_state()
+        sa = t.insert([1], [111, 0])
+        sb = t.insert([2], [222, 0])
+        assert sa // WAYS == sb // WAYS  # same (only) bucket
+        state = apply_update(state, t.make_update(max_slots=1))
+        res = device_lookup(state, jnp.asarray([[1], [2]], dtype=jnp.uint32), 1, 0)
+        f = np.asarray(res.found)
+        v = np.asarray(res.vals)
+        # exactly one visible, with its real vals; the other is a clean miss
+        assert sorted(f.tolist()) == [False, True]
+        assert v[f][0][0] in (111, 222)
+        # second drain completes the bucket: both visible, correct vals
+        state = apply_update(state, t.make_update(max_slots=1))
+        res = device_lookup(state, jnp.asarray([[1], [2]], dtype=jnp.uint32), 1, 0)
+        assert np.asarray(res.found).all()
+        np.testing.assert_array_equal(np.asarray(res.vals)[:, 0], [111, 222])
+
+
 def make_queries(keys_list, K):
     return jnp.asarray(np.array(keys_list, dtype=np.uint32).reshape(-1, K))
 
