@@ -48,6 +48,12 @@ class BenchmarkConfig:
     target_cache_hit: float = 0.95
     target_fastpath_p99_us: float = 100.0
 
+    # run the pre-classified DHCP stream through the engine's DHCP-only
+    # device program (reference parity: dhcp_fastpath.c is its own XDP
+    # program and replies never traverse the TC chain). False = the fused
+    # full-pipeline step.
+    dhcp_only_program: bool = True
+
 
 @dataclasses.dataclass
 class BenchmarkResult:
@@ -74,6 +80,9 @@ class BenchmarkResult:
     # fast-path-only latency the <100us target gates
     fastpath_p99_us: float = 0.0
     batches: int = 0
+    # which device program served the run: "dhcp_fastpath" (DHCP-only fast
+    # lane) or "fused_pipeline" — numbers are not comparable across the two
+    program: str = ""
 
     def meets_targets(self, cfg: BenchmarkConfig) -> list[str]:
         """Returns failed-target descriptions (empty == pass), the
@@ -142,6 +151,19 @@ class DHCPBenchmark:
         ]
         self._leased: dict[bytes, int] = {}  # mac -> yiaddr
 
+    def _program(self) -> str:
+        """Which device program _process will use (recorded in the result —
+        a fused-step fallback must be visible, not silent)."""
+        if self.cfg.dhcp_only_program and hasattr(self.engine, "process_dhcp"):
+            return "dhcp_fastpath"
+        return "fused_pipeline"
+
+    def _process(self, frames: list[bytes]) -> dict:
+        """Route the batch to the configured device program."""
+        if self._program() == "dhcp_fastpath":
+            return self.engine.process_dhcp(frames, batch=self.cfg.batch_size)
+        return self.engine.process(frames)
+
     # -- frame builders --
     def _discover(self, mac: bytes, xid: int) -> bytes:
         p = dhcp_codec.build_request(mac, dhcp_codec.DISCOVER, xid=xid)
@@ -177,7 +199,7 @@ class DHCPBenchmark:
         while i < len(self._macs) and self.clock() < t_end:
             chunk = self._macs[i : i + B]
             frames = [self._discover(m, xid + k) for k, m in enumerate(chunk)]
-            res = self.engine.process(frames)
+            res = self._process(frames)
             offers = {lane: f for lane, f in res["slow"] if f is not None}
             offers.update({lane: f for lane, f in res["tx"]})
             req_frames, req_macs = [], []
@@ -188,7 +210,7 @@ class DHCPBenchmark:
             if req_frames:
                 # a lease only counts once the server ACKs it — NAK'd or
                 # dropped REQUESTs must not become renewal targets
-                res2 = self.engine.process(req_frames)
+                res2 = self._process(req_frames)
                 acks = {lane: f for lane, f in res2["slow"] if f is not None}
                 acks.update({lane: f for lane, f in res2["tx"]})
                 for lane, m in enumerate(req_macs):
@@ -211,7 +233,7 @@ class DHCPBenchmark:
         # measurement deltas start from here (warmup excluded)
         start_dhcp = self.engine.stats.dhcp.copy()
         start_slow_errors = self.engine.stats.slow_errors
-        res = BenchmarkResult()
+        res = BenchmarkResult(program=self._program())
         lat_us: list[float] = []  # whole-batch wall time
         fast_lat_us: list[float] = []  # per-request, pure-fastpath batches
         B = cfg.batch_size
@@ -237,7 +259,7 @@ class DHCPBenchmark:
                     mac = macs[int(self._rng.integers(len(macs)))]
                     frames.append(self._discover(mac, xid + k))
             t1 = self.clock()
-            out = self.engine.process(frames)
+            out = self._process(frames)
             dt_us = (self.clock() - t1) * 1e6
             lat_us.append(dt_us)
             if not out["slow"]:

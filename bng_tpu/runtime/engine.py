@@ -80,6 +80,30 @@ def _pipeline_jit(geom: PipelineGeom):
     return jax.jit(step, donate_argnums=(0,))
 
 
+@functools.lru_cache(maxsize=8)
+def _dhcp_jit(geom):
+    """DHCP-only device program — the latency fast lane.
+
+    In the reference the DHCP fast path is its OWN XDP program
+    (bpf/dhcp_fastpath.c): an XDP_TX reply never traverses the TC
+    NAT/QoS/antispoof hooks. A pre-classified control batch (UDP:67)
+    therefore only needs parse + 3-tier lookup + OFFER compose — a
+    several-fold smaller program than the fused step, which is what the
+    p99-OFFER target is measured against. Shares (and donates) the same
+    dhcp table leaves as the fused step, so the two programs can never
+    fork state."""
+    from bng_tpu.ops.dhcp import dhcp_fastpath
+    from bng_tpu.ops.parse import parse_batch
+
+    def step(dhcp_tables, upd, pkt, length, now_s):
+        dhcp_tables = apply_fastpath_updates(dhcp_tables, upd)
+        par = parse_batch(pkt, length)
+        res = dhcp_fastpath(pkt, length, par, dhcp_tables, geom, now_s)
+        return dhcp_tables, res.is_reply, res.out_pkt, res.out_len, res.stats
+
+    return jax.jit(step, donate_argnums=(0,))
+
+
 @dataclass
 class EngineStats:
     dhcp: np.ndarray = field(default_factory=lambda: np.zeros(DHCP_NSTATS, dtype=np.uint64))
@@ -227,6 +251,7 @@ class Engine:
         # jit cache is keyed on geometry so Engine instances with identical
         # table shapes share one compile (tests build many engines)
         self._step = _pipeline_jit(self.geom)
+        self._dhcp_step = _dhcp_jit(fastpath.geom)
 
     def resync_tables(self) -> None:
         """Full device re-upload after a bulk host-table build.
@@ -246,22 +271,11 @@ class Engine:
             spoof_config=jnp.asarray(self.antispoof.config),
         )
 
-    def _drain_updates(self):
-        def drain():
-            return (
-                self.fastpath.make_updates(),
-                self.nat.make_updates(),
-                self.qos.up.make_update(self.qos.update_slots),
-                self.qos.down.make_update(self.qos.update_slots),
-                self.antispoof.bindings.make_update(self.antispoof.update_slots),
-                jnp.asarray(self.antispoof.ranges),
-                jnp.asarray(self.antispoof.config),
-            )
-
-        # A bulk build on a live engine must not brick the step loop: ANY
-        # delta-synced host table (qos, nat, dhcp fastpath, antispoof)
-        # whose bulk_insert abandoned dirty tracking raises here; answer
-        # with one full re-upload and drain again (now-clean).
+    def _drain_with_resync(self, drain):
+        """Run a make-updates drain; on the bulk-build "full upload" signal
+        (bulk_insert abandoned dirty tracking) answer with one full device
+        re-upload and drain again (now-clean) — a bulk build on a live
+        engine must not brick the step loop."""
         try:
             return drain()
         except RuntimeError as e:
@@ -269,6 +283,33 @@ class Engine:
                 raise
             self.resync_tables()
             return drain()
+
+    def _drain_updates(self):
+        return self._drain_with_resync(lambda: (
+            self.fastpath.make_updates(),
+            self.nat.make_updates(),
+            self.qos.up.make_update(self.qos.update_slots),
+            self.qos.down.make_update(self.qos.update_slots),
+            self.antispoof.bindings.make_update(self.antispoof.update_slots),
+            jnp.asarray(self.antispoof.ranges),
+            jnp.asarray(self.antispoof.config),
+        ))
+
+    def _pack_frames(self, frames: list[bytes], B: int):
+        """Stage a frame list into device-shaped [B, L] + lengths."""
+        if len(frames) > B:
+            raise ValueError(f"batch of {len(frames)} exceeds batch size {B}")
+        pkt = np.zeros((B, self.L), dtype=np.uint8)
+        length = np.zeros((B,), dtype=np.uint32)
+        for i, f in enumerate(frames):
+            if len(f) > self.L:
+                # never truncate silently: a clipped frame would be shaped
+                # and NAT-accounted at the wrong length and TX'd corrupt
+                raise ValueError(
+                    f"frame of {len(f)} bytes exceeds engine pkt_slot {self.L}")
+            pkt[i, : len(f)] = np.frombuffer(f, dtype=np.uint8)
+            length[i] = len(f)
+        return pkt, length
 
     def process(
         self,
@@ -281,22 +322,11 @@ class Engine:
         Returns {"tx": [(lane, frame)], "fwd": [...], "dropped": [lanes],
         "slow": [(lane, reply_frame|None)]}.
         """
-        if len(frames) > self.B:
-            raise ValueError(f"batch of {len(frames)} exceeds engine batch size {self.B}")
         now = now if now is not None else self.clock()
         now_s = np.uint32(int(now))
         now_us = np.uint32(int(now * 1e6) & 0xFFFFFFFF)
 
-        pkt = np.zeros((self.B, self.L), dtype=np.uint8)
-        length = np.zeros((self.B,), dtype=np.uint32)
-        for i, f in enumerate(frames):
-            if len(f) > self.L:
-                # never truncate silently: a clipped frame would be shaped
-                # and NAT-accounted at the wrong length and TX'd corrupt
-                raise ValueError(
-                    f"frame of {len(f)} bytes exceeds engine pkt_slot {self.L}")
-            pkt[i, : len(f)] = np.frombuffer(f, dtype=np.uint8)
-            length[i] = len(f)
+        pkt, length = self._pack_frames(frames, self.B)
         if isinstance(from_access, bool):
             fa = np.full((self.B,), from_access, dtype=bool)
         else:
@@ -340,6 +370,58 @@ class Engine:
                 out["slow"].append((i, reply))
             if viol[i] and self.violation_sink is not None:
                 self.violation_sink(i, frames[i])
+        return out
+
+    def process_dhcp(self, frames: list[bytes], now: float | None = None,
+                     batch: int | None = None) -> dict:
+        """Latency fast lane: run a PRE-CLASSIFIED control batch (DHCP to
+        UDP:67) through the DHCP-only device program.
+
+        Reference hook-order parity: dhcp_fastpath.c is its own XDP
+        program; an XDP_TX reply never traverses the TC NAT/QoS/antispoof
+        chain, so a control batch must not pay the fused step's cost.
+        Non-DHCP frames in the batch simply fall out as "slow" lanes
+        (is_reply False), exactly like XDP_PASS.
+
+        The dhcp table leaves of self.tables thread through this step
+        (donated) just as the fused step threads them — one authoritative
+        device copy, whichever program runs next. Returns
+        {"tx": [(lane, frame)], "slow": [(lane, reply|None)]}.
+        """
+        if batch is not None:
+            B = batch
+        else:  # next pow2, floor 64 — bounds the shape-specialized compiles
+            B = max(64, 1 << max(0, len(frames) - 1).bit_length())
+        now = now if now is not None else self.clock()
+        pkt, length = self._pack_frames(frames, B)
+
+        upd = self._drain_with_resync(self.fastpath.make_updates)
+        dhcp_tables, is_reply, out_pkt, out_len, stats = self._dhcp_step(
+            self.tables.dhcp, upd, jnp.asarray(pkt), jnp.asarray(length),
+            np.uint32(int(now)))
+        self.tables = self.tables._replace(dhcp=dhcp_tables)
+        self.stats.batches += 1
+        self.stats.dhcp += np.asarray(stats, dtype=np.uint64)
+
+        reply = np.asarray(is_reply)[: len(frames)]
+        out = {"tx": [], "slow": []}
+        out_rows = None
+        ol = np.asarray(out_len)
+        for i, r in enumerate(reply):
+            if r:
+                if out_rows is None:
+                    out_rows = np.asarray(out_pkt)
+                out["tx"].append((i, bytes(out_rows[i, : int(ol[i])])))
+                self.stats.tx += 1
+            else:
+                self.stats.passed += 1
+                rep = None
+                try:
+                    if self.slow_path is not None:
+                        rep = self.slow_path(frames[i])
+                except Exception:  # noqa: BLE001 — slow path is untrusted input
+                    self.stats.slow_errors += 1
+                out["slow"].append((i, rep))
         return out
 
     def _dispatch_step(self, pkt, length, fa, now_s, now_us) -> PipelineResult:

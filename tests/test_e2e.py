@@ -305,3 +305,57 @@ def test_nat_release_purges_sessions_before_block_reuse():
     # B gets the recycled block
     blk = nat.allocate_nat(b, now=300)
     assert blk["port_start"] == 1024  # reused A's block
+
+
+class TestDHCPFastLane:
+    """process_dhcp: the DHCP-only device program (latency fast lane).
+
+    Reference hook-order parity: bpf/dhcp_fastpath.c is its own XDP
+    program — XDP_TX replies never traverse the TC chain — so a control
+    batch runs a several-fold smaller program than the fused step."""
+
+    def test_parity_with_fused_step(self, stack):
+        engine, server, *_ , clock = stack
+        mac = bytes.fromhex("02deadbe0001")
+        disc = client_frame(mac, dhcp_codec.DISCOVER, xid=0x41)
+        # DORA through the slow path installs the subscriber
+        out = engine.process_dhcp([disc])
+        assert len(out["slow"]) == 1 and out["slow"][0][1] is not None
+        offered = dhcp_codec.decode(packets.decode(out["slow"][0][1]).payload)
+        req = client_frame(mac, dhcp_codec.REQUEST, xid=0x42,
+                           requested_ip=offered.yiaddr)
+        out = engine.process_dhcp([req])
+        assert len(out["slow"]) == 1  # REQUEST completes via slow path too
+
+        # now cached: the SAME DISCOVER must be answered on-device by BOTH
+        # programs, byte-for-byte
+        fast = engine.process_dhcp([disc])
+        assert len(fast["tx"]) == 1, fast
+        fused = engine.process([disc])
+        assert len(fused["tx"]) == 1, fused
+        assert fast["tx"][0][1] == fused["tx"][0][1]
+
+    def test_shared_table_state_both_directions(self, stack):
+        engine, server, *_ , clock = stack
+        mac = bytes.fromhex("02deadbe0002")
+        ip = ip_to_u32("10.0.0.77")
+        # install via the host mirror; drain through the DHCP-ONLY step
+        engine.fastpath.add_subscriber(mac, pool_id=1, ip=ip,
+                                       lease_expiry=T0 + 900)
+        disc = client_frame(mac, dhcp_codec.DISCOVER, xid=0x43)
+        assert len(engine.process_dhcp([disc])["tx"]) == 1
+        # the fused step sees the same (threaded) tables — no re-drain
+        assert len(engine.process([disc])["tx"]) == 1
+
+        # and deletion drained through the FUSED step hides it from the
+        # dhcp-only program too
+        engine.fastpath.remove_subscriber(mac)
+        assert len(engine.process([disc])["slow"]) == 1
+        assert len(engine.process_dhcp([disc])["tx"]) == 0
+
+    def test_non_dhcp_frames_fall_out_as_slow(self, stack):
+        engine, *_ = stack
+        junk = data_frame(b"\x02" * 6, ip_to_u32("10.0.0.9"),
+                          ip_to_u32("8.8.8.8"), 1234, 80)
+        out = engine.process_dhcp([junk])
+        assert out["tx"] == [] and len(out["slow"]) == 1
