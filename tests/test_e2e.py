@@ -206,6 +206,25 @@ class TestQoS:
         assert len(r["dropped"]) >= 4
         assert engine.stats.qos[1] >= 4  # QST_PKTS_DROPPED
 
+    def test_download_direction_rate_limit(self, stack):
+        """qos_egress parity (qos_ratelimit.c:126-172): DOWNLOAD shaping
+        keys on the post-DNAT destination — network-side lanes must hit
+        the qos_down table, not ride for free."""
+        engine, server, nat, qos, spoof, clock = stack
+        sub_ip = ip_to_u32("10.0.0.61")
+        nat.allocate_nat(sub_ip, T0)
+        nat_ip, nat_port = nat.handle_new_flow(
+            sub_ip, ip_to_u32("1.2.3.4"), 40000, 443, 17, 600, T0)[:2]
+        qos.set_subscriber(sub_ip, down_bps=8000, up_bps=8000,
+                           up_burst=1000, down_burst=1000)
+        # inbound: internet -> subscriber's public mapping (DNAT resolves)
+        down = packets.udp_packet(b"\x04" * 6, SERVER_MAC,
+                                  ip_to_u32("1.2.3.4"), nat_ip, 443, nat_port,
+                                  b"d" * 458)
+        r = engine.process([down] * 3, from_access=False)
+        # 2x500B fit the 1000B bucket; the 3rd must drop
+        assert len(r["fwd"]) == 2 and len(r["dropped"]) == 1, r
+
     def test_refill_after_time(self, stack):
         engine, server, nat, qos, spoof, clock = stack
         sub_ip = ip_to_u32("10.0.0.61")
