@@ -22,8 +22,52 @@ import numpy as np
 from bng_tpu.runtime import nativelib
 
 FLAG_FROM_ACCESS = 0x1
+# set by the ring on RX when the frame parses as IPv4/UDP dst:67 — the
+# consumer may route an all-control batch through the DHCP-only device
+# program (BNG_DESC_F_DHCP_CTRL in bngring.h)
+FLAG_DHCP_CTRL = 0x2
 
 VERDICT_PASS, VERDICT_DROP, VERDICT_TX, VERDICT_FWD = 0, 1, 2, 3
+
+
+def classify_dhcp(frame: bytes) -> int:
+    """Genuine-DHCP classifier (0-2 VLAN tags) — the PyRing mirror of
+    bngring.cpp's classify_dhcp; must agree bit-for-bit. Strict on
+    purpose: IPv4 non-fragment UDP dst:67 with BOOTREQUEST op AND the
+    DHCP magic cookie — natable port-67 transit, fragments, and non-DHCP
+    floods stay on the fused pipeline (NAT/antispoof/QoS treatment).
+    Callers gate on from_access (the fused path only answers access-side
+    DHCP: dhcp_tx = is_reply & from_access)."""
+    if len(frame) < 14:
+        return 0
+    off = 12
+    et = (frame[off] << 8) | frame[off + 1]
+    for _ in range(2):
+        if et not in (0x8100, 0x88A8):
+            break
+        off += 4
+        if len(frame) < off + 2:
+            return 0
+        et = (frame[off] << 8) | frame[off + 1]
+    off += 2  # L3 start
+    if et != 0x0800 or len(frame) < off + 20 or (frame[off] >> 4) != 4:
+        return 0
+    ihl = (frame[off] & 0x0F) * 4
+    if ihl < 20 or frame[off + 9] != 17:
+        return 0
+    if ((frame[off + 6] << 8) | frame[off + 7]) & 0x3FFF:
+        return 0  # fragmented: no parseable L4
+    l4 = off + ihl
+    if len(frame) < l4 + 8:
+        return 0
+    dport = (frame[l4 + 2] << 8) | frame[l4 + 3]
+    if dport != 67:
+        return 0
+    bootp = l4 + 8
+    if len(frame) < bootp + 240 or frame[bootp] != 1:
+        return 0
+    magic = int.from_bytes(frame[bootp + 236 : bootp + 240], "big")
+    return FLAG_DHCP_CTRL if magic == 0x63825363 else 0
 
 
 class RingStats(C.Structure):
@@ -254,7 +298,10 @@ class PyRing:
             self._stats["fill_empty" if self._free == 0 else "rx_full"] += 1
             return False
         self._free -= 1
-        self._rx.append((frame, FLAG_FROM_ACCESS if from_access else 0))
+        fl = FLAG_FROM_ACCESS if from_access else 0
+        if from_access:  # direction gate — see classify_dhcp docstring
+            fl |= classify_dhcp(frame)
+        self._rx.append((frame, fl))
         return True
 
     def tx_inject(self, frame: bytes, from_access: bool = True) -> bool:

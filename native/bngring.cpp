@@ -240,12 +240,54 @@ uint64_t bng_ring_rx_reserve(bng_ring *r) {
   return d.addr;
 }
 
+/* Genuine-DHCP classifier (0-2 VLAN tags), mirroring the fast path's
+ * eligibility parse (dhcp_fastpath.c: op==BOOTREQUEST + magic cookie).
+ * Deliberately strict — only frames the DHCP-only device program would
+ * actually consider are classified, so the fast lane can never swallow
+ * natable port-67 transit, fragments, or non-DHCP floods (those keep the
+ * fused pipeline's NAT/antispoof/QoS treatment). Runs once per RX frame. */
+static uint32_t classify_dhcp(const uint8_t *p, uint32_t len) {
+  if (len < 14) return 0;
+  uint32_t off = 12;
+  uint32_t et = (static_cast<uint32_t>(p[off]) << 8) | p[off + 1];
+  for (int i = 0; i < 2 && (et == 0x8100 || et == 0x88a8); i++) {
+    off += 4;
+    if (len < off + 2) return 0;
+    et = (static_cast<uint32_t>(p[off]) << 8) | p[off + 1];
+  }
+  off += 2; /* L3 start */
+  if (et != 0x0800 || len < off + 20) return 0;
+  if ((p[off] >> 4) != 4) return 0;
+  uint32_t ihl = (p[off] & 0x0F) * 4u;
+  if (ihl < 20 || p[off + 9] != 17) return 0; /* UDP */
+  /* fragmented packets (MF set or nonzero offset) carry no parseable L4 */
+  uint32_t fragword = (static_cast<uint32_t>(p[off + 6]) << 8) | p[off + 7];
+  if (fragword & 0x3FFFu) return 0;
+  uint32_t l4 = off + ihl;
+  if (len < l4 + 8) return 0;
+  uint32_t dport = (static_cast<uint32_t>(p[l4 + 2]) << 8) | p[l4 + 3];
+  if (dport != 67) return 0;
+  /* BOOTP: op==BOOTREQUEST and the DHCP magic cookie at +236 */
+  uint32_t bootp = l4 + 8;
+  if (len < bootp + 240 || p[bootp] != 1) return 0;
+  uint32_t magic = (static_cast<uint32_t>(p[bootp + 236]) << 24) |
+                   (static_cast<uint32_t>(p[bootp + 237]) << 16) |
+                   (static_cast<uint32_t>(p[bootp + 238]) << 8) |
+                   p[bootp + 239];
+  return magic == 0x63825363u ? BNG_DESC_F_DHCP_CTRL : 0;
+}
+
 int bng_ring_rx_submit(bng_ring *r, uint64_t addr, uint32_t len,
                        uint32_t flags) {
   if (!valid_addr(r, addr) || len > r->frame_size) {
     r->stats.bad_desc++;
     return -1;
   }
+  /* direction gate: the fused pipeline only answers access-side DHCP
+   * (dhcp_tx = is_reply & from_access) — a network-side frame must never
+   * enter the fast lane */
+  if (flags & BNG_DESC_F_FROM_ACCESS)
+    flags |= classify_dhcp(r->umem + addr, len);
   bng_desc d{addr, len, flags};
   if (!r->rx.push(d)) {
     r->stats.rx_full++;

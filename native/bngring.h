@@ -60,10 +60,18 @@ enum bng_verdict {
 typedef struct bng_desc {
   uint64_t addr;
   uint32_t len;
-  uint32_t flags; /* bit0: from_access (subscriber-side ingress) */
+  uint32_t flags; /* bit0: from_access; bit1: DHCP control frame */
 } bng_desc;
 
 #define BNG_DESC_F_FROM_ACCESS 0x1u
+/* Set by the ring on RX submit for ACCESS-SIDE frames that parse as
+ * genuine DHCP: IPv4 non-fragment UDP dst:67 with BOOTREQUEST op and the
+ * DHCP magic cookie (0-2 VLAN tags). The consumer may route an
+ * all-control batch through the DHCP-only device program (the
+ * reference's standalone-XDP hook order, where a DHCP reply never
+ * traverses the TC chain); everything else keeps the fused pipeline's
+ * NAT/antispoof/QoS treatment. */
+#define BNG_DESC_F_DHCP_CTRL 0x2u
 
 typedef struct bng_ring_stats {
   uint64_t rx;          /* frames assembled into batches */

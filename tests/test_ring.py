@@ -430,3 +430,86 @@ class TestFillPoolConcurrency:
             f"frame leak/duplication: {ring.free_frames()}/{nframes} free, "
             f"stats={ring.stats()}")
         ring.close()
+
+
+class TestDHCPClassify:
+    """Ring-side control classification (BNG_DESC_F_DHCP_CTRL, bit1):
+    IPv4/UDP dst:67 with 0-2 VLAN tags, parity between the C++ and PyRing
+    classifiers — enables the engine's DHCP-only fast lane on all-control
+    batches."""
+
+    def _dhcp_frame(self, vlans=None):
+        from bng_tpu.control import dhcp_codec, packets
+
+        mac = bytes.fromhex("02c0ffee0031")
+        p = dhcp_codec.build_request(mac, dhcp_codec.DISCOVER)
+        f = packets.udp_packet(mac, b"\xff" * 6, 0, 0xFFFFFFFF, 68, 67,
+                               p.encode().ljust(320, b"\x00"))
+        if vlans:
+            # insert 802.1Q/802.1ad tags after the MACs
+            tags = b""
+            ets = ([0x88A8, 0x8100] if len(vlans) == 2 else [0x8100])
+            for et, vid in zip(ets, vlans):
+                tags += et.to_bytes(2, "big") + vid.to_bytes(2, "big")
+            f = f[:12] + tags + f[12:]
+        return f
+
+    def test_classifier_parity_and_tagging(self, ring_cls):
+        from bng_tpu.control import packets
+        from bng_tpu.runtime.ring import FLAG_DHCP_CTRL, classify_dhcp
+
+        ring = ring_cls(nframes=64, frame_size=1024, depth=32)
+        frames = [self._dhcp_frame(), self._dhcp_frame([100]),
+                  self._dhcp_frame([100, 200])]
+        data = packets.udp_packet(b"\x02" * 6, b"\x04" * 6, 0x0A000002,
+                                  0x08080808, 1234, 80, b"x")
+        # port 67 but NOT DHCP (no BOOTP/magic): natable transit, not control
+        port67 = packets.udp_packet(b"\x02" * 6, b"\x04" * 6, 0x0A000002,
+                                    0x08080808, 1234, 67, b"y" * 300)
+        # a fragment of a dst-67 flow: no parseable L4
+        frag = bytearray(self._dhcp_frame())
+        frag[20] = 0x20  # MF flag in the IPv4 frag word
+        frag = bytes(frag)
+        pushes = frames + [data, port67, frag]
+        for f in pushes:
+            assert ring.rx_push(f)
+        # network-side DHCP must NOT classify (direction gate)
+        assert ring.rx_push(self._dhcp_frame(), from_access=False)
+        B = 8
+        pkt = np.zeros((B, 1024), dtype=np.uint8)
+        ln = np.zeros((B,), dtype=np.uint32)
+        fl = np.zeros((B,), dtype=np.uint32)
+        n = ring.assemble(pkt, ln, fl)
+        assert n == 7
+        want = [True, True, True, False, False, False, False]
+        assert [(x & FLAG_DHCP_CTRL) != 0 for x in fl[:7]] == want
+        # python-side classifier agrees bit-for-bit with what the ring set
+        for i, f in enumerate(pushes):
+            assert classify_dhcp(f) == (fl[i] & FLAG_DHCP_CTRL)
+        ring.complete(np.zeros((n,), dtype=np.uint8), pkt, ln, n)
+
+    def test_all_control_batch_takes_fast_lane(self, ring_cls):
+        ring = ring_cls(nframes=64, frame_size=1024, depth=32)
+        eng_test = TestRingEngine()
+        engine, server = eng_test._stack(ring)
+        calls = {"dhcp": 0}
+        orig = engine._run_dhcp_batch
+
+        def spy(pkt, length, now):
+            calls["dhcp"] += 1
+            return orig(pkt, length, now)
+
+        engine._run_dhcp_batch = spy
+        # all-control batch -> fast lane
+        assert ring.rx_push(self._dhcp_frame())
+        assert engine.process_ring(ring) == 1
+        assert calls["dhcp"] == 1
+        # mixed batch -> fused step (spy not called again)
+        from bng_tpu.control import packets
+        assert ring.rx_push(self._dhcp_frame())
+        assert ring.rx_push(packets.udp_packet(
+            b"\x02" * 6, b"\x04" * 6, 0x0A000002, 0x08080808, 1234, 80, b"x"))
+        assert engine.process_ring(ring) == 2
+        assert calls["dhcp"] == 1
+        # the slow path answered the DISCOVER both times (server reply TX'd)
+        assert engine.stats.passed >= 2
