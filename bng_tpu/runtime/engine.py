@@ -49,6 +49,7 @@ from bng_tpu.ops.qos import QOS_NSTATS
 from bng_tpu.ops.antispoof import ANTISPOOF_WORDS
 from bng_tpu.ops.qtable import HostQTable, QTableGeom, apply_qupdate
 from bng_tpu.ops.table import HostTable, TableGeom, apply_update
+from bng_tpu.runtime.ring import FLAG_DHCP_CTRL
 from bng_tpu.runtime.tables import FastPathTables, apply_fastpath_updates
 
 # default per-lane packet slot: a full MTU frame (1500) + headroom for
@@ -105,13 +106,19 @@ def _dhcp_jit(geom):
 
 
 class _DhcpBatchResult(NamedTuple):
-    """DHCP-only step result, shaped for the ring verdict demux."""
+    """DHCP-only step result, shaped for the ring verdict demux AND the
+    stats fold — async like PipelineResult (device outputs stay futures
+    until the ring retire forces them, so the fast lane pipelines too)."""
 
-    verdict: np.ndarray  # [B] uint8-compatible (TX / PASS only)
+    verdict: "jax.Array"  # [B] uint8 (TX / PASS only)
     out_pkt: "jax.Array"
     out_len: "jax.Array"
     nat_punt: np.ndarray  # [B] all-False (no NAT on this program)
     spoof_violation: np.ndarray  # [B] all-False
+    dhcp_stats: "jax.Array"  # [DHCP_NSTATS]
+    nat_stats: np.ndarray  # zeros
+    qos_stats: np.ndarray  # zeros
+    spoof_stats: np.ndarray  # zeros
 
 
 @dataclass
@@ -404,7 +411,7 @@ class Engine:
             B = max(64, 1 << max(0, len(frames) - 1).bit_length())
         now = now if now is not None else self.clock()
         pkt, length = self._pack_frames(frames, B)
-        res = self._run_dhcp_batch(pkt, length, now)
+        res = self._run_dhcp_batch_sync(pkt, length, now)
         reply = np.asarray(res.verdict)[: len(frames)] == VERDICT_TX
         out_pkt, out_len = res.out_pkt, res.out_len
         out = {"tx": [], "slow": []}
@@ -428,11 +435,12 @@ class Engine:
         return out
 
     def _run_dhcp_batch(self, pkt, length, now: float) -> "_DhcpBatchResult":
-        """Run one staged batch through the DHCP-only device program,
-        threading (and donating) the shared dhcp table leaves. Returns a
-        result with the fields the ring verdict demux reads (TX for
-        on-device replies, PASS otherwise; no NAT punts or spoof
-        violations exist on this program)."""
+        """Dispatch one staged batch to the DHCP-only device program,
+        threading (and donating) the shared dhcp table leaves. Outputs are
+        futures (async, like _dispatch_step) — the caller folds stats and
+        forces verdicts when it needs them (TX for on-device replies,
+        PASS otherwise; no NAT punts or spoof violations exist on this
+        program)."""
         B = pkt.shape[0]
         upd = self._drain_with_resync(self.fastpath.make_updates)
         dhcp_tables, is_reply, out_pkt, out_len, stats = self._dhcp_step(
@@ -440,12 +448,22 @@ class Engine:
             np.uint32(int(now)))
         self.tables = self.tables._replace(dhcp=dhcp_tables)
         self.stats.batches += 1
-        self.stats.dhcp += np.asarray(stats, dtype=np.uint64)
-        verdict = np.where(np.asarray(is_reply), VERDICT_TX, VERDICT_PASS)
+        verdict = jnp.where(is_reply, np.uint8(VERDICT_TX),
+                            np.uint8(VERDICT_PASS))
         no = np.zeros((B,), dtype=bool)
-        return _DhcpBatchResult(verdict=verdict, out_pkt=out_pkt,
-                                out_len=out_len, nat_punt=no,
-                                spoof_violation=no)
+        return _DhcpBatchResult(
+            verdict=verdict, out_pkt=out_pkt, out_len=out_len,
+            nat_punt=no, spoof_violation=no, dhcp_stats=stats,
+            nat_stats=np.zeros(NAT_NSTATS, dtype=np.uint32),
+            qos_stats=np.zeros(QOS_NSTATS, dtype=np.uint32),
+            spoof_stats=np.zeros(ANTISPOOF_NSTATS, dtype=np.uint32))
+
+    def _run_dhcp_batch_sync(self, pkt, length, now: float) -> "_DhcpBatchResult":
+        """Dispatch + fold — the sync-path pairing (mirrors _run_step for
+        the fused program; the pipelined path folds at retire instead)."""
+        res = self._run_dhcp_batch(pkt, length, now)
+        self._fold_stats(res)
+        return res
 
     def _dispatch_step(self, pkt, length, fa, now_s, now_us) -> PipelineResult:
         """Enqueue one jitted step (async — outputs are futures). The table
@@ -501,8 +519,8 @@ class Engine:
         # DHCP-only fast lane — reference hook-order parity, and a
         # several-fold smaller program for the latency-sensitive traffic.
         # Mixed batches run the fused step: one dispatch beats two.
-        if bool(((flags[:n] & 0x2) != 0).all()):
-            res = self._run_dhcp_batch(pkt, length, now)
+        if bool(((flags[:n] & FLAG_DHCP_CTRL) != 0).all()):
+            res = self._run_dhcp_batch_sync(pkt, length, now)
         else:
             res = self._run_step(pkt, length, fa, now_s, now_us)
         self._apply_ring_verdicts(ring, res, pkt, length, n, now)
@@ -588,8 +606,15 @@ class Engine:
                 now_s = np.uint32(int(now))
                 now_us = np.uint32(int(now * 1e6) & 0xFFFFFFFF)
                 try:
-                    res = self._dispatch_step(pkt, length, (flags & 0x1) != 0,
-                                              now_s, now_us)
+                    # all-control batches ride the DHCP-only fast lane here
+                    # too — its outputs are equally async, so the overlap
+                    # with the previous batch's retire is preserved
+                    if bool(((flags[:n] & FLAG_DHCP_CTRL) != 0).all()):
+                        res = self._run_dhcp_batch(pkt, length, now)
+                    else:
+                        res = self._dispatch_step(pkt, length,
+                                                  (flags & 0x1) != 0,
+                                                  now_s, now_us)
                 except BaseException:
                     # fail closed: the assemble opened a ring window that
                     # must not wedge. complete() retires FIFO, so the

@@ -452,8 +452,13 @@ static uint32_t pump_dir(bng_ring *src, bng_ring *dst, uint32_t budget) {
     bool got = src->tx.pop(&d);
     if (!got) got = src->fwd.pop(&d);
     if (!got) break;
-    /* flags flip: frames leaving the access side arrive at the core side */
-    uint32_t fl = d.flags ^ BNG_DESC_F_FROM_ACCESS;
+    /* flags flip: frames leaving the access side arrive at the core side.
+     * Drop the DHCP-control bit — it was classified for the ORIGINAL
+     * direction; rx_submit re-classifies access-bound frames, and a stale
+     * bit on a now-network-side frame would smuggle it into the fast lane
+     * past the direction gate. */
+    uint32_t fl =
+        (d.flags & ~BNG_DESC_F_DHCP_CTRL) ^ BNG_DESC_F_FROM_ACCESS;
     bng_ring_rx_push(dst, src->umem + d.addr, d.len, fl);
     src->fill.push(d);
     moved++;

@@ -183,6 +183,32 @@ class TestWire:
         a.close()
         b.close()
 
+    def test_pump_does_not_leak_dhcp_ctrl_flag(self, ring_cls):
+        """A FWD'd access-side DHCP frame arriving on the core side must
+        NOT keep its control bit (code-review r3: a stale bit would smuggle
+        network-side frames past the fast lane's direction gate)."""
+        from bng_tpu.control import dhcp_codec, packets
+        from bng_tpu.runtime.ring import FLAG_DHCP_CTRL
+
+        a = ring_cls(nframes=32, frame_size=1024, depth=16)
+        b = ring_cls(nframes=32, frame_size=1024, depth=16)
+        mac = bytes.fromhex("02c0ffee0041")
+        p = dhcp_codec.build_request(mac, dhcp_codec.DISCOVER)
+        f = packets.udp_packet(mac, b"\xff" * 6, 0, 0xFFFFFFFF, 68, 67,
+                               p.encode().ljust(320, b"\x00"))
+        assert a.rx_push(f, from_access=True)
+        out = np.zeros((4, 1024), dtype=np.uint8)
+        ln = np.zeros((4,), dtype=np.uint32)
+        fl = np.zeros((4,), dtype=np.uint32)
+        n = a.assemble(out, ln, fl)
+        assert fl[0] & FLAG_DHCP_CTRL  # classified on the access side
+        a.complete(np.array([3], dtype=np.uint8), out, ln, n)  # FWD
+        assert wire_pump(a, b, budget=8) == 1
+        n = b.assemble(out, ln, fl)
+        assert n == 1 and (fl[0] & FLAG_DHCP_CTRL) == 0
+        a.close()
+        b.close()
+
 
 class TestRingEngine:
     """Ring-driven end-to-end: the production I/O loop."""
@@ -323,17 +349,22 @@ class TestRingEngine:
         assert engine.process_ring_pipelined(ring) == 0  # batch A in flight
 
         real_dispatch = engine._dispatch_step
+        real_dhcp = engine._run_dhcp_batch
 
         def boom(*a, **k):
             raise RuntimeError("synthetic device error")
 
+        # DHCP batches ride the fast lane; patch BOTH dispatch entry points
+        # so the failure covers whichever program the batch routes to
         engine._dispatch_step = boom
+        engine._run_dhcp_batch = boom
         ring.rx_push(discover(2), from_access=True)
         import pytest as _pytest
 
         with _pytest.raises(RuntimeError, match="synthetic"):
             engine.process_ring_pipelined(ring)  # batch B dispatch dies
         engine._dispatch_step = real_dispatch
+        engine._run_dhcp_batch = real_dhcp
 
         # batch A's OFFER still arrived (retired before the fail-close)
         got = ring.tx_pop()
