@@ -106,6 +106,41 @@ def _sharded_step_jit(mesh: Mesh, geom: PipelineGeom, n: int):
     return jax.jit(sharded, donate_argnums=(0,))
 
 
+@functools.lru_cache(maxsize=4)
+def _sharded_dhcp_jit(mesh: Mesh, geom: PipelineGeom, n: int):
+    """Sharded DHCP-only program — the multichip OFFER latency fast lane.
+
+    Mirrors Engine._dhcp_jit (reference hook-order parity: the DHCP fast
+    path is its own XDP program) over the mesh: parse + hash-sharded
+    3-tier lookup (all-to-all key/result exchange) + OFFER compose, with
+    stats psum-reduced. Shares (and donates) the same dhcp table leaves
+    as the fused sharded step, so the two programs can never fork state.
+    """
+    from bng_tpu.ops.dhcp import dhcp_fastpath
+    from bng_tpu.ops.parse import parse_batch
+    from bng_tpu.runtime.tables import apply_fastpath_updates
+
+    dhcp_geom = _sharded_geom(geom, n).dhcp
+
+    def local(dhcp1, upd1, pkt, length, now_s):
+        dhcp = jax.tree.map(lambda x: x[0], dhcp1)
+        upd = jax.tree.map(lambda x: x[0], upd1)
+        dhcp = apply_fastpath_updates(dhcp, upd)
+        par = parse_batch(pkt, length)
+        res = dhcp_fastpath(pkt, length, par, dhcp, dhcp_geom, now_s)
+        return (jax.tree.map(lambda x: x[None], dhcp), res.is_reply,
+                res.out_pkt, res.out_len, jax.lax.psum(res.stats, AXIS))
+
+    sharded = jax.shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(P(AXIS), P(AXIS), P(AXIS), P(AXIS), P()),
+        out_specs=(P(AXIS), P(AXIS), P(AXIS), P(AXIS), P()),
+        check_vma=False,
+    )
+    return jax.jit(sharded, donate_argnums=(0,))
+
+
 class ShardedCluster:
     """N-shard BNG over a 1D mesh. Control-plane writes route to owners."""
 
@@ -147,6 +182,7 @@ class ShardedCluster:
             spoof=self.spoof[0].geom,
         )
         self._step = _sharded_step_jit(self.mesh, self.geom, self.n)
+        self._dhcp_step = _sharded_dhcp_jit(self.mesh, self.geom, self.n)
         self.tables = None  # lazily built on first step / sync()
 
     # ---- owner routing (must match device shard_owner) ----
@@ -204,6 +240,25 @@ class ShardedCluster:
         stacked = np.stack([np.asarray(a) for a in arrs])
         return jax.device_put(stacked, NamedSharding(self.mesh, spec))
 
+    def _stack_per_shard(self, per_shard):
+        """Stack a per-shard pytree list on the mesh axis (the one
+        stacking/sharding convention — used by drains and sync)."""
+        return jax.tree.map(lambda *xs: self._stack(xs, P(AXIS)), *per_shard)
+
+    def _drain_with_resync(self, drain):
+        """Run a make-updates drain; on the bulk-build "full upload"
+        signal answer with one full re-upload and drain again — the
+        Engine._drain_with_resync contract, so a bulk build on a live
+        cluster does not brick the step loop. (The re-upload resets
+        device-authoritative counters/tokens, as documented there.)"""
+        try:
+            return drain()
+        except RuntimeError as e:
+            if "full upload" not in str(e):
+                raise
+            self.sync_tables()
+            return drain()
+
     def _drain_updates(self):
         """Per-shard bounded update batches, stacked on the mesh axis.
 
@@ -212,7 +267,7 @@ class ShardedCluster:
         so device-authoritative state (NAT session counters, QoS tokens)
         is never clobbered by a full re-upload.
         """
-        per_shard = [
+        return self._drain_with_resync(lambda: self._stack_per_shard([
             (
                 self.fastpath[i].make_updates(),
                 self.nat[i].make_updates(),
@@ -223,8 +278,12 @@ class ShardedCluster:
                 jnp.asarray(self.spoof[i].config),
             )
             for i in range(self.n)
-        ]
-        return jax.tree.map(lambda *xs: self._stack(xs, P(AXIS)), *per_shard)
+        ]))
+
+    def _drain_fastpath(self):
+        """Fastpath-only drain (the DHCP fast lane's update path)."""
+        return self._drain_with_resync(lambda: self._stack_per_shard(
+            [self.fastpath[i].make_updates() for i in range(self.n)]))
 
     def antispoof_upd(self, i: int):
         return self.spoof[i].bindings.make_update(self.spoof[i].update_slots)
@@ -248,9 +307,31 @@ class ShardedCluster:
                 spoof_config=jnp.asarray(self.spoof[i].config),
             )
             per_shard.append(t)
-        self.tables = jax.tree.map(
-            lambda *xs: self._stack(xs, P(AXIS)), *per_shard
-        )
+        self.tables = self._stack_per_shard(per_shard)
+
+    def dhcp_step(self, pkt: np.ndarray, length: np.ndarray, now_s: int):
+        """One sharded DHCP-only step (the control-batch fast lane).
+
+        Same layout contract as step(); only the fastpath update drain
+        runs, and the shared dhcp table leaves thread through donated —
+        NAT/QoS/antispoof deltas stay queued for the next fused step.
+        Returns {"is_reply", "out_pkt", "out_len", "dhcp_stats"}.
+        """
+        if self.tables is None:
+            self.sync_tables()
+        sh = NamedSharding(self.mesh, P(AXIS))
+        pkt_d = jax.device_put(pkt, sh)
+        len_d = jax.device_put(length.astype(np.uint32), sh)
+        upd = self._drain_fastpath()
+        dhcp1, is_reply, out_pkt, out_len, stats = self._dhcp_step(
+            self.tables.dhcp, upd, pkt_d, len_d, jnp.uint32(now_s))
+        self.tables = self.tables._replace(dhcp=dhcp1)
+        return {
+            "is_reply": np.asarray(is_reply),
+            "out_pkt": out_pkt,
+            "out_len": np.asarray(out_len),
+            "dhcp_stats": np.asarray(stats),
+        }
 
     def step(self, pkt: np.ndarray, length: np.ndarray, from_access: np.ndarray,
              now_s: int, now_us: int):

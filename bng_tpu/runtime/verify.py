@@ -114,6 +114,7 @@ def _check_sharded() -> None:
     ln = np.full((n * 64,), 0, dtype=np.uint32)
     fa = np.ones((n * 64,), dtype=bool)
     cl.step(pkt, ln, fa, 1, 1)
+    cl.dhcp_step(pkt, ln, 1)  # the sharded control fast lane too
 
 
 # (name, check, tpu_only).  tpu_only checks force real Mosaic lowering and
