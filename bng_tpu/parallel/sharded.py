@@ -347,7 +347,11 @@ class ShardedCluster:
         pkt_d = jax.device_put(pkt, sh)
         len_d = jax.device_put(length.astype(np.uint32), sh)
         fa_d = jax.device_put(from_access, sh)
-        out = self._step(self.tables, self._drain_updates(), pkt_d, len_d, fa_d,
+        # drain FIRST: a bulk-build resync rebinds self.tables, and Python
+        # evaluates arguments left-to-right — reading self.tables before
+        # the drain would pass (and donate) the stale pre-resync reference
+        upd = self._drain_updates()
+        out = self._step(self.tables, upd, pkt_d, len_d, fa_d,
                          jnp.uint32(now_s), jnp.uint32(now_us))
         (verdict, out_pkt, out_len, new_tables, dhcp_stats, nat_stats,
          qos_stats, spoof_stats, nat_punt, viol) = out

@@ -469,8 +469,12 @@ class Engine:
         """Enqueue one jitted step (async — outputs are futures). The table
         state threads immediately; callers force outputs when they need
         them (sync path: right away; pipelined path: one batch later)."""
+        # drain FIRST: a bulk-build resync rebinds self.tables, and Python
+        # evaluates arguments left-to-right — reading self.tables before
+        # the drain would pass (and donate) the stale pre-resync reference
+        upd = self._drain_updates()
         res: PipelineResult = self._step(
-            self.tables, self._drain_updates(), jnp.asarray(pkt), jnp.asarray(length),
+            self.tables, upd, jnp.asarray(pkt), jnp.asarray(length),
             jnp.asarray(fa), now_s, now_us,
         )
         self.tables = res.tables

@@ -208,3 +208,80 @@ class TestGraftEntry:
         import __graft_entry__ as g
 
         g.dryrun_multichip(8)  # conftest already forced cpu; guard is idempotent
+
+
+class TestBulkOnLiveStepLoop:
+    """A bulk table build on a LIVE engine/cluster must recover via one
+    full re-upload and serve the bulk-inserted entries on the very next
+    step (code-review r3: argument evaluation order captured the stale
+    pre-resync tables, silently discarding the re-upload)."""
+
+    def _discover(self, mac_u64: int) -> bytes:
+        from bng_tpu.control import dhcp_codec, packets
+
+        mac = int(mac_u64).to_bytes(8, "big")[2:]
+        p = dhcp_codec.build_request(mac, dhcp_codec.DISCOVER)
+        p.options.append((dhcp_codec.OPT_PARAM_REQ_LIST, bytes([1, 3, 6, 51, 54])))
+        return packets.udp_packet(mac, b"\xff" * 6, 0, 0xFFFFFFFF, 68, 67,
+                                  p.encode().ljust(320, b"\x00"))
+
+    def test_engine_step_after_bulk_serves_new_subscribers(self):
+        from bng_tpu.control.nat import NATManager
+        from bng_tpu.runtime.engine import Engine
+        from bng_tpu.runtime.tables import FastPathTables
+        from bng_tpu.utils.net import ip_to_u32
+
+        now = 1_753_000_000
+        fp = FastPathTables(sub_nbuckets=1 << 10, vlan_nbuckets=64,
+                            cid_nbuckets=64, max_pools=4, stash=64)
+        fp.set_server_config(bytes.fromhex("02aabbccdd01"), ip_to_u32("10.0.0.1"))
+        fp.add_pool(1, ip_to_u32("10.0.0.0"), 16, ip_to_u32("10.0.0.1"),
+                    lease_time=3600)
+        nat = NATManager(public_ips=[ip_to_u32("203.0.113.1")],
+                         sessions_nbuckets=256, sub_nat_nbuckets=64)
+        eng = Engine(fp, nat, batch_size=8, clock=lambda: float(now))
+        # go live (first step uploads tables, clears dirty tracking)
+        eng.process([b""])
+        # bulk build ON THE LIVE ENGINE — abandons bounded-delta tracking
+        n = 200
+        macs = np.arange(n, dtype=np.uint64) + 0x02AB00000000
+        idx = np.arange(n, dtype=np.uint64)
+        fp.add_subscribers_bulk(
+            macs, pool_ids=np.full(n, 1, np.uint32),
+            ips=((10 << 24) + 2 + idx).astype(np.uint32),
+            lease_expiries=np.uint32(now + 600))
+        out = eng.process([self._discover(int(macs[0]))])
+        assert len(out["tx"]) == 1, "bulk-inserted subscriber not served post-resync"
+
+    def test_cluster_step_after_bulk_serves_new_subscribers(self):
+        from bng_tpu.parallel.sharded import ShardedCluster
+        from bng_tpu.utils.net import ip_to_u32
+
+        now = 1_753_000_000
+        n_dev = 4
+        cl = ShardedCluster(n_dev, batch_per_shard=8, sub_nbuckets=1 << 10)
+        cl.set_server_config_all(bytes.fromhex("02aabbccdd01"), ip_to_u32("10.0.0.1"))
+        cl.add_pool_all(1, ip_to_u32("10.0.0.0"), 16, ip_to_u32("10.0.0.1"),
+                        lease_time=3600)
+        B = n_dev * cl.b
+        zero = np.zeros((B, 512), np.uint8)
+        zl = np.zeros((B,), np.uint32)
+        fa = np.ones((B,), dtype=bool)
+        cl.step(zero, zl, fa, now, 0)  # live
+        # bulk build on shard 0's host mirror
+        n = 200
+        macs = np.arange(n, dtype=np.uint64) + 0x02AC00000000
+        idx = np.arange(n, dtype=np.uint64)
+        cl.fastpath[0].add_subscribers_bulk(
+            macs, pool_ids=np.full(n, 1, np.uint32),
+            ips=((10 << 24) + 2 + idx).astype(np.uint32),
+            lease_expiries=np.uint32(now + 600))
+        # pick a mac OWNED by shard 0 so the sharded lookup resolves it
+        owned = next(int(m) for m in macs if cl.dhcp_sub_shard(int(m)) == 0)
+        f = self._discover(owned)
+        pkt = np.zeros((B, 512), np.uint8)
+        ln = np.zeros((B,), np.uint32)
+        pkt[0, : len(f)] = np.frombuffer(f, np.uint8)
+        ln[0] = len(f)
+        out = cl.step(pkt, ln, fa, now + 1, 0)
+        assert out["verdict"][0] == 2, "bulk-inserted subscriber not served post-resync"

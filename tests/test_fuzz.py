@@ -208,3 +208,60 @@ class TestDeviceKernelFuzz:
             m[16] = int(rng.integers(256)); m[17] = int(rng.integers(256))
             m[38] = int(rng.integers(256)); m[39] = int(rng.integers(256))
             self._run(engine, [bytes(m)])
+
+
+class TestRingClassifierFuzz:
+    """The ring-side DHCP classifier parses untrusted wire bytes in C++ —
+    byte soup and truncation-boundary frames must never crash either
+    backend, and the C++/Python classifiers must agree bit-for-bit on
+    every input (the fast-lane routing depends on that parity)."""
+
+    def test_byte_soup_parity_and_no_crash(self):
+        import numpy as np
+
+        from bng_tpu.runtime.ring import (
+            FLAG_DHCP_CTRL, NativeRing, PyRing, classify_dhcp, load_native,
+        )
+
+        rng = np.random.default_rng(0xF0F0)
+        frames = []
+        # pure noise at classifier-relevant lengths (header boundaries)
+        for ln in [0, 1, 13, 14, 17, 18, 21, 22, 33, 34, 41, 42, 60, 100,
+                   285, 286, 287, 288, 300, 512]:
+            frames.append(bytes(rng.integers(0, 256, size=ln, dtype=np.uint8)))
+        # near-DHCP frames: start from a valid one, corrupt one byte at a time
+        from bng_tpu.control import dhcp_codec, packets
+
+        mac = bytes.fromhex("02c0ffee0055")
+        p = dhcp_codec.build_request(mac, dhcp_codec.DISCOVER)
+        good = packets.udp_packet(mac, b"\xff" * 6, 0, 0xFFFFFFFF, 68, 67,
+                                  p.encode().ljust(320, b"\x00"))
+        for pos in rng.integers(0, len(good), size=64):
+            b = bytearray(good)
+            b[pos] ^= 0xFF
+            frames.append(bytes(b))
+        # truncations of the valid frame across every parse boundary
+        for cut in [13, 14, 33, 34, 41, 42, 275, 281, 282, 283, 284]:
+            frames.append(good[:cut])
+
+        backends = [PyRing]
+        if load_native() is not None:
+            backends.append(NativeRing)
+        for cls in backends:
+            ring = cls(nframes=256, frame_size=1024, depth=256)
+            pushed = []
+            for f in frames:
+                if ring.rx_push(f, from_access=True):
+                    pushed.append(f)
+            B = len(pushed)
+            pkt = np.zeros((max(B, 1), 1024), dtype=np.uint8)
+            ln = np.zeros((max(B, 1),), dtype=np.uint32)
+            fl = np.zeros((max(B, 1),), dtype=np.uint32)
+            n = ring.assemble(pkt, ln, fl)
+            assert n == B
+            for i, f in enumerate(pushed):
+                assert (fl[i] & FLAG_DHCP_CTRL) == classify_dhcp(f), \
+                    f"{cls.__name__} classifier disagrees on frame {i}"
+            if n:
+                ring.complete(np.zeros((n,), dtype=np.uint8), pkt, ln, n)
+            ring.close()
