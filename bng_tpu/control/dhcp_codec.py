@@ -54,6 +54,15 @@ class DHCPPacket:
     sname: bytes = b""
     file: bytes = b""
     options: list[tuple[int, bytes]] = field(default_factory=list)
+    # pre-encoded options (END included): when set AND the option count
+    # still matches options_raw_n, encode() uses these bytes verbatim
+    # instead of TLV-encoding `options` — the slow-path server caches its
+    # static per-pool reply suffix this way. Appending an option after
+    # the raw bytes were built changes the count and automatically falls
+    # back to the full TLV encode (in-place REPLACEMENT of an existing
+    # option must clear options_raw explicitly).
+    options_raw: bytes | None = None
+    options_raw_n: int = -1
 
     # -- option helpers --
     def opt(self, code: int) -> bytes | None:
@@ -107,14 +116,24 @@ class DHCPPacket:
         chaddr = (self.chaddr + b"\x00" * 16)[:16]
         sname = (self.sname + b"\x00" * 64)[:64]
         bfile = (self.file + b"\x00" * 128)[:128]
-        opts = b""
-        for code, val in self.options:
-            if code == OPT_PAD:
-                opts += b"\x00"
-            else:
-                opts += bytes([code, len(val)]) + val
-        opts += bytes([OPT_END])
+        use_raw = (self.options_raw is not None
+                   and len(self.options) == self.options_raw_n)
+        opts = self.options_raw if use_raw else encode_options(self.options)
         return fixed + chaddr + sname + bfile + struct.pack("!I", DHCP_MAGIC) + opts
+
+
+def encode_options(options: list[tuple[int, bytes]]) -> bytes:
+    """TLV-encode an option list (END terminated). Exposed so callers with
+    repeated static option sets (the slow-path server's per-pool reply
+    suffix) can cache the encoded bytes."""
+    parts = []
+    for code, val in options:
+        if code == OPT_PAD:
+            parts.append(b"\x00")
+        else:
+            parts.append(bytes((code, len(val))) + val)
+    parts.append(bytes((OPT_END,)))
+    return b"".join(parts)
 
 
 def decode(data: bytes) -> DHCPPacket:

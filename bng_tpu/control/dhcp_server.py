@@ -106,6 +106,8 @@ class DHCPServer:
         self._offers: dict[int, tuple[int, int]] = {}  # mac -> (ip, pool_id)
         self.stats = ServerStats()
         self._session_seq = 0
+        # (pool_id, lease_time, include_lease) -> (options list, TLV bytes)
+        self._reply_opts_cache: dict[tuple, tuple[list, bytes]] = {}
 
     # ------------------------------------------------------------------
     def handle_frame(self, raw: bytes) -> bytes | None:
@@ -391,29 +393,53 @@ class DHCPServer:
         return len(dead)
 
     # ------------------------------------------------------------------
-    def _build_reply(self, req: DHCPPacket, msg_type: int, ip: int, pool: Pool,
-                     lease_time: int | None = None, include_lease: bool = True) -> DHCPPacket:
-        lt = lease_time if lease_time is not None else pool.lease_time
+    def _static_reply_options(self, pool: Pool, lt: int,
+                              include_lease: bool) -> tuple[list, bytes]:
+        """The reply options after MSG_TYPE are a function of (pool, lease
+        config) only — build once per key, cache the list AND its encoded
+        TLV suffix (the slow path's hottest allocation)."""
+        # keyed on the option-relevant VALUES, so a reconfigured pool can
+        # never serve a stale cached suffix
+        key = (pool.pool_id, lt, include_lease, pool.prefix_len,
+               pool.gateway, pool.dns_primary, pool.dns_secondary)
+        hit = self._reply_opts_cache.get(key)
+        if hit is not None:
+            return hit
         from bng_tpu.utils.net import prefix_to_mask
 
-        p = DHCPPacket(
-            op=2, xid=req.xid, flags=req.flags, ciaddr=req.ciaddr if msg_type == ACK else 0,
-            yiaddr=ip, siaddr=self.server_ip, giaddr=req.giaddr, chaddr=req.chaddr,
-        )
-        p.options.append((dhcp_codec.OPT_MSG_TYPE, bytes([msg_type])))
-        p.options.append((dhcp_codec.OPT_SERVER_ID, struct.pack("!I", self.server_ip)))
+        opts = [(dhcp_codec.OPT_SERVER_ID, struct.pack("!I", self.server_ip))]
         if include_lease:
-            p.options.append((dhcp_codec.OPT_LEASE_TIME, struct.pack("!I", lt)))
-        p.options.append((dhcp_codec.OPT_SUBNET_MASK, struct.pack("!I", prefix_to_mask(pool.prefix_len))))
-        p.options.append((dhcp_codec.OPT_ROUTER, struct.pack("!I", pool.gateway)))
+            opts.append((dhcp_codec.OPT_LEASE_TIME, struct.pack("!I", lt)))
+        opts.append((dhcp_codec.OPT_SUBNET_MASK, struct.pack("!I", prefix_to_mask(pool.prefix_len))))
+        opts.append((dhcp_codec.OPT_ROUTER, struct.pack("!I", pool.gateway)))
         if pool.dns_primary:
             dns = struct.pack("!I", pool.dns_primary)
             if pool.dns_secondary:
                 dns += struct.pack("!I", pool.dns_secondary)
-            p.options.append((dhcp_codec.OPT_DNS, dns))
+            opts.append((dhcp_codec.OPT_DNS, dns))
         if include_lease:
-            p.options.append((dhcp_codec.OPT_RENEWAL_TIME, struct.pack("!I", lt // 2)))
-            p.options.append((dhcp_codec.OPT_REBIND_TIME, struct.pack("!I", (lt * 7) // 8)))
+            opts.append((dhcp_codec.OPT_RENEWAL_TIME, struct.pack("!I", lt // 2)))
+            opts.append((dhcp_codec.OPT_REBIND_TIME, struct.pack("!I", (lt * 7) // 8)))
+        hit = (opts, dhcp_codec.encode_options(opts))
+        # bound the cache: per-subscriber lease times (authenticator
+        # profiles) could otherwise grow it without limit
+        if len(self._reply_opts_cache) >= 1024:
+            self._reply_opts_cache.pop(next(iter(self._reply_opts_cache)))
+        self._reply_opts_cache[key] = hit
+        return hit
+
+    def _build_reply(self, req: DHCPPacket, msg_type: int, ip: int, pool: Pool,
+                     lease_time: int | None = None, include_lease: bool = True) -> DHCPPacket:
+        lt = lease_time if lease_time is not None else pool.lease_time
+        p = DHCPPacket(
+            op=2, xid=req.xid, flags=req.flags, ciaddr=req.ciaddr if msg_type == ACK else 0,
+            yiaddr=ip, siaddr=self.server_ip, giaddr=req.giaddr, chaddr=req.chaddr,
+        )
+        static_opts, static_raw = self._static_reply_options(pool, lt, include_lease)
+        mt = (dhcp_codec.OPT_MSG_TYPE, bytes([msg_type]))
+        p.options = [mt] + static_opts
+        p.options_raw = bytes((dhcp_codec.OPT_MSG_TYPE, 1, msg_type)) + static_raw
+        p.options_raw_n = len(p.options)
         return p
 
     def _build_nak(self, req: DHCPPacket) -> DHCPPacket:

@@ -16,12 +16,14 @@ ETH_P_8021AD = 0x88A8
 
 
 def checksum16(data: bytes) -> int:
+    # big-int fold: the 1's-complement 16-bit word sum equals the whole
+    # buffer folded mod 0xFFFF (one C-speed from_bytes, no unpack loop)
     if len(data) % 2:
         data += b"\x00"
-    s = sum(struct.unpack(f"!{len(data)//2}H", data))
-    s = (s & 0xFFFF) + (s >> 16)
-    s = (s & 0xFFFF) + (s >> 16)
-    return (~s) & 0xFFFF
+    n = int.from_bytes(data, "big")
+    while n > 0xFFFF:
+        n = (n & 0xFFFF) + (n >> 16)
+    return (~n) & 0xFFFF
 
 
 def eth_header(dst: bytes, src: bytes, ethertype: int, vlans: list[int] | None = None) -> bytes:
@@ -51,10 +53,15 @@ def ipv4_header(
     tos: int = 0,
 ) -> bytes:
     total = 20 + payload_len
-    hdr = struct.pack("!BBHHHBBH4s4s", 0x45, tos, total, ident, 0, ttl, proto, 0,
-                      struct.pack("!I", src_ip), struct.pack("!I", dst_ip))
-    csum = checksum16(hdr)
-    return hdr[:10] + struct.pack("!H", csum) + hdr[12:]
+    # checksum computed arithmetically from the fields (one pack, no
+    # unpack round-trip — this is the slow-path server's hottest helper)
+    s = ((0x4500 | tos) + total + ident + ((ttl << 8) | proto)
+         + (src_ip >> 16) + (src_ip & 0xFFFF)
+         + (dst_ip >> 16) + (dst_ip & 0xFFFF))
+    s = (s & 0xFFFF) + (s >> 16)
+    s = (s & 0xFFFF) + (s >> 16)
+    return struct.pack("!BBHHHBBHII", 0x45, tos, total, ident, 0, ttl, proto,
+                       (~s) & 0xFFFF, src_ip, dst_ip)
 
 
 def udp_header(src_port: int, dst_port: int, payload_len: int, csum: int = 0) -> bytes:
@@ -72,6 +79,21 @@ def udp_packet(
     vlans: list[int] | None = None,
     ttl: int = 64,
 ) -> bytes:
+    if vlans is None:
+        # hot path (slow-path DHCP server replies): one pack for the whole
+        # eth+ip+udp header stack, checksum folded arithmetically
+        total = 28 + len(payload)
+        s = (0x4500 + total + ((ttl << 8) | 17)
+             + (src_ip >> 16) + (src_ip & 0xFFFF)
+             + (dst_ip >> 16) + (dst_ip & 0xFFFF))
+        s = (s & 0xFFFF) + (s >> 16)
+        s = (s & 0xFFFF) + (s >> 16)
+        return struct.pack(
+            "!6s6sHBBHHHBBHIIHHHH",
+            dst_mac, src_mac, ETH_P_IP,
+            0x45, 0, total, 0, 0, ttl, 17, (~s) & 0xFFFF, src_ip, dst_ip,
+            src_port, dst_port, 8 + len(payload), 0,
+        ) + payload
     udp = udp_header(src_port, dst_port, len(payload)) + payload
     ip = ipv4_header(src_ip, dst_ip, len(udp), 17, ttl=ttl)
     return eth_header(dst_mac, src_mac, ETH_P_IP, vlans) + ip + udp
@@ -153,13 +175,10 @@ def decode(raw: bytes) -> DecodedPacket:
     p.ethertype = et
     if et != ETH_P_IP:
         return p
-    ihl = (raw[off] & 0x0F) * 4
-    p.ip_total_len = struct.unpack_from("!H", raw, off + 2)[0]
-    p.ttl = raw[off + 8]
-    p.proto = raw[off + 9]
-    p.ip_checksum = struct.unpack_from("!H", raw, off + 10)[0]
-    p.src_ip = struct.unpack_from("!I", raw, off + 12)[0]
-    p.dst_ip = struct.unpack_from("!I", raw, off + 16)[0]
+    (ver_ihl, _tos, p.ip_total_len, _ident, _frag, p.ttl, p.proto,
+     p.ip_checksum, p.src_ip, p.dst_ip) = struct.unpack_from(
+        "!BBHHHBBHII", raw, off)
+    ihl = (ver_ihl & 0x0F) * 4
     p.ip_checksum_ok = checksum16(raw[off : off + ihl]) == 0
     l4 = off + ihl
     if p.proto == 17:

@@ -40,9 +40,7 @@ def mac_to_u64(mac: bytes | str) -> int:
         mac = parse_mac(mac)
     if len(mac) != 6:
         raise ValueError(f"MAC must be 6 bytes, got {len(mac)}")
-    out = 0
-    for b in mac:
-        out = (out << 8) | b
+    out = int.from_bytes(mac, "big")
     return out
 
 
