@@ -378,3 +378,52 @@ class TestDHCPFastLane:
                           ip_to_u32("8.8.8.8"), 1234, 80)
         out = engine.process_dhcp([junk])
         assert out["tx"] == [] and len(out["slow"]) == 1
+
+
+class TestCoADeviceIntegration:
+    """RADIUS CoA -> device QoS enforcement, end to end (the reference's
+    EBPFQoSUpdaterFunc flow, coa_handler.go:175-460: a policy change must
+    reach the packet path with no session restart)."""
+
+    def test_coa_policy_change_enforced_on_next_step(self, stack):
+        from bng_tpu.control.radius import packet as rp
+        from bng_tpu.control.radius.coa import CoAProcessor, CoAServer
+        from bng_tpu.control.radius.policy import PolicyManager, QoSPolicy
+
+        engine, server, nat, qos, spoof, clock = stack
+        sub_ip = ip_to_u32("10.0.0.66")
+        mac = bytes.fromhex("02c0ffee0066")
+        # generous initial policy: everything passes
+        qos.set_subscriber(sub_ip, down_bps=1_000_000_000, up_bps=1_000_000_000)
+        frames = [data_frame(mac, sub_ip, ip_to_u32("8.8.8.8"), 1111, 9999,
+                             b"x" * 400)] * 6
+        r = engine.process(frames)
+        assert len(r["dropped"]) == 0
+
+        # CoA: throttle to a policy whose burst admits ~2 of these frames
+        pm = PolicyManager()
+        pm.add(QoSPolicy("throttled", download_bps=8_000, upload_bps=8_000,
+                         burst_factor=1.0))
+        session = type("S", (), {"ip": sub_ip, "mac": mac})()
+
+        def qos_update(ip, policy_name):
+            p = pm.get(policy_name)
+            qos.set_subscriber(ip, down_bps=p.download_bps, up_bps=p.upload_bps,
+                               down_burst=1000, up_burst=1000,
+                               priority=p.priority)
+            return True
+
+        proc = CoAProcessor(find_by_ip=lambda ip: session,
+                            qos_update=qos_update, policy_manager=pm)
+        srv = CoAServer(b"secret", proc)
+        req = rp.RadiusPacket(rp.COA_REQUEST, 9)
+        req.add(rp.FRAMED_IP_ADDRESS, sub_ip)
+        req.add(rp.FILTER_ID, "throttled")
+        resp = rp.RadiusPacket.decode(srv.handle_raw(req.encode(b"secret")))
+        assert resp.code == rp.COA_ACK
+
+        # the policy change rides the bounded update drain into the very
+        # next device step: 1000B bucket / ~442B frames -> ~2 pass, rest drop
+        clock.advance(0.001)
+        r2 = engine.process(frames)
+        assert len(r2["dropped"]) >= 3, r2
