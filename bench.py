@@ -324,6 +324,22 @@ def main(on_tpu: bool) -> None:
     offer_p99 = float(np.percentile(llat_us, 99))
     offer_hits = int(np.asarray(lreply).sum())
 
+    offer_profile_top = None
+    if want_profile == "1":
+        try:  # per-op profile of the DHCP-only program: a missed <50us
+            # OFFER target must self-diagnose in the artifact
+            from bng_tpu.utils.profiling import format_report, profile_op_times
+
+            rep = profile_op_times(
+                lambda: dhcp_step(dtables, lpkt_d, llen_d, jnp.uint32(now)),
+                iters=10)
+            _mark("\n[dhcp-only program]\n" + format_report(rep))
+            offer_profile_top = [{"op": o.name, "us": round(o.us_per_iter, 1)}
+                                 for o in rep.ops[:6]]
+        except Exception as e:  # profiling must never sink the benchmark
+            _mark(f"offer profiling failed (continuing): {type(e).__name__}: {e}")
+            _DIAG["offer_profile_error"] = f"{type(e).__name__}: {e}" 
+
     # ---- batch-size/latency curve + dispatch decomposition (VERDICT r2
     # ask #3): per-B blocked percentiles (what a lone batch feels) AND the
     # depth-8 pipelined per-step time (device time with dispatch amortized
@@ -388,6 +404,7 @@ def main(on_tpu: bool) -> None:
         "offer_hits": offer_hits,
         "latency_curve": curve,
         **({"profile_top_ops": profile_top} if profile_top else {}),
+        **({"offer_profile_top_ops": offer_profile_top} if offer_profile_top else {}),
         "device": str(dev),
         "compile_s": round(compile_s, 1),
         "setup_s": round(setup_s, 1),

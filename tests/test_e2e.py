@@ -402,12 +402,13 @@ class TestCoADeviceIntegration:
 
         # CoA: throttle to a policy whose burst admits ~2 of these frames
         pm = PolicyManager()
-        pm.add(QoSPolicy("throttled", download_bps=8_000, upload_bps=8_000,
-                         burst_factor=1.0))
+        pm.add(QoSPolicy("throttled", download_bps=8_000, upload_bps=8_000))
         session = type("S", (), {"ip": sub_ip, "mac": mac})()
 
         def qos_update(ip, policy_name):
             p = pm.get(policy_name)
+            # burst pinned to 1000B so the admitted-frame count below is
+            # deterministic regardless of the policy's burst_factor
             qos.set_subscriber(ip, down_bps=p.download_bps, up_bps=p.upload_bps,
                                down_burst=1000, up_burst=1000,
                                priority=p.priority)
