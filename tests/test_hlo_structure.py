@@ -110,6 +110,43 @@ class TestDHCPFastpathShape:
         assert narrow_1d == 0, f"{narrow_1d} 1-D narrow gathers"
 
 
+class TestNAT44Shape:
+    def test_probes_are_wide_row_gathers(self):
+        """NAT's three cuckoo tables (sessions K=4, reverse K=4, sub_nat
+        K=1 — all KW=8) must probe as packed [1,32] bucket rows; the
+        kernel + accounting pass stay within a tight gather/scatter
+        budget (narrow whole-table gathers are the serialized shape)."""
+        from bng_tpu.control.nat import NATManager
+        from bng_tpu.ops.nat44 import nat44_kernel, nat44_update_sessions
+        from bng_tpu.ops.parse import parse_batch
+        from bng_tpu.utils.net import ip_to_u32
+
+        nat = NATManager(public_ips=[ip_to_u32("203.0.113.1")],
+                         sessions_nbuckets=256, sub_nat_nbuckets=64)
+        tables = nat.device_tables()
+        B, L = 256, 512
+        pkt = jnp.zeros((B, L), dtype=jnp.uint8)
+        ln = jnp.full((B,), 200, dtype=jnp.uint32)
+
+        def step(tables, pkt, ln):
+            par = parse_batch(pkt, ln)
+            res = nat44_kernel(pkt, ln, par, tables, nat.geom, jnp.uint32(1))
+            sess = nat44_update_sessions(tables.sessions, res, par, ln,
+                                         keep=res.translated,
+                                         now_s=jnp.uint32(1))
+            return res.out_pkt, res.translated, sess
+
+        hlo = _stablehlo(step, tables, pkt, ln)
+        row_probes = _count(r"slice_sizes = array<i64: 1, 32>", hlo)
+        assert row_probes >= 6, f"packed probes missing: {row_probes}"
+        narrow_1d = _count(r"slice_sizes = array<i64: 1>(?!,)", hlo)
+        assert narrow_1d == 0, f"{narrow_1d} 1-D narrow gathers"
+        total = _count(r'"stablehlo\.gather"', hlo)
+        assert total <= 22, f"gather explosion: {total}"
+        scatters = _count(r'"stablehlo\.scatter"', hlo)
+        assert scatters <= 4, f"scatter explosion: {scatters}"
+
+
 class TestShardedExchangeShape:
     def test_two_collectives_per_lookup(self):
         """The sharded lookup must stay exactly two all-to-alls (request +
