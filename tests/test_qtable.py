@@ -191,3 +191,37 @@ class TestBulkInsert:
         t.device_state()  # resync
         t.insert(99999, rate_bps=1, burst=1)
         assert t.dirty_count() == 1
+
+
+class TestTimestampWrap:
+    def test_refill_across_u32_us_wrap(self):
+        """The µs clock wraps every ~71.6 minutes; refill must compute the
+        elapsed time modulo 2^32 (uint32 wrap-safe diff), not go negative
+        or grant a huge refill at the boundary."""
+        import jax.numpy as jnp
+
+        from bng_tpu.ops.qos import qos_kernel
+        from bng_tpu.runtime.engine import QoSTables
+
+        qos = QoSTables(nbuckets=64)
+        # 8 Mbps = 1e6 B/s; burst 10kB
+        qos.set_subscriber(0x0A000002, down_bps=8_000_000, up_bps=8_000_000,
+                           up_burst=10_000, down_burst=10_000)
+        st = qos.up.device_state()
+        ips = jnp.full((4,), 0x0A000002, dtype=jnp.uint32)
+        lens = jnp.full((4,), 2_000, dtype=jnp.uint32)
+        active = jnp.ones((4,), dtype=bool)
+
+        # drain most of the bucket just before the wrap point
+        t1 = jnp.uint32(0xFFFFFF00)
+        r1 = qos_kernel(ips, lens, active, st, qos.geom, t1)
+        assert list(np.asarray(r1.allowed)) == [True] * 4  # 8k of 10k burst
+        st = r1.table
+
+        # 2ms later, ACROSS the wrap: refill = 2000us * 1B/us = 2000B.
+        # bucket = min(2000 + 2000, burst); exactly two 2000B packets pass
+        t2 = jnp.uint32((0xFFFFFF00 + 2_000) & 0xFFFFFFFF)
+        assert int(t2) < int(t1)  # genuinely wrapped
+        r2 = qos_kernel(ips, lens, active, st, qos.geom, t2)
+        assert list(np.asarray(r2.allowed)) == [True, True, False, False], \
+            np.asarray(r2.allowed)
