@@ -54,15 +54,20 @@ class DHCPPacket:
     sname: bytes = b""
     file: bytes = b""
     options: list[tuple[int, bytes]] = field(default_factory=list)
-    # pre-encoded options (END included): when set AND the option count
-    # still matches options_raw_n, encode() uses these bytes verbatim
-    # instead of TLV-encoding `options` — the slow-path server caches its
-    # static per-pool reply suffix this way. Appending an option after
-    # the raw bytes were built changes the count and automatically falls
-    # back to the full TLV encode (in-place REPLACEMENT of an existing
-    # option must clear options_raw explicitly).
+    # pre-encoded options (END included): when set AND `options` still
+    # equals the snapshot taken by set_options_raw(), encode() uses these
+    # bytes verbatim instead of TLV-encoding `options` — the slow-path
+    # server caches its static per-pool reply suffix this way. ANY
+    # mutation of `options` after the snapshot (append, replace-in-place,
+    # delete) falls back to the full TLV encode automatically; the
+    # identity fast path keeps the cached-suffix case O(1).
     options_raw: bytes | None = None
-    options_raw_n: int = -1
+    _options_raw_snap: tuple | None = None
+
+    def set_options_raw(self, raw: bytes) -> None:
+        """Install pre-encoded option bytes for the CURRENT `options` list."""
+        self.options_raw = raw
+        self._options_raw_snap = tuple(self.options)
 
     # -- option helpers --
     def opt(self, code: int) -> bytes | None:
@@ -116,8 +121,11 @@ class DHCPPacket:
         chaddr = (self.chaddr + b"\x00" * 16)[:16]
         sname = (self.sname + b"\x00" * 64)[:64]
         bfile = (self.file + b"\x00" * 128)[:128]
-        use_raw = (self.options_raw is not None
-                   and len(self.options) == self.options_raw_n)
+        snap = self._options_raw_snap
+        use_raw = (self.options_raw is not None and snap is not None
+                   and len(snap) == len(self.options)
+                   and all(a is b or a == b
+                           for a, b in zip(snap, self.options)))
         opts = self.options_raw if use_raw else encode_options(self.options)
         return fixed + chaddr + sname + bfile + struct.pack("!I", DHCP_MAGIC) + opts
 

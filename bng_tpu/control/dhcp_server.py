@@ -398,10 +398,12 @@ class DHCPServer:
         """The reply options after MSG_TYPE are a function of (pool, lease
         config) only — build once per key, cache the list AND its encoded
         TLV suffix (the slow path's hottest allocation)."""
-        # keyed on the option-relevant VALUES, so a reconfigured pool can
-        # never serve a stale cached suffix
+        # keyed on the option-relevant VALUES, so a reconfigured pool (or a
+        # future runtime server-IP change — OPT_SERVER_ID is baked into the
+        # cached bytes) can never serve a stale cached suffix
         key = (pool.pool_id, lt, include_lease, pool.prefix_len,
-               pool.gateway, pool.dns_primary, pool.dns_secondary)
+               pool.gateway, pool.dns_primary, pool.dns_secondary,
+               self.server_ip)
         hit = self._reply_opts_cache.get(key)
         if hit is not None:
             return hit
@@ -438,8 +440,7 @@ class DHCPServer:
         static_opts, static_raw = self._static_reply_options(pool, lt, include_lease)
         mt = (dhcp_codec.OPT_MSG_TYPE, bytes([msg_type]))
         p.options = [mt] + static_opts
-        p.options_raw = bytes((dhcp_codec.OPT_MSG_TYPE, 1, msg_type)) + static_raw
-        p.options_raw_n = len(p.options)
+        p.set_options_raw(bytes((dhcp_codec.OPT_MSG_TYPE, 1, msg_type)) + static_raw)
         return p
 
     def _build_nak(self, req: DHCPPacket) -> DHCPPacket:

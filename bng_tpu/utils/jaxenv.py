@@ -113,19 +113,38 @@ def guarded_backend(
     probe_timeout_s: float = 120.0,
     retry_sleep_s: float = 10.0,
     cpu_devices: int = 8,
+    window_s: float = 0.0,
 ) -> tuple[str, str]:
     """Initialize a usable JAX backend without ever hanging or crashing.
 
     Returns ``(platform, error)``.  ``error`` is non-empty when the
     accelerator was wanted but unreachable and CPU fallback was taken.
+
+    ``window_s > 0`` turns the bounded ``tries`` loop into a
+    capture-on-return loop: keep probing (each probe bounded by
+    ``probe_timeout_s``) until a probe succeeds or the wall-clock window
+    expires.  This is the unattended round-end mode (VERDICT r3 weak #4):
+    the axon tunnel drops for stretches, and a single 150 s probe turned a
+    whole round's deliverable into a CPU artifact.  Probes are subprocesses,
+    so a dead tunnel costs one child per attempt, never a wedged parent.
     """
     if not prefer_accelerator or os.environ.get("JAX_PLATFORMS") == "cpu":
         force_cpu(cpu_devices)
         return "cpu", ""
     err = ""
-    for attempt in range(tries):
+    deadline = time.monotonic() + window_s if window_s > 0 else None
+    attempt = 0
+    while True:
+        if attempt >= tries:
+            break
+        if deadline is not None and attempt:
+            # a retry costs up to sleep+probe: only start one that can
+            # finish inside the window, so probing never eats run budget
+            if time.monotonic() + retry_sleep_s + probe_timeout_s >= deadline:
+                break
         if attempt:
             time.sleep(retry_sleep_s)
+        attempt += 1
         platform, err = probe_accelerator(probe_timeout_s)
         if platform:
             # Probe succeeded; in-process init should follow the same path.

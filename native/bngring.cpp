@@ -285,7 +285,10 @@ int bng_ring_rx_submit(bng_ring *r, uint64_t addr, uint32_t len,
   }
   /* direction gate: the fused pipeline only answers access-side DHCP
    * (dhcp_tx = is_reply & from_access) — a network-side frame must never
-   * enter the fast lane */
+   * enter the fast lane.  The classifier is authoritative: a caller's
+   * pre-set DHCP_CTRL bit is cleared first, so a stale/hostile flags word
+   * can never route a network-side frame around NAT/antispoof/QoS. */
+  flags &= ~BNG_DESC_F_DHCP_CTRL;
   if (flags & BNG_DESC_F_FROM_ACCESS)
     flags |= classify_dhcp(r->umem + addr, len);
   bng_desc d{addr, len, flags};
@@ -453,12 +456,10 @@ static uint32_t pump_dir(bng_ring *src, bng_ring *dst, uint32_t budget) {
     if (!got) got = src->fwd.pop(&d);
     if (!got) break;
     /* flags flip: frames leaving the access side arrive at the core side.
-     * Drop the DHCP-control bit — it was classified for the ORIGINAL
-     * direction; rx_submit re-classifies access-bound frames, and a stale
-     * bit on a now-network-side frame would smuggle it into the fast lane
-     * past the direction gate. */
-    uint32_t fl =
-        (d.flags & ~BNG_DESC_F_DHCP_CTRL) ^ BNG_DESC_F_FROM_ACCESS;
+     * The stale direction-specific DHCP-control bit needs no handling
+     * here: rx_submit clears and re-derives it authoritatively for every
+     * submitted frame. */
+    uint32_t fl = d.flags ^ BNG_DESC_F_FROM_ACCESS;
     bng_ring_rx_push(dst, src->umem + d.addr, d.len, fl);
     src->fill.push(d);
     moved++;

@@ -265,3 +265,54 @@ class TestRingClassifierFuzz:
             if n:
                 ring.complete(np.zeros((n,), dtype=np.uint8), pkt, ln, n)
             ring.close()
+
+
+class TestCodecCacheInvalidation:
+    """ADVICE r3: options_raw must never serve stale bytes after an
+    in-place option REPLACEMENT (same count, different value)."""
+
+    def test_replace_in_place_invalidates_raw_cache(self):
+        p = dhcp_codec.DHCPPacket(op=2, xid=1, chaddr=b"\x02" * 6)
+        p.options = [(dhcp_codec.OPT_MSG_TYPE, bytes([dhcp_codec.OFFER])),
+                     (dhcp_codec.OPT_LEASE_TIME, (86400).to_bytes(4, "big"))]
+        p.set_options_raw(dhcp_codec.encode_options(p.options))
+        before = p.encode()
+        # same option count, new value: the old count-based check missed this
+        p.options[1] = (dhcp_codec.OPT_LEASE_TIME, (60).to_bytes(4, "big"))
+        after = p.encode()
+        assert after != before
+        assert after == dhcp_codec.decode(after).encode()
+        assert dhcp_codec.decode(after).opt(dhcp_codec.OPT_LEASE_TIME) == (60).to_bytes(4, "big")
+
+    def test_unmutated_uses_raw_bytes_verbatim(self):
+        p = dhcp_codec.DHCPPacket(op=2, xid=1, chaddr=b"\x02" * 6)
+        p.options = [(dhcp_codec.OPT_MSG_TYPE, bytes([dhcp_codec.ACK]))]
+        sentinel = dhcp_codec.encode_options(p.options) + b"\x00\x00"  # pad tail
+        p.set_options_raw(sentinel)
+        assert p.encode().endswith(sentinel)
+
+
+class TestChecksum16Fold:
+    """ADVICE r3: the mod-0xFFFF reduction must match the word-sum fold,
+    including the nonzero-multiple-of-0xFFFF edge."""
+
+    def _ref(self, data: bytes) -> int:
+        if len(data) % 2:
+            data += b"\x00"
+        s = sum(int.from_bytes(data[i:i + 2], "big") for i in range(0, len(data), 2))
+        while s > 0xFFFF:
+            s = (s & 0xFFFF) + (s >> 16)
+        return (~s) & 0xFFFF
+
+    def test_matches_word_sum_reference(self):
+        rng = np.random.default_rng(0xC5)
+        from bng_tpu.control.packets import checksum16
+        for n in (0, 1, 2, 3, 20, 1499, 65536):
+            data = rng.integers(0, 256, size=n, dtype=np.uint8).tobytes()
+            assert checksum16(data) == self._ref(data)
+
+    def test_ffff_multiple_edge(self):
+        from bng_tpu.control.packets import checksum16
+        assert checksum16(b"") == 0xFFFF
+        assert checksum16(b"\xff\xff") == self._ref(b"\xff\xff") == 0
+        assert checksum16(b"\xff\xfe\x00\x01") == self._ref(b"\xff\xfe\x00\x01")

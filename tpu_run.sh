@@ -1,0 +1,48 @@
+#!/bin/bash
+# One-window TPU validation (PERF_NOTES §3.6, VERDICT r3 item 1).
+#
+# Runs everything the round needs while the axon tunnel is up, each step in
+# its own process (a stuck client can wedge the relay — PERF_NOTES §3.5), with
+# a health probe between steps so a mid-window outage aborts cleanly instead
+# of hanging the remaining steps.  Results land in bench_runs.jsonl via
+# bench.py's _persist; the transcript goes to $LOG.
+#
+# Step order = information value per VERDICT r3: the lowering gate first
+# (cheap, gates everything), then config 3 (way-granular QoS — the round's
+# load-bearing unknown), config 2 (NAT44 regression check vs the 33.2 Mpps
+# r3 number), config 6 (DHCP standalone @1M subs), config 4 (never yet
+# completed on TPU), config 5 (sharded, n=1 geometry on the single chip),
+# then the headline fused pipeline at 1M subscribers.
+set -u
+cd "$(dirname "$0")"
+LOG=${TPU_RUN_LOG:-/tmp/tpu_validation.log}
+LOCK=/tmp/tpu_run.lock
+if ! mkdir "$LOCK" 2>/dev/null; then
+  echo "another tpu_run.sh holds $LOCK; exiting" | tee -a "$LOG"; exit 2
+fi
+trap 'rmdir "$LOCK" 2>/dev/null' EXIT
+
+# Chip is known up inside the window: no capture-on-return probing per step.
+export BNG_BENCH_PROBE_WINDOW=0 BNG_BENCH_PROBE_TIMEOUT=60 BNG_BENCH_PROBE_TRIES=1
+
+probe() {
+  timeout 75 python -c "import jax, jax.numpy as j; (j.ones((8,8))@j.ones((8,8))).block_until_ready()" >/dev/null 2>&1
+}
+step() {
+  echo "=== $1 ($(date -u +%H:%M:%S))" | tee -a "$LOG"
+  BNG_BENCH_TIMEOUT=$2 timeout $(($2 + 60)) bash -c "$3" 2>&1 \
+    | grep -v WARNING | tail -12 | tee -a "$LOG"
+  probe || { echo "TUNNEL DEAD after $1 ($(date -u +%H:%M:%S))" | tee -a "$LOG"; exit 1; }
+}
+
+probe || { echo "tunnel down at start ($(date -u +%H:%M:%S))" | tee -a "$LOG"; exit 1; }
+echo "=== window open $(date -u +%H:%M:%S)" | tee -a "$LOG"
+step "lowering-gate" 600  "python bench.py --verify-lowering"
+step "config3-qos"   900  "python bench.py --config 3"
+step "config2-nat"   900  "python bench.py --config 2"
+step "config6-dhcp"  900  "python bench.py --config 6"
+step "config4-pppoe" 900  "python bench.py --config 4"
+step "config5-shard" 900  "python bench.py --config 5"
+step "headline-1M"   2400 "BNG_BENCH_SUBS=1000000 BNG_BENCH_FLOWS=1000000 python bench.py"
+echo "ALL DONE $(date -u +%H:%M:%S)" | tee -a "$LOG"
+touch /tmp/tpu_run.done
