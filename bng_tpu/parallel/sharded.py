@@ -3,10 +3,13 @@
 Scale-out design (replacing the reference's HTTP/SSE + hashring node mesh,
 SURVEY.md §2.3, with ICI collectives):
 
-- **Packets are data-parallel**: the ring steers each subscriber's traffic
-  to a consistent chip (rendezvous hashing at the host ring — the
-  pkg/pool/peer.go owner-routing role), so each chip's batch is its own
-  subscribers' traffic.
+- **Packets are data-parallel**: the host ring steers each subscriber's
+  traffic to a consistent chip (runtime/ring.py shard_of + bngring.cpp
+  bng_ring_shard_of — the pkg/pool/peer.go owner-routing role, re-hosted
+  at the ring): upstream by FNV-1a32(private src IP), downstream by NAT
+  public-IP ownership, so each chip's batch region (assemble_sharded) is
+  its own subscribers' traffic. affinity_shard_ip() is the same function
+  on the control-plane side.
 - **Flow state is chip-local**: NAT sessions / QoS buckets / antispoof
   bindings live on the chip that owns the subscriber — no cross-chip
   traffic for the hot NAT path (mirrors the reference where each node owns
@@ -203,12 +206,62 @@ class ShardedCluster:
         words = [w[i : i + 1] for i in range(8)]
         return int(shard_owner(words, self.n)[0])
 
-    def affinity_shard(self, subscriber_key: str) -> int:
-        """Traffic-placement shard for a subscriber (rendezvous over chips)."""
-        from bng_tpu.parallel.hashring import rendezvous_owner
+    def affinity_shard_ip(self, private_ip: int) -> int:
+        """Traffic-placement shard for a subscriber's private IP.
 
-        nodes = [str(i) for i in range(self.n)]
-        return int(rendezvous_owner(nodes, subscriber_key))
+        MUST match the host ring's per-frame steering decision bit-for-bit
+        (ring.shard_of / bngring.cpp bng_ring_shard_of: FNV-1a32 over the
+        4 wire-order IP bytes, mod n): the ring steers the subscriber's
+        upstream traffic here, so this is the only shard where chip-local
+        NAT/QoS/antispoof state for the subscriber is ever consulted.
+        Place that state via allocate_nat/set_qos/add_spoof_binding below
+        rather than indexing self.nat[...] directly."""
+        from bng_tpu.utils.net import fnv1a32
+
+        return fnv1a32(int(private_ip).to_bytes(4, "big")) % self.n
+
+    # ---- subscriber-affinity service placement (owner-shard routing) ----
+    def allocate_nat(self, private_ip: int, now: int = 0):
+        """Allocate a NAT port block on the subscriber's owner shard.
+
+        Returns (owner_shard, allocation) — the pkg/pool/peer.go
+        owner-or-forward role: the ring steers the subscriber's packets to
+        owner_shard, so its NAT state lives there and nowhere else."""
+        o = self.affinity_shard_ip(private_ip)
+        return o, self.nat[o].allocate_nat(private_ip, now)
+
+    def handle_new_flow(self, src_ip: int, *args, **kw):
+        o = self.affinity_shard_ip(src_ip)
+        return o, self.nat[o].handle_new_flow(src_ip, *args, **kw)
+
+    def set_qos(self, private_ip: int, **kw) -> int:
+        o = self.affinity_shard_ip(private_ip)
+        self.qos[o].set_subscriber(private_ip, **kw)
+        return o
+
+    def add_spoof_binding(self, mac, ipv4: int, mode: int) -> int:
+        o = self.affinity_shard_ip(ipv4)
+        self.spoof[o].add_binding(mac, ipv4, mode)
+        return o
+
+    def pub_ip_map(self) -> dict[int, int]:
+        """NAT public IP -> owner shard (downstream ring steering)."""
+        return {ip: s for s in range(self.n) for ip in self.nat[s].public_ips}
+
+    def make_ring(self, nframes: int = 4096, frame_size: int = 2048,
+                  depth: int = 1024, prefer_native: bool = True):
+        """A host packet ring steering frames to this cluster's shards.
+
+        The assemble_sharded layout (shard i's lanes at rows i*b..(i+1)*b)
+        is exactly step()'s batch contract, so `ring -> assemble_sharded ->
+        step -> complete` is the full multichip I/O loop."""
+        from bng_tpu.runtime.ring import make_ring as _mk
+
+        ring = _mk(nframes, frame_size, depth, prefer_native=prefer_native,
+                   n_shards=self.n)
+        for ip, s in self.pub_ip_map().items():
+            ring.steer_pub_ip(ip, s)
+        return ring
 
     # ---- control-plane writes ----
     def add_pool_all(self, pool_id: int, network: int, prefix_len: int, gateway: int,

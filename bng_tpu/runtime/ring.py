@@ -70,6 +70,44 @@ def classify_dhcp(frame: bytes) -> int:
     return FLAG_DHCP_CTRL if magic == 0x63825363 else 0
 
 
+def shard_of(frame: bytes, flags: int, n_shards: int,
+             pub_ips: dict[int, int] | None = None) -> int:
+    """Owner-shard steering decision — the PyRing mirror of bngring.cpp's
+    bng_ring_shard_of; must agree bit-for-bit (spec in bngring.h).
+
+    The subscriber-affinity placement chip-local NAT/QoS/antispoof state
+    depends on (parallel/sharded.py): upstream by FNV-1a32(src IP),
+    downstream by NAT-public-IP ownership (pub_ips: host-order IP ->
+    shard) falling back to FNV-1a32(dst IP), DHCP-control and non-IPv4
+    frames by FNV-1a32(src MAC). `flags` are the descriptor flags AFTER
+    classification (FROM_ACCESS | DHCP_CTRL)."""
+    from bng_tpu.utils.net import fnv1a32
+
+    if n_shards == 1 or len(frame) < 14:
+        return 0
+    if not (flags & FLAG_DHCP_CTRL):
+        off = 12
+        et = (frame[off] << 8) | frame[off + 1]
+        for _ in range(2):
+            if et not in (0x8100, 0x88A8):
+                break
+            off += 4
+            if len(frame) < off + 2:
+                break
+            et = (frame[off] << 8) | frame[off + 1]
+        off += 2  # L3 start
+        if et == 0x0800 and len(frame) >= off + 20 and (frame[off] >> 4) == 4:
+            if flags & FLAG_FROM_ACCESS:
+                return fnv1a32(frame[off + 12 : off + 16]) % n_shards
+            dst = frame[off + 16 : off + 20]
+            if pub_ips:
+                s = pub_ips.get(int.from_bytes(dst, "big"))
+                if s is not None and s < n_shards:
+                    return s
+            return fnv1a32(dst) % n_shards
+    return fnv1a32(frame[6:12]) % n_shards
+
+
 class RingStats(C.Structure):
     _fields_ = [
         ("rx", C.c_uint64),
@@ -111,6 +149,22 @@ def _configure(lib: C.CDLL) -> None:
     lib.bng_batch_assemble.argtypes = [
         C.c_void_p, C.POINTER(C.c_uint8), C.POINTER(C.c_uint32),
         C.POINTER(C.c_uint32), C.c_uint32, C.c_uint32]
+    lib.bng_ring_create_sharded.restype = C.c_void_p
+    lib.bng_ring_create_sharded.argtypes = [C.c_uint32, C.c_uint32,
+                                            C.c_uint32, C.c_uint32]
+    lib.bng_ring_n_shards.restype = C.c_uint32
+    lib.bng_ring_n_shards.argtypes = [C.c_void_p]
+    lib.bng_ring_steer_pub_ip.restype = C.c_int
+    lib.bng_ring_steer_pub_ip.argtypes = [C.c_void_p, C.c_uint32, C.c_uint32]
+    lib.bng_ring_shard_of.restype = C.c_uint32
+    lib.bng_ring_shard_of.argtypes = [C.c_void_p, C.POINTER(C.c_uint8),
+                                      C.c_uint32, C.c_uint32]
+    lib.bng_batch_assemble_sharded.restype = C.c_uint32
+    lib.bng_batch_assemble_sharded.argtypes = [
+        C.c_void_p, C.POINTER(C.c_uint8), C.POINTER(C.c_uint32),
+        C.POINTER(C.c_uint32), C.c_uint32, C.c_uint32]
+    lib.bng_ring_shard_rx_pending.restype = C.c_uint32
+    lib.bng_ring_shard_rx_pending.argtypes = [C.c_void_p, C.c_uint32]
     lib.bng_ring_tx_inject.restype = C.c_int
     lib.bng_ring_tx_inject.argtypes = [C.c_void_p, C.POINTER(C.c_uint8),
                                        C.c_uint32, C.c_uint32]
@@ -155,16 +209,19 @@ class NativeRing:
     """One port's ring pair backed by the C++ UMEM/SPSC implementation."""
 
     def __init__(self, nframes: int = 4096, frame_size: int = 2048,
-                 depth: int = 1024):
+                 depth: int = 1024, n_shards: int = 1):
         lib = load_native()
         if lib is None:
             raise RuntimeError("native ring library unavailable")
         self._lib = lib
-        self._h = lib.bng_ring_create(nframes, frame_size, depth)
+        self._h = lib.bng_ring_create_sharded(nframes, frame_size, depth,
+                                              n_shards)
         if not self._h:
-            raise RuntimeError("bng_ring_create failed (sizes must be pow2)")
+            raise RuntimeError("bng_ring_create failed (sizes must be pow2, "
+                               "1 <= n_shards <= 64)")
         self.frame_size = frame_size
         self.depth = depth
+        self.n_shards = n_shards
 
     @property
     def umem_ptr(self):
@@ -197,6 +254,16 @@ class NativeRing:
         fl = FLAG_FROM_ACCESS if from_access else 0
         return self._lib.bng_ring_tx_inject(self._h, _u8p(buf), len(frame), fl) == 0
 
+    # -- steering --
+    def steer_pub_ip(self, ip: int, shard: int) -> bool:
+        """Register a NAT public IP (host order) as owned by `shard`."""
+        return self._lib.bng_ring_steer_pub_ip(self._h, ip, shard) == 0
+
+    def shard_of(self, frame: bytes, flags: int) -> int:
+        buf = np.frombuffer(frame, dtype=np.uint8)
+        return int(self._lib.bng_ring_shard_of(self._h, _u8p(buf),
+                                               len(frame), flags))
+
     # -- consumer --
     def assemble(self, out: np.ndarray, out_len: np.ndarray,
                  out_flags: np.ndarray) -> int:
@@ -204,6 +271,26 @@ class NativeRing:
         B, slot = out.shape
         return int(self._lib.bng_batch_assemble(
             self._h, _u8p(out), _u32p(out_len), _u32p(out_flags), B, slot))
+
+    def assemble_sharded(self, out: np.ndarray, out_len: np.ndarray,
+                         out_flags: np.ndarray) -> int:
+        """Sharded assemble: out is [n_shards*b, slot]; shard i's lanes land
+        at rows i*b..(i+1)*b (ShardedCluster.step's layout), padding rows
+        zeroed. Returns the number of REAL frames staged; when nonzero the
+        opened window must be completed with n = out.shape[0]."""
+        B, slot = out.shape
+        if B % self.n_shards:
+            raise ValueError(f"batch {B} not divisible by {self.n_shards} shards")
+        if B // self.n_shards > self.depth:
+            # the C side refuses (total rows > in-flight capacity) by
+            # returning 0 — which a caller cannot tell from "no traffic";
+            # surface the geometry error loudly instead of stalling forever
+            raise ValueError(
+                f"b_per_shard {B // self.n_shards} exceeds ring depth "
+                f"{self.depth}")
+        return int(self._lib.bng_batch_assemble_sharded(
+            self._h, _u8p(out), _u32p(out_len), _u32p(out_flags),
+            B // self.n_shards, slot))
 
     def complete(self, verdict: np.ndarray, out: np.ndarray,
                  out_len: np.ndarray, n: int) -> None:
@@ -235,6 +322,9 @@ class NativeRing:
     # -- introspection --
     def rx_pending(self) -> int:
         return self._lib.bng_ring_rx_pending(self._h)
+
+    def shard_rx_pending(self, shard: int) -> int:
+        return self._lib.bng_ring_shard_rx_pending(self._h, shard)
 
     def tx_pending(self) -> int:
         return self._lib.bng_ring_tx_pending(self._h)
@@ -276,32 +366,49 @@ class PyRing:
     """Pure-Python ring with the NativeRing API (the _stub.go fallback)."""
 
     def __init__(self, nframes: int = 4096, frame_size: int = 2048,
-                 depth: int = 1024):
+                 depth: int = 1024, n_shards: int = 1):
+        if not 1 <= n_shards <= 64:
+            raise RuntimeError("1 <= n_shards <= 64")
         self.frame_size = frame_size
         self.depth = depth
+        self.n_shards = n_shards
         self._free = nframes
-        self._rx: deque[tuple[bytes, int]] = deque()
+        self._rx: list[deque[tuple[bytes, int]]] = [deque()
+                                                    for _ in range(n_shards)]
         self._tx: deque[tuple[bytes, int]] = deque()
         self._fwd: deque[tuple[bytes, int]] = deque()
         self._slow: deque[tuple[bytes, int]] = deque()
-        self._inflight: list[list[tuple[bytes, int]]] = []  # FIFO of batches
+        # FIFO of batches; None entries = sharded-assemble padding lanes
+        self._inflight: list[list[tuple[bytes, int] | None]] = []
+        self._pub_ips: dict[int, int] = {}
         self._stats = {k: 0 for k, _ in RingStats._fields_}
 
     def close(self) -> None:
         pass
 
+    # -- steering --
+    def steer_pub_ip(self, ip: int, shard: int) -> bool:
+        if shard >= self.n_shards:
+            return False
+        self._pub_ips[ip] = shard
+        return True
+
+    def shard_of(self, frame: bytes, flags: int) -> int:
+        return shard_of(frame, flags, self.n_shards, self._pub_ips)
+
     def rx_push(self, frame: bytes, from_access: bool = True) -> bool:
         if len(frame) > self.frame_size:
             self._stats["bad_desc"] += 1
             return False
-        if self._free == 0 or len(self._rx) >= self.depth:
-            self._stats["fill_empty" if self._free == 0 else "rx_full"] += 1
-            return False
-        self._free -= 1
         fl = FLAG_FROM_ACCESS if from_access else 0
         if from_access:  # direction gate — see classify_dhcp docstring
             fl |= classify_dhcp(frame)
-        self._rx.append((frame, fl))
+        shard = self.shard_of(frame, fl)
+        if self._free == 0 or len(self._rx[shard]) >= self.depth:
+            self._stats["fill_empty" if self._free == 0 else "rx_full"] += 1
+            return False
+        self._free -= 1
+        self._rx[shard].append((frame, fl))
         return True
 
     def tx_inject(self, frame: bytes, from_access: bool = True) -> bool:
@@ -314,6 +421,14 @@ class PyRing:
 
     MAX_INFLIGHT = 2  # two assemble..complete windows (double buffering)
 
+    def _stage(self, out, out_len, out_flags, row_i, frame, fl, slot):
+        copy = min(len(frame), slot)
+        row = np.zeros((slot,), dtype=np.uint8)
+        row[:copy] = np.frombuffer(frame[:copy], dtype=np.uint8)
+        out[row_i] = row
+        out_len[row_i] = copy
+        out_flags[row_i] = fl
+
     def assemble(self, out: np.ndarray, out_len: np.ndarray,
                  out_flags: np.ndarray) -> int:
         if len(self._inflight) >= self.MAX_INFLIGHT:
@@ -321,20 +436,53 @@ class PyRing:
         B, slot = out.shape
         batch = []
         n = 0
-        while n < B and self._rx:
-            frame, fl = self._rx.popleft()
-            copy = min(len(frame), slot)
-            row = np.zeros((slot,), dtype=np.uint8)
-            row[:copy] = np.frombuffer(frame[:copy], dtype=np.uint8)
-            out[n] = row
-            out_len[n] = copy
-            out_flags[n] = fl
-            batch.append((frame, fl))
-            n += 1
+        # round-robin over shard queues (n_shards==1: plain drain)
+        idle, s = 0, 0
+        while n < B and idle < self.n_shards:
+            if not self._rx[s]:
+                idle += 1
+            else:
+                idle = 0
+                frame, fl = self._rx[s].popleft()
+                self._stage(out, out_len, out_flags, n, frame, fl, slot)
+                batch.append((frame, fl))
+                n += 1
+            s = (s + 1) % self.n_shards
         if n:
             self._inflight.append(batch)
         self._stats["rx"] += n
         return n
+
+    def assemble_sharded(self, out: np.ndarray, out_len: np.ndarray,
+                         out_flags: np.ndarray) -> int:
+        """Per-shard lane ranges — see NativeRing.assemble_sharded."""
+        if len(self._inflight) >= self.MAX_INFLIGHT:
+            return 0
+        B, slot = out.shape
+        if B % self.n_shards:
+            raise ValueError(f"batch {B} not divisible by {self.n_shards} shards")
+        b = B // self.n_shards
+        if b > self.depth:  # NativeRing parity: geometry error, not "empty"
+            raise ValueError(f"b_per_shard {b} exceeds ring depth {self.depth}")
+        batch: list[tuple[bytes, int] | None] = []
+        got = 0
+        for s in range(self.n_shards):
+            for _ in range(b):
+                if self._rx[s]:
+                    frame, fl = self._rx[s].popleft()
+                    self._stage(out, out_len, out_flags, len(batch), frame,
+                                fl, slot)
+                    batch.append((frame, fl))
+                    got += 1
+                else:
+                    out[len(batch)] = 0
+                    out_len[len(batch)] = 0
+                    out_flags[len(batch)] = 0
+                    batch.append(None)  # padding lane
+        if got:
+            self._inflight.append(batch)
+        self._stats["rx"] += got
+        return got
 
     def complete(self, verdict: np.ndarray, out: np.ndarray,
                  out_len: np.ndarray, n: int) -> None:
@@ -343,6 +491,8 @@ class PyRing:
             raise RuntimeError("batch_complete: n mismatch")
         batch = self._inflight.pop(0)
         for i in range(n):
+            if batch[i] is None:  # sharded-assemble padding lane
+                continue
             frame, fl = batch[i]
             v = int(verdict[i])
             if v in (VERDICT_TX, VERDICT_FWD):
@@ -378,7 +528,10 @@ class PyRing:
         return self._pop(self._slow)
 
     def rx_pending(self) -> int:
-        return len(self._rx)
+        return sum(len(q) for q in self._rx)
+
+    def shard_rx_pending(self, shard: int) -> int:
+        return len(self._rx[shard]) if shard < self.n_shards else 0
 
     def tx_pending(self) -> int:
         return len(self._tx)
@@ -397,11 +550,12 @@ class PyRing:
 
 
 def make_ring(nframes: int = 4096, frame_size: int = 2048,
-              depth: int = 1024, prefer_native: bool = True):
+              depth: int = 1024, prefer_native: bool = True,
+              n_shards: int = 1):
     """NativeRing when the toolchain allows, PyRing otherwise."""
     if prefer_native:
         try:
-            return NativeRing(nframes, frame_size, depth)
+            return NativeRing(nframes, frame_size, depth, n_shards)
         except RuntimeError:
             pass
-    return PyRing(nframes, frame_size, depth)
+    return PyRing(nframes, frame_size, depth, n_shards)

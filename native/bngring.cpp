@@ -147,17 +147,39 @@ struct MpmcRing {
 
 } // namespace
 
+/* Public-IP -> shard steering map: fixed-size open addressing with the
+ * bounded-probe discipline the fast-path tables use everywhere
+ * (nat44.c:423 bounds probes for the verifier; same style here).
+ *
+ * THREADING: single writer (control thread, bng_ring_steer_pub_ip),
+ * many readers (wire thread inside rx_submit). Publication protocol:
+ * the writer stores ip first, then shard_plus1 with release; a reader
+ * that observes shard_plus1 != 0 with acquire therefore sees the
+ * matching ip. Entries are never deleted; an existing IP's shard may be
+ * updated at runtime (the atomic store makes the switch clean). */
+struct PubMap {
+  static constexpr uint32_t SLOTS = 1024;
+  static constexpr uint32_t MAX_PROBE = 64;
+  struct Ent {
+    std::atomic<uint32_t> ip{0};
+    std::atomic<uint32_t> shard_plus1{0}; /* 0 = empty */
+  };
+  Ent ents[SLOTS];
+};
+
 struct bng_ring {
   uint8_t *umem = nullptr;
   uint64_t umem_size = 0;
   uint32_t frame_size = 0;
   uint32_t nframes = 0;
+  uint32_t n_shards = 1;
 
   MpmcRing fill; /* free frames (addr only) — any-thread alloc/free */
-  Ring rx;   /* wire -> engine */
+  Ring *rxq = nullptr; /* wire -> engine, one SPSC queue per shard */
   Ring tx;   /* engine TX verdicts -> wire (same port) */
   Ring fwd;  /* engine FWD verdicts -> wire (other port) */
   Ring slow; /* engine PASS verdicts -> slow path */
+  PubMap pubmap; /* downstream steering: NAT public IP -> owner shard */
 
   /* in-flight batches (assemble..complete windows). TWO slots so a
    * double-buffered engine can assemble+dispatch batch k+1 before
@@ -176,13 +198,15 @@ struct bng_ring {
 
 extern "C" {
 
-bng_ring *bng_ring_create(uint32_t nframes, uint32_t frame_size,
-                          uint32_t depth) {
+bng_ring *bng_ring_create_sharded(uint32_t nframes, uint32_t frame_size,
+                                  uint32_t depth, uint32_t n_shards) {
   if (!is_pow2(nframes) || !is_pow2(depth) || frame_size < 64) return nullptr;
+  if (n_shards < 1 || n_shards > 64) return nullptr;
   auto *r = new (std::nothrow) bng_ring();
   if (!r) return nullptr;
   r->frame_size = frame_size;
   r->nframes = nframes;
+  r->n_shards = n_shards;
   r->umem_size = static_cast<uint64_t>(nframes) * frame_size;
   /* PAGE alignment, size rounded to a page multiple: AF_XDP's
    * XDP_UMEM_REG requires a page-aligned area (bngxsk.cpp registers this
@@ -191,11 +215,15 @@ bng_ring *bng_ring_create(uint32_t nframes, uint32_t frame_size,
   const uint64_t page = 4096;
   uint64_t alloc_size = (r->umem_size + page - 1) & ~(page - 1);
   r->umem = static_cast<uint8_t *>(aligned_alloc(page, alloc_size));
-  bool ok = r->umem && r->fill.init(nframes) && r->rx.init(depth) &&
-            r->tx.init(depth) && r->fwd.init(depth) && r->slow.init(depth);
-  r->inflight_cap = depth;
+  r->rxq = new (std::nothrow) Ring[n_shards];
+  bool ok = r->umem && r->rxq && r->fill.init(nframes) && r->tx.init(depth) &&
+            r->fwd.init(depth) && r->slow.init(depth);
+  for (uint32_t s = 0; ok && s < n_shards; s++) ok = r->rxq[s].init(depth);
+  /* a sharded batch is n_shards regions of up to depth rows each */
+  r->inflight_cap = depth * n_shards;
   for (uint32_t i = 0; i < bng_ring::MAX_INFLIGHT; i++) {
-    r->inflight[i] = static_cast<bng_desc *>(calloc(depth, sizeof(bng_desc)));
+    r->inflight[i] =
+        static_cast<bng_desc *>(calloc(r->inflight_cap, sizeof(bng_desc)));
     ok = ok && r->inflight[i];
   }
   if (!ok) {
@@ -211,10 +239,17 @@ bng_ring *bng_ring_create(uint32_t nframes, uint32_t frame_size,
   return r;
 }
 
+bng_ring *bng_ring_create(uint32_t nframes, uint32_t frame_size,
+                          uint32_t depth) {
+  return bng_ring_create_sharded(nframes, frame_size, depth, 1);
+}
+
 void bng_ring_destroy(bng_ring *r) {
   if (!r) return;
   r->fill.fini();
-  r->rx.fini();
+  if (r->rxq)
+    for (uint32_t s = 0; s < r->n_shards; s++) r->rxq[s].fini();
+  delete[] r->rxq;
   r->tx.fini();
   r->fwd.fini();
   r->slow.fini();
@@ -222,6 +257,8 @@ void bng_ring_destroy(bng_ring *r) {
   free(r->umem);
   delete r;
 }
+
+uint32_t bng_ring_n_shards(bng_ring *r) { return r->n_shards; }
 
 uint8_t *bng_ring_umem(bng_ring *r) { return r->umem; }
 uint64_t bng_ring_umem_size(bng_ring *r) { return r->umem_size; }
@@ -277,6 +314,84 @@ static uint32_t classify_dhcp(const uint8_t *p, uint32_t len) {
   return magic == 0x63825363u ? BNG_DESC_F_DHCP_CTRL : 0;
 }
 
+/* FNV-1a32 — must match bng_tpu/utils/net.py fnv1a32 bit-for-bit (the
+ * control plane computes subscriber affinity with the Python twin). */
+static uint32_t fnv1a32_bytes(const uint8_t *p, uint32_t n) {
+  uint32_t h = 2166136261u;
+  for (uint32_t i = 0; i < n; i++) {
+    h ^= p[i];
+    h *= 16777619u;
+  }
+  return h;
+}
+
+static int pubmap_find(const PubMap &m, uint32_t ip, bool for_insert) {
+  uint8_t key[4] = {static_cast<uint8_t>(ip >> 24),
+                    static_cast<uint8_t>(ip >> 16),
+                    static_cast<uint8_t>(ip >> 8), static_cast<uint8_t>(ip)};
+  uint32_t h = fnv1a32_bytes(key, 4);
+  for (uint32_t probe = 0; probe < PubMap::MAX_PROBE; probe++) {
+    uint32_t slot = (h + probe) & (PubMap::SLOTS - 1);
+    const PubMap::Ent &e = m.ents[slot];
+    if (e.shard_plus1.load(std::memory_order_acquire) == 0)
+      return for_insert ? static_cast<int>(slot) : -1;
+    if (e.ip.load(std::memory_order_relaxed) == ip)
+      return static_cast<int>(slot);
+  }
+  return -1;
+}
+
+int bng_ring_steer_pub_ip(bng_ring *r, uint32_t ip, uint32_t shard) {
+  if (shard >= r->n_shards) return -1;
+  int slot = pubmap_find(r->pubmap, ip, /*for_insert=*/true);
+  if (slot < 0) return -1;
+  /* ip before shard_plus1-with-release: a concurrent reader that sees the
+   * entry occupied sees the right ip (PubMap threading contract above) */
+  r->pubmap.ents[slot].ip.store(ip, std::memory_order_relaxed);
+  r->pubmap.ents[slot].shard_plus1.store(shard + 1, std::memory_order_release);
+  return 0;
+}
+
+/* Steering decision — spec in bngring.h; Python twin: ring.py shard_of.
+ * Walks the same L2/L3 prefix as classify_dhcp (0-2 VLAN tags). */
+uint32_t bng_ring_shard_of(bng_ring *r, const uint8_t *p, uint32_t len,
+                           uint32_t flags) {
+  uint32_t n = r->n_shards;
+  if (n == 1) return 0;
+  if (len < 14) return 0;
+  if (!(flags & BNG_DESC_F_DHCP_CTRL)) {
+    uint32_t off = 12;
+    uint32_t et = (static_cast<uint32_t>(p[off]) << 8) | p[off + 1];
+    for (int i = 0; i < 2 && (et == 0x8100 || et == 0x88a8); i++) {
+      off += 4;
+      if (len < off + 2) break;
+      et = (static_cast<uint32_t>(p[off]) << 8) | p[off + 1];
+    }
+    off += 2; /* L3 start */
+    if (et == 0x0800 && len >= off + 20 && (p[off] >> 4) == 4) {
+      if (flags & BNG_DESC_F_FROM_ACCESS) {
+        /* upstream: subscriber = src private IP */
+        return fnv1a32_bytes(p + off + 12, 4) % n;
+      }
+      /* downstream: NAT public IP owner, else dst-IP hash */
+      const uint8_t *dst = p + off + 16;
+      uint32_t dip = (static_cast<uint32_t>(dst[0]) << 24) |
+                     (static_cast<uint32_t>(dst[1]) << 16) |
+                     (static_cast<uint32_t>(dst[2]) << 8) | dst[3];
+      int slot = pubmap_find(r->pubmap, dip, /*for_insert=*/false);
+      if (slot >= 0) {
+        uint32_t s =
+            r->pubmap.ents[slot].shard_plus1.load(std::memory_order_relaxed) -
+            1;
+        if (s < n) return s;
+      }
+      return fnv1a32_bytes(dst, 4) % n;
+    }
+  }
+  /* DHCP control (any shard correct; MAC = sticky) and non-IPv4 */
+  return fnv1a32_bytes(p + 6, 6) % n;
+}
+
 int bng_ring_rx_submit(bng_ring *r, uint64_t addr, uint32_t len,
                        uint32_t flags) {
   if (!valid_addr(r, addr) || len > r->frame_size) {
@@ -291,8 +406,9 @@ int bng_ring_rx_submit(bng_ring *r, uint64_t addr, uint32_t len,
   flags &= ~BNG_DESC_F_DHCP_CTRL;
   if (flags & BNG_DESC_F_FROM_ACCESS)
     flags |= classify_dhcp(r->umem + addr, len);
+  uint32_t shard = bng_ring_shard_of(r, r->umem + addr, len, flags);
   bng_desc d{addr, len, flags};
-  if (!r->rx.push(d)) {
+  if (!r->rxq[shard].push(d)) {
     r->stats.rx_full++;
     r->fill.push(d); /* recycle */
     return -1;
@@ -312,6 +428,17 @@ int bng_ring_rx_push(bng_ring *r, const uint8_t *data, uint32_t len,
   return bng_ring_rx_submit(r, addr, len, flags);
 }
 
+static void stage_frame(bng_ring *r, uint8_t *out, uint32_t *out_len,
+                        uint32_t *out_flags, uint32_t row, uint32_t slot,
+                        const bng_desc &d) {
+  uint32_t copy = d.len < slot ? d.len : slot;
+  memcpy(out + static_cast<size_t>(row) * slot, r->umem + d.addr, copy);
+  if (copy < slot)
+    memset(out + static_cast<size_t>(row) * slot + copy, 0, slot - copy);
+  out_len[row] = copy;
+  out_flags[row] = d.flags;
+}
+
 uint32_t bng_batch_assemble(bng_ring *r, uint8_t *out, uint32_t *out_len,
                             uint32_t *out_flags, uint32_t max_batch,
                             uint32_t slot) {
@@ -321,13 +448,17 @@ uint32_t bng_batch_assemble(bng_ring *r, uint8_t *out, uint32_t *out_len,
       (r->inflight_head + r->inflight_count) % bng_ring::MAX_INFLIGHT;
   uint32_t n = 0;
   bng_desc d;
-  while (n < max_batch && r->rx.pop(&d)) {
-    uint32_t copy = d.len < slot ? d.len : slot;
-    memcpy(out + static_cast<size_t>(n) * slot, r->umem + d.addr, copy);
-    if (copy < slot)
-      memset(out + static_cast<size_t>(n) * slot + copy, 0, slot - copy);
-    out_len[n] = copy;
-    out_flags[n] = d.flags;
+  /* round-robin over shard queues so no shard starves (n_shards==1 is
+   * the plain single-queue drain) */
+  uint32_t idle = 0;
+  for (uint32_t s = 0; n < max_batch && idle < r->n_shards;
+       s = (s + 1) % r->n_shards) {
+    if (!r->rxq[s].pop(&d)) {
+      idle++;
+      continue;
+    }
+    idle = 0;
+    stage_frame(r, out, out_len, out_flags, n, slot, d);
     r->inflight[tail][n] = d;
     n++;
   }
@@ -336,6 +467,40 @@ uint32_t bng_batch_assemble(bng_ring *r, uint8_t *out, uint32_t *out_len,
   r->inflight_count++;
   r->stats.rx += n;
   return n;
+}
+
+uint32_t bng_batch_assemble_sharded(bng_ring *r, uint8_t *out,
+                                    uint32_t *out_len, uint32_t *out_flags,
+                                    uint32_t b_per_shard, uint32_t slot) {
+  if (r->inflight_count >= bng_ring::MAX_INFLIGHT) return 0; /* windows full */
+  uint32_t total = r->n_shards * b_per_shard;
+  if (b_per_shard == 0 || total > r->inflight_cap) return 0;
+  uint32_t tail =
+      (r->inflight_head + r->inflight_count) % bng_ring::MAX_INFLIGHT;
+  uint32_t got = 0;
+  bng_desc d;
+  for (uint32_t s = 0; s < r->n_shards; s++) {
+    for (uint32_t k = 0; k < b_per_shard; k++) {
+      uint32_t row = s * b_per_shard + k;
+      if (r->rxq[s].pop(&d)) {
+        stage_frame(r, out, out_len, out_flags, row, slot, d);
+        r->inflight[tail][row] = d;
+        got++;
+      } else {
+        /* padding lane: zeroed so stale caller-buffer bytes can never be
+         * parsed as a packet; complete() skips it via the addr marker */
+        memset(out + static_cast<size_t>(row) * slot, 0, slot);
+        out_len[row] = 0;
+        out_flags[row] = 0;
+        r->inflight[tail][row] = bng_desc{UINT64_MAX, 0, 0};
+      }
+    }
+  }
+  if (got == 0) return 0; /* nothing pending: no window opened */
+  r->inflight_n[tail] = total;
+  r->inflight_count++;
+  r->stats.rx += got;
+  return got;
 }
 
 int bng_batch_complete(bng_ring *r, const uint8_t *verdict,
@@ -348,6 +513,7 @@ int bng_batch_complete(bng_ring *r, const uint8_t *verdict,
     return -1;
   for (uint32_t i = 0; i < n; i++) {
     bng_desc d = r->inflight[head][i];
+    if (d.addr == UINT64_MAX) continue; /* sharded-assemble padding lane */
     uint8_t v = verdict[i];
     if (v == BNG_VERDICT_TX || v == BNG_VERDICT_FWD) {
       /* device rewrote the packet: copy staged bytes back over the frame */
@@ -435,7 +601,14 @@ int bng_ring_slow_pop(bng_ring *r, uint8_t *buf, uint32_t cap,
   return pop_from(r, r->slow, buf, cap, flags);
 }
 
-uint32_t bng_ring_rx_pending(bng_ring *r) { return r->rx.pending(); }
+uint32_t bng_ring_rx_pending(bng_ring *r) {
+  uint32_t sum = 0;
+  for (uint32_t s = 0; s < r->n_shards; s++) sum += r->rxq[s].pending();
+  return sum;
+}
+uint32_t bng_ring_shard_rx_pending(bng_ring *r, uint32_t shard) {
+  return shard < r->n_shards ? r->rxq[shard].pending() : 0;
+}
 uint32_t bng_ring_tx_pending(bng_ring *r) { return r->tx.pending(); }
 uint32_t bng_ring_fwd_pending(bng_ring *r) { return r->fwd.pending(); }
 uint32_t bng_ring_slow_pending(bng_ring *r) { return r->slow.pending(); }
@@ -478,6 +651,6 @@ uint32_t bng_abi_desc_addr_off(void) { return offsetof(bng_desc, addr); }
 uint32_t bng_abi_desc_len_off(void) { return offsetof(bng_desc, len); }
 uint32_t bng_abi_desc_flags_off(void) { return offsetof(bng_desc, flags); }
 uint32_t bng_abi_stats_size(void) { return sizeof(bng_ring_stats); }
-uint32_t bng_abi_version(void) { return 1; }
+uint32_t bng_abi_version(void) { return 2; }
 
 } /* extern "C" */

@@ -93,7 +93,42 @@ typedef struct bng_ring bng_ring; /* opaque */
  * nframes, depth: power of two. frame_size: bytes per UMEM slot (>= 64). */
 bng_ring *bng_ring_create(uint32_t nframes, uint32_t frame_size,
                           uint32_t depth);
+
+/* Sharded variant: n_shards (1..64) per-shard RX queues of `depth` each.
+ * rx_submit steers every frame to its owner shard (the pkg/pool/peer.go
+ * owner-routing role, re-hosted at the host ring so each chip's batch is
+ * its own subscribers' traffic — the placement invariant chip-local
+ * NAT/QoS state depends on, bng_tpu/parallel/sharded.py).
+ *
+ * STEERING SPEC (bit-for-bit mirror: bng_tpu/runtime/ring.py shard_of):
+ *   - DHCP control frames (BNG_DESC_F_DHCP_CTRL): FNV-1a32(src MAC) % n.
+ *     Any shard is CORRECT for DHCP (tables are hash-sharded with
+ *     all-to-all exchange); MAC keeps a subscriber's control traffic
+ *     sticky for cache locality.
+ *   - access-side IPv4: FNV-1a32(4 src-IP bytes, wire order) % n —
+ *     the subscriber's private IP, matching the control plane's
+ *     affinity placement of NAT/QoS/antispoof state.
+ *   - network-side IPv4: public-IP exact-match table (set per shard via
+ *     bng_ring_steer_pub_ip — downstream NAT state lives on the shard
+ *     that owns the public IP); miss -> FNV-1a32(4 dst-IP bytes) % n.
+ *   - non-IPv4 / unparseable: FNV-1a32(src MAC) % n (len<14: shard 0).
+ */
+bng_ring *bng_ring_create_sharded(uint32_t nframes, uint32_t frame_size,
+                                  uint32_t depth, uint32_t n_shards);
 void bng_ring_destroy(bng_ring *r);
+
+uint32_t bng_ring_n_shards(bng_ring *r);
+
+/* Register a NAT public IP (host byte order) as owned by `shard`.
+ * Bounded-probe open addressing; returns 0, or -1 when the map is full /
+ * shard out of range. Updating an existing IP's shard is allowed. */
+int bng_ring_steer_pub_ip(bng_ring *r, uint32_t ip, uint32_t shard);
+
+/* Steering decision for a frame (exposed for parity tests and
+ * non-UMEM producers). flags: the would-be descriptor flags AFTER
+ * classification (FROM_ACCESS + DHCP_CTRL). */
+uint32_t bng_ring_shard_of(bng_ring *r, const uint8_t *data, uint32_t len,
+                           uint32_t flags);
 
 /* Raw UMEM view (for tests / zero-copy producers). */
 uint8_t *bng_ring_umem(bng_ring *r);
@@ -123,6 +158,18 @@ uint32_t bng_batch_assemble(bng_ring *r, uint8_t *out, uint32_t *out_len,
                             uint32_t *out_flags, uint32_t max_batch,
                             uint32_t slot);
 
+/* Sharded assemble: fixed per-shard lane ranges. Shard s's frames land
+ * in rows [s*b_per_shard, s*b_per_shard + k_s); unfilled rows are zeroed
+ * (len 0, flags 0) so the device pipeline sees invalid lanes (verdict
+ * PASS) and complete() recycles nothing for them. The batch's row layout
+ * matches ShardedCluster.step's contract (shard i's lanes at rows
+ * i*b..(i+1)*b). Opens one in-flight window of n_shards*b_per_shard rows
+ * — complete() must be called with n = n_shards*b_per_shard. Returns the
+ * number of REAL frames staged (0 = nothing pending, no window opened). */
+uint32_t bng_batch_assemble_sharded(bng_ring *r, uint8_t *out,
+                                    uint32_t *out_len, uint32_t *out_flags,
+                                    uint32_t b_per_shard, uint32_t slot);
+
 /* Apply per-lane verdicts to the in-flight batch from the last assemble.
  * For TX/FWD lanes, rewritten bytes come from out[b*slot..] with
  * out_len[b] (device-rewritten packet); the frame is updated in UMEM and
@@ -150,8 +197,10 @@ int bng_ring_fwd_pop(bng_ring *r, uint8_t *buf, uint32_t cap,
 int bng_ring_slow_pop(bng_ring *r, uint8_t *buf, uint32_t cap,
                       uint32_t *flags);
 
-/* Pending counts (consumer-visible). */
+/* Pending counts (consumer-visible). rx_pending sums all shards;
+ * shard_rx_pending reads one shard's queue. */
 uint32_t bng_ring_rx_pending(bng_ring *r);
+uint32_t bng_ring_shard_rx_pending(bng_ring *r, uint32_t shard);
 uint32_t bng_ring_tx_pending(bng_ring *r);
 uint32_t bng_ring_fwd_pending(bng_ring *r);
 uint32_t bng_ring_slow_pending(bng_ring *r);

@@ -422,3 +422,81 @@ class TestSkewDegradesToSlowPath:
         assert n_tx == C, (n_tx, C)  # capacity lanes answered on device
         assert n_slow == len(same) - C  # overflow degrades to slow path
         assert int((v == 1).sum()) == 0  # and NOTHING is dropped
+
+
+class TestRingShardSteering:
+    """Cluster-level owner-routing invariant (VERDICT r3 item 3): the host
+    ring steers a subscriber's traffic to the affinity shard, where its
+    chip-local NAT/QoS state is consulted — and a frame arriving on a
+    WRONG shard punts to the slow path instead of being silently
+    translated/shaped (the all-state-is-owner-local safety property)."""
+
+    T0 = 1_753_000_000
+
+    def test_owner_shard_serves_wrong_shard_punts(self):
+        n = 2
+        cl = ShardedCluster(n, batch_per_shard=8)
+        cl.set_server_config_all(bytes.fromhex("02aabbccdd01"),
+                                 ip_to_u32("10.0.0.1"))
+        cl.add_pool_all(1, ip_to_u32("10.0.0.0"), 24, ip_to_u32("10.0.0.1"),
+                        lease_time=3600)
+        sub_ip = ip_to_u32("10.0.0.77")
+        owner, alloc = cl.allocate_nat(sub_ip, self.T0)
+        assert alloc is not None
+        o2, flow = cl.handle_new_flow(sub_ip, ip_to_u32("1.2.3.4"),
+                                      40000, 443, 17, 600, self.T0)
+        assert o2 == owner and flow is not None
+        pub_ip, pub_port = flow
+        qo = cl.set_qos(sub_ip, down_bps=1_000_000, up_bps=1_000_000)
+        assert qo == owner
+        assert cl.pub_ip_map()[pub_ip] == owner
+        cl.sync_tables()
+
+        ring = cl.make_ring(nframes=256, frame_size=2048, depth=64)
+        assert ring.n_shards == n
+        up = packets.udp_packet(b"\x02" * 6, b"\x04" * 6, sub_ip,
+                                ip_to_u32("1.2.3.4"), 40000, 443, b"u" * 100)
+        down = packets.udp_packet(b"\x04" * 6, b"\x02" * 6,
+                                  ip_to_u32("1.2.3.4"), pub_ip,
+                                  443, pub_port, b"d" * 64)
+        assert ring.shard_of(up, 1) == owner  # FLAG_FROM_ACCESS=1
+        assert ring.rx_push(up, from_access=True)
+        assert ring.rx_push(down, from_access=False)
+
+        B, L = n * cl.b, 512
+        pkt = np.zeros((B, L), dtype=np.uint8)
+        ln = np.zeros((B,), dtype=np.uint32)
+        fl = np.zeros((B,), dtype=np.uint32)
+        assert ring.assemble_sharded(pkt, ln, fl) == 2
+        base = owner * cl.b
+        assert ln[base] == len(up) and ln[base + 1] == len(down)
+        out = cl.step(pkt, ln, (fl & 1) != 0, self.T0 + 1, 1_000_000)
+        assert int(out["verdict"][base]) == 3      # SNAT'd on the owner
+        assert int(out["verdict"][base + 1]) == 3  # DNAT'd on the owner
+        ring.complete(out["verdict"].astype(np.uint8),
+                      np.asarray(out["out_pkt"]),
+                      out["out_len"].astype(np.uint32), B)
+        assert ring.stats()["fwd"] == 2
+
+        # same upstream frame force-fed to the wrong shard: must PASS
+        wrong = (owner + 1) % n
+        wpkt = np.zeros((B, L), dtype=np.uint8)
+        wln = np.zeros((B,), dtype=np.uint32)
+        wrow = wrong * cl.b
+        wpkt[wrow, : len(up)] = np.frombuffer(up, dtype=np.uint8)
+        wln[wrow] = len(up)
+        out2 = cl.step(wpkt, wln, np.ones((B,), dtype=bool),
+                       self.T0 + 2, 2_000_000)
+        assert int(out2["verdict"][wrow]) == 0  # punt, never mistranslate
+
+    def test_affinity_matches_ring_for_ip_sweep(self):
+        """Control-plane affinity and ring steering agree for any IP."""
+        cl = ShardedCluster(N, batch_per_shard=8)
+        ring = cl.make_ring(nframes=64, frame_size=2048, depth=32,
+                            prefer_native=False)  # PyRing: same spec
+        for i in range(64):
+            ip = ip_to_u32(f"10.{i % 4}.{i // 4}.{i + 1}")
+            up = packets.udp_packet(b"\x02" * 6, b"\x04" * 6, ip,
+                                    ip_to_u32("8.8.8.8"), 1000 + i, 443,
+                                    b"x" * 32)
+            assert cl.affinity_shard_ip(ip) == ring.shard_of(up, 1)

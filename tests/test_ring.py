@@ -52,7 +52,7 @@ class TestABI:
     def test_stats_layout_and_version(self):
         lib = load_native()
         assert lib.bng_abi_stats_size() == C.sizeof(RingStats)
-        assert lib.bng_abi_version() == 1
+        assert lib.bng_abi_version() == 2
 
 
 class TestRingBasics:
@@ -544,3 +544,166 @@ class TestDHCPClassify:
         assert calls["dhcp"] == 1
         # the slow path answered the DISCOVER both times (server reply TX'd)
         assert engine.stats.passed >= 2
+
+
+class TestShardSteering:
+    """Ring->shard subscriber steering (owner-routing at the host ring,
+    the pkg/pool/peer.go:230-368 role): C++/PyRing decision parity, the
+    affinity invariant (control plane and ring agree on the owner), the
+    per-shard lane-range batch layout, and padding-lane accounting."""
+
+    def _ip_frame(self, src_ip, dst_ip, vlans=None, sport=1234, dport=443):
+        from bng_tpu.control import packets
+
+        f = packets.udp_packet(b"\x02\xaa\x00\x00\x00\x07", b"\x04" * 6,
+                               src_ip, dst_ip, sport, dport, b"p" * 64,
+                               vlans=vlans)
+        return f
+
+    def _dhcp_frame(self, mac):
+        from bng_tpu.control import dhcp_codec, packets
+
+        p = dhcp_codec.build_request(mac, dhcp_codec.DISCOVER)
+        return packets.udp_packet(mac, b"\xff" * 6, 0, 0xFFFFFFFF, 68, 67,
+                                  p.encode().ljust(320, b"\x00"))
+
+    def _corpus(self):
+        rng = np.random.default_rng(0x51EE)
+        frames = []
+        for i in range(24):  # IPv4 up/down, 0-2 VLAN tags
+            vl = [None, [100], [100, 200]][i % 3]
+            frames.append(self._ip_frame(0x0A000000 + i, 0xCB007100 + (i % 4),
+                                         vlans=vl))
+        for i in range(4):  # DHCP control
+            frames.append(self._dhcp_frame(bytes([2, 0xAA, 0, 0, 0, i])))
+        frames.append(b"\x02" * 6 + b"\x04" * 6 + b"\x86\xdd" + b"\x00" * 60)
+        frames.append(b"\x01\x02\x03")  # shorter than an Ethernet header
+        frames.append(bytes(rng.integers(0, 256, size=200, dtype=np.uint8)))
+        return frames
+
+    @pytest.mark.skipif(not native_available, reason="no native lib")
+    def test_shard_of_native_py_parity(self):
+        from bng_tpu.runtime.ring import (FLAG_DHCP_CTRL, FLAG_FROM_ACCESS,
+                                          classify_dhcp, shard_of)
+
+        n = 8
+        pub = {0xCB007100 + s % n: s for s in range(4)}
+        nr = NativeRing(nframes=64, frame_size=2048, depth=32, n_shards=n)
+        try:
+            for ip, s in pub.items():
+                assert nr.steer_pub_ip(ip, s)
+            for f in self._corpus():
+                for fa in (True, False):
+                    fl = FLAG_FROM_ACCESS if fa else 0
+                    if fa:
+                        fl |= classify_dhcp(f)
+                    assert nr.shard_of(f, fl) == shard_of(f, fl, n, pub), (
+                        f[:20].hex(), fl)
+        finally:
+            nr.close()
+
+    def test_steering_spec(self, ring_cls):
+        """Upstream = FNV(src IP) % n; downstream = pub-IP owner, else
+        FNV(dst IP) % n; DHCP/non-IP = FNV(src MAC) % n."""
+        from bng_tpu.runtime.ring import FLAG_DHCP_CTRL, FLAG_FROM_ACCESS
+        from bng_tpu.utils.net import fnv1a32
+
+        n = 8
+        r = ring_cls(nframes=64, frame_size=2048, depth=32, n_shards=n)
+        assert r.steer_pub_ip(0xCB007105, 5)
+        assert not r.steer_pub_ip(0xCB007106, n)  # shard out of range
+        up = self._ip_frame(0x0A0000FE, 0xCB007105)
+        assert (r.shard_of(up, FLAG_FROM_ACCESS)
+                == fnv1a32(bytes([10, 0, 0, 0xFE])) % n)
+        # downstream to the registered public IP -> owner shard 5
+        down = self._ip_frame(0x01020304, 0xCB007105)
+        assert r.shard_of(down, 0) == 5
+        # downstream to an unregistered IP -> dst-IP hash
+        down2 = self._ip_frame(0x01020304, 0x08080808)
+        assert r.shard_of(down2, 0) == fnv1a32(bytes([8, 8, 8, 8])) % n
+        # DHCP control + non-IPv4: src-MAC hash
+        mac = bytes([2, 0xAA, 0, 0, 0, 9])
+        dh = self._dhcp_frame(mac)
+        assert (r.shard_of(dh, FLAG_FROM_ACCESS | FLAG_DHCP_CTRL)
+                == fnv1a32(mac) % n)
+        v6 = b"\x02" * 6 + mac + b"\x86\xdd" + b"\x00" * 60
+        assert r.shard_of(v6, FLAG_FROM_ACCESS) == fnv1a32(mac) % n
+        r.close()
+
+    def test_assemble_sharded_lane_ranges_and_padding(self, ring_cls):
+        """Shard i's frames land at rows i*b..; padding rows are zeroed and
+        complete() recycles only real frames."""
+        from bng_tpu.utils.net import fnv1a32
+
+        n, b, slot = 4, 4, 256
+        r = ring_cls(nframes=64, frame_size=512, depth=16, n_shards=n)
+        # craft src IPs that steer to shards 1 and 3
+        by_shard = {}
+        ip = 0x0A000001
+        while len(by_shard) < 2 or any(len(v) < 2 for v in by_shard.values()):
+            s = fnv1a32(ip.to_bytes(4, "big")) % n
+            if s in (1, 3):
+                by_shard.setdefault(s, []).append(ip)
+            ip += 1
+            if len(by_shard.get(1, [])) >= 2 and len(by_shard.get(3, [])) >= 2:
+                break
+        frames = {s: [self._ip_frame(i, 0x08080808) for i in ips[:2]]
+                  for s, ips in by_shard.items()}
+        for s in (1, 3):
+            for f in frames[s]:
+                assert r.rx_push(f, from_access=True)
+        out = np.full((n * b, slot), 0xEE, dtype=np.uint8)  # stale bytes
+        ln = np.full((n * b,), 99, dtype=np.uint32)
+        fl = np.full((n * b,), 99, dtype=np.uint32)
+        got = r.assemble_sharded(out, ln, fl)
+        assert got == 4
+        for s in (1, 3):
+            for k, f in enumerate(frames[s]):
+                row = s * b + k
+                assert ln[row] == len(f)
+                assert bytes(out[row, : len(f)]) == f
+        # padding rows: len 0, flags 0, bytes zeroed (no stale 0xEE)
+        for row in (0, 1, 2 * b, 1 * b + 2, 3 * b + 3):
+            assert ln[row] == 0 and fl[row] == 0
+            assert not out[row].any()
+        # complete with n = total rows; every verdict PASS
+        r.complete(np.zeros((n * b,), dtype=np.uint8), out, ln, n * b)
+        assert r.slow_pending() == 4  # only the real frames
+        drained = 0
+        while r.slow_pop() is not None:
+            drained += 1
+        assert drained == 4
+        assert r.free_frames() == 64
+        r.close()
+
+    def test_assemble_sharded_overflow_stays_queued(self, ring_cls):
+        from bng_tpu.utils.net import fnv1a32
+
+        n, b = 2, 1
+        r = ring_cls(nframes=64, frame_size=512, depth=16, n_shards=n)
+        ip = 0x0A000001
+        while fnv1a32(ip.to_bytes(4, "big")) % n != 1:
+            ip += 1
+        f = self._ip_frame(ip, 0x08080808)
+        for _ in range(3):
+            assert r.rx_push(f, from_access=True)
+        out = np.zeros((n * b, 256), dtype=np.uint8)
+        ln = np.zeros((n * b,), dtype=np.uint32)
+        fl = np.zeros((n * b,), dtype=np.uint32)
+        assert r.assemble_sharded(out, ln, fl) == 1  # region is 1 row
+        assert r.shard_rx_pending(1) == 2  # the rest stay queued, in order
+        r.complete(np.zeros((n * b,), dtype=np.uint8), out, ln, n * b)
+        assert r.assemble_sharded(out, ln, fl) == 1
+        r.complete(np.zeros((n * b,), dtype=np.uint8), out, ln, n * b)
+        assert r.shard_rx_pending(1) == 1
+        r.close()
+
+    def test_assemble_sharded_empty_opens_no_window(self, ring_cls):
+        r = ring_cls(nframes=64, frame_size=512, depth=16, n_shards=2)
+        out = np.zeros((4, 256), dtype=np.uint8)
+        ln = np.zeros((4,), dtype=np.uint32)
+        fl = np.zeros((4,), dtype=np.uint32)
+        assert r.assemble_sharded(out, ln, fl) == 0
+        with pytest.raises(RuntimeError):
+            r.complete(np.zeros((4,), dtype=np.uint8), out, ln, 4)
+        r.close()

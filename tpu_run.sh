@@ -13,7 +13,7 @@
 # r3 number), config 6 (DHCP standalone @1M subs), config 4 (never yet
 # completed on TPU), config 5 (sharded, n=1 geometry on the single chip),
 # then the headline fused pipeline at 1M subscribers.
-set -u
+set -u -o pipefail
 cd "$(dirname "$0")"
 LOG=${TPU_RUN_LOG:-/tmp/tpu_validation.log}
 LOCK=/tmp/tpu_run.lock
@@ -21,6 +21,7 @@ if ! mkdir "$LOCK" 2>/dev/null; then
   echo "another tpu_run.sh holds $LOCK; exiting" | tee -a "$LOG"; exit 2
 fi
 trap 'rmdir "$LOCK" 2>/dev/null' EXIT
+FAILED=0
 
 # Chip is known up inside the window: no capture-on-return probing per step.
 export BNG_BENCH_PROBE_WINDOW=0 BNG_BENCH_PROBE_TIMEOUT=60 BNG_BENCH_PROBE_TRIES=1
@@ -30,8 +31,12 @@ probe() {
 }
 step() {
   echo "=== $1 ($(date -u +%H:%M:%S))" | tee -a "$LOG"
-  BNG_BENCH_TIMEOUT=$2 timeout $(($2 + 60)) bash -c "$3" 2>&1 \
-    | grep -v WARNING | tail -12 | tee -a "$LOG"
+  if ! BNG_BENCH_TIMEOUT=$2 timeout $(($2 + 60)) bash -c "$3" 2>&1 \
+      | grep -v WARNING | tail -12 | tee -a "$LOG"; then
+    # a failed step taints the window (no done-marker) but the remaining
+    # steps still run — partial hardware numbers beat none
+    echo "STEP FAILED: $1" | tee -a "$LOG"; FAILED=1
+  fi
   probe || { echo "TUNNEL DEAD after $1 ($(date -u +%H:%M:%S))" | tee -a "$LOG"; exit 1; }
 }
 
@@ -44,5 +49,8 @@ step "config6-dhcp"  900  "python bench.py --config 6"
 step "config4-pppoe" 900  "python bench.py --config 4"
 step "config5-shard" 900  "python bench.py --config 5"
 step "headline-1M"   2400 "BNG_BENCH_SUBS=1000000 BNG_BENCH_FLOWS=1000000 python bench.py"
+if [ "$FAILED" -ne 0 ]; then
+  echo "DONE WITH FAILURES $(date -u +%H:%M:%S)" | tee -a "$LOG"; exit 1
+fi
 echo "ALL DONE $(date -u +%H:%M:%S)" | tee -a "$LOG"
 touch /tmp/tpu_run.done

@@ -13,6 +13,8 @@ LOG=${TPU_WATCH_LOG:-/tmp/tpu_watch.log}
 MAX_S=${TPU_WATCH_MAX_S:-39600}   # default: an 11 h round window
 SLEEP_S=${TPU_WATCH_SLEEP_S:-150}
 START=$(date +%s)
+# a done-marker from a PREVIOUS round must not satisfy this watch
+rm -f /tmp/tpu_run.done
 echo "watch start $(date -u +%H:%M:%S) max=${MAX_S}s" | tee -a "$LOG"
 while true; do
   if [ -f /tmp/tpu_run.done ]; then
