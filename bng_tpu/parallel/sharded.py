@@ -245,8 +245,22 @@ class ShardedCluster:
         return o
 
     def pub_ip_map(self) -> dict[int, int]:
-        """NAT public IP -> owner shard (downstream ring steering)."""
-        return {ip: s for s in range(self.n) for ip in self.nat[s].public_ips}
+        """NAT public IP -> owner shard (downstream ring steering).
+
+        Raises when one public IP is claimed by multiple shards: downstream
+        steering is by-IP only, so shared ownership is not expressible — a
+        silent last-shard-wins map would punt every return packet of the
+        other shards' flows to the slow path."""
+        owners: dict[int, int] = {}
+        for s in range(self.n):
+            for ip in self.nat[s].public_ips:
+                if ip in owners and owners[ip] != s:
+                    raise ValueError(
+                        f"public IP {ip:#x} owned by shards {owners[ip]} and "
+                        f"{s}: downstream steering needs exclusive ownership "
+                        f"(give each shard distinct public_ips)")
+                owners[ip] = s
+        return owners
 
     def make_ring(self, nframes: int = 4096, frame_size: int = 2048,
                   depth: int = 1024, prefer_native: bool = True):
@@ -260,7 +274,14 @@ class ShardedCluster:
         ring = _mk(nframes, frame_size, depth, prefer_native=prefer_native,
                    n_shards=self.n)
         for ip, s in self.pub_ip_map().items():
-            ring.steer_pub_ip(ip, s)
+            if not ring.steer_pub_ip(ip, s):
+                # an unregistered public IP would silently fall back to
+                # dst-IP hashing — every return packet punts on a wrong
+                # shard. A ring that cannot express the placement is a
+                # configuration error, not a degraded mode.
+                raise RuntimeError(
+                    f"ring steering table rejected public IP {ip:#x} "
+                    f"(capacity/probe bound); reduce public IPs per ring")
         return ring
 
     # ---- control-plane writes ----
@@ -277,6 +298,30 @@ class ShardedCluster:
         o = self.dhcp_sub_shard(mac)
         self.fastpath[o].add_subscriber(mac, **kw)
         return o
+
+    def add_subscribers_bulk(self, macs_u64, pool_ids, ips, lease_expiries,
+                             **kw) -> np.ndarray:
+        """Reference-scale sharded build: split 1M+ subscribers by owner
+        shard (vectorized shard_owner — the same mix the device lookup
+        routes with) and bulk-insert each shard's slice. Returns the [N]
+        owner-shard array. Follow with sync_tables() for a full upload
+        (maps sized for 1M: /root/reference/bpf/maps.h:10)."""
+        macs_u64 = np.asarray(macs_u64, dtype=np.uint64)
+        hi = (macs_u64 >> np.uint64(32)).astype(np.uint32)
+        lo = (macs_u64 & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+        owners = np.asarray(shard_owner([hi, lo], self.n))
+        pool_ids = np.broadcast_to(np.asarray(pool_ids, dtype=np.uint32),
+                                   macs_u64.shape)
+        ips = np.broadcast_to(np.asarray(ips, dtype=np.uint32), macs_u64.shape)
+        lease_expiries = np.broadcast_to(
+            np.asarray(lease_expiries, dtype=np.uint32), macs_u64.shape)
+        for s in range(self.n):
+            m = owners == s
+            if m.any():
+                self.fastpath[s].add_subscribers_bulk(
+                    macs_u64[m], pool_ids=pool_ids[m], ips=ips[m],
+                    lease_expiries=lease_expiries[m], **kw)
+        return owners
 
     def add_vlan_subscriber(self, s_tag: int, c_tag: int, **kw) -> int:
         o = self.dhcp_vlan_shard(s_tag, c_tag)

@@ -389,6 +389,22 @@ class Engine:
                 self.violation_sink(i, frames[i])
         return out
 
+    # fast-lane compile-shape budget: every auto-sized control batch maps
+    # onto one of these pow2 buckets, so a latency sweep over arbitrary
+    # batch sizes can trigger at most len(DHCP_BATCH_BUCKETS) compiles of
+    # the DHCP-only program (pinned by tests/test_hlo_structure.py)
+    DHCP_BATCH_FLOOR = 64
+    DHCP_BATCH_CAP = 8192
+
+    @classmethod
+    def dhcp_batch_bucket(cls, n: int) -> int:
+        """Pow2 bucket (floor 64, cap 8192) for a fast-lane batch of n
+        frames. The cap bounds the compile set; a caller with more than
+        DHCP_BATCH_CAP control frames should split the batch (the engine's
+        ring assembler never produces one that large)."""
+        b = max(cls.DHCP_BATCH_FLOOR, 1 << max(0, n - 1).bit_length())
+        return min(b, cls.DHCP_BATCH_CAP)
+
     def process_dhcp(self, frames: list[bytes], now: float | None = None,
                      batch: int | None = None) -> dict:
         """Latency fast lane: run a PRE-CLASSIFIED control batch (DHCP to
@@ -405,10 +421,21 @@ class Engine:
         device copy, whichever program runs next. Returns
         {"tx": [(lane, frame)], "slow": [(lane, reply|None)]}.
         """
+        if batch is None and len(frames) > self.DHCP_BATCH_CAP:
+            # above the compile-shape cap: split into capped chunks and
+            # merge (lane indices re-based), so callers keep the old
+            # any-size behavior without growing the compile set
+            out = {"tx": [], "slow": []}
+            for base in range(0, len(frames), self.DHCP_BATCH_CAP):
+                part = self.process_dhcp(frames[base : base + self.DHCP_BATCH_CAP],
+                                         now=now)
+                for k in ("tx", "slow"):
+                    out[k].extend((base + i, v) for i, v in part[k])
+            return out
         if batch is not None:
             B = batch
-        else:  # next pow2, floor 64 — bounds the shape-specialized compiles
-            B = max(64, 1 << max(0, len(frames) - 1).bit_length())
+        else:
+            B = self.dhcp_batch_bucket(len(frames))
         now = now if now is not None else self.clock()
         pkt, length = self._pack_frames(frames, B)
         res = self._run_dhcp_batch_sync(pkt, length, now)

@@ -175,3 +175,83 @@ class TestShardedExchangeShape:
         hlo = _stablehlo(f, st, q)
         n_a2a = _count(r"all_to_all", hlo)
         assert n_a2a == 2, f"expected 2 all_to_alls, got {n_a2a}"
+
+
+class TestFastLaneCompileShapeBudget:
+    """VERDICT r3 weak #6: process_dhcp compiles one program per pow2
+    batch bucket. Pin the bucket set so a latency sweep over arbitrary
+    control-batch sizes can never quietly spend a chip window compiling."""
+
+    def test_bucket_set_is_bounded_and_exact(self):
+        from bng_tpu.runtime.engine import Engine
+
+        buckets = {Engine.dhcp_batch_bucket(n) for n in range(0, 20_000, 7)}
+        buckets |= {Engine.dhcp_batch_bucket(n) for n in
+                    (1, 63, 64, 65, 127, 128, 8191, 8192, 8193, 100_000)}
+        assert buckets == {64, 128, 256, 512, 1024, 2048, 4096, 8192}
+        # monotone + covering: every n <= cap fits its bucket
+        for n in range(1, 8193, 11):
+            assert n <= Engine.dhcp_batch_bucket(n)
+
+    def test_engine_reuses_bucket_shapes(self):
+        """Distinct frame counts in one bucket must share one compiled
+        program (counted via the jit cache of the DHCP-only step)."""
+        from bng_tpu.control import dhcp_codec, packets
+        from bng_tpu.control.nat import NATManager
+        from bng_tpu.runtime.engine import Engine
+        from bng_tpu.runtime.tables import FastPathTables
+        from bng_tpu.utils.net import ip_to_u32
+
+        fastpath = FastPathTables(sub_nbuckets=256, vlan_nbuckets=64,
+                                  cid_nbuckets=64, max_pools=16)
+        fastpath.set_server_config(bytes.fromhex("02aabbccdd01"),
+                                   ip_to_u32("10.0.0.1"))
+        nat = NATManager(public_ips=[ip_to_u32("203.0.113.1")],
+                         sessions_nbuckets=256, sub_nat_nbuckets=64)
+        engine = Engine(fastpath, nat, batch_size=8,
+                        clock=lambda: 1_753_000_000.0)
+
+        def disc(i):
+            mac = bytes([2, 0xAB, 0, 0, 0, i])
+            p = dhcp_codec.build_request(mac, dhcp_codec.DISCOVER)
+            return packets.udp_packet(mac, b"\xff" * 6, 0, 0xFFFFFFFF, 68,
+                                      67, p.encode().ljust(320, b"\x00"))
+
+        sizes = [1, 3, 17, 50, 64]  # all in the 64-bucket
+        for s in sizes:
+            engine.process_dhcp([disc(i) for i in range(s)])
+        cache = engine._dhcp_step._cache_size()
+        assert cache == 1, f"expected 1 compiled fast-lane shape, got {cache}"
+        engine.process_dhcp([disc(i) for i in range(65)])  # 128-bucket
+        assert engine._dhcp_step._cache_size() == 2
+
+    def test_over_cap_batch_splits_not_crashes(self, monkeypatch):
+        """len(frames) > DHCP_BATCH_CAP splits into capped chunks with
+        lane indices re-based (review r4: the cap must not regress large
+        process_dhcp calls into a ValueError)."""
+        from bng_tpu.control import dhcp_codec, packets
+        from bng_tpu.control.nat import NATManager
+        from bng_tpu.runtime.engine import Engine
+        from bng_tpu.runtime.tables import FastPathTables
+        from bng_tpu.utils.net import ip_to_u32
+
+        fastpath = FastPathTables(sub_nbuckets=256, vlan_nbuckets=64,
+                                  cid_nbuckets=64, max_pools=16)
+        fastpath.set_server_config(bytes.fromhex("02aabbccdd01"),
+                                   ip_to_u32("10.0.0.1"))
+        nat = NATManager(public_ips=[ip_to_u32("203.0.113.1")],
+                         sessions_nbuckets=256, sub_nat_nbuckets=64)
+        engine = Engine(fastpath, nat, batch_size=8,
+                        clock=lambda: 1_753_000_000.0)
+        monkeypatch.setattr(Engine, "DHCP_BATCH_CAP", 64)
+
+        def disc(i):
+            mac = bytes([2, 0xAC, 0, 0, i // 256, i % 256])
+            p = dhcp_codec.build_request(mac, dhcp_codec.DISCOVER)
+            return packets.udp_packet(mac, b"\xff" * 6, 0, 0xFFFFFFFF, 68,
+                                      67, p.encode().ljust(320, b"\x00"))
+
+        frames = [disc(i) for i in range(150)]  # 3 chunks of <=64
+        out = engine.process_dhcp(frames)
+        lanes = sorted(i for i, _ in out["tx"] + out["slow"])
+        assert lanes == list(range(150))  # every lane accounted, re-based

