@@ -69,6 +69,14 @@ class BNGConfig:
     bgp_enabled: bool = False
     bgp_local_as: int = 65000
     bgp_router_id: str = ""
+    # FRR wiring: when true, BGP commands run through real `vtysh -c`
+    # subprocesses (main.go:884-940, bgp.go:554-578); default keeps the
+    # inert executor so `run` works without FRR installed
+    bgp_vtysh: bool = False
+    bgp_vtysh_path: str = "vtysh"
+    # routing platform: "stub" (in-memory) | "linux" (iproute2/netlink —
+    # real kernel routes/rules; needs CAP_NET_ADMIN)
+    routing_platform: str = "stub"
     # metrics
     metrics_port: int = 9090
     metrics_enabled: bool = True
@@ -346,13 +354,34 @@ class BNGApp:
                 self._on_close(att.xsk.close)
             self._on_close(lambda: c["engine"].flush_pipeline())
 
-        # 12. BGP (main.go:884-940) — executor supplied by operator; stub here
+        # 12. routing + BGP (main.go:884-940). The platform and the FRR
+        # executor are both flag-gated: stub/inert by default (run works
+        # with no FRR and no CAP_NET_ADMIN), real when asked for.
+        if cfg.routing_platform == "linux":
+            from bng_tpu.control.routing import (IPRoute2Platform,
+                                                 RoutingManager)
+            c["routing"] = RoutingManager(platform=IPRoute2Platform())
+            self.log.info("routing platform", kind="linux-iproute2")
+        elif cfg.routing_platform == "stub":
+            from bng_tpu.control.routing import RoutingManager, StubPlatform
+            c["routing"] = RoutingManager(platform=StubPlatform())
+        else:  # a typo must not silently disable multi-ISP routing
+            raise ValueError(
+                f"routing_platform={cfg.routing_platform!r}: "
+                f"expected 'stub' or 'linux'")
         if cfg.bgp_enabled:
-            from bng_tpu.control.routing import BGPConfig, BGPController
+            from bng_tpu.control.routing import (BGPConfig, BGPController,
+                                                 vtysh_executor)
+            if cfg.bgp_vtysh:
+                executor = vtysh_executor(cfg.bgp_vtysh_path)
+                self.log.info("bgp executor", kind="vtysh",
+                              binary=cfg.bgp_vtysh_path)
+            else:
+                executor = lambda cmd: ""  # noqa: E731 — inert by default
             c["bgp"] = BGPController(
                 BGPConfig(local_as=cfg.bgp_local_as,
                           router_id=cfg.bgp_router_id),
-                executor=lambda cmd: "")
+                executor=executor)
 
         # 13. metrics (main.go:1214-1241)
         if cfg.metrics_enabled:

@@ -140,6 +140,289 @@ class StubPlatform:
         return rtt
 
 
+class IPRoute2Platform:
+    """Linux RoutingPlatform over iproute2 (`ip -j`) subprocesses.
+
+    The real-kernel counterpart of StubPlatform — the role of the
+    reference's NetlinkPlatform (pkg/routing/netlink_linux.go:20-442),
+    using the `ip(8)` CLI's JSON output instead of a netlink library
+    (pyroute2 is not in the image; iproute2 is, and its -j output is the
+    stable programmatic interface). Same observable contract as the stub:
+    FileExistsError on duplicate adds, FileNotFoundError on missing
+    deletes/interfaces, TimeoutError from ping.
+
+    `runner` is injectable for hermetic tests; production uses
+    subprocess.run. Requires CAP_NET_ADMIN for mutations.
+    """
+
+    def __init__(self, runner=None, ip_binary: str = "ip",
+                 timeout: float = 5.0):
+        import subprocess
+
+        self._ip = ip_binary
+        self._timeout = timeout
+        self._runner = runner or (lambda args: subprocess.run(
+            args, capture_output=True, text=True, timeout=self._timeout))
+
+    # -- plumbing ----------------------------------------------------------
+    def _run(self, *args: str, check: bool = True) -> str:
+        res = self._runner([self._ip, *args])
+        if check and res.returncode != 0:
+            err = (res.stderr or res.stdout or "").strip()
+            low = err.lower()
+            if "file exists" in low:
+                raise FileExistsError(err)
+            if ("no such" in low or "not found" in low
+                    or "cannot find" in low or "does not exist" in low):
+                raise FileNotFoundError(err)
+            raise RuntimeError(f"ip {' '.join(args)}: rc="
+                               f"{res.returncode}: {err[:200]}")
+        return res.stdout
+
+    def _json(self, *args: str):
+        out = self._run("-j", *args)
+        return json.loads(out) if out.strip() else []
+
+    @staticmethod
+    def _route_args(route: Route) -> list[str]:
+        args = [route.destination, "table", str(route.table)]
+        if route.nexthops:  # ECMP (netlink_linux.go multipath role)
+            for nh in route.nexthops:
+                args.append("nexthop")
+                if nh.gateway:
+                    args += ["via", nh.gateway]
+                if nh.interface:
+                    args += ["dev", nh.interface]
+                args += ["weight", str(max(1, nh.weight))]
+            return args
+        if route.gateway:
+            args += ["via", route.gateway]
+        if route.interface:
+            args += ["dev", route.interface]
+        if route.metric:
+            args += ["metric", str(route.metric)]
+        return args
+
+    # -- routes ------------------------------------------------------------
+    def add_route(self, route: Route) -> None:
+        self._run("route", "add", *self._route_args(route))
+
+    def delete_route(self, route: Route) -> None:
+        self._run("route", "del", *self._route_args(route))
+
+    def get_routes(self, table: int) -> list[Route]:
+        routes = []
+        for r in self._json("route", "show", "table", str(table)):
+            nexthops = tuple(
+                NextHop(gateway=nh.get("gateway", ""),
+                        interface=nh.get("dev", ""),
+                        weight=int(nh.get("weight", 1)))
+                for nh in r.get("nexthops", ()))
+            dst = r.get("dst", "")
+            if dst == "default":
+                dst = "0.0.0.0/0"
+            elif "/" not in dst:
+                dst += "/32"
+            routes.append(Route(
+                destination=dst, gateway=r.get("gateway", ""),
+                interface=r.get("dev", ""), table=table,
+                metric=int(r.get("metric", 0)), nexthops=nexthops))
+        return routes
+
+    def flush_table(self, table: int) -> None:
+        self._run("route", "flush", "table", str(table), check=False)
+
+    # -- policy rules (ip rule) --------------------------------------------
+    @staticmethod
+    def _rule_args(rule: PolicyRule) -> list[str]:
+        args = ["priority", str(rule.priority)]
+        args += ["from", rule.src or "all"]
+        if rule.dst:
+            args += ["to", rule.dst]
+        if rule.fwmark:
+            args += ["fwmark", str(rule.fwmark)]
+        args += ["table", str(rule.table)]
+        return args
+
+    def add_rule(self, rule: PolicyRule) -> None:
+        # iproute2 happily duplicates rules; enforce the stub's
+        # FileExistsError contract ourselves
+        if rule in self.get_rules():
+            raise FileExistsError(f"rule exists: {rule}")
+        self._run("rule", "add", *self._rule_args(rule))
+
+    def delete_rule(self, rule: PolicyRule) -> None:
+        # the kernel's own ENOENT ("No such file or directory") maps to
+        # FileNotFoundError in _run — no O(total rules) pre-scan needed
+        self._run("rule", "del", *self._rule_args(rule))
+
+    def get_rules(self) -> list[PolicyRule]:
+        rules = []
+        for r in self._json("rule", "show"):
+            table = r.get("table", "")
+            if not str(table).isdigit():
+                continue  # local/main/default system tables
+            src = r.get("src", "")
+            if src in ("all", ""):
+                src = ""
+            else:  # iproute2 omits srclen for /32: normalize to CIDR
+                src += f"/{r.get('srclen', 32)}"
+            dst = r.get("dst", "")
+            if dst:
+                dst += f"/{r.get('dstlen', 32)}"
+            rules.append(PolicyRule(
+                priority=int(r.get("priority", 0)), table=int(table),
+                src=src, dst=dst, fwmark=int(r.get("fwmark", "0x0"), 16)
+                if isinstance(r.get("fwmark"), str) else int(r.get("fwmark", 0))))
+        return rules
+
+    # -- interfaces --------------------------------------------------------
+    def get_interface(self, name: str) -> InterfaceInfo:
+        links = self._json("link", "show", "dev", name)
+        if not links:
+            raise FileNotFoundError(f"no such interface: {name}")
+        link = links[0]
+        addrs = []
+        for a in self._json("addr", "show", "dev", name):
+            for ai in a.get("addr_info", ()):
+                addrs.append(f"{ai['local']}/{ai['prefixlen']}")
+        return InterfaceInfo(
+            name=name, index=int(link.get("ifindex", 0)),
+            mtu=int(link.get("mtu", 1500)),
+            hwaddr=link.get("address", ""),
+            up="UP" in link.get("flags", ()), addresses=addrs)
+
+    def set_interface_up(self, name: str) -> None:
+        self._run("link", "set", "dev", name, "up")
+
+    def set_interface_down(self, name: str) -> None:
+        self._run("link", "set", "dev", name, "down")
+
+    # -- health ------------------------------------------------------------
+    def ping(self, target: str, timeout: float = 1.0) -> float:
+        """ICMP echo probe — raw-socket first (the reference's approach,
+        netlink_linux.go:237; needs CAP_NET_RAW), ping(8) as the unprivileged
+        fallback. Returns RTT seconds, raises TimeoutError on no reply."""
+        import os
+        import socket
+        import struct
+
+        try:
+            s = socket.socket(socket.AF_INET, socket.SOCK_RAW,
+                              socket.IPPROTO_ICMP)
+        except PermissionError:
+            return self._ping_binary(target, timeout)
+        from bng_tpu.control.packets import checksum16
+
+        try:
+            s.settimeout(timeout)
+            # ident+seq+random token: a reply only counts if it echoes THIS
+            # probe's token AND comes from the probed address — a late
+            # reply from a previous (slower) target must never validate a
+            # dead upstream (review r4)
+            ident = os.getpid() & 0xFFFF
+            seq = next(_PING_SEQ) & 0xFFFF
+            token = os.urandom(8)
+            payload = struct.pack("!HH", ident, seq) + token
+            csum = checksum16(struct.pack("!BBH", 8, 0, 0) + payload)
+            pkt = struct.pack("!BBH", 8, 0, csum) + payload
+            t0 = time.monotonic()
+            s.sendto(pkt, (target, 0))
+            deadline = t0 + timeout
+            while True:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise TimeoutError(f"ping {target} timed out")
+                s.settimeout(remaining)
+                try:
+                    data, addr = s.recvfrom(2048)
+                except (socket.timeout, TimeoutError):
+                    raise TimeoutError(f"ping {target} timed out") from None
+                if addr[0] != target:
+                    continue
+                # strip the IP header; match echo-reply + ident/seq/token
+                ihl = (data[0] & 0x0F) * 4
+                icmp = data[ihl:]
+                if (len(icmp) >= 16 and icmp[0] == 0
+                        and icmp[4:8] == payload[:4]
+                        and icmp[8:16] == token):
+                    return time.monotonic() - t0
+        finally:
+            s.close()
+
+    @staticmethod
+    def _ping_binary(target: str, timeout: float) -> float:
+        import subprocess
+
+        t0 = time.monotonic()
+        try:
+            res = subprocess.run(
+                ["ping", "-c", "1", "-W", str(max(1, int(timeout))), target],
+                capture_output=True, text=True, timeout=timeout + 2)
+        except (subprocess.TimeoutExpired, FileNotFoundError):
+            raise TimeoutError(f"ping {target} unavailable/timed out") from None
+        if res.returncode != 0:
+            raise TimeoutError(f"ping {target} failed: rc={res.returncode}")
+        return time.monotonic() - t0
+
+
+# monotone ICMP sequence across all platform instances in this process
+import itertools as _itertools
+
+_PING_SEQ = _itertools.count(1)
+
+
+def vtysh_executor(binary: str = "vtysh", timeout: float = 10.0,
+                   runner=None):
+    """Real FRR executor: `vtysh -c <line> -c <line> ...` subprocesses.
+
+    Parity: the reference builds exactly this command per call
+    (pkg/routing/bgp.go:554-578, wired in cmd/bng/main.go:884-940).
+    BGPController hands multi-line configs as newline-joined strings;
+    each line becomes its own -c argument, matching vtysh semantics.
+    Returns stdout; raises RuntimeError on nonzero rc so controller state
+    never silently diverges from FRR.
+    """
+    import subprocess
+
+    # bounded argv: a bulk inject/withdraw at 1M-subscriber scale would
+    # otherwise exceed ARG_MAX (execve E2BIG). Chunks re-enter config mode
+    # so each invocation is a complete vtysh session.
+    MAX_LINES = 400
+
+    def _invoke(lines: list[str]) -> str:
+        args = [binary]
+        for line in lines:
+            args += ["-c", line]
+        run = runner or (lambda a: subprocess.run(
+            a, capture_output=True, text=True, timeout=timeout))
+        res = run(args)
+        if res.returncode != 0:
+            err = (res.stderr or res.stdout or "").strip()
+            raise RuntimeError(f"vtysh rc={res.returncode}: {err[:200]}")
+        return res.stdout
+
+    def execute(command: str) -> str:
+        lines = command.split("\n")
+        if len(lines) <= MAX_LINES:
+            return _invoke(lines)
+        # preserve the session preamble (configure terminal [+ router ...])
+        # at the head of every chunk so later chunks still apply
+        preamble = []
+        while (len(preamble) < len(lines) - 1
+               and (lines[len(preamble)].startswith("configure")
+                    or lines[len(preamble)].startswith("router "))):
+            preamble.append(lines[len(preamble)])
+        body = lines[len(preamble):]
+        out = []
+        step = MAX_LINES - len(preamble)
+        for i in range(0, len(body), step):
+            out.append(_invoke(preamble + body[i : i + step]))
+        return "".join(out)
+
+    return execute
+
+
 class LinkState(str, Enum):
     UNKNOWN = "unknown"
     UP = "up"

@@ -170,8 +170,17 @@ class ShardedCluster:
             for _ in range(n_shards)
         ]
         base_pub = public_ips or [0xCB007100 + i for i in range(n_shards)]
+        if len(base_pub) < n_shards:
+            # downstream ring steering is by public-IP ownership: a public
+            # IP shared across shards is not expressible (return traffic
+            # could only reach one of them) — reject at construction, not
+            # at make_ring time
+            raise ValueError(
+                f"need >= {n_shards} public IPs for {n_shards} shards "
+                f"(got {len(base_pub)}): each shard's NAT pool must own "
+                f"its public IPs exclusively")
         self.nat = [
-            NATManager(public_ips=[base_pub[i % len(base_pub)]],
+            NATManager(public_ips=[base_pub[i]],
                        sessions_nbuckets=nat_sessions_nbuckets,
                        sub_nat_nbuckets=256)
             for i in range(n_shards)

@@ -555,9 +555,8 @@ class TestMillionSubscriberShardedBuild:
 
     def test_shared_public_ip_across_shards_rejected(self):
         """Downstream steering is by-IP: shared public-IP ownership is not
-        expressible, so ring construction must fail loudly (review r4),
+        expressible, so the cluster must fail at CONSTRUCTION (review r4),
         never silently steer 3/4 of return traffic to a wrong shard."""
-        cl = ShardedCluster(2, batch_per_shard=8,
-                            public_ips=[ip_to_u32("203.0.113.9")])
-        with pytest.raises(ValueError, match="exclusive ownership"):
-            cl.make_ring(nframes=64, frame_size=2048, depth=32)
+        with pytest.raises(ValueError, match="exclusively"):
+            ShardedCluster(2, batch_per_shard=8,
+                           public_ips=[ip_to_u32("203.0.113.9")])

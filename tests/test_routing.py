@@ -288,3 +288,295 @@ class TestSubscriberRoutes:
         m = SubscriberRouteManager(executor=RecordingFRR())
         with pytest.raises(ValueError):
             m.inject_route("s", "u", "not-an-ip")
+
+
+# ---------------------------------------------------------------------------
+# Real-world wiring (VERDICT r3 item 5): vtysh executor + Linux platform
+# ---------------------------------------------------------------------------
+
+class _FakeProc:
+    def __init__(self, stdout="", stderr="", returncode=0):
+        self.stdout, self.stderr, self.returncode = stdout, stderr, returncode
+
+
+class TestVtyshExecutor:
+    """Parity: bgp.go:554-578 — one -c per config line."""
+
+    def test_multiline_config_becomes_dash_c_chain(self):
+        from bng_tpu.control.routing import vtysh_executor
+
+        calls = []
+        ex = vtysh_executor(binary="/usr/bin/vtysh",
+                           runner=lambda a: (calls.append(a), _FakeProc("ok"))[1])
+        out = ex("configure terminal\nrouter bgp 65001\nneighbor 1.2.3.4 remote-as 65002")
+        assert out == "ok"
+        assert calls == [[
+            "/usr/bin/vtysh",
+            "-c", "configure terminal",
+            "-c", "router bgp 65001",
+            "-c", "neighbor 1.2.3.4 remote-as 65002",
+        ]]
+
+    def test_nonzero_rc_raises(self):
+        from bng_tpu.control.routing import vtysh_executor
+
+        ex = vtysh_executor(runner=lambda a: _FakeProc(stderr="% Unknown command", returncode=1))
+        with pytest.raises(RuntimeError, match="Unknown command"):
+            ex("show bgp summary")
+
+    def test_bgp_controller_through_vtysh_executor(self):
+        """BGPController -> vtysh_executor end-to-end: real FRR argv."""
+        from bng_tpu.control.routing import (BGPConfig, BGPController,
+                                             BGPNeighbor, vtysh_executor)
+
+        calls = []
+        ctl = BGPController(
+            BGPConfig(local_as=65001, router_id="10.0.0.1"),
+            executor=vtysh_executor(
+                runner=lambda a: (calls.append(a), _FakeProc())[1]))
+        ctl.add_neighbor(BGPNeighbor(address="192.0.2.1", remote_as=65002))
+        ctl.announce_prefix("198.51.100.0/24")
+        flat = [" ".join(c) for c in calls]
+        assert any("router bgp 65001" in f and "remote-as 65002" in f for f in flat)
+        assert any("network 198.51.100.0/24" in f for f in flat)
+
+
+class TestIPRoute2Hermetic:
+    """IPRoute2Platform with an injected runner: exact ip(8) argv + JSON
+    parsing, no kernel required (the _stub.go-style hermetic layer)."""
+
+    def _platform(self, outputs=None):
+        from bng_tpu.control.routing import IPRoute2Platform
+
+        calls = []
+        outputs = dict(outputs or {})
+
+        def runner(args):
+            calls.append(args)
+            key = " ".join(args[1:])
+            out = outputs.get(key, "")
+            return _FakeProc(stdout=out)
+
+        return IPRoute2Platform(runner=runner), calls
+
+    def test_add_route_argv(self):
+        p, calls = self._platform()
+        p.add_route(Route(destination="10.1.0.0/16", gateway="192.168.0.1",
+                          interface="eth1", table=101, metric=50))
+        assert calls == [["ip", "route", "add", "10.1.0.0/16", "table", "101",
+                          "via", "192.168.0.1", "dev", "eth1", "metric", "50"]]
+
+    def test_ecmp_route_argv(self):
+        from bng_tpu.control.routing import NextHop
+
+        p, calls = self._platform()
+        p.add_route(Route(destination="0.0.0.0/0", table=254, nexthops=(
+            NextHop(gateway="10.0.0.1", interface="eth1", weight=2),
+            NextHop(gateway="10.0.1.1", interface="eth2", weight=1))))
+        assert calls[0] == ["ip", "route", "add", "0.0.0.0/0", "table", "254",
+                            "nexthop", "via", "10.0.0.1", "dev", "eth1",
+                            "weight", "2",
+                            "nexthop", "via", "10.0.1.1", "dev", "eth2",
+                            "weight", "1"]
+
+    def test_get_routes_parses_json(self):
+        routes_json = ('[{"dst":"default","gateway":"10.0.0.1","dev":"eth1",'
+                       '"metric":100},'
+                       '{"dst":"192.0.2.5","dev":"lo"},'
+                       '{"dst":"10.2.0.0/16","nexthops":[{"gateway":"10.0.0.1",'
+                       '"dev":"eth1","weight":2},{"gateway":"10.0.1.1",'
+                       '"dev":"eth2","weight":1}]}]')
+        p, _ = self._platform({"-j route show table 101": routes_json})
+        got = p.get_routes(101)
+        assert got[0].destination == "0.0.0.0/0" and got[0].metric == 100
+        assert got[1].destination == "192.0.2.5/32"
+        assert [n.weight for n in got[2].nexthops] == [2, 1]
+
+    def test_file_exists_maps_to_contract_error(self):
+        from bng_tpu.control.routing import IPRoute2Platform
+
+        p = IPRoute2Platform(runner=lambda a: _FakeProc(
+            stderr="RTNETLINK answers: File exists", returncode=2))
+        with pytest.raises(FileExistsError):
+            p.add_route(Route(destination="10.0.0.0/24", table=100))
+
+    def test_rules_parse_and_duplicate_contract(self):
+        rules_json = ('[{"priority":0,"src":"all","table":"local"},'
+                      '{"priority":15000,"src":"10.99.0.0","srclen":24,'
+                      '"table":"101"},'
+                      '{"priority":32766,"src":"all","table":"main"}]')
+        p, calls = self._platform({"-j rule show": rules_json})
+        rules = p.get_rules()
+        assert rules == [PolicyRule(priority=15000, table=101,
+                                    src="10.99.0.0/24")]
+        with pytest.raises(FileExistsError):
+            p.add_rule(PolicyRule(priority=15000, table=101,
+                                  src="10.99.0.0/24"))
+
+
+def _have_net_admin() -> bool:
+    import subprocess
+
+    try:
+        r = subprocess.run(["ip", "route", "add", "192.0.2.254/32", "dev",
+                            "lo", "table", "19999"], capture_output=True)
+        if r.returncode != 0:
+            return False
+        subprocess.run(["ip", "route", "flush", "table", "19999"],
+                       capture_output=True)
+        return True
+    except OSError:
+        return False
+
+
+NET_ADMIN = _have_net_admin()
+
+
+@pytest.mark.skipif(not NET_ADMIN, reason="needs CAP_NET_ADMIN + iproute2")
+class TestIPRoute2Kernel:
+    """The adapter passes the StubPlatform contract against the REAL
+    kernel (netlink_linux.go:20-442 role). Uses dedicated table/priority
+    numbers and cleans up after itself."""
+
+    TABLE = 19998
+
+    @pytest.fixture
+    def p(self):
+        from bng_tpu.control.routing import IPRoute2Platform
+
+        plat = IPRoute2Platform()
+        yield plat
+        plat.flush_table(self.TABLE)
+        for r in plat.get_rules():
+            if r.table == self.TABLE:
+                plat.delete_rule(r)
+
+    def test_route_crud_contract(self, p):
+        r = Route(destination="192.0.2.0/24", interface="lo", table=self.TABLE)
+        p.add_route(r)
+        got = p.get_routes(self.TABLE)
+        assert len(got) == 1
+        assert got[0].destination == "192.0.2.0/24"
+        assert got[0].interface == "lo"
+        with pytest.raises(FileExistsError):
+            p.add_route(r)
+        p.delete_route(r)
+        assert p.get_routes(self.TABLE) == []
+
+    def test_ecmp_route_in_kernel(self, p):
+        import subprocess
+
+        subprocess.run(["ip", "link", "add", "bngr0", "type", "veth",
+                        "peer", "name", "bngr1"], capture_output=True)
+        try:
+            p.set_interface_up("bngr0")
+            p.set_interface_up("bngr1")
+            r = Route(destination="198.51.100.0/24", table=self.TABLE,
+                      nexthops=(NextHop(gateway="", interface="bngr0",
+                                        weight=2),
+                                NextHop(gateway="", interface="bngr1",
+                                        weight=1)))
+            p.add_route(r)
+            got = p.get_routes(self.TABLE)
+            assert len(got) == 1
+            assert sorted(n.interface for n in got[0].nexthops) == \
+                ["bngr0", "bngr1"]
+        finally:
+            subprocess.run(["ip", "link", "del", "bngr0"], capture_output=True)
+
+    def test_policy_rule_contract(self, p):
+        rule = PolicyRule(priority=19998, table=self.TABLE, src="10.98.0.0/24")
+        p.add_rule(rule)
+        assert rule in p.get_rules()
+        with pytest.raises(FileExistsError):
+            p.add_rule(rule)
+        p.delete_rule(rule)
+        assert rule not in p.get_rules()
+        with pytest.raises(FileNotFoundError):
+            p.delete_rule(rule)
+
+    def test_interface_and_updown(self, p):
+        lo = p.get_interface("lo")
+        assert lo.index == 1 and lo.up
+        with pytest.raises(FileNotFoundError):
+            p.get_interface("bng-does-not-exist")
+
+    def test_routing_manager_on_real_kernel(self, p):
+        """Multi-ISP steering end-to-end against the kernel: ISP table +
+        subscriber policy rule actually land in ip route/ip rule."""
+        from bng_tpu.control.routing import RoutingManager
+
+        m = RoutingManager(platform=p)
+        m.create_isp_table("ispA", self.TABLE, gateway="", interface="lo")
+        m.route_subscriber_to_isp("10.98.0.77", self.TABLE, priority=19998)
+        assert any(r.table == self.TABLE for r in p.get_rules())
+        m.unroute_subscriber("10.98.0.77", self.TABLE, priority=19998)
+        assert not any(r.table == self.TABLE for r in p.get_rules())
+
+    def test_raw_icmp_ping_loopback(self, p):
+        try:
+            rtt = p.ping("127.0.0.1", timeout=2.0)
+        except TimeoutError:
+            pytest.skip("no ICMP capability in sandbox")
+        assert 0 <= rtt < 2.0
+        with pytest.raises(TimeoutError):
+            p.ping("192.0.2.123", timeout=0.3)  # TEST-NET: no reply
+
+
+class TestCLIVtyshWiring:
+    """`run` with BGP flags emits real vtysh commands (VERDICT r3 item 5
+    done-criterion), proven through an executor-logging fake vtysh."""
+
+    def test_bgp_flags_drive_vtysh_subprocess(self, tmp_path):
+        from bng_tpu.cli import BNGApp, BNGConfig
+        from bng_tpu.control.routing import BGPNeighbor
+
+        log = tmp_path / "vtysh.log"
+        fake = tmp_path / "vtysh"
+        fake.write_text("#!/bin/sh\necho \"$@\" >> " + str(log) + "\n")
+        fake.chmod(0o755)
+        app = BNGApp(BNGConfig(bgp_enabled=True, bgp_vtysh=True,
+                               bgp_vtysh_path=str(fake),
+                               bgp_local_as=65010))
+        try:
+            app.components["bgp"].add_neighbor(
+                BGPNeighbor(address="192.0.2.9", remote_as=65020))
+        finally:
+            app.close()
+        logged = log.read_text()
+        assert "router bgp 65010" in logged
+        assert "neighbor 192.0.2.9 remote-as 65020" in logged
+
+    def test_linux_platform_flag(self):
+        from bng_tpu.cli import BNGApp, BNGConfig
+
+        if not NET_ADMIN:
+            pytest.skip("needs CAP_NET_ADMIN")
+        app = BNGApp(BNGConfig(routing_platform="linux"))
+        try:
+            assert "routing" in app.components
+            lo = app.components["routing"].platform.get_interface("lo")
+            assert lo.index == 1
+        finally:
+            app.close()
+
+    def test_bulk_config_chunks_under_arg_max(self):
+        """A 1M-scale bulk inject/withdraw must not build one giant argv
+        (execve E2BIG); chunks re-enter config mode (review r4)."""
+        from bng_tpu.control.routing import vtysh_executor
+
+        calls = []
+        ex = vtysh_executor(runner=lambda a: (calls.append(a), _FakeProc())[1])
+        lines = ["configure terminal", "router bgp 65001"] + [
+            f"network 10.{i >> 8 & 255}.{i & 255}.0/32" for i in range(1000)]
+        ex("\n".join(lines))
+        assert len(calls) > 1  # chunked
+        for c in calls:
+            assert len(c) < 2 * 450  # bounded argv
+            # every chunk is a complete session: preamble present
+            assert c[1:5] == ["-c", "configure terminal", "-c",
+                              "router bgp 65001"]
+        # all 1000 lines delivered exactly once
+        delivered = [x for call in calls for x in call[2::2]
+                     if x.startswith("network ")]
+        assert len(delivered) == 1000 and len(set(delivered)) == 1000
