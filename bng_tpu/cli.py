@@ -58,6 +58,12 @@ class BNGConfig:
     walled_garden_enabled: bool = True
     portal_ip: str = "10.255.255.1"
     portal_port: int = 8080
+    # DNS wire (control/dns_wire.py): UDP listener serving the resolver,
+    # forwarding cache misses upstream with failover
+    dns_enabled: bool = False
+    dns_listen: str = "0.0.0.0:53"
+    dns_upstreams: list = dataclasses.field(
+        default_factory=lambda: ["8.8.8.8:53", "1.1.1.1:53"])
     # HA
     ha_role: str = ""  # "", "active", "standby"
     ha_peer: str = ""  # active's cluster URL (http://host:port) for standbys
@@ -129,7 +135,14 @@ class BNGApp:
         self._last_sync = 0.0
         self._syn_i = 0
         self.components: dict[str, object] = {}
-        self._build()
+        try:
+            self._build()
+        except BaseException:
+            # a half-built app leaks live resources (listener threads,
+            # bound sockets, AF_XDP attachments): run the LIFO cleanup for
+            # whatever was already wired before re-raising
+            self.close()
+            raise
 
     def _on_close(self, fn) -> None:
         self._cleanup.append(fn)
@@ -173,6 +186,26 @@ class BNGApp:
                                       portal_port=cfg.portal_port),
                 clock=self.clock)
             self._on_close(lambda: garden.check_expired())
+
+        # 2b. DNS wire (pkg/dns role, now with a real socket): UDP listener
+        # serving the resolver; walled-garden subscribers get the portal
+        # for every name, everyone else forwards upstream with failover
+        if cfg.dns_enabled:
+            from bng_tpu.control.dns import DNSConfig, Resolver
+            from bng_tpu.control.dns_wire import DNSServer, UDPForwarder
+
+            dns_cfg = DNSConfig(upstreams=list(cfg.dns_upstreams),
+                                walled_garden_redirect_ip=cfg.portal_ip)
+            resolver = c["dns_resolver"] = Resolver(
+                dns_cfg, forwarder=UDPForwarder(dns_cfg.upstreams,
+                                                timeout=dns_cfg.timeout))
+            host, _, port = cfg.dns_listen.partition(":")
+            dns_srv = c["dns_server"] = DNSServer(
+                resolver, host=host or "0.0.0.0", port=int(port or 53))
+            dns_srv.start()
+            self._on_close(dns_srv.stop)
+            self.log.info("dns listener", addr=f"{dns_srv.addr[0]}:"
+                                               f"{dns_srv.addr[1]}")
 
         # 3. pools (main.go:567-594)
         pool_mgr = c["pools"] = PoolManager(fastpath_tables=fastpath)
@@ -263,6 +296,50 @@ class BNGApp:
             pool_manager=pool_mgr, fastpath_tables=fastpath,
             authenticator=authenticator, qos_hook=qos_hook,
             nat_hook=nat_hook, clock=self.clock)
+
+        # 8b. walled-garden subscribers feed the DNS resolver's per-client
+        # garden: a MAC's garden state maps to its lease IP at each
+        # transition, so the portal answer applies the moment DHCP hands
+        # the subscriber an address (resolver.go:150-157 role)
+        if cfg.dns_enabled and cfg.walled_garden_enabled:
+            from bng_tpu.control.walledgarden import SubscriberState
+            from bng_tpu.utils.net import u32_to_ip
+
+            resolver = c["dns_resolver"]
+            garden = c["walledgarden"]
+
+            def _apply_garden_ip(state, ip_u32, _resolver=resolver):
+                ip = u32_to_ip(ip_u32)
+                if state == SubscriberState.PROVISIONED:
+                    _resolver.remove_walled_garden_client(ip)
+                else:
+                    _resolver.add_walled_garden_client(ip)
+
+            # garden transition with a live lease: apply to that IP
+            def _garden_dns_sync(mac_u64, state, _dhcp=dhcp):
+                lease = _dhcp.leases.get(mac_u64)
+                if lease is not None:
+                    _apply_garden_ip(state, lease.ip)
+
+            garden.on_state_change(_garden_dns_sync)
+
+            # lease lifecycle closes the other direction: a grant applies
+            # the MAC's CURRENT garden state (covers garden-before-DHCP),
+            # and a stop scrubs the IP unconditionally so a reassigned
+            # address never inherits the previous subscriber's portal
+            prev_acct = dhcp.accounting_hook
+
+            def _lease_dns_sync(event, lease, sid, _garden=garden,
+                                _resolver=resolver):
+                if prev_acct is not None:
+                    prev_acct(event, lease, sid)
+                if event == "start":
+                    _apply_garden_ip(_garden.get_subscriber_state(lease.mac),
+                                     lease.ip)
+                else:
+                    _resolver.remove_walled_garden_client(u32_to_ip(lease.ip))
+
+            dhcp.accounting_hook = _lease_dns_sync
 
         # 9. engine: the TPU dataplane replacing the XDP attach
         c["engine"] = Engine(

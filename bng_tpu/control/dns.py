@@ -23,11 +23,13 @@ from enum import Enum
 
 # DNS constants (types.go:173-221)
 TYPE_A = 1
+TYPE_NS = 2
 TYPE_CNAME = 5
 TYPE_AAAA = 28
 TYPE_PTR = 12
 TYPE_MX = 15
 TYPE_TXT = 16
+TYPE_SRV = 33
 CLASS_IN = 1
 
 RCODE_SUCCESS = 0
@@ -67,7 +69,11 @@ class Record:
     ttl: int = 0
     ipv4: str = ""
     ipv6: str = ""
-    target: str = ""  # CNAME target
+    target: str = ""  # CNAME/NS/PTR target
+    # verbatim rdata for other types (TXT, MX, SRV, ...): the wire codec
+    # stores a decompressed copy so non-address records survive the
+    # forward path instead of being silently dropped
+    rdata: bytes = b""
 
 
 @dataclass

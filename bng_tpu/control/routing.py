@@ -245,10 +245,9 @@ class IPRoute2Platform:
         return args
 
     def add_rule(self, rule: PolicyRule) -> None:
-        # iproute2 happily duplicates rules; enforce the stub's
-        # FileExistsError contract ourselves
-        if rule in self.get_rules():
-            raise FileExistsError(f"rule exists: {rule}")
+        # the kernel rejects exact duplicates with EEXIST ("File exists"),
+        # which _run maps to the stub's FileExistsError contract — no
+        # O(total rules) pre-scan per subscriber rule
         self._run("rule", "add", *self._rule_args(rule))
 
     def delete_rule(self, rule: PolicyRule) -> None:

@@ -81,6 +81,7 @@ class WalledGardenManager:
         self._allowed: dict[int, AllowedDestination] = {}
         self._on_redirect = None
         self._on_expire = None
+        self._on_state_change = None
         self._stats = {"redirects": 0, "expired": 0}
         self._init_allowed_destinations()
 
@@ -102,6 +103,12 @@ class WalledGardenManager:
     def on_expire(self, callback) -> None:
         self._on_expire = callback
 
+    def on_state_change(self, callback) -> None:
+        """callback(mac_u64, state) after every set_subscriber_state —
+        lets enforcement points (the DNS resolver's per-client garden,
+        the device-side gate) track membership without polling."""
+        self._on_state_change = callback
+
     # -- subscriber state ----------------------------------------------
 
     def set_subscriber_state(self, mac: bytes | str, state: SubscriberState,
@@ -116,6 +123,8 @@ class WalledGardenManager:
                 expiry = now + self.config.default_timeout
             self._entries[key] = Entry(state=state, vlan_id=vlan_id,
                                        expiry_time=expiry, added_at=now)
+        if self._on_state_change:  # outside the lock: callbacks may re-enter
+            self._on_state_change(key, state)
 
     def get_subscriber_state(self, mac: bytes | str) -> SubscriberState:
         with self._lock:
@@ -133,8 +142,13 @@ class WalledGardenManager:
         self.set_subscriber_state(mac, SubscriberState.BLOCKED)
 
     def remove_mac(self, mac: bytes | str) -> None:
+        key = mac_to_u64(mac)
         with self._lock:
-            self._entries.pop(mac_to_u64(mac), None)
+            removed = self._entries.pop(key, None) is not None
+        # removal reverts the MAC to UNKNOWN (gardened by default): every
+        # enforcement point must hear about it, same as a transition
+        if removed and self._on_state_change:
+            self._on_state_change(key, SubscriberState.UNKNOWN)
 
     def list_walled_macs(self) -> list[int]:
         with self._lock:
@@ -177,9 +191,11 @@ class WalledGardenManager:
                     del self._entries[key]
                     expired.append(key)
             self._stats["expired"] += len(expired)
-        if self._on_expire:
-            for key in expired:
+        for key in expired:
+            if self._on_expire:
                 self._on_expire(key)
+            if self._on_state_change:  # expiry reverts to UNKNOWN
+                self._on_state_change(key, SubscriberState.UNKNOWN)
         return len(expired)
 
     def stats(self) -> dict:

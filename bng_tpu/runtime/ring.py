@@ -165,6 +165,18 @@ def _configure(lib: C.CDLL) -> None:
         C.POINTER(C.c_uint32), C.c_uint32, C.c_uint32]
     lib.bng_ring_shard_rx_pending.restype = C.c_uint32
     lib.bng_ring_shard_rx_pending.argtypes = [C.c_void_p, C.c_uint32]
+    lib.bng_ring_rx_reserve.restype = C.c_uint64
+    lib.bng_ring_rx_reserve.argtypes = [C.c_void_p]
+    lib.bng_ring_rx_submit.restype = C.c_int
+    lib.bng_ring_rx_submit.argtypes = [C.c_void_p, C.c_uint64, C.c_uint32,
+                                       C.c_uint32]
+    for name in ("tx_pop_desc", "fwd_pop_desc"):
+        fn = getattr(lib, f"bng_ring_{name}")
+        fn.restype = C.c_int
+        fn.argtypes = [C.c_void_p, C.POINTER(C.c_uint64),
+                       C.POINTER(C.c_uint32), C.POINTER(C.c_uint32)]
+    lib.bng_ring_frame_free.restype = C.c_int
+    lib.bng_ring_frame_free.argtypes = [C.c_void_p, C.c_uint64]
     lib.bng_ring_tx_inject.restype = C.c_int
     lib.bng_ring_tx_inject.argtypes = [C.c_void_p, C.POINTER(C.c_uint8),
                                        C.c_uint32, C.c_uint32]

@@ -92,6 +92,83 @@ class XskSocket:
         self._h = handle
         self.ring = ring  # keeps the UMEM alive
         self.mode = MODE_ZEROCOPY if lib.bng_xsk_mode(handle) == 0 else MODE_COPY
+        self._tx_pending: list[tuple[int, int]] = []  # (addr, len) awaiting slots
+        self.pump_stats = {"filled": 0, "rx": 0, "tx": 0, "completed": 0,
+                           "rx_submit_fail": 0}
+
+    def pump(self, budget: int = 64, from_access: bool = True) -> int:
+        """One wire-pump round: the glue that makes the real AF_XDP rungs
+        serve the engine (the loader.go attach-ladder's data-moving role).
+
+        (a) feed the kernel fill ring from the bngring free pool,
+        (b) drain kernel RX -> bng_ring_rx_submit (zero-copy: the frame
+            is already in UMEM; classification/steering run there),
+        (c) pop TX/FWD verdict descriptors -> kernel TX ring (zero-copy),
+        (d) reap TX completions -> frames back to the free pool.
+        Returns frames moved (rx+tx)."""
+        lib, ring = self._lib, self.ring
+        rlib, rh = ring._lib, ring._h
+        moved = 0
+        # (a) fill
+        addrs = []
+        for _ in range(budget):
+            a = rlib.bng_ring_rx_reserve(rh)
+            if a == 0xFFFFFFFFFFFFFFFF:
+                break
+            addrs.append(a)
+        if addrs:
+            arr = (C.c_uint64 * len(addrs))(*addrs)
+            pushed = lib.bng_xsk_fill(self._h, arr, len(addrs))
+            self.pump_stats["filled"] += pushed
+            for a in addrs[pushed:]:  # fill ring full: hand frames back
+                rlib.bng_ring_frame_free(rh, a)
+        # (b) RX. The kernel places the packet at chunk_base + headroom
+        # and reports THAT address; the ring's descriptors are chunk-based
+        # (the fill pool recycles by base), so normalize: slide the bytes
+        # to the chunk start and submit the base. In copy mode the kernel
+        # already copied once; this small memmove keeps rung 1 simple —
+        # the zerocopy rung will want headroom-aware descriptors instead.
+        oa = (C.c_uint64 * budget)()
+        ol = (C.c_uint32 * budget)()
+        n = lib.bng_xsk_rx(self._h, oa, ol, budget)
+        fl = 0x1 if from_access else 0  # FLAG_FROM_ACCESS
+        umem_base = C.addressof(ring.umem_ptr.contents)
+        for i in range(n):
+            off = oa[i] % ring.frame_size
+            base = oa[i] - off
+            if off:
+                C.memmove(umem_base + base, umem_base + oa[i], ol[i])
+            if rlib.bng_ring_rx_submit(rh, base, ol[i], fl) != 0:
+                self.pump_stats["rx_submit_fail"] += 1
+        self.pump_stats["rx"] += n
+        moved += n
+        # (c) TX: retries first, then fresh verdict descriptors
+        txq = self._tx_pending
+        addr = C.c_uint64()
+        ln = C.c_uint32()
+        while len(txq) < budget:
+            got = rlib.bng_ring_tx_pop_desc(rh, C.byref(addr), C.byref(ln),
+                                            None)
+            if not got:
+                got = rlib.bng_ring_fwd_pop_desc(rh, C.byref(addr),
+                                                 C.byref(ln), None)
+            if not got:
+                break
+            txq.append((addr.value, ln.value))
+        if txq:
+            ta = (C.c_uint64 * len(txq))(*[a for a, _ in txq])
+            tl = (C.c_uint32 * len(txq))(*[l for _, l in txq])
+            sent = lib.bng_xsk_tx(self._h, ta, tl, len(txq))
+            self.pump_stats["tx"] += sent
+            moved += sent
+            del txq[:sent]  # unsent stay pending for the next round
+        # (d) completions
+        ca = (C.c_uint64 * budget)()
+        c = lib.bng_xsk_complete(self._h, ca, budget)
+        for i in range(c):
+            rlib.bng_ring_frame_free(rh, ca[i])
+        self.pump_stats["completed"] += c
+        return moved
 
     @property
     def fd(self) -> int:

@@ -572,6 +572,41 @@ int bng_ring_tx_inject(bng_ring *r, const uint8_t *data, uint32_t len,
   return 0;
 }
 
+/* Descriptor-based output pops for the AF_XDP wire: the frame STAYS in
+ * UMEM (the kernel reads it directly for TX); the caller returns it to
+ * the fill pool with bng_ring_frame_free after the completion ring
+ * reports it sent. The copying *_pop variants below remain for
+ * non-UMEM consumers (slow path, tests). */
+static int pop_desc_from(bng_ring *r, Ring &ring, uint64_t *addr,
+                         uint32_t *len, uint32_t *flags) {
+  bng_desc d;
+  if (!ring.pop(&d)) return 0;
+  (void)r;
+  *addr = d.addr;
+  *len = d.len;
+  if (flags) *flags = d.flags;
+  return 1;
+}
+
+int bng_ring_tx_pop_desc(bng_ring *r, uint64_t *addr, uint32_t *len,
+                         uint32_t *flags) {
+  return pop_desc_from(r, r->tx, addr, len, flags);
+}
+int bng_ring_fwd_pop_desc(bng_ring *r, uint64_t *addr, uint32_t *len,
+                          uint32_t *flags) {
+  return pop_desc_from(r, r->fwd, addr, len, flags);
+}
+
+int bng_ring_frame_free(bng_ring *r, uint64_t addr) {
+  if (!valid_addr(r, addr)) {
+    r->stats.bad_desc++;
+    return -1;
+  }
+  bng_desc d{addr, 0, 0};
+  r->fill.push(d);
+  return 0;
+}
+
 static int pop_from(bng_ring *r, Ring &ring, uint8_t *buf, uint32_t cap,
                     uint32_t *flags) {
   bng_desc d;

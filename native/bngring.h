@@ -187,6 +187,18 @@ int bng_batch_complete(bng_ring *r, const uint8_t *verdict,
 int bng_ring_tx_inject(bng_ring *r, const uint8_t *data, uint32_t len,
                        uint32_t flags);
 
+/* Descriptor-based output pops for the AF_XDP wire: the frame stays in
+ * UMEM (zero-copy TX); return it to the fill pool with
+ * bng_ring_frame_free once the kernel's completion ring reports it
+ * sent. Returns 1 with addr/len/flags filled, 0 when empty. */
+int bng_ring_tx_pop_desc(bng_ring *r, uint64_t *addr, uint32_t *len,
+                         uint32_t *flags);
+int bng_ring_fwd_pop_desc(bng_ring *r, uint64_t *addr, uint32_t *len,
+                          uint32_t *flags);
+/* Return a UMEM frame to the fill pool (post-TX-completion, or an
+ * unused rx_reserve). Returns 0, or -1 on an invalid address. */
+int bng_ring_frame_free(bng_ring *r, uint64_t addr);
+
 /* Drain one frame from the tx / fwd / slow ring into buf (cap bytes).
  * Returns frame length, 0 if empty, or -1 on truncation (frame bigger
  * than cap; frame is consumed). Recycles the UMEM frame. */

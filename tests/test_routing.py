@@ -409,9 +409,14 @@ class TestIPRoute2Hermetic:
         rules = p.get_rules()
         assert rules == [PolicyRule(priority=15000, table=101,
                                     src="10.99.0.0/24")]
+        # duplicate contract rides the kernel's own EEXIST (no pre-scan)
+        from bng_tpu.control.routing import IPRoute2Platform
+
+        dup = IPRoute2Platform(runner=lambda a: _FakeProc(
+            stderr="RTNETLINK answers: File exists", returncode=2))
         with pytest.raises(FileExistsError):
-            p.add_rule(PolicyRule(priority=15000, table=101,
-                                  src="10.99.0.0/24"))
+            dup.add_rule(PolicyRule(priority=15000, table=101,
+                                    src="10.99.0.0/24"))
 
 
 def _have_net_admin() -> bool:

@@ -6,6 +6,9 @@ LADDER (driver -> generic -> stub), not a live NIC (pkg/ebpf
 loader.go:294-315 role).
 """
 
+import time
+
+import numpy as np
 import pytest
 
 from bng_tpu.runtime import xsk
@@ -65,3 +68,144 @@ class TestLadder:
         ln = np.zeros((4,), dtype=np.uint32)
         fl = np.zeros((4,), dtype=np.uint32)
         assert ring.assemble(out, ln, fl) == 1
+
+
+def _veth_ok() -> bool:
+    import subprocess
+
+    r = subprocess.run(["ip", "link", "add", "bngxt0", "type", "veth",
+                        "peer", "name", "bngxt1"], capture_output=True)
+    if r.returncode != 0:
+        return False
+    subprocess.run(["ip", "link", "del", "bngxt0"], capture_output=True)
+    return True
+
+
+def _rung1_possible() -> bool:
+    from bng_tpu.runtime import xdp_redirect, xsk
+
+    return (xsk.probe() != "unavailable" and xdp_redirect.probe()
+            and _veth_ok())
+
+
+@pytest.mark.skipif(not _rung1_possible(),
+                    reason="needs CAP_NET_ADMIN + AF_XDP + CAP_BPF")
+class TestCopyModeRungOnVeth:
+    """The ladder's real rung 1 against the real kernel (VERDICT r3 item
+    7; the reference's kernel-verifier CI gate role,
+    .github/workflows/bpf-test.yml): copy-mode bind on a veth pair, the
+    xskmap-redirect program through the ACTUAL BPF verifier, one frame
+    kernel->UMEM->ring->verdict->kernel."""
+
+    IF_A, IF_B = "bngxt0", "bngxt1"
+
+    @pytest.fixture
+    def veth(self):
+        import subprocess
+
+        subprocess.run(["ip", "link", "del", self.IF_A], capture_output=True)
+        subprocess.run(["ip", "link", "add", self.IF_A, "type", "veth",
+                        "peer", "name", self.IF_B], check=True,
+                       capture_output=True)
+        for i in (self.IF_A, self.IF_B):
+            subprocess.run(["ip", "link", "set", i, "up"], check=True,
+                           capture_output=True)
+        time.sleep(0.3)  # carrier settle
+        yield
+        subprocess.run(["ip", "link", "del", self.IF_A], capture_output=True)
+
+    def test_rung1_full_loop(self, veth):
+        import socket as so
+
+        from bng_tpu.control import packets
+        from bng_tpu.runtime import xdp_redirect
+        from bng_tpu.runtime.ring import NativeRing
+
+        ring = NativeRing(nframes=4096, frame_size=2048, depth=1024)
+        att = xsk.open_wire(ring, ifname=self.IF_A, queue=0)
+        assert att.mode == "copy", (att.mode, att.detail)  # rung 1 reached
+        s = att.xsk
+        redir = xdp_redirect.XdpRedirect(self.IF_A, {0: s.fd})
+        tx = so.socket(so.AF_PACKET, so.SOCK_RAW)
+        rx_sock = so.socket(so.AF_PACKET, so.SOCK_RAW, so.htons(0x0003))
+        try:
+            s.pump()  # pre-fill the kernel fill ring
+            frame = packets.udp_packet(
+                b"\x02\xaa\xaa\xaa\xaa\x01", b"\x02\xbb\xbb\xbb\xbb\x02",
+                0x0A000001, 0x0A000002, 5000, 6000, b"xsk-rung-one")
+            tx.bind((self.IF_B, 0))
+            rx_sock.bind((self.IF_B, 0))
+            rx_sock.settimeout(0.1)
+            tx.send(frame)
+
+            pkt = np.zeros((8, 2048), dtype=np.uint8)
+            ln = np.zeros((8,), dtype=np.uint32)
+            fl = np.zeros((8,), dtype=np.uint32)
+            n = 0
+            for _ in range(100):  # noise (IPv6 ND etc.) may share the veth
+                s.pump()
+                if ring.rx_pending():
+                    n = ring.assemble(pkt, ln, fl)
+                    rows = [bytes(pkt[i, : ln[i]]) for i in range(n)]
+                    if frame in rows:
+                        break
+                    ring.complete(np.full((n,), 1, dtype=np.uint8), pkt,
+                                  ln, n)
+                    n = 0
+                time.sleep(0.02)
+            assert n, "frame never arrived through the kernel"
+            rows = [bytes(pkt[i, : ln[i]]) for i in range(n)]
+            idx = rows.index(frame)
+            assert fl[idx] & 0x1  # from_access
+
+            # verdict TX with a device 'rewrite'; must egress via kernel
+            reply = bytearray(frame)
+            reply[-1] ^= 0xFF
+            pkt[idx, : len(reply)] = np.frombuffer(bytes(reply),
+                                                   dtype=np.uint8)
+            ln[idx] = len(reply)
+            verdict = np.full((n,), 1, dtype=np.uint8)
+            verdict[idx] = 2  # TX
+            ring.complete(verdict, pkt, ln, n)
+            got = None
+            deadline = time.time() + 3
+            while time.time() < deadline:
+                s.pump()
+                try:
+                    data = rx_sock.recv(4096)
+                except TimeoutError:
+                    continue
+                if data == bytes(reply):
+                    got = data
+                    break
+            assert got == bytes(reply), s.pump_stats
+            assert s.pump_stats["completed"] >= 1  # kernel reported the TX
+            assert ring.free_frames() > 0
+        finally:
+            tx.close()
+            rx_sock.close()
+            redir.close()
+            s.close()
+            ring.close()
+
+    def test_verifier_rejects_bad_program(self, veth):
+        """The kernel verifier is real: an out-of-bounds ctx read must be
+        rejected (proves the gate actually gates)."""
+        import struct
+
+        from bng_tpu.runtime import xdp_redirect as xr
+
+        bad = b"".join([
+            xr._insn(0x61, 2, 1, 4096, 0),  # r2 = ctx[4096]: out of range
+            xr._insn(0xB7, 0, 0, 0, 2),
+            xr._insn(0x95, 0, 0, 0, 0),
+        ])
+        lic = __import__("ctypes").create_string_buffer(b"GPL")
+        ib = __import__("ctypes").create_string_buffer(bad, len(bad))
+        attr = struct.pack(
+            "<IIQQIIQII16sII", xr.BPF_PROG_TYPE_XDP, len(bad) // 8,
+            __import__("ctypes").addressof(ib),
+            __import__("ctypes").addressof(lic),
+            0, 0, 0, 0, 0, b"bng_bad", 0, xr.BPF_XDP).ljust(128, b"\x00")
+        with pytest.raises(OSError):
+            xr._bpf(xr.BPF_PROG_LOAD, attr)
