@@ -297,57 +297,97 @@ class BNGApp:
             authenticator=authenticator, qos_hook=qos_hook,
             nat_hook=nat_hook, clock=self.clock)
 
-        # 8b. walled-garden subscribers feed the DNS resolver's per-client
-        # garden: a MAC's garden state maps to its lease IP at each
-        # transition, so the portal answer applies the moment DHCP hands
-        # the subscriber an address (resolver.go:150-157 role)
-        if cfg.dns_enabled and cfg.walled_garden_enabled:
+        # 9. engine: the TPU dataplane replacing the XDP attach. The
+        # device-side garden gate compiles in only when the walled garden
+        # is enabled (a disabled feature must cost zero per batch).
+        garden_tables = None
+        if cfg.walled_garden_enabled:
+            from bng_tpu.runtime.engine import GardenTables
+
+            garden_tables = GardenTables()
+        c["engine"] = Engine(
+            fastpath=fastpath, nat=nat, qos=qos, antispoof=c["antispoof"],
+            garden=garden_tables,
+            batch_size=cfg.batch_size, slow_path=dhcp.handle_frame,
+            clock=self.clock)
+        self.log.info("engine built", batch_size=cfg.batch_size,
+                      nat=cfg.nat_enabled, qos=cfg.qos_enabled)
+
+        # 9b. walled-garden enforcement sync. One MAC-state feed drives
+        # BOTH enforcement points: the DEVICE gate (engine.garden — a
+        # pre-auth subscriber's data traffic drops on-chip; beyond the
+        # reference, whose garden maps reach no bpf program) and, when
+        # enabled, the DNS resolver's per-client portal answers
+        # (resolver.go:150-157 role). A MAC's garden state maps to its
+        # lease IP at each garden transition AND each lease event (grant
+        # applies the current state — covers garden-before-DHCP; stop
+        # scrubs the IP so a reassigned address inherits nothing).
+        if cfg.walled_garden_enabled:
             from bng_tpu.control.walledgarden import SubscriberState
             from bng_tpu.utils.net import u32_to_ip
 
-            resolver = c["dns_resolver"]
             garden = c["walledgarden"]
+            gt = c["engine"].garden
+            resolver = c.get("dns_resolver")
+            # allowed destinations (manager.go:95-103): the portal on ANY
+            # TCP port (the DNS-redirect flow lands on the original URL's
+            # port 80/443, not just the portal's own listener) and every
+            # DNS server a gardened client could plausibly query — the
+            # addresses DHCP actually advertises (global + per-pool) plus
+            # the garden config's allowlist; a gardened client whose
+            # resolver the gate drops could never even reach the portal.
+            gt.allow_destination(ip_to_u32(cfg.portal_ip), 0, 6)
+            dns_ips = {cfg.dns_primary, cfg.dns_secondary,
+                       *garden.config.allowed_dns}
+            for spec in pool_specs:
+                if isinstance(spec, dict):
+                    dns_ips |= {spec.get("dns_primary", ""),
+                                spec.get("dns_secondary", "")}
+            for d in sorted(d for d in dns_ips if d):
+                gt.allow_destination(ip_to_u32(d), 53, 0)
 
-            def _apply_garden_ip(state, ip_u32, _resolver=resolver):
-                ip = u32_to_ip(ip_u32)
-                if state == SubscriberState.PROVISIONED:
-                    _resolver.remove_walled_garden_client(ip)
-                else:
-                    _resolver.add_walled_garden_client(ip)
+            def _apply_garden_ip(state, ip_u32, _resolver=resolver, _gt=gt):
+                # DEVICE gate: only EXPLICIT garden membership drops
+                # on-chip — UNKNOWN (never registered) stays unenforced,
+                # or a default-on garden would drop every data packet of
+                # subscribers the portal flow never touched.
+                # DNS resolver: keeps the manager's own stricter contract
+                # (everything non-PROVISIONED is gardened, UNKNOWN
+                # included) — portal answers are harmless-if-wrong in the
+                # way a device drop is not, and the reference's resolver
+                # behaves this way (resolver.go:150-157).
+                _gt.set_gardened(ip_u32, state in (
+                    SubscriberState.WALLED_GARDEN, SubscriberState.BLOCKED))
+                if _resolver is not None:
+                    ip = u32_to_ip(ip_u32)
+                    if state == SubscriberState.PROVISIONED:
+                        _resolver.remove_walled_garden_client(ip)
+                    else:
+                        _resolver.add_walled_garden_client(ip)
 
-            # garden transition with a live lease: apply to that IP
-            def _garden_dns_sync(mac_u64, state, _dhcp=dhcp):
+            def _garden_sync(mac_u64, state, _dhcp=dhcp):
                 lease = _dhcp.leases.get(mac_u64)
                 if lease is not None:
                     _apply_garden_ip(state, lease.ip)
 
-            garden.on_state_change(_garden_dns_sync)
+            garden.on_state_change(_garden_sync)
 
-            # lease lifecycle closes the other direction: a grant applies
-            # the MAC's CURRENT garden state (covers garden-before-DHCP),
-            # and a stop scrubs the IP unconditionally so a reassigned
-            # address never inherits the previous subscriber's portal
             prev_acct = dhcp.accounting_hook
 
-            def _lease_dns_sync(event, lease, sid, _garden=garden,
-                                _resolver=resolver):
+            def _lease_sync(event, lease, sid, _garden=garden,
+                            _resolver=resolver, _gt=gt):
                 if prev_acct is not None:
                     prev_acct(event, lease, sid)
                 if event == "start":
                     _apply_garden_ip(_garden.get_subscriber_state(lease.mac),
                                      lease.ip)
                 else:
-                    _resolver.remove_walled_garden_client(u32_to_ip(lease.ip))
+                    _gt.set_gardened(lease.ip, False)
+                    if _resolver is not None:
+                        _resolver.remove_walled_garden_client(
+                            u32_to_ip(lease.ip))
 
-            dhcp.accounting_hook = _lease_dns_sync
-
-        # 9. engine: the TPU dataplane replacing the XDP attach
-        c["engine"] = Engine(
-            fastpath=fastpath, nat=nat, qos=qos, antispoof=c["antispoof"],
-            batch_size=cfg.batch_size, slow_path=dhcp.handle_frame,
-            clock=self.clock)
-        self.log.info("engine built", batch_size=cfg.batch_size,
-                      nat=cfg.nat_enabled, qos=cfg.qos_enabled)
+            dhcp.accounting_hook = _lease_sync
 
         # 10. DHCPv6 + SLAAC (main.go:1063-1180)
         if cfg.dhcpv6_enabled:

@@ -1,0 +1,77 @@
+"""Device-side walled-garden gate — beyond the reference.
+
+The reference's walled garden is slow-path only: its `SetEBPFMaps` hooks
+have no consuming bpf program (/root/reference/pkg/walledgarden/
+manager.go:172-178), so an unauthenticated subscriber's data traffic
+simply PASSes to the host. The fused TPU pipeline already sees every
+packet, so enforcement moves on-device: a gardened subscriber's upstream
+traffic to a non-allowed destination DROPs at batch rate, and only
+portal/DNS flows (the manager's allowed destinations,
+manager.go:95-103) reach anything at all.
+
+Design (TPU-first):
+- gardened-subscriber membership is a bucket-packed cuckoo table keyed by
+  the subscriber's private IPv4 (the identity the data path actually
+  has; the host control plane maps MAC->lease IP at each garden/lease
+  transition). Values are 8-word rows (word 0 = gardened flag) — the
+  wide-row shape the HLO budget pins (PERF_NOTES §2: narrow gathers
+  serialize).
+- allowed destinations are a dense [D, 3] uint32 array (ip, port, proto;
+  port/proto 0 = wildcard, ip 0 = empty row) compared [B, D] broadcast —
+  the same dense-beats-trie call as the antispoof ranges
+  (ops/antispoof.py): D <= 64 destinations is a handful of VPU compares.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from bng_tpu.ops.parse import Parsed
+from bng_tpu.ops.table import TableGeom, TableState, lookup
+
+GARDEN_WORDS = 8  # value row: [flag, 7 spare] — wide-row gather shape
+GV_FLAG = 0
+
+# stats
+(GST_GATED_DROPS, GST_ALLOWED_HITS) = range(2)
+GARDEN_NSTATS = 2
+
+GardenGeom = TableGeom
+
+
+class GardenResult(NamedTuple):
+    gate_drop: jax.Array  # [B] bool — gardened lane to a non-allowed dest
+    gardened: jax.Array  # [B] bool — lane belongs to a gardened subscriber
+    stats: jax.Array  # [GARDEN_NSTATS] uint32
+
+
+def garden_kernel(
+    parsed: Parsed,
+    eligible: jax.Array,  # [B] bool — upstream IPv4 data lanes (not DHCP)
+    subscribers: TableState,
+    geom: GardenGeom,
+    allowed: jax.Array,  # [D, 3] uint32: (ip, port, proto); ip 0 = empty
+) -> GardenResult:
+    res = lookup(subscribers, parsed.src_ip[:, None].astype(jnp.uint32), geom)
+    gardened = res.found & (res.vals[:, GV_FLAG] != 0) & eligible
+
+    ip = allowed[:, 0]
+    port = allowed[:, 1]
+    proto = allowed[:, 2]
+    dst_ok = parsed.dst_ip[:, None] == ip[None, :]
+    port_ok = (port[None, :] == 0) | (parsed.dst_port.astype(jnp.uint32)[:, None]
+                                      == port[None, :])
+    proto_ok = (proto[None, :] == 0) | (parsed.proto.astype(jnp.uint32)[:, None]
+                                        == proto[None, :])
+    valid_row = (ip != 0)[None, :]
+    allowed_lane = (dst_ok & port_ok & proto_ok & valid_row).any(axis=1)
+
+    gate_drop = gardened & ~allowed_lane
+    stats = jnp.stack([
+        gate_drop.sum().astype(jnp.uint32),
+        (gardened & allowed_lane).sum().astype(jnp.uint32),
+    ])
+    return GardenResult(gate_drop=gate_drop, gardened=gardened, stats=stats)

@@ -47,7 +47,7 @@ from bng_tpu.ops.nat44 import (
 from bng_tpu.ops.parse import parse_batch
 from bng_tpu.ops.qos import QOS_NSTATS, QoSGeom, qos_kernel
 from bng_tpu.ops.qtable import QTableState
-from bng_tpu.ops.table import TableState
+from bng_tpu.ops.table import TableGeom, TableState
 
 VERDICT_PASS, VERDICT_DROP, VERDICT_TX, VERDICT_FWD = 0, 1, 2, 3
 
@@ -62,6 +62,11 @@ class PipelineTables(NamedTuple):
     spoof: TableState
     spoof_ranges: jax.Array  # [R, 2]
     spoof_config: jax.Array  # [2]
+    # device-side walled garden (beyond the reference, ops/garden.py);
+    # None = gate disabled (nil-safe, the reference's optional-maps
+    # discipline, walledgarden/manager.go:113-116)
+    garden: TableState | None = None
+    garden_allowed: jax.Array | None = None  # [D, 3]
 
 
 class PipelineGeom(NamedTuple):
@@ -69,6 +74,7 @@ class PipelineGeom(NamedTuple):
     nat: NATGeom
     qos: QoSGeom
     spoof: AntispoofGeom
+    garden: TableGeom | None = None
 
 
 class PipelineResult(NamedTuple):
@@ -83,6 +89,7 @@ class PipelineResult(NamedTuple):
     priority: jax.Array  # [B] uint32 (QoS class)
     nat_punt: jax.Array  # [B] bool — new flow, host must create session
     spoof_violation: jax.Array  # [B] bool — host audit log
+    garden_stats: jax.Array | None = None  # [GARDEN_NSTATS] when gated
 
 
 def pipeline_step(
@@ -109,9 +116,23 @@ def pipeline_step(
     # 0.0.0.0 must reach the slow path)
     spoof_drop = spoof_drop & ~dhcp.is_dhcp
 
-    # --- NAT44 (TC; nat44.c:565-948) — not for DHCP lanes ---
+    # --- walled-garden gate (device-side; BEYOND the reference, whose
+    # garden maps have no consuming bpf program — ops/garden.py) ---
+    garden_drop = jnp.zeros_like(from_access)
+    garden_stats = None
+    if tables.garden is not None:
+        from bng_tpu.ops.garden import garden_kernel
+
+        garden = garden_kernel(
+            parsed,
+            from_access & parsed.is_ipv4 & ~dhcp.is_dhcp,
+            tables.garden, geom.garden, tables.garden_allowed)
+        garden_drop = garden.gate_drop
+        garden_stats = garden.stats
+
+    # --- NAT44 (TC; nat44.c:565-948) — not for DHCP or gated lanes ---
     nat = nat44_kernel(pkt, length, parsed, tables.nat, geom.nat, now_s)
-    natable = ~dhcp.is_dhcp & ~spoof_drop
+    natable = ~dhcp.is_dhcp & ~spoof_drop & ~garden_drop
     nat_fwd = nat.translated & natable
     nat_punt = nat.punted & natable
 
@@ -128,7 +149,7 @@ def pipeline_step(
     qos_drop = (up.dropped & from_access) | (down.dropped & ~from_access)
 
     # --- verdict combination (precedence: TX > DROP > FWD > PASS) ---
-    drop = (spoof_drop | qos_drop) & ~dhcp_tx
+    drop = (spoof_drop | qos_drop | garden_drop) & ~dhcp_tx
     verdict = jnp.where(
         dhcp_tx, VERDICT_TX,
         jnp.where(drop, VERDICT_DROP,
@@ -160,4 +181,5 @@ def pipeline_step(
         priority=jnp.maximum(up.priority, down.priority),
         nat_punt=nat_punt,
         spoof_violation=spoof.violation,
+        garden_stats=garden_stats,
     )

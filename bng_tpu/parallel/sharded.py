@@ -41,7 +41,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from bng_tpu.control.nat import NATManager
 from bng_tpu.ops.pipeline import PipelineGeom, PipelineTables, pipeline_step
 from bng_tpu.ops.table import TableGeom, shard_owner
-from bng_tpu.runtime.engine import AntispoofTables, QoSTables, _apply_all_updates
+from bng_tpu.runtime.engine import (AntispoofTables, GardenTables, QoSTables,
+                                    _apply_all_updates)
 from bng_tpu.runtime.tables import FastPathTables
 from bng_tpu.utils.net import mac_to_u64, split_u64
 
@@ -187,11 +188,16 @@ class ShardedCluster:
         ]
         self.qos = [QoSTables(nbuckets=qos_nbuckets) for _ in range(n_shards)]
         self.spoof = [AntispoofTables(nbuckets=spoof_nbuckets) for _ in range(n_shards)]
+        # device walled-garden gate, chip-local like NAT/QoS (membership is
+        # keyed by subscriber private IP = the affinity key)
+        self.garden = [GardenTables(nbuckets=spoof_nbuckets)
+                       for _ in range(n_shards)]
         self.geom = PipelineGeom(
             dhcp=self.fastpath[0].geom,
             nat=self.nat[0].geom,
             qos=self.qos[0].geom,
             spoof=self.spoof[0].geom,
+            garden=self.garden[0].geom,
         )
         self._step = _sharded_step_jit(self.mesh, self.geom, self.n)
         self._dhcp_step = _sharded_dhcp_jit(self.mesh, self.geom, self.n)
@@ -252,6 +258,16 @@ class ShardedCluster:
         o = self.affinity_shard_ip(ipv4)
         self.spoof[o].add_binding(mac, ipv4, mode)
         return o
+
+    def set_gardened(self, private_ip: int, gardened: bool) -> int:
+        o = self.affinity_shard_ip(private_ip)
+        self.garden[o].set_gardened(private_ip, gardened)
+        return o
+
+    def allow_garden_destination(self, ip: int, port: int = 0,
+                                 proto: int = 0) -> None:
+        for g in self.garden:  # policy is global; membership is per-shard
+            g.allow_destination(ip, port, proto)
 
     def pub_ip_map(self) -> dict[int, int]:
         """NAT public IP -> owner shard (downstream ring steering).
@@ -383,6 +399,9 @@ class ShardedCluster:
                 self.antispoof_upd(i),
                 jnp.asarray(self.spoof[i].ranges),
                 jnp.asarray(self.spoof[i].config),
+                self.garden[i].subscribers.make_update(
+                    self.garden[i].update_slots),
+                jnp.asarray(self.garden[i].allowed),
             )
             for i in range(self.n)
         ]))
@@ -412,6 +431,8 @@ class ShardedCluster:
                 spoof=self.spoof[i].bindings.device_state(),
                 spoof_ranges=jnp.asarray(self.spoof[i].ranges),
                 spoof_config=jnp.asarray(self.spoof[i].config),
+                garden=self.garden[i].subscribers.device_state(),
+                garden_allowed=jnp.asarray(self.garden[i].allowed),
             )
             per_shard.append(t)
         self.tables = self._stack_per_shard(per_shard)

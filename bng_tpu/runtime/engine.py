@@ -59,7 +59,11 @@ PKT_SLOT = 1536
 
 
 def _apply_all_updates(tables: PipelineTables, upd) -> PipelineTables:
-    fp_upd, nat_upd, qup, qdown, sp_upd, sp_ranges, sp_config = upd
+    fp_upd, nat_upd, qup, qdown, sp_upd, sp_ranges, sp_config, *garden = upd
+    g_state, g_allowed = tables.garden, tables.garden_allowed
+    if garden:  # (garden_upd, allowed_rows) when the device gate is on
+        g_state = apply_update(tables.garden, garden[0])
+        g_allowed = garden[1]
     return PipelineTables(
         dhcp=apply_fastpath_updates(tables.dhcp, fp_upd),
         nat=apply_nat_updates(tables.nat, nat_upd),
@@ -68,6 +72,8 @@ def _apply_all_updates(tables: PipelineTables, upd) -> PipelineTables:
         spoof=apply_update(tables.spoof, sp_upd),
         spoof_ranges=sp_ranges,
         spoof_config=sp_config,
+        garden=g_state,
+        garden_allowed=g_allowed,
     )
 
 
@@ -226,6 +232,47 @@ class AntispoofTables:
         self.ranges[free[0]] = (prefix_len, network)
 
 
+class GardenTables:
+    """Host side of the device walled-garden gate (ops/garden.py).
+
+    Beyond the reference: its walled garden never reaches a bpf program
+    (walledgarden/manager.go:172-178 hooks are unconsumed), so pre-auth
+    data traffic PASSes to the host. Here membership (subscriber private
+    IP -> gardened flag) and the allowed destinations (portal, DNS —
+    manager.go:95-103) live on-device and gate in the fused pipeline.
+    Driven by WalledGardenManager state transitions through the normal
+    bounded update drain."""
+
+    def __init__(self, nbuckets: int = 1 << 12, stash: int = 64,
+                 update_slots: int = 128, max_allowed: int = 64):
+        from bng_tpu.ops.garden import GARDEN_WORDS
+
+        self.subscribers = HostTable(nbuckets, 1, GARDEN_WORDS, stash=stash,
+                                     name="garden_subscribers")
+        self.allowed = np.zeros((max_allowed, 3), dtype=np.uint32)
+        self.geom = TableGeom(nbuckets, stash)
+        self.update_slots = update_slots
+
+    def set_gardened(self, ip: int, gardened: bool) -> None:
+        """Mark/unmark a subscriber IP as gardened (idempotent; insert is
+        an upsert, so re-gardening costs one dirty slot, not two)."""
+        from bng_tpu.ops.garden import GARDEN_WORDS, GV_FLAG
+
+        if gardened:
+            row = np.zeros((GARDEN_WORDS,), dtype=np.uint32)
+            row[GV_FLAG] = 1
+            self.subscribers.insert([ip], row)
+        else:
+            self.subscribers.delete([ip])
+
+    def allow_destination(self, ip: int, port: int = 0, proto: int = 0) -> None:
+        """port/proto 0 = wildcard (manager.go:237-242 key semantics)."""
+        free = np.nonzero(self.allowed[:, 0] == 0)[0]
+        if len(free) == 0:
+            raise RuntimeError("allowed-destinations table full")
+        self.allowed[free[0]] = (ip, port, proto)
+
+
 class Engine:
     def __init__(
         self,
@@ -233,6 +280,7 @@ class Engine:
         nat: NATManager,
         qos: QoSTables | None = None,
         antispoof: AntispoofTables | None = None,
+        garden: "GardenTables | None" = None,
         batch_size: int = 256,
         pkt_slot: int = PKT_SLOT,
         slow_path: Callable[[bytes], bytes | None] | None = None,
@@ -243,6 +291,11 @@ class Engine:
         self.nat = nat
         self.qos = qos or QoSTables()
         self.antispoof = antispoof or AntispoofTables()
+        # None = device gate off: the pipeline compiles WITHOUT the garden
+        # kernel (no per-batch lookup/compare for a disabled feature); the
+        # composition root passes GardenTables only when the walled garden
+        # is enabled (nil-safe optional maps, manager.go:113-116 role)
+        self.garden = garden
         self.B = batch_size
         self.L = pkt_slot
         self.slow_path = slow_path
@@ -254,7 +307,9 @@ class Engine:
         self._stage_idx = 0
 
         self.geom = PipelineGeom(
-            dhcp=fastpath.geom, nat=nat.geom, qos=self.qos.geom, spoof=self.antispoof.geom
+            dhcp=fastpath.geom, nat=nat.geom, qos=self.qos.geom,
+            spoof=self.antispoof.geom,
+            garden=self.garden.geom if self.garden else None,
         )
         self.tables: PipelineTables = PipelineTables(
             dhcp=fastpath.device_tables(),
@@ -264,6 +319,10 @@ class Engine:
             spoof=self.antispoof.bindings.device_state(),
             spoof_ranges=jnp.asarray(self.antispoof.ranges),
             spoof_config=jnp.asarray(self.antispoof.config),
+            garden=(self.garden.subscribers.device_state()
+                    if self.garden else None),
+            garden_allowed=(jnp.asarray(self.garden.allowed)
+                            if self.garden else None),
         )
         # jit cache is keyed on geometry so Engine instances with identical
         # table shapes share one compile (tests build many engines)
@@ -286,6 +345,10 @@ class Engine:
             spoof=self.antispoof.bindings.device_state(),
             spoof_ranges=jnp.asarray(self.antispoof.ranges),
             spoof_config=jnp.asarray(self.antispoof.config),
+            garden=(self.garden.subscribers.device_state()
+                    if self.garden else None),
+            garden_allowed=(jnp.asarray(self.garden.allowed)
+                            if self.garden else None),
         )
 
     def _drain_with_resync(self, drain):
@@ -310,6 +373,8 @@ class Engine:
             self.antispoof.bindings.make_update(self.antispoof.update_slots),
             jnp.asarray(self.antispoof.ranges),
             jnp.asarray(self.antispoof.config),
+            *((self.garden.subscribers.make_update(self.garden.update_slots),
+               jnp.asarray(self.garden.allowed)) if self.garden else ()),
         ))
 
     def _pack_frames(self, frames: list[bytes], B: int):

@@ -71,7 +71,7 @@ def _check_pallas_raw() -> None:
 def _check_pipeline() -> None:
     from bng_tpu.control.nat import NATManager
     from bng_tpu.ops.pipeline import PipelineGeom, PipelineTables, pipeline_step
-    from bng_tpu.runtime.engine import AntispoofTables, QoSTables
+    from bng_tpu.runtime.engine import AntispoofTables, GardenTables, QoSTables
     from bng_tpu.runtime.tables import FastPathTables
     from bng_tpu.utils.net import ip_to_u32
 
@@ -83,13 +83,17 @@ def _check_pipeline() -> None:
                      sub_nat_nbuckets=1 << 10)
     qos = QoSTables(nbuckets=256)
     spoof = AntispoofTables(nbuckets=256)
-    geom = PipelineGeom(dhcp=fp.geom, nat=nat.geom, qos=qos.geom, spoof=spoof.geom)
+    garden = GardenTables(nbuckets=256)  # gate ON: compile the real program
+    geom = PipelineGeom(dhcp=fp.geom, nat=nat.geom, qos=qos.geom,
+                        spoof=spoof.geom, garden=garden.geom)
     tables = PipelineTables(
         dhcp=fp.device_tables(), nat=nat.device_tables(),
         qos_up=qos.up.device_state(), qos_down=qos.down.device_state(),
         spoof=spoof.bindings.device_state(),
         spoof_ranges=jnp.asarray(spoof.ranges),
         spoof_config=jnp.asarray(spoof.config),
+        garden=garden.subscribers.device_state(),
+        garden_allowed=jnp.asarray(garden.allowed),
     )
     pkt = jnp.zeros((B, L), dtype=jnp.uint8)
     ln = jnp.full((B,), 300, dtype=jnp.uint32)

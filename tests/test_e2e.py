@@ -428,3 +428,110 @@ class TestCoADeviceIntegration:
         clock.advance(0.001)
         r2 = engine.process(frames)
         assert len(r2["dropped"]) >= 3, r2
+
+
+class TestDeviceWalledGarden:
+    """Device-side walled-garden gate (beyond the reference, whose garden
+    maps reach no bpf program — walledgarden/manager.go:172-178): a
+    pre-auth subscriber's packet to an arbitrary IP DROPs on device;
+    portal/DNS destinations pass; post-auth everything passes. Membership
+    changes flow through the bounded update drain like every table."""
+
+    PORTAL = ip_to_u32("10.255.255.1")
+    DNS = ip_to_u32("8.8.8.8")
+
+    def _stack_with_garden(self):
+        from bng_tpu.runtime.engine import GardenTables
+
+        clock = FakeClock()
+        fastpath = FastPathTables(sub_nbuckets=512, vlan_nbuckets=64,
+                                  cid_nbuckets=64, max_pools=16)
+        fastpath.set_server_config(SERVER_MAC, SERVER_IP)
+        pools = PoolManager(fastpath)
+        pools.add_pool(Pool(pool_id=1, network=ip_to_u32("10.0.0.0"),
+                            prefix_len=24, gateway=SERVER_IP, lease_time=3600))
+        nat = NATManager(public_ips=[ip_to_u32("203.0.113.1")],
+                         sessions_nbuckets=256, sub_nat_nbuckets=64)
+        garden = GardenTables(nbuckets=256)
+        garden.allow_destination(self.PORTAL, 8080, 6)   # portal TCP
+        garden.allow_destination(self.DNS, 53, 0)        # DNS any proto
+        server = DHCPServer(SERVER_MAC, SERVER_IP, pools,
+                            fastpath_tables=fastpath,
+                            nat_hook=lambda ip, now: nat.allocate_nat(ip, now),
+                            clock=clock)
+        engine = Engine(fastpath, nat, garden=garden, batch_size=8,
+                        slow_path=server.handle_frame, clock=clock)
+        return engine, server, nat, garden, clock
+
+    def test_pre_auth_drops_on_device_post_auth_passes(self):
+        engine, server, nat, garden, clock = self._stack_with_garden()
+        mac = bytes.fromhex("02aabb000077")
+        sub_ip = ip_to_u32("10.0.0.77")
+        nat.allocate_nat(sub_ip, T0)
+        nat.handle_new_flow(sub_ip, ip_to_u32("93.184.216.34"), 40000, 443,
+                            17, 600, T0)
+        garden.set_gardened(sub_ip, True)  # pre-auth
+
+        arbitrary = data_frame(mac, sub_ip, ip_to_u32("93.184.216.34"),
+                               40000, 443)
+        dns = data_frame(mac, sub_ip, self.DNS, 40000, 53)
+        portal = data_frame(mac, sub_ip, self.PORTAL, 40000, 8080,
+                            proto="tcp")
+        discover = client_frame(mac, dhcp_codec.DISCOVER)
+        out = engine.process([arbitrary, dns, portal, discover],
+                             from_access=True)
+        # arbitrary dest: DROPPED ON DEVICE despite live NAT state
+        assert out["dropped"] == [0], out
+        # portal + DNS reach the slow path (allowed destinations)
+        slow_lanes = [i for i, _ in out["slow"]]
+        assert 1 in slow_lanes and 2 in slow_lanes
+        # DHCP must still flow (DORA happens while gardened)
+        assert 3 in slow_lanes or any(i == 3 for i, _ in out["tx"])
+
+        # post-auth: release via the update drain — next batch forwards
+        garden.set_gardened(sub_ip, False)
+        out2 = engine.process([arbitrary, dns, portal], from_access=True)
+        assert out2["dropped"] == []
+        assert 0 in [i for i, _ in out2["fwd"]]  # NAT'd on device again
+
+    def test_gate_never_touches_other_subscribers(self):
+        engine, server, nat, garden, clock = self._stack_with_garden()
+        gardened_ip = ip_to_u32("10.0.0.88")
+        free_ip = ip_to_u32("10.0.0.89")
+        garden.set_gardened(gardened_ip, True)
+        nat.allocate_nat(free_ip, T0)
+        nat.handle_new_flow(free_ip, ip_to_u32("1.2.3.4"), 41000, 443,
+                            17, 600, T0)
+        blocked = data_frame(bytes.fromhex("02aabb000088"), gardened_ip,
+                             ip_to_u32("1.2.3.4"), 41000, 443)
+        ok = data_frame(bytes.fromhex("02aabb000089"), free_ip,
+                        ip_to_u32("1.2.3.4"), 41000, 443)
+        out = engine.process([blocked, ok], from_access=True)
+        assert out["dropped"] == [0]
+        assert 1 in [i for i, _ in out["fwd"]]
+
+    def test_cli_garden_transitions_drive_device_gate(self):
+        """BNGApp: a garden transition + live lease lands in the engine's
+        device gate through the composition-root sync."""
+        import types
+
+        from bng_tpu.cli import BNGApp, BNGConfig
+        from bng_tpu.utils.net import mac_to_u64
+
+        app = BNGApp(BNGConfig())
+        try:
+            dhcp = app.components["dhcp"]
+            garden_mgr = app.components["walledgarden"]
+            gt = app.components["engine"].garden
+            mac = "02:00:00:00:00:61"
+            ip = ip_to_u32("10.0.0.61")
+            dhcp.leases[mac_to_u64(mac)] = types.SimpleNamespace(
+                ip=ip, mac=mac, session_id="s1")
+            garden_mgr.add_to_walled_garden(mac)
+            assert gt.subscribers.lookup([ip]) is not None
+            garden_mgr.release_from_walled_garden(mac)
+            assert gt.subscribers.lookup([ip]) is None
+            # portal/DNS allowed destinations were seeded from config
+            assert (gt.allowed[:, 0] != 0).sum() >= 3
+        finally:
+            app.close()
