@@ -801,8 +801,10 @@ def config5_sharded(on_tpu):
     # sharded build splits 1M subscribers by owner shard vectorized
     N = int(os.environ.get("BNG_BENCH_SUBS", 1_000_000 if on_tpu else 1_000))
     sub_nb = 1 << max(10, (N * 2 // 4 // n).bit_length())  # ~50% load/shard
+    # garden off: measure the same per-packet work the reference's full
+    # BNG does (its walled garden never gates the packet path)
     cl = ShardedCluster(n, batch_per_shard=B_per, sub_nbuckets=sub_nb,
-                        max_pools=64)
+                        max_pools=64, garden_enabled=False)
     cl.set_server_config_all(bytes.fromhex("02aabbccdd01"), ip_to_u32("10.0.0.1"))
     n_pools = max(1, (N >> 16) + 1)
     for pid in range(n_pools):

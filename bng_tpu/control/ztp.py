@@ -86,6 +86,45 @@ class BootstrapConfig:
     max_retries: int = 0  # 0 = wait forever
     poll_interval: float = 5.0
     pin_fingerprint: str = ""  # expected server cert SHA-256 (tls.go pinning)
+    tls: "object | None" = None  # ztp_tls.TLSConfig (None = plaintext/dev)
+
+
+def make_https_transport(config: BootstrapConfig):
+    """Pinning-enforcing HTTPS transport for BootstrapClient (the
+    bootstrap.go:449-464 POST through tls.go's BuildTLSConfig channel).
+
+    Uses config.tls (a ztp_tls.TLSConfig) when set; a bare
+    pin_fingerprint becomes the classic TOFU bootstrap config
+    (self-signed Nexus, no CA yet, SHA-256 pin mandatory)."""
+    import json as _json
+
+    from bng_tpu.control import ztp_tls
+
+    tls_cfg = config.tls
+    if tls_cfg is None:
+        if not config.pin_fingerprint:
+            raise ValueError("https transport needs tls config or a pin")
+        tls_cfg = ztp_tls.TLSConfig(require_valid_chain=False,
+                                    pinned_certs=[config.pin_fingerprint])
+
+    def transport(req: BootstrapRequest) -> dict:
+        body = _json.dumps({"serial": req.serial, "mac": req.mac,
+                            "model": req.model,
+                            "firmware": req.firmware}).encode()
+        status, parsed, _warnings = ztp_tls.https_get_json(
+            config.nexus_url.rstrip("/") + "/api/v1/bootstrap/register",
+            tls_cfg, method="POST", body=body,
+            headers={"Content-Type": "application/json"})
+        # anything but 200/201 is an error (bootstrap.go:327): a 403
+        # "unknown serial" must surface, not masquerade as pending
+        if status not in (200, 201) or parsed is None:
+            detail = ""
+            if isinstance(parsed, dict):
+                detail = f": {parsed.get('error') or parsed.get('message', '')}"
+            raise ConnectionError(f"nexus bootstrap HTTP {status}{detail}")
+        return parsed
+
+    return transport
 
 
 @dataclass

@@ -81,6 +81,8 @@ def _sharded_geom(geom: PipelineGeom, n: int) -> PipelineGeom:
 def _sharded_step_jit(mesh: Mesh, geom: PipelineGeom, n: int):
     geom_sh = _sharded_geom(geom, n)
 
+    has_garden = geom.garden is not None
+
     def local_step(tables1, upd1, pkt, length, fa, now_s, now_us):
         # shard_map hands each chip a leading dim of 1: drop it
         tables = jax.tree.map(lambda x: x[0], tables1)
@@ -95,16 +97,22 @@ def _sharded_step_jit(mesh: Mesh, geom: PipelineGeom, n: int):
         nat_stats = jax.lax.psum(res.nat_stats, AXIS)
         qos_stats = jax.lax.psum(res.qos_stats, AXIS)
         spoof_stats = jax.lax.psum(res.spoof_stats, AXIS)
-        return (res.verdict, res.out_pkt, res.out_len, new_tables1,
-                dhcp_stats, nat_stats, qos_stats, spoof_stats,
-                res.nat_punt, res.spoof_violation)
+        out = (res.verdict, res.out_pkt, res.out_len, new_tables1,
+               dhcp_stats, nat_stats, qos_stats, spoof_stats,
+               res.nat_punt, res.spoof_violation)
+        if has_garden:
+            out += (jax.lax.psum(res.garden_stats, AXIS),)
+        return out
 
+    out_specs = (P(AXIS), P(AXIS), P(AXIS), P(AXIS), P(), P(), P(), P(),
+                 P(AXIS), P(AXIS))
+    if has_garden:
+        out_specs += (P(),)
     sharded = jax.shard_map(
         local_step,
         mesh=mesh,
         in_specs=(P(AXIS), P(AXIS), P(AXIS), P(AXIS), P(AXIS), P(), P()),
-        out_specs=(P(AXIS), P(AXIS), P(AXIS), P(AXIS), P(), P(), P(), P(),
-                   P(AXIS), P(AXIS)),
+        out_specs=out_specs,
         check_vma=False,
     )
     return jax.jit(sharded, donate_argnums=(0,))
@@ -161,6 +169,7 @@ class ShardedCluster:
         qos_nbuckets: int = 256,
         spoof_nbuckets: int = 256,
         public_ips: list[int] | None = None,
+        garden_enabled: bool = True,
     ):
         self.n = n_shards
         self.mesh = mesh if mesh is not None else make_mesh(n_shards)
@@ -189,15 +198,17 @@ class ShardedCluster:
         self.qos = [QoSTables(nbuckets=qos_nbuckets) for _ in range(n_shards)]
         self.spoof = [AntispoofTables(nbuckets=spoof_nbuckets) for _ in range(n_shards)]
         # device walled-garden gate, chip-local like NAT/QoS (membership is
-        # keyed by subscriber private IP = the affinity key)
-        self.garden = [GardenTables(nbuckets=spoof_nbuckets)
-                       for _ in range(n_shards)]
+        # keyed by subscriber private IP = the affinity key). Optional: a
+        # disabled feature must cost zero per batch (garden_enabled=False
+        # compiles the kernel out, same as Engine's garden=None)
+        self.garden = ([GardenTables(nbuckets=spoof_nbuckets)
+                        for _ in range(n_shards)] if garden_enabled else None)
         self.geom = PipelineGeom(
             dhcp=self.fastpath[0].geom,
             nat=self.nat[0].geom,
             qos=self.qos[0].geom,
             spoof=self.spoof[0].geom,
-            garden=self.garden[0].geom,
+            garden=self.garden[0].geom if garden_enabled else None,
         )
         self._step = _sharded_step_jit(self.mesh, self.geom, self.n)
         self._dhcp_step = _sharded_dhcp_jit(self.mesh, self.geom, self.n)
@@ -260,12 +271,16 @@ class ShardedCluster:
         return o
 
     def set_gardened(self, private_ip: int, gardened: bool) -> int:
+        if self.garden is None:
+            raise RuntimeError("device garden gate disabled for this cluster")
         o = self.affinity_shard_ip(private_ip)
         self.garden[o].set_gardened(private_ip, gardened)
         return o
 
     def allow_garden_destination(self, ip: int, port: int = 0,
                                  proto: int = 0) -> None:
+        if self.garden is None:
+            raise RuntimeError("device garden gate disabled for this cluster")
         for g in self.garden:  # policy is global; membership is per-shard
             g.allow_destination(ip, port, proto)
 
@@ -399,9 +414,10 @@ class ShardedCluster:
                 self.antispoof_upd(i),
                 jnp.asarray(self.spoof[i].ranges),
                 jnp.asarray(self.spoof[i].config),
-                self.garden[i].subscribers.make_update(
-                    self.garden[i].update_slots),
-                jnp.asarray(self.garden[i].allowed),
+                *((self.garden[i].subscribers.make_update(
+                       self.garden[i].update_slots),
+                   jnp.asarray(self.garden[i].allowed))
+                  if self.garden is not None else ()),
             )
             for i in range(self.n)
         ]))
@@ -431,8 +447,10 @@ class ShardedCluster:
                 spoof=self.spoof[i].bindings.device_state(),
                 spoof_ranges=jnp.asarray(self.spoof[i].ranges),
                 spoof_config=jnp.asarray(self.spoof[i].config),
-                garden=self.garden[i].subscribers.device_state(),
-                garden_allowed=jnp.asarray(self.garden[i].allowed),
+                garden=(self.garden[i].subscribers.device_state()
+                        if self.garden is not None else None),
+                garden_allowed=(jnp.asarray(self.garden[i].allowed)
+                                if self.garden is not None else None),
             )
             per_shard.append(t)
         self.tables = self._stack_per_shard(per_shard)
@@ -482,7 +500,7 @@ class ShardedCluster:
         out = self._step(self.tables, upd, pkt_d, len_d, fa_d,
                          jnp.uint32(now_s), jnp.uint32(now_us))
         (verdict, out_pkt, out_len, new_tables, dhcp_stats, nat_stats,
-         qos_stats, spoof_stats, nat_punt, viol) = out
+         qos_stats, spoof_stats, nat_punt, viol, *garden_stats) = out
         self.tables = new_tables
         return {
             "verdict": np.asarray(verdict),
@@ -494,4 +512,6 @@ class ShardedCluster:
             "spoof_stats": np.asarray(spoof_stats),
             "nat_punt": np.asarray(nat_punt),
             "violation": np.asarray(viol),
+            **({"garden_stats": np.asarray(garden_stats[0])}
+               if garden_stats else {}),
         }

@@ -133,6 +133,8 @@ class EngineStats:
     nat: np.ndarray = field(default_factory=lambda: np.zeros(NAT_NSTATS, dtype=np.uint64))
     qos: np.ndarray = field(default_factory=lambda: np.zeros(QOS_NSTATS, dtype=np.uint64))
     spoof: np.ndarray = field(default_factory=lambda: np.zeros(ANTISPOOF_NSTATS, dtype=np.uint64))
+    # device walled-garden gate: [gated_drops, allowed_hits] (ops/garden.py)
+    garden: np.ndarray = field(default_factory=lambda: np.zeros(2, dtype=np.uint64))
     batches: int = 0
     tx: int = 0
     fwd: int = 0
@@ -578,6 +580,9 @@ class Engine:
         self.stats.nat += np.asarray(res.nat_stats, dtype=np.uint64)
         self.stats.qos += np.asarray(res.qos_stats, dtype=np.uint64)
         self.stats.spoof += np.asarray(res.spoof_stats, dtype=np.uint64)
+        gs = getattr(res, "garden_stats", None)  # DHCP-only batches have none
+        if gs is not None:
+            self.stats.garden += np.asarray(gs, dtype=np.uint64)
 
     def _run_step(self, pkt, length, fa, now_s, now_us) -> PipelineResult:
         """Dispatch + fold (the synchronous step both process paths use)."""

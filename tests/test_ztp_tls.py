@@ -1,0 +1,267 @@
+"""ZTP TLS: pinning, expiry, DER parsing, and a real pinned handshake.
+
+Fixtures are generated with openssl at test time (real certificates, not
+hand-built ASN.1), mirroring the reference's use of the live TLS stack in
+pkg/ztp/tls.go tests.
+"""
+
+import datetime
+import json
+import os
+import socket
+import ssl
+import subprocess
+import threading
+
+import numpy as np
+import pytest
+
+from bng_tpu.control import ztp_tls as zt
+
+
+def _openssl_selfsigned(tmp, cn="nexus.test", days=365, san="DNS:nexus.test,IP:127.0.0.1"):
+    key = os.path.join(tmp, f"{cn}.key")
+    crt = os.path.join(tmp, f"{cn}.crt")
+    subprocess.run(
+        ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+         "-keyout", key, "-out", crt, "-days", str(days),
+         "-subj", f"/CN={cn}", "-addext", f"subjectAltName={san}"],
+        check=True, capture_output=True)
+    return key, crt
+
+
+@pytest.fixture(scope="module")
+def certs(tmp_path_factory):
+    tmp = str(tmp_path_factory.mktemp("ztp_tls"))
+    key, crt = _openssl_selfsigned(tmp)
+    with open(crt) as f:
+        pem = f.read()
+    der = zt.pem_to_der(pem)[0]
+    return {"tmp": tmp, "key": key, "crt": crt, "pem": pem, "der": der}
+
+
+class TestDERParser:
+    def test_parse_real_openssl_cert(self, certs):
+        info = zt.parse_certificate(certs["der"])
+        assert info.subject == "CN=nexus.test"
+        assert info.issuer == "CN=nexus.test"  # self-signed
+        assert "nexus.test" in info.dns_names
+        assert "127.0.0.1" in info.ip_addresses
+        assert info.not_before is not None and info.not_after is not None
+        assert info.not_after > info.not_before
+        assert info.fingerprint == zt.cert_fingerprint(certs["der"])
+        assert len(info.serial_number) > 0
+
+    def test_ca_flag(self, certs):
+        # openssl req -x509 marks CA:TRUE by default
+        assert zt.parse_certificate(certs["der"]).is_ca
+
+    def test_expiring_soon_math(self, certs):
+        soon, remaining = zt.is_certificate_expiring_soon(certs["der"], 30)
+        assert not soon and 300 < remaining < 400
+        soon, _ = zt.is_certificate_expiring_soon(certs["der"], 400)
+        assert soon
+
+    def test_fuzz_never_crashes(self, certs):
+        rng = np.random.default_rng(0x7E5)
+        base = bytearray(certs["der"])
+        for _ in range(300):
+            m = bytearray(base)
+            for _ in range(int(rng.integers(1, 8))):
+                m[int(rng.integers(len(m)))] = int(rng.integers(256))
+            if rng.integers(2):
+                m = m[: int(rng.integers(1, len(m)))]
+            try:
+                zt.parse_certificate(bytes(m))
+            except (ValueError, OverflowError):
+                pass  # structured rejection only — never a crash/hang
+
+
+class TestConfigValidation:
+    def test_contradictions_rejected(self):
+        with pytest.raises(ValueError, match="min_version"):
+            zt.validate_tls_config(zt.TLSConfig(min_version="1.0"))
+        with pytest.raises(ValueError, match="pick one"):
+            zt.validate_tls_config(zt.TLSConfig(
+                insecure_skip_verify=True, pinned_certs=["ab" * 32]))
+        with pytest.raises(ValueError, match="authenticates nobody"):
+            zt.validate_tls_config(zt.TLSConfig(require_valid_chain=False))
+        with pytest.raises(ValueError, match="hex SHA-256"):
+            zt.validate_tls_config(zt.TLSConfig(
+                require_valid_chain=False, pinned_certs=["zz"]))
+        zt.validate_tls_config(zt.TLSConfig())  # defaults are valid
+
+    def test_fingerprint_normalization(self):
+        fp = "AB:CD:" + "11" * 30
+        assert zt.normalize_fingerprint(fp) == "abcd" + "11" * 30
+
+
+class TestVerifyPeer:
+    def test_pin_match_and_mismatch(self, certs):
+        fp = zt.cert_fingerprint(certs["der"])
+        cfg = zt.TLSConfig(require_valid_chain=False, pinned_certs=[fp])
+        res = zt.verify_peer([certs["der"]], cfg)
+        assert res.valid and res.pinning_verified
+        bad = zt.TLSConfig(require_valid_chain=False,
+                           pinned_certs=["00" * 32])
+        with pytest.raises(zt.CertificateValidationError, match="pinned"):
+            zt.verify_peer([certs["der"]], bad)
+
+    def test_expired_and_not_yet_valid(self, certs):
+        cfg = zt.TLSConfig()
+        future = datetime.datetime(2900, 1, 1, tzinfo=datetime.timezone.utc)
+        with pytest.raises(zt.CertificateValidationError, match="expired"):
+            zt.verify_peer([certs["der"]], cfg, now=future)
+        past = datetime.datetime(2000, 1, 1, tzinfo=datetime.timezone.utc)
+        with pytest.raises(zt.CertificateValidationError, match="not yet"):
+            zt.verify_peer([certs["der"]], cfg, now=past)
+
+    def test_expiry_warning_surface(self, certs):
+        cfg = zt.TLSConfig(cert_expiry_warning_days=9999)
+        res = zt.verify_peer([certs["der"]], cfg)
+        assert res.valid and any("expires in" in w for w in res.warnings)
+
+    def test_empty_chain_rejected(self):
+        with pytest.raises(zt.CertificateValidationError, match="no peer"):
+            zt.verify_peer([], zt.TLSConfig())
+
+
+class TestPinnedHandshake:
+    """Real TLS over loopback: the bootstrap scenario — self-signed Nexus,
+    no CA, SHA-256 pin (TOFU), https_get_json enforces the pin before the
+    request (tls.go:208-229 enforcement point)."""
+
+    def _serve_tls(self, certs, payload: dict):
+        ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+        ctx.load_cert_chain(certs["crt"], certs["key"])
+        srv = socket.socket()
+        srv.bind(("127.0.0.1", 0))
+        srv.listen(4)
+        port = srv.getsockname()[1]
+        body = json.dumps(payload).encode()
+
+        def serve():
+            srv.settimeout(5)
+            try:
+                while True:
+                    conn, _ = srv.accept()
+                    try:
+                        tls = ctx.wrap_socket(conn, server_side=True)
+                        tls.recv(4096)
+                        tls.sendall(
+                            b"HTTP/1.1 200 OK\r\nContent-Length: "
+                            + str(len(body)).encode()
+                            + b"\r\nContent-Type: application/json\r\n\r\n"
+                            + body)
+                        tls.close()
+                    except (ssl.SSLError, OSError):
+                        pass
+            except (TimeoutError, socket.timeout, OSError):
+                pass
+
+        t = threading.Thread(target=serve, daemon=True)
+        t.start()
+        return srv, port
+
+    def test_pinned_bootstrap_roundtrip(self, certs):
+        srv, port = self._serve_tls(certs, {"device_id": "bng-007"})
+        try:
+            fp = zt.cert_fingerprint(certs["der"])
+            cfg = zt.TLSConfig(require_valid_chain=False, pinned_certs=[fp],
+                               server_name="nexus.test")
+            status, parsed, warnings = zt.https_get_json(
+                f"https://127.0.0.1:{port}/api/v1/bootstrap", cfg)
+            assert status == 200 and parsed == {"device_id": "bng-007"}
+        finally:
+            srv.close()
+
+    def test_wrong_pin_aborts_before_request(self, certs):
+        srv, port = self._serve_tls(certs, {"never": "served"})
+        try:
+            cfg = zt.TLSConfig(require_valid_chain=False,
+                               pinned_certs=["11" * 32])
+            with pytest.raises(zt.CertificateValidationError):
+                zt.https_get_json(f"https://127.0.0.1:{port}/x", cfg)
+        finally:
+            srv.close()
+
+    def test_ca_validated_handshake(self, certs):
+        """require_valid_chain path: the self-signed cert IS the CA."""
+        srv, port = self._serve_tls(certs, {"ok": 1})
+        try:
+            cfg = zt.TLSConfig(ca_cert_pem=certs["pem"],
+                               server_name="nexus.test")
+            # hostname mismatch (we dial 127.0.0.1 but check_hostname is
+            # on): Python checks against the IP SAN — 127.0.0.1 IS in the
+            # SAN, so this validates end-to-end through the real chain
+            status, parsed, _ = zt.https_get_json(
+                f"https://127.0.0.1:{port}/x", cfg)
+            assert status == 200 and parsed == {"ok": 1}
+        finally:
+            srv.close()
+
+
+class TestBootstrapOverPinnedTLS:
+    """BootstrapClient -> make_https_transport -> real pinned TLS server:
+    the full ZTP registration flow the reference runs over tls.go."""
+
+    def test_register_through_pinned_channel(self, certs):
+        from bng_tpu.control.ztp import (BootstrapClient, BootstrapConfig,
+                                         DeviceIdentity, make_https_transport)
+
+        ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+        ctx.load_cert_chain(certs["crt"], certs["key"])
+        srv = socket.socket()
+        srv.bind(("127.0.0.1", 0))
+        srv.listen(2)
+        port = srv.getsockname()[1]
+        got = {}
+
+        def serve_one():
+            srv.settimeout(5)
+            conn, _ = srv.accept()
+            tls = ctx.wrap_socket(conn, server_side=True)
+            raw = b""
+            while b"\r\n\r\n" not in raw:
+                raw += tls.recv(8192)
+            head, _, body_part = raw.partition(b"\r\n\r\n")
+            clen = 0
+            for line in head.decode(errors="replace").split("\r\n"):
+                if line.lower().startswith("content-length:"):
+                    clen = int(line.split(":", 1)[1])
+            while len(body_part) < clen:
+                body_part += tls.recv(8192)
+            got["body"] = body_part.decode(errors="replace")
+            body = json.dumps({"status": "configured", "node_id": "bng-42",
+                               "site_id": "site-1", "role": "active"}).encode()
+            tls.sendall(b"HTTP/1.1 200 OK\r\nContent-Length: "
+                        + str(len(body)).encode() + b"\r\n\r\n" + body)
+            tls.close()
+
+        t = threading.Thread(target=serve_one, daemon=True)
+        t.start()
+        try:
+            cfg = BootstrapConfig(
+                nexus_url=f"https://127.0.0.1:{port}",
+                pin_fingerprint=zt.cert_fingerprint(certs["der"]))
+            client = BootstrapClient(
+                cfg, make_https_transport(cfg),
+                identity=DeviceIdentity(serial="SN123", mac="02:00:00:00:00:01"))
+            dev = client.register_once()
+            assert dev.node_id == "bng-42" and dev.role == "active"
+            assert json.loads(got["body"])["serial"] == "SN123"
+        finally:
+            srv.close()
+
+    def test_wrong_pin_never_sends_registration(self, certs):
+        from bng_tpu.control.ztp import (BootstrapClient, BootstrapConfig,
+                                         DeviceIdentity, make_https_transport)
+
+        cfg = BootstrapConfig(nexus_url="https://127.0.0.1:1",
+                              pin_fingerprint="22" * 32)
+        client = BootstrapClient(
+            cfg, make_https_transport(cfg),
+            identity=DeviceIdentity(serial="SN1", mac="02:00:00:00:00:02"),
+            sleep=lambda s: None)
+        with pytest.raises(Exception):
+            client.register_once()
