@@ -507,6 +507,12 @@ class BNGApp:
             engine = c["engine"]
             collector.add_source(lambda: metrics.collect_engine(engine.stats))
             collector.add_source(lambda: metrics.collect_dhcp_server(dhcp.stats))
+            if cfg.walled_garden_enabled:
+                collector.add_source(
+                    lambda: metrics.collect_garden(engine.stats))
+            if cfg.dns_enabled:
+                collector.add_source(lambda: metrics.collect_dns(
+                    dns_srv.stats, resolver.stats()))
             collector.add_source(lambda: metrics.collect_pools(
                 {str(pid): st for pid, st in pool_mgr.stats().items()}))
             self._on_close(collector.stop)

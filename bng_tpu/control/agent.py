@@ -44,9 +44,16 @@ class Agent:
     local caches warm so the dataplane never blocks on Nexus."""
 
     def __init__(self, config: AgentConfig, nexus: NexusClient,
-                 clock=time.time):
+                 bootstrap_client=None, clock=time.time):
+        """bootstrap_client: an optional ztp.BootstrapClient — when given,
+        start() runs the full registration flow first (the agent's TLS
+        bootstrap variant, pkg/agent/bootstrap.go:62-340, typically over
+        ztp.make_https_transport's pinned channel) and adopts the
+        returned DeviceConfig (node identity, partner, pools)."""
         self.config = config
         self.nexus = nexus
+        self._bootstrap = bootstrap_client
+        self.device_config = None  # ztp.DeviceConfig after bootstrap
         self._clock = clock
         self._lock = threading.Lock()
         self._state = AgentState.INIT
@@ -62,7 +69,8 @@ class Agent:
         self.on_isp_churn = None
         self.stats = {"heartbeats": 0, "heartbeat_failures": 0,
                       "subscriber_updates": 0, "nte_updates": 0,
-                      "isp_churns": 0}
+                      "isp_churns": 0, "bootstrapped": 0,
+                      "bootstrap_failures": 0}
 
     # -- state ----------------------------------------------------------
 
@@ -85,12 +93,27 @@ class Agent:
 
     # -- lifecycle ------------------------------------------------------
 
-    def start(self) -> None:
+    def start(self, bootstrap_deadline: float | None = None) -> None:
         """Synchronous start: bootstrap -> full sync -> watch. The
         composition root drives heartbeat()/tick() on its scheduler
         (the reference's goroutine loops, agent.go:216-313)."""
         self._started_at = self._clock()
         self._set_state(AgentState.BOOTSTRAPPING)
+        if self._bootstrap is not None:
+            # registration poll (pending/backoff handled by the client);
+            # the returned DeviceConfig is this agent's durable identity.
+            # A failed bootstrap must not leave a live-looking agent stuck
+            # in 'bootstrapping': transition to DEGRADED, then re-raise.
+            try:
+                dev = self._bootstrap.bootstrap(deadline=bootstrap_deadline)
+            except BaseException:
+                self.stats["bootstrap_failures"] += 1
+                self._set_state(AgentState.DEGRADED)
+                raise
+            self.device_config = dev
+            if dev.node_id:
+                self.config.device_id = dev.node_id
+            self.stats["bootstrapped"] = 1
         self._set_state(AgentState.SYNCING)
         self._full_sync()
         self._watch()

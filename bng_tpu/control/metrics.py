@@ -260,6 +260,21 @@ class BNGMetrics:
             "bng_subscriber_by_class", "Subscribers per class", ("class",))
         self.subscriber_by_isp = r.gauge(
             "bng_subscriber_by_isp", "Subscribers per ISP", ("isp",))
+        # round-4 subsystems (no reference analog for the device gate —
+        # its garden never gated the packet path; observability is how a
+        # new enforcement point earns trust)
+        self.garden_gated_drops = r.counter(
+            "bng_walled_garden_device_drops_total",
+            "Packets dropped on device by the walled-garden gate")
+        self.garden_allowed_hits = r.counter(
+            "bng_walled_garden_device_allowed_total",
+            "Gardened packets passed to an allowed destination")
+        self.dns_queries = r.counter(
+            "bng_dns_queries_total", "DNS queries served", ("outcome",))
+        self.dns_cache_hit_rate = r.gauge(
+            "bng_dns_cache_hit_rate", "DNS cache hit rate")
+        self.dns_overloaded = r.counter(
+            "bng_dns_overloaded_total", "DNS queries dropped under overload")
 
     # -- collection (metrics.go:555-623) -------------------------------
 
@@ -293,6 +308,28 @@ class BNGMetrics:
             v = getattr(server_stats, msg, None)
             if v is not None:
                 self.dhcp_requests_total.set_total(v, type=msg)
+
+    def collect_garden(self, engine_stats) -> None:
+        """Device walled-garden gate counters (EngineStats.garden)."""
+        g = getattr(engine_stats, "garden", None)
+        if g is None or len(g) < 2:
+            return
+        self.garden_gated_drops.set_total(int(g[0]))
+        self.garden_allowed_hits.set_total(int(g[1]))
+
+    def collect_dns(self, server_stats: dict, resolver_stats: dict) -> None:
+        """DNSServer.stats + Resolver.stats() -> bng_dns_* families."""
+        self.dns_queries.set_total(server_stats.get("served", 0),
+                                   outcome="served")
+        self.dns_queries.set_total(server_stats.get("bad_packets", 0),
+                                   outcome="bad_packet")
+        self.dns_queries.set_total(server_stats.get("server_errors", 0),
+                                   outcome="error")
+        self.dns_overloaded.set_total(server_stats.get("overloaded", 0))
+        hits = resolver_stats.get("cache_hits", 0)
+        total = resolver_stats.get("queries", 0)
+        if total:
+            self.dns_cache_hit_rate.set(hits / total)
 
     def expose(self) -> str:
         return self.registry.expose()

@@ -350,3 +350,34 @@ class TestMetrics:
         col.add_source(lambda: m.subscriber_total.set(42))
         col.collect_once()
         assert m.subscriber_total.value() == 42
+
+
+class TestRound4Metrics:
+    def test_garden_and_dns_families_exposed(self):
+        from types import SimpleNamespace
+
+        from bng_tpu.control.metrics import BNGMetrics
+
+        m = BNGMetrics()
+        m.collect_garden(SimpleNamespace(garden=[7, 3]))
+        m.collect_dns({"served": 10, "bad_packets": 1, "server_errors": 0,
+                       "overloaded": 2},
+                      {"queries": 20, "cache_hits": 5})
+        text = m.expose()
+        assert "bng_walled_garden_device_drops_total 7" in text
+        assert "bng_walled_garden_device_allowed_total 3" in text
+        assert 'bng_dns_queries_total{outcome="served"} 10' in text
+        assert "bng_dns_overloaded_total 2" in text
+        assert "bng_dns_cache_hit_rate 0.25" in text
+
+    def test_cli_collects_round4_sources(self):
+        from bng_tpu.cli import BNGApp, BNGConfig
+
+        app = BNGApp(BNGConfig(dns_enabled=True, dns_listen="127.0.0.1:0"))
+        try:
+            app.components["collector"].collect_once()
+            text = app.components["metrics"].expose()
+            assert "bng_walled_garden_device_drops_total" in text
+            assert "bng_dns_queries_total" in text
+        finally:
+            app.close()

@@ -265,3 +265,54 @@ class TestBootstrapOverPinnedTLS:
             sleep=lambda s: None)
         with pytest.raises(Exception):
             client.register_once()
+
+
+class TestAgentBootstrapOverTLS:
+    """Agent.start() runs the full ZTP registration over the pinned
+    channel and adopts the returned identity (agent/bootstrap.go role)."""
+
+    def test_agent_adopts_bootstrap_identity(self, certs):
+        from bng_tpu.control.agent import Agent, AgentConfig, AgentState
+        from bng_tpu.control.nexus import NexusClient
+        from bng_tpu.control.ztp import (BootstrapClient, BootstrapConfig,
+                                         DeviceIdentity, make_https_transport)
+
+        ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+        ctx.load_cert_chain(certs["crt"], certs["key"])
+        srv = socket.socket()
+        srv.bind(("127.0.0.1", 0))
+        srv.listen(2)
+        port = srv.getsockname()[1]
+
+        def serve_one():
+            srv.settimeout(5)
+            conn, _ = srv.accept()
+            tls = ctx.wrap_socket(conn, server_side=True)
+            raw = b""
+            while b"\r\n\r\n" not in raw:
+                raw += tls.recv(8192)
+            body = json.dumps({"status": "configured",
+                               "node_id": "olt-agent-3",
+                               "role": "standby"}).encode()
+            tls.sendall(b"HTTP/1.1 200 OK\r\nContent-Length: "
+                        + str(len(body)).encode() + b"\r\n\r\n" + body)
+            tls.close()
+
+        threading.Thread(target=serve_one, daemon=True).start()
+        try:
+            bcfg = BootstrapConfig(
+                nexus_url=f"https://127.0.0.1:{port}",
+                pin_fingerprint=zt.cert_fingerprint(certs["der"]))
+            bclient = BootstrapClient(
+                bcfg, make_https_transport(bcfg),
+                identity=DeviceIdentity(serial="SN9", mac="02:00:00:00:00:09"))
+            agent = Agent(AgentConfig(device_id="pre-bootstrap"),
+                          NexusClient(node_id="n1"),
+                          bootstrap_client=bclient)
+            agent.start()
+            assert agent.state == AgentState.ONLINE
+            assert agent.config.device_id == "olt-agent-3"
+            assert agent.device_config.role == "standby"
+            assert agent.stats["bootstrapped"] == 1
+        finally:
+            srv.close()
