@@ -255,3 +255,46 @@ class TestFastLaneCompileShapeBudget:
         out = engine.process_dhcp(frames)
         lanes = sorted(i for i, _ in out["tx"] + out["slow"])
         assert lanes == list(range(150))  # every lane accounted, re-based
+
+
+class TestGardenGateShape:
+    """The garden gate must stay in the wide-gather regime (PERF_NOTES §2:
+    narrow 1-word gathers serialize to ~7ns/element on v5e)."""
+
+    def test_gather_budget_isolated_kernel(self):
+        """The gate in isolation (src/dst ip + port/proto as inputs):
+        a bounded handful of WIDE gathers — two bucket-row probes + the
+        value-row gather + stash — never a per-word gather explosion."""
+        import jax
+        from bng_tpu.ops.garden import garden_kernel
+        from bng_tpu.ops.parse import Parsed
+        from bng_tpu.runtime.engine import GardenTables
+
+        g = GardenTables(nbuckets=1 << 10)
+        B = 1024
+
+        def step(state, allowed, src_ip, dst_ip, dst_port, proto, ok):
+            parsed = Parsed(**{f: (src_ip if f == "src_ip" else
+                                   dst_ip if f == "dst_ip" else
+                                   dst_port if f == "dst_port" else
+                                   proto if f == "proto" else
+                                   ok if f == "is_ipv4" else
+                                   jnp.zeros((B,), dtype=jnp.uint32))
+                               for f in Parsed._fields})
+            res = garden_kernel(parsed, ok, state, g.geom, allowed)
+            return res.gate_drop, res.stats
+
+        u32 = jnp.zeros((B,), dtype=jnp.uint32)
+        txt = jax.jit(step).lower(
+            g.subscribers.device_state(), jnp.asarray(g.allowed),
+            u32, u32, u32, u32, jnp.ones((B,), dtype=bool)).as_text()
+        # exactly the device_lookup structure: 2 wide bucket-row probes +
+        # 1 wide value-row gather (stash is a broadcast compare). The
+        # [64,1] column reads of the tiny static allowed array are fine;
+        # a [capacity,1] column gather over the subscriber table is the
+        # serialized shape and must never appear.
+        assert _count(r"slice_sizes = array<i64: 1, 32>", txt) == 2
+        assert _count(r"slice_sizes = array<i64: 1, 8>", txt) == 1
+        assert _count(r"slice_sizes = array<i64: 1>(?!,)", txt) == 0
+        cap = (1 << 10) * 4
+        assert _count(rf"slice_sizes = array<i64: {cap}, 1>", txt) == 0
