@@ -464,11 +464,29 @@ class BNGApp:
             self.log.info("wire attach", mode=att.mode,
                           interface=cfg.wire_if or "(none)",
                           detail=att.detail)
+            if att.xsk is not None:
+                # an AF_XDP socket only RECEIVES via an xskmap redirect
+                # program; load ours through the kernel verifier. TX works
+                # without it, so a missing CAP_BPF degrades (logged), it
+                # does not abort the attach ladder.
+                from bng_tpu.runtime import xdp_redirect
+
+                try:
+                    c["xdp_redirect"] = xdp_redirect.XdpRedirect(
+                        cfg.wire_if, {cfg.wire_queue: att.xsk.fd})
+                    self.log.info("xdp redirect loaded",
+                                  interface=cfg.wire_if,
+                                  queue=cfg.wire_queue)
+                except OSError as e:
+                    self.log.warning("xdp redirect unavailable (RX via "
+                                     "kernel needs CAP_BPF)", error=str(e))
             # LIFO shutdown: flush the pipelined batch (needs the ring),
-            # then detach the socket, then free the ring/UMEM
+            # then detach the socket + redirect, then free the ring/UMEM
             self._on_close(ring.close)
             if att.xsk is not None:
                 self._on_close(att.xsk.close)
+            if "xdp_redirect" in c:
+                self._on_close(c["xdp_redirect"].close)
             self._on_close(lambda: c["engine"].flush_pipeline())
 
         # 12. routing + BGP (main.go:884-940). The platform and the FRR
@@ -527,15 +545,24 @@ class BNGApp:
         self._cleanup.clear()
 
     def drive_once(self) -> int:
-        """One dataplane beat: feed the synthetic source (if configured)
-        and run a double-buffered engine step over the ring. Returns
-        frames retired (the run loop sleeps when this stays 0)."""
+        """One dataplane beat: pump the AF_XDP socket (kernel RX -> ring,
+        ring TX verdicts -> kernel) when a real rung is attached, feed the
+        synthetic source (if configured), and run a double-buffered engine
+        step over the ring. Returns frames moved (the run loop sleeps
+        when this stays 0)."""
         ring = self.components.get("ring")
         if ring is None:
             return 0
+        att = self.components.get("wire_attachment")
+        pumped = 0
+        if att is not None and att.xsk is not None:
+            pumped = att.xsk.pump()  # kernel -> ring before the step
         if self.config.synthetic_subs:
             self._push_synthetic(ring)
-        return self.components["engine"].process_ring_pipelined(ring)
+        moved = self.components["engine"].process_ring_pipelined(ring)
+        if att is not None and att.xsk is not None:
+            pumped += att.xsk.pump()  # verdicts -> kernel after the step
+        return moved + pumped
 
     def _push_synthetic(self, ring, per_beat: int = 16) -> None:
         """Rotating-MAC DISCOVER source (the loadtest generator's role,

@@ -13,6 +13,7 @@ Types: pkg/state/types.go:9-330.
 
 from __future__ import annotations
 
+import bisect
 import threading
 import time
 from dataclasses import dataclass, field
@@ -119,10 +120,7 @@ class Store:
     def put_subscriber(self, s: Subscriber) -> None:
         old = self.subscribers.get(s.id)
         if old:
-            self._sub_by_mac.pop(old.mac.lower(), None)
-            self._sub_by_cid.pop(old.circuit_id, None)
-            if old.nte_id:
-                self._sub_by_nte.get(old.nte_id, set()).discard(s.id)
+            self._unindex_subscriber(old)
         self.subscribers[s.id] = s
         if s.mac:
             self._sub_by_mac[s.mac.lower()] = s.id
@@ -154,11 +152,18 @@ class Store:
         s = self.subscribers.pop(sub_id, None)
         if s is None:
             return False
-        self._sub_by_mac.pop(s.mac.lower(), None)
-        self._sub_by_cid.pop(s.circuit_id, None)
-        if s.nte_id:
-            self._sub_by_nte.get(s.nte_id, set()).discard(sub_id)
+        self._unindex_subscriber(s)
         return True
+
+    def _unindex_subscriber(self, s: Subscriber) -> None:
+        # ownership-guarded like every other index teardown: a MAC or
+        # circuit-id reassigned to another subscriber must keep ITS entry
+        if s.mac and self._sub_by_mac.get(s.mac.lower()) == s.id:
+            del self._sub_by_mac[s.mac.lower()]
+        if s.circuit_id and self._sub_by_cid.get(s.circuit_id) == s.id:
+            del self._sub_by_cid[s.circuit_id]
+        if s.nte_id:
+            self._sub_by_nte.get(s.nte_id, set()).discard(s.id)
 
     # -- leases --
     @_locked
@@ -346,8 +351,6 @@ class Store:
                               port: int) -> NATBinding | None:
         """Reverse lookup by public endpoint — the LEA-request shape
         (store.go:819-833; same query pkg/nat's compliance log answers)."""
-        import bisect
-
         blocks = self._nat_by_public.get(public_ip, [])
         i = bisect.bisect_right(blocks, (port, float("inf"), "")) - 1
         if i >= 0:
@@ -382,6 +385,8 @@ class Store:
 
     # -- background cleanup loops (store.go:100-127, 858-1024) --
     def start(self) -> None:
+        if self._thread is not None and self._thread.is_alive():
+            return  # one sweeper; a second start() must not orphan it
         self._stop.clear()
         self._thread = threading.Thread(target=self._cleanup_loop,
                                         daemon=True, name="bng-state-sweep")

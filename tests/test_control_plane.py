@@ -337,3 +337,29 @@ class TestStateStoreDepth:
         s.stop()
         assert not errors, errors[:1]
         assert s.stats()["leases_expired"] > 0
+
+    def test_reassigned_mac_keeps_new_owner_index(self):
+        """Deleting the OLD subscriber must not clobber the index entry a
+        reassigned MAC/circuit-id now points at (review r4)."""
+        st, s, _ = self._store()
+        s.put_subscriber(st.Subscriber(id="s1", mac="02:00:00:00:00:0a",
+                                       circuit_id="olt1/1"))
+        s.put_subscriber(st.Subscriber(id="s2", mac="02:00:00:00:00:0a",
+                                       circuit_id="olt1/1"))
+        assert s.delete_subscriber("s1")
+        assert s.subscriber_by_mac("02:00:00:00:00:0a").id == "s2"
+        assert s.subscriber_by_circuit_id("olt1/1").id == "s2"
+
+    def test_double_start_keeps_one_sweeper(self):
+        import threading as th
+
+        st, s, _ = self._store()
+        s.lease_sweep_interval = 10.0
+        s.start()
+        t1 = s._thread
+        s.start()
+        assert s._thread is t1  # no orphaned second sweeper
+        before = sum(1 for t in th.enumerate()
+                     if t.name == "bng-state-sweep")
+        assert before == 1
+        s.stop()
