@@ -213,6 +213,9 @@ class ShardedCluster:
         self._step = _sharded_step_jit(self.mesh, self.geom, self.n)
         self._dhcp_step = _sharded_dhcp_jit(self.mesh, self.geom, self.n)
         self.tables = None  # lazily built on first step / sync()
+        self._ring_bufs = None  # process_ring staging (lazy)
+        # per-step psum deltas folded by process_ring (Engine.stats role)
+        self.stats: dict = {"slow_errors": 0}
 
     # ---- owner routing (must match device shard_owner) ----
     def dhcp_sub_shard(self, mac) -> int:
@@ -478,6 +481,112 @@ class ShardedCluster:
             "out_len": np.asarray(out_len),
             "dhcp_stats": np.asarray(stats),
         }
+
+    def process_ring(self, ring, now_s: int, now_us: int,
+                     pkt_slot: int = 2048, slow_path=None,
+                     violation_sink=None) -> int:
+        """One multichip production beat: drain a STEERING ring through
+        the sharded step and demux verdicts back (the single-chip analog
+        is Engine.process_ring; the batch layout contract is
+        assemble_sharded's per-shard lane ranges = step()'s rows).
+
+        Engine-parity semantics:
+        - all-control batches (ring-classified DHCP, FLAG_DHCP_CTRL on
+          every real lane) ride the sharded DHCP-only fast lane;
+        - per-step stats deltas fold into self.stats;
+        - the slow queue is drained lane-aligned: NAT new-flow punts
+          create the session on the subscriber's OWNER shard inline,
+          everything else goes to `slow_path(frame) -> reply|None` with
+          replies injected on the TX ring; spoof violations reach
+          `violation_sink(lane, frame)`.
+
+        The ring must be one of this cluster's (make_ring) so shard i's
+        region holds shard i's subscribers; pkt_slot must cover the
+        ring's frame size or oversize frames would be staged truncated.
+        Returns frames processed."""
+        if pkt_slot < ring.frame_size:
+            raise ValueError(
+                f"pkt_slot {pkt_slot} < ring frame_size {ring.frame_size}: "
+                f"oversize frames would be silently truncated")
+        B = self.n * self.b
+        if self._ring_bufs is None or self._ring_bufs[0].shape != (B, pkt_slot):
+            self._ring_bufs = (np.zeros((B, pkt_slot), dtype=np.uint8),
+                               np.zeros((B,), dtype=np.uint32),
+                               np.zeros((B,), dtype=np.uint32))
+        pkt, length, flags = self._ring_bufs
+        got = ring.assemble_sharded(pkt, length, flags)
+        if not got:
+            return 0
+        from bng_tpu.runtime.ring import FLAG_DHCP_CTRL, VERDICT_PASS, VERDICT_TX
+
+        real = length > 0
+        all_ctrl = bool(((flags[real] & FLAG_DHCP_CTRL) != 0).all())
+        if all_ctrl:  # the multichip OFFER-latency fast lane
+            out = self.dhcp_step(pkt, length, now_s)
+            verdict = np.where(out["is_reply"], np.uint8(VERDICT_TX),
+                               np.uint8(VERDICT_PASS))
+            out_pkt, out_len = out["out_pkt"], out["out_len"]
+            punt = np.zeros((B,), dtype=bool)
+            viol = np.zeros((B,), dtype=bool)
+            self._fold_stats(dhcp=out["dhcp_stats"])
+        else:
+            out = self.step(pkt, length, (flags & 0x1) != 0, now_s, now_us)
+            verdict = out["verdict"].astype(np.uint8)
+            out_pkt, out_len = out["out_pkt"], out["out_len"]
+            punt = out["nat_punt"]
+            viol = out["violation"]
+            self._fold_stats(dhcp=out["dhcp_stats"], nat=out["nat_stats"],
+                             qos=out["qos_stats"], spoof=out["spoof_stats"],
+                             garden=out.get("garden_stats"))
+        ring.complete(verdict, np.asarray(out_pkt),
+                      np.asarray(out_len).astype(np.uint32), B)
+
+        if violation_sink is not None:
+            for lane in np.nonzero(viol)[0]:
+                violation_sink(int(lane),
+                               bytes(pkt[lane, : int(length[lane])]))
+        # slow drain, lane-aligned with the PASS lanes complete() queued
+        for lane in np.nonzero((verdict == VERDICT_PASS) & real)[0]:
+            got_f = ring.slow_pop()
+            if got_f is None:
+                break  # slow ring overflowed during complete()
+            frame, fl = got_f
+            try:
+                if punt[lane]:
+                    self._punt_new_flow(frame, int(now_s))
+                elif slow_path is not None:
+                    reply = slow_path(frame)
+                    if reply is not None:
+                        ring.tx_inject(reply, from_access=(fl & 0x1) != 0)
+            except Exception:  # noqa: BLE001 — slow path is untrusted input
+                self.stats["slow_errors"] += 1
+        return got
+
+    def _fold_stats(self, **deltas) -> None:
+        for k, v in deltas.items():
+            if v is None:
+                continue
+            acc = self.stats.get(k)
+            if acc is None:
+                self.stats[k] = np.asarray(v, dtype=np.uint64).copy()
+            else:
+                acc += np.asarray(v, dtype=np.uint64)
+
+    def _punt_new_flow(self, frame: bytes, now: int) -> None:
+        """Device egress-miss: create the session on the OWNER shard
+        (Engine._punt_new_flow with owner routing in front)."""
+        from bng_tpu.control import packets as P
+
+        try:
+            d = P.decode(frame)
+        except Exception:
+            return
+        if d.ethertype != 0x0800:
+            return
+        src_port = d.icmp_id if d.proto == 1 else d.src_port
+        dst_port = 0 if d.proto == 1 else d.dst_port
+        self.handle_new_flow(d.src_ip, d.dst_ip, src_port, dst_port,
+                             d.proto, len(frame), now)
 
     def step(self, pkt: np.ndarray, length: np.ndarray, from_access: np.ndarray,
              now_s: int, now_us: int):

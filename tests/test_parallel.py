@@ -560,3 +560,87 @@ class TestMillionSubscriberShardedBuild:
         with pytest.raises(ValueError, match="exclusively"):
             ShardedCluster(2, batch_per_shard=8,
                            public_ips=[ip_to_u32("203.0.113.9")])
+
+
+class TestClusterRingLoop:
+    """process_ring: the multichip production beat — steering ring ->
+    sharded step -> verdict demux, end to end."""
+
+    T0 = 1_753_000_000
+
+    def test_ring_to_step_to_verdicts(self):
+        n = 2
+        cl = ShardedCluster(n, batch_per_shard=8)
+        cl.set_server_config_all(bytes.fromhex("02aabbccdd01"),
+                                 ip_to_u32("10.0.0.1"))
+        cl.add_pool_all(1, ip_to_u32("10.0.0.0"), 24, ip_to_u32("10.0.0.1"),
+                        lease_time=3600)
+        mac = bytes.fromhex("02c0ffee0077")
+        sub_ip = ip_to_u32("10.0.0.66")
+        cl.add_subscriber(mac, pool_id=1, ip=sub_ip,
+                          lease_expiry=self.T0 + 600)
+        owner, _ = cl.allocate_nat(sub_ip, self.T0)
+        cl.handle_new_flow(sub_ip, ip_to_u32("1.2.3.4"), 40000, 443, 17,
+                           600, self.T0)
+        cl.sync_tables()
+        ring = cl.make_ring(nframes=256, frame_size=2048, depth=64)
+
+        p = dhcp_codec.build_request(mac, dhcp_codec.DISCOVER, xid=0x77)
+        disc = packets.udp_packet(mac, b"\xff" * 6, 0, 0xFFFFFFFF, 68, 67,
+                                  p.encode().ljust(320, b"\x00"))
+        up = packets.udp_packet(mac, b"\x04" * 6, sub_ip,
+                                ip_to_u32("1.2.3.4"), 40000, 443, b"u" * 64)
+        junk = packets.udp_packet(mac, b"\x04" * 6, ip_to_u32("10.0.0.99"),
+                                  ip_to_u32("9.9.9.9"), 1, 2, b"j")
+        for f in (disc, up, junk):
+            assert ring.rx_push(f, from_access=True)
+        got = cl.process_ring(ring, self.T0 + 1, 1_000_000)
+        assert got == 3
+        # demux: cached DISCOVER -> device OFFER on TX; SNAT'd flow ->
+        # FWD; unknown-subscriber junk -> slow (PASS)
+        assert ring.tx_pending() == 1
+        assert ring.fwd_pending() == 1
+        # the junk PASS lane was drained inline (no slow handler: frame
+        # recycled — Engine._apply_ring_verdicts semantics)
+        assert ring.slow_pending() == 0
+        offer, _fl = ring.tx_pop()
+        reply = dhcp_codec.decode(bytes(offer)[42:])
+        assert reply.op == 2 and reply.xid == 0x77
+        ring.fwd_pop()  # drain the SNAT'd frame
+        # stats deltas folded (Engine.stats role)
+        assert int(cl.stats["dhcp"].sum()) > 0
+        assert int(cl.stats["nat"].sum()) > 0
+        # empty ring: a beat is a no-op, no window leaks
+        assert cl.process_ring(ring, self.T0 + 2, 2_000_000) == 0
+        assert ring.free_frames() > 0
+
+        # all-control batch rides the sharded DHCP fast lane; slow lanes
+        # reach the host handler and its reply is injected on TX
+        handled = []
+
+        def slow(frame):
+            handled.append(frame)
+            return None
+
+        p2 = dhcp_codec.build_request(bytes.fromhex("02c0ffee0088"),
+                                      dhcp_codec.DISCOVER, xid=0x88)
+        unknown = packets.udp_packet(bytes.fromhex("02c0ffee0088"),
+                                     b"\xff" * 6, 0, 0xFFFFFFFF, 68, 67,
+                                     p2.encode().ljust(320, b"\x00"))
+        assert ring.rx_push(disc, from_access=True)     # cached: device TX
+        assert ring.rx_push(unknown, from_access=True)  # miss: slow handler
+        assert cl.process_ring(ring, self.T0 + 3, 3_000_000,
+                               slow_path=slow) == 2
+        assert ring.tx_pending() == 1  # the cached OFFER
+        assert len(handled) == 1 and handled[0] == unknown
+
+        # a NAT new-flow punt creates the session on the OWNER shard:
+        # the SAME flow forwards on the next beat
+        flow2 = packets.udp_packet(mac, b"\x04" * 6, sub_ip,
+                                   ip_to_u32("5.6.7.8"), 41000, 443,
+                                   b"n" * 64)
+        assert ring.rx_push(flow2, from_access=True)
+        cl.process_ring(ring, self.T0 + 4, 4_000_000)  # punt handled inline
+        assert ring.rx_push(flow2, from_access=True)
+        cl.process_ring(ring, self.T0 + 5, 5_000_000)
+        assert ring.fwd_pending() == 1  # packet 2 SNATs on device
