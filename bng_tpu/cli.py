@@ -399,6 +399,18 @@ class BNGApp:
             from bng_tpu.control.slaac import SLAACConfig, SLAACServer
             c["slaac"] = SLAACServer(SLAACConfig())
 
+        # 10b. slow-path demux: the reference runs one socket+goroutine
+        # per protocol server; here every PASSed frame lands on the ring's
+        # one slow queue, so the engine's slow_path becomes a dispatcher
+        # over whatever servers are enabled (v4 handled even alone)
+        if cfg.dhcpv6_enabled or cfg.slaac_enabled:
+            from bng_tpu.control.slowpath import SlowPathDemux
+
+            demux = c["slowpath"] = SlowPathDemux(
+                dhcp=dhcp, dhcpv6=c.get("dhcpv6"), slaac=c.get("slaac"),
+                clock=self.clock)
+            c["engine"].slow_path = demux
+
         # 11. HA pair (main.go:759-881)
         if cfg.ha_role:
             from bng_tpu.control.ha import (ActiveSyncer, InMemorySessionStore,

@@ -164,6 +164,32 @@ class DecodedPacket:
     icmp_id: int = 0
 
 
+def udp6_packet(
+    src_mac: bytes,
+    dst_mac: bytes,
+    src_ip: bytes,  # 16 bytes
+    dst_ip: bytes,  # 16 bytes
+    src_port: int,
+    dst_port: int,
+    payload: bytes,
+    hop_limit: int = 64,
+) -> bytes:
+    """Eth + IPv6 + UDP frame (DHCPv6 control traffic). The UDP checksum
+    is MANDATORY in IPv6 (RFC 8200 §8.1): computed over the v6
+    pseudo-header + UDP header + payload."""
+    udp_len = 8 + len(payload)
+    udp_hdr = struct.pack("!HHHH", src_port, dst_port, udp_len, 0)
+    pseudo = src_ip + dst_ip + struct.pack("!IHBB", udp_len, 0, 0, 17)
+    csum = checksum16(pseudo + udp_hdr + payload)
+    if csum == 0:  # all-zero means "no checksum" in UDP: transmit as ffff
+        csum = 0xFFFF
+    udp_hdr = struct.pack("!HHHH", src_port, dst_port, udp_len, csum)
+    ip6 = struct.pack("!IHBB", 0x60000000, udp_len, 17, hop_limit) \
+        + src_ip + dst_ip
+    return dst_mac + src_mac + struct.pack("!H", 0x86DD) + ip6 \
+        + udp_hdr + payload
+
+
 def decode(raw: bytes) -> DecodedPacket:
     """Parse a raw frame back into fields (for asserting kernel output)."""
     p = DecodedPacket()

@@ -1,0 +1,108 @@
+"""Slow-path protocol demux: one entry point for the ring's PASS lanes.
+
+The reference runs one goroutine + socket per protocol server (cmd/bng
+main.go:1063-1180: DHCPv4 on UDP:67, DHCPv6 on UDP6:547, SLAAC on raw
+ICMPv6, PPPoE on AF_PACKET). In the TPU build every packet the device
+PASSes lands on ONE slow queue (the ring), so the composition root needs
+one callable that dispatches each Ethernet frame to the server that owns
+it and returns the reply frame(s) for TX injection.
+
+Framing: DHCPv4 and SLAAC servers speak Ethernet frames natively; the
+DHCPv6 server speaks raw DHCPv6 messages (like the reference's, which
+gets UDP payloads from its socket — server.go:420), so this module owns
+the Eth/IPv6/UDP encap/decap around it.
+"""
+
+from __future__ import annotations
+
+from bng_tpu.control import packets
+
+ETH_P_IPV6 = 0x86DD
+DHCP6_SERVER_PORT = 547
+DHCP6_CLIENT_PORT = 546
+ALL_DHCP_AGENTS = bytes.fromhex("ff020000000000000000000000010002")
+
+
+class SlowPathDemux:
+    """Dispatch PASSed frames to DHCPv4 / DHCPv6 / SLAAC / PPPoE.
+
+    Every handler is optional (nil-safe, the reference's optional-manager
+    discipline); unmatched frames return None (frame recycles). The
+    callable signature matches Engine/ShardedCluster ``slow_path``.
+    """
+
+    def __init__(self, dhcp=None, dhcpv6=None, slaac=None, pppoe=None,
+                 clock=None):
+        import time
+
+        self.dhcp = dhcp
+        self.dhcpv6 = dhcpv6
+        self.slaac = slaac
+        self.pppoe = pppoe
+        self.clock = clock or time.time
+        self.stats = {"dhcp4": 0, "dhcp6": 0, "slaac": 0, "pppoe": 0,
+                      "unmatched": 0}
+
+    def __call__(self, frame: bytes) -> bytes | None:
+        if len(frame) < 14:
+            self.stats["unmatched"] += 1
+            return None
+        ethertype = int.from_bytes(frame[12:14], "big")
+        if ethertype in (0x8863, 0x8864) and self.pppoe is not None:
+            self.stats["pppoe"] += 1
+            replies = self.pppoe.handle_frame(frame, self.clock())
+            # the ring's slow contract is one reply per frame; PPPoE
+            # negotiation can emit several — the first goes back inline,
+            # the rest ride the server's pending queue drained by tick()
+            return replies[0] if replies else None
+        if ethertype == ETH_P_IPV6:
+            reply = self._try_dhcpv6(frame)
+            if reply is not None:
+                return reply
+            if self.slaac is not None:
+                reply = self.slaac.handle_frame(frame)
+                if reply is not None:
+                    self.stats["slaac"] += 1
+                    return reply
+            self.stats["unmatched"] += 1
+            return None
+        if self.dhcp is not None:
+            reply = self.dhcp.handle_frame(frame)
+            if reply is not None:
+                self.stats["dhcp4"] += 1
+                return reply
+        self.stats["unmatched"] += 1
+        return None
+
+    def _try_dhcpv6(self, frame: bytes) -> bytes | None:
+        """Eth/IPv6/UDP:547 -> DHCPv6Server.handle_message -> framed reply."""
+        if self.dhcpv6 is None or len(frame) < 14 + 40 + 8:
+            return None
+        if frame[18] != 17:  # IPv6 next-header UDP (no ext headers on ctrl)
+            return None
+        udp = 14 + 40
+        dport = int.from_bytes(frame[udp + 2 : udp + 4], "big")
+        if dport != DHCP6_SERVER_PORT:
+            return None
+        udp_len = int.from_bytes(frame[udp + 4 : udp + 6], "big")
+        payload = frame[udp + 8 : udp + udp_len]
+        if not payload:
+            return None
+        reply = self.dhcpv6.handle_message(payload)
+        if reply is None:
+            return None
+        self.stats["dhcp6"] += 1
+        client_mac = frame[6:12]
+        client_ip = frame[22:38]  # IPv6 source
+        server_mac = getattr(self.dhcpv6.config, "server_mac",
+                             b"\x02\xbb\x00\x00\x00\x01")
+        return packets.udp6_packet(server_mac, client_mac,
+                                   _server_ip6(client_ip), client_ip,
+                                   DHCP6_SERVER_PORT, DHCP6_CLIENT_PORT,
+                                   reply)
+
+
+def _server_ip6(client_ip: bytes) -> bytes:
+    """Reply source: link-local server address (fe80::1 — the relay/
+    server-on-link convention; good for direct on-link clients)."""
+    return bytes.fromhex("fe800000000000000000000000000001")

@@ -367,3 +367,111 @@ def test_dnssl_option_length():
     body = ra[i + 8:]
     # encoded domain: 3isp7example0 = 13 bytes -> padded to 16
     assert length_units == 1 + 16 // 8  # == 3 (RFC 6106)
+
+
+class TestSlowPathDemux:
+    """One slow queue, many protocol servers (cmd/bng socket-per-server
+    role collapsed onto the ring): v4 DHCP, v6 DHCP (Eth/IPv6/UDP framed
+    here), and SLAAC RS dispatch from raw Ethernet frames."""
+
+    def _demux(self):
+        from bng_tpu.control.dhcp_server import DHCPServer
+        from bng_tpu.control.pool import Pool, PoolManager
+        from bng_tpu.control.slaac import SLAACConfig, SLAACServer
+        from bng_tpu.control.slowpath import SlowPathDemux
+        from bng_tpu.control.dhcpv6.server import (DHCPv6Server,
+                                                   DHCPv6ServerConfig)
+        from bng_tpu.utils.net import ip_to_u32
+
+        pools = PoolManager(None)
+        pools.add_pool(Pool(pool_id=1, network=ip_to_u32("10.4.0.0"),
+                            prefix_len=24, gateway=ip_to_u32("10.4.0.1"),
+                            lease_time=3600))
+        v4 = DHCPServer(b"\x02\xbb\x00\x00\x00\x01", ip_to_u32("10.4.0.1"),
+                        pools, clock=lambda: 1_753_000_000.0)
+        v6 = DHCPv6Server(DHCPv6ServerConfig(),
+                          clock=lambda: 1_753_000_000.0)
+        ra = SLAACServer(SLAACConfig())
+        return SlowPathDemux(dhcp=v4, dhcpv6=v6, slaac=ra), v6
+
+    def test_v4_frames_still_answered(self):
+        from bng_tpu.control import dhcp_codec, packets
+
+        demux, _ = self._demux()
+        mac = bytes.fromhex("02d40000 0001".replace(" ", ""))
+        p = dhcp_codec.build_request(mac, dhcp_codec.DISCOVER, xid=0x99)
+        disc = packets.udp_packet(mac, b"\xff" * 6, 0, 0xFFFFFFFF, 68, 67,
+                                  p.encode().ljust(320, b"\x00"))
+        reply = demux(disc)
+        assert reply is not None
+        assert dhcp_codec.decode(reply[42:]).op == 2
+        assert demux.stats["dhcp4"] == 1
+
+    def test_v6_solicit_framed_roundtrip(self):
+        from bng_tpu.control import packets
+
+        demux, v6 = self._demux()
+        mac = bytes.fromhex("02d600000001")
+        link_local = bytes.fromhex("fe80000000000000") + mac[:3] + b"\xff\xfe" + mac[3:]
+        sol = solicit()
+        frame = packets.udp6_packet(
+            mac, bytes.fromhex("333300010002"), link_local,
+            bytes.fromhex("ff020000000000000000000000010002"),
+            546, 547, sol.encode())
+        reply_frame = demux(frame)
+        assert reply_frame is not None and demux.stats["dhcp6"] == 1
+        # the reply is a well-formed Eth/IPv6/UDP frame back to the client
+        assert reply_frame[0:6] == mac  # dst = client
+        assert reply_frame[12:14] == b"\x86\xdd"
+        assert reply_frame[38:54] == link_local  # v6 dst = client ll
+        sport = int.from_bytes(reply_frame[54:56], "big")
+        dport = int.from_bytes(reply_frame[56:58], "big")
+        assert (sport, dport) == (547, 546)
+        adv = DHCPv6Message.decode(reply_frame[62:])
+        assert adv.msg_type == MSG_ADVERTISE
+
+    def test_rs_gets_ra(self):
+        demux, _ = self._demux()
+        mac = bytes.fromhex("02d600000002")
+        ll = bytes.fromhex("fe80000000000000") + mac[:3] + b"\xff\xfe" + mac[3:]
+        # minimal ICMPv6 RS frame
+        icmp = bytes([133, 0, 0, 0, 0, 0, 0, 0])
+        ip6 = bytes([0x60, 0, 0, 0]) + len(icmp).to_bytes(2, "big") + bytes([58, 255]) + ll \
+            + bytes.fromhex("ff020000000000000000000000000002")
+        frame = bytes.fromhex("333300000002") + mac + b"\x86\xdd" + ip6 + icmp
+        ra = demux(frame)
+        assert ra is not None and demux.stats["slaac"] == 1
+        assert ra[12:14] == b"\x86\xdd"
+
+    def test_junk_unmatched(self):
+        demux, _ = self._demux()
+        assert demux(b"\x00" * 10) is None
+        assert demux(b"\x02" * 12 + b"\x12\x34" + b"x" * 40) is None
+        assert demux.stats["unmatched"] == 2
+
+    def test_cli_wires_demux_and_engine_ring_serves_v6(self):
+        """End to end through the ENGINE ring: a DHCPv6 SOLICIT frame
+        PASSes the device pipeline, the demux answers, the ADVERTISE
+        comes back on the TX queue."""
+        from bng_tpu.cli import BNGApp, BNGConfig
+        from bng_tpu.control import packets
+        from bng_tpu.runtime.ring import PyRing
+
+        app = BNGApp(BNGConfig())
+        try:
+            assert "slowpath" in app.components
+            ring = PyRing(nframes=64, frame_size=2048, depth=32)
+            mac = bytes.fromhex("02d600000003")
+            ll = bytes.fromhex("fe80000000000000") + mac[:3] + b"\xff\xfe" + mac[3:]
+            frame = packets.udp6_packet(
+                mac, bytes.fromhex("333300010002"), ll,
+                bytes.fromhex("ff020000000000000000000000010002"),
+                546, 547, solicit().encode())
+            assert ring.rx_push(frame, from_access=True)
+            app.components["engine"].process_ring(ring)
+            got = ring.tx_pop()
+            assert got is not None
+            adv = DHCPv6Message.decode(got[0][62:])
+            assert adv.msg_type == MSG_ADVERTISE
+        finally:
+            app.close()
