@@ -89,6 +89,9 @@ class BNGConfig:
     # dhcpv6 / slaac
     dhcpv6_enabled: bool = True
     dhcpv6_prefix: str = "2001:db8:1::/64"
+    # reply-source for framed DHCPv6 ("" = EUI-64 link-local of
+    # server_mac); set a global address when clients reach us via a relay
+    dhcpv6_server_ip: str = ""
     slaac_enabled: bool = True
     # wire (AF_XDP attach ladder; runtime/xsk.py)
     wire_if: str = ""  # NIC to bind AF_XDP on ("" = in-memory ring only)
@@ -391,10 +394,19 @@ class BNGApp:
 
         # 10. DHCPv6 + SLAAC (main.go:1063-1180)
         if cfg.dhcpv6_enabled:
-            from bng_tpu.control.dhcpv6.server import (DHCPv6Server,
+            from bng_tpu.control.dhcpv6.server import (AddressPool6,
+                                                       DHCPv6Server,
                                                        DHCPv6ServerConfig)
+            server_ip6 = b""
+            if cfg.dhcpv6_server_ip:
+                server_ip6 = ipaddress.IPv6Address(
+                    cfg.dhcpv6_server_ip).packed
             c["dhcpv6"] = DHCPv6Server(
-                DHCPv6ServerConfig(), clock=self.clock)
+                DHCPv6ServerConfig(server_mac=parse_mac(cfg.server_mac),
+                                   server_ip6=server_ip6),
+                address_pool=AddressPool6(cfg.dhcpv6_prefix,
+                                          cfg.lease_time, cfg.lease_time * 2),
+                clock=self.clock)
         if cfg.slaac_enabled:
             from bng_tpu.control.slaac import SLAACConfig, SLAACServer
             c["slaac"] = SLAACServer(SLAACConfig())

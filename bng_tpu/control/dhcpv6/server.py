@@ -129,6 +129,11 @@ class PrefixPool6:
 @dataclass
 class DHCPv6ServerConfig:
     server_mac: bytes = b"\x02\xbb\x00\x00\x00\x01"
+    # Reply-source address for framed (on-wire) replies. Empty -> the
+    # demux derives the EUI-64 link-local from server_mac (the reference
+    # replies from its real bound address, server.go:18; relays need a
+    # non-placeholder source or they drop the Relay-Reply).
+    server_ip6: bytes = b""
     dns_servers: list[bytes] = field(default_factory=list)  # 16B each
     domain_list: list[str] = field(default_factory=list)
     preference: int = 0

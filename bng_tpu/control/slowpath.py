@@ -78,7 +78,9 @@ class SlowPathDemux:
         """Eth/IPv6/UDP:547 -> DHCPv6Server.handle_message -> framed reply."""
         if self.dhcpv6 is None or len(frame) < 14 + 40 + 8:
             return None
-        if frame[18] != 17:  # IPv6 next-header UDP (no ext headers on ctrl)
+        # Eth(14) + IPv6: next-header lives at offset 14+6=20 (frame[18:20]
+        # is the payload-length field). No ext headers on control traffic.
+        if frame[20] != 17:
             return None
         udp = 14 + 40
         dport = int.from_bytes(frame[udp + 2 : udp + 4], "big")
@@ -97,12 +99,17 @@ class SlowPathDemux:
         server_mac = getattr(self.dhcpv6.config, "server_mac",
                              b"\x02\xbb\x00\x00\x00\x01")
         return packets.udp6_packet(server_mac, client_mac,
-                                   _server_ip6(client_ip), client_ip,
+                                   self._server_ip6(server_mac), client_ip,
                                    DHCP6_SERVER_PORT, DHCP6_CLIENT_PORT,
                                    reply)
 
+    def _server_ip6(self, server_mac: bytes) -> bytes:
+        """Reply source: configured server address if set, else the
+        EUI-64 link-local derived from server_mac (reference replies
+        from its real bound address — server.go:18)."""
+        configured = getattr(self.dhcpv6.config, "server_ip6", b"")
+        if configured:
+            return configured
+        from bng_tpu.control.slaac import link_local
 
-def _server_ip6(client_ip: bytes) -> bytes:
-    """Reply source: link-local server address (fe80::1 — the relay/
-    server-on-link convention; good for direct on-link clients)."""
-    return bytes.fromhex("fe800000000000000000000000000001")
+        return link_local(server_mac)

@@ -428,7 +428,7 @@ class TestSlowPathDemux:
         dport = int.from_bytes(reply_frame[56:58], "big")
         assert (sport, dport) == (547, 546)
         adv = DHCPv6Message.decode(reply_frame[62:])
-        assert adv.msg_type == MSG_ADVERTISE
+        assert adv.msg_type == p6.ADVERTISE
 
     def test_rs_gets_ra(self):
         demux, _ = self._demux()
@@ -472,6 +472,6 @@ class TestSlowPathDemux:
             got = ring.tx_pop()
             assert got is not None
             adv = DHCPv6Message.decode(got[0][62:])
-            assert adv.msg_type == MSG_ADVERTISE
+            assert adv.msg_type == p6.ADVERTISE
         finally:
             app.close()
