@@ -44,6 +44,18 @@ class BNGConfig:
     radius_server: str = ""
     radius_secret: str = ""
     radius_secret_file: str = ""
+    # RADIUS accounting (pkg/radius/accounting.go role); active whenever a
+    # radius server is configured. Spool path "" = in-memory only.
+    acct_interim_interval: int = 300
+    acct_spool_path: str = ""
+    # PPPoE (pkg/pppoe; wired like main.go:1063-1180)
+    pppoe_enabled: bool = False
+    pppoe_ac_name: str = "bng-tpu"
+    pppoe_service_name: str = ""
+    pppoe_auth: str = "chap"  # chap | pap | none
+    # local credentials (YAML `pppoe-users: [{username, password}]`);
+    # ignored when RADIUS is configured (RADIUS wins, reference behavior)
+    pppoe_users: list = dataclasses.field(default_factory=list)
     # NAT
     nat_enabled: bool = True
     nat_public_ips: list = dataclasses.field(default_factory=lambda: ["203.0.113.1"])
@@ -136,6 +148,10 @@ class BNGApp:
         self.clock = clock
         self._cleanup = []
         self._last_sync = 0.0
+        self._last_expire = 0.0
+        self._last_garden = 0.0
+        self._last_acct_sync = 0.0
+        self._last_acct_retry = 0.0
         self._syn_i = 0
         self.components: dict[str, object] = {}
         try:
@@ -292,6 +308,19 @@ class BNGApp:
             nat = NATManager(public_ips=[ip_to_u32("203.0.113.1")],
                              sessions_nbuckets=256, sub_nat_nbuckets=64)
 
+        # 7b. RADIUS accounting (accounting.go:410-497 role): start/stop
+        # ride the DHCP lease lifecycle; interim/retry fire from App.tick.
+        # Installed BEFORE the garden wiring so its hook chain (9b)
+        # preserves accounting.
+        acct = None
+        if "radius" in c:
+            from bng_tpu.control.radius.accounting import AccountingManager
+            acct = c["accounting"] = AccountingManager(
+                c["radius"],
+                interim_interval_s=cfg.acct_interim_interval,
+                spool_path=cfg.acct_spool_path or None,
+                clock=self.clock)
+
         # 8. DHCP server, wired like main.go:642 + SetXxx hooks
         dhcp = c["dhcp"] = DHCPServer(
             server_mac=parse_mac(cfg.server_mac),
@@ -299,6 +328,18 @@ class BNGApp:
             pool_manager=pool_mgr, fastpath_tables=fastpath,
             authenticator=authenticator, qos_hook=qos_hook,
             nat_hook=nat_hook, clock=self.clock)
+        if acct is not None:
+            from bng_tpu.utils.net import u32_to_ip as _u32ip
+
+            def _acct_lease(event, lease, sid, _acct=acct):
+                if event == "start":
+                    _acct.start(sid, username=lease.username
+                                or _u32ip(lease.ip), framed_ip=lease.ip,
+                                mac="-".join(f"{b:02X}" for b in lease.mac))
+                else:
+                    _acct.stop(sid)
+
+            dhcp.accounting_hook = _acct_lease
 
         # 9. engine: the TPU dataplane replacing the XDP attach. The
         # device-side garden gate compiles in only when the walled garden
@@ -308,9 +349,15 @@ class BNGApp:
             from bng_tpu.runtime.engine import GardenTables
 
             garden_tables = GardenTables()
+        pppoe_tables = None
+        if cfg.pppoe_enabled:
+            from bng_tpu.runtime.tables import PPPoEFastPathTables
+
+            pppoe_tables = c["pppoe_tables"] = PPPoEFastPathTables(
+                server_mac=parse_mac(cfg.server_mac))
         c["engine"] = Engine(
             fastpath=fastpath, nat=nat, qos=qos, antispoof=c["antispoof"],
-            garden=garden_tables,
+            garden=garden_tables, pppoe=pppoe_tables,
             batch_size=cfg.batch_size, slow_path=dhcp.handle_frame,
             clock=self.clock)
         self.log.info("engine built", batch_size=cfg.batch_size,
@@ -411,16 +458,104 @@ class BNGApp:
             from bng_tpu.control.slaac import SLAACConfig, SLAACServer
             c["slaac"] = SLAACServer(SLAACConfig())
 
+        # 10c. PPPoE server (pkg/pppoe; main.go:1063-1180 construction
+        # role). Negotiation is host-side via PASS lanes; OPEN sessions
+        # publish to the device tables (10's pppoe_tables) so DATA frames
+        # decap/encap in the fused pipeline.
+        if cfg.pppoe_enabled:
+            from bng_tpu.control.pppoe.auth import (LocalVerifier,
+                                                    RadiusVerifier)
+            from bng_tpu.control.pppoe.codec import PROTO_CHAP, PROTO_PAP
+            from bng_tpu.control.pppoe.server import (PPPoEServer,
+                                                      PPPoEServerConfig)
+
+            if "radius" in c:
+                verifier = RadiusVerifier(c["radius"])
+            else:
+                creds = {}
+                for u in cfg.pppoe_users:
+                    if isinstance(u, dict):
+                        creds[str(u["username"])] = str(u["password"]).encode()
+                verifier = LocalVerifier(creds)
+            auth_proto = {"chap": PROTO_CHAP, "pap": PROTO_PAP,
+                          "none": 0}.get(cfg.pppoe_auth)
+            if auth_proto is None:
+                raise ValueError(f"pppoe_auth={cfg.pppoe_auth!r}: "
+                                 f"expected 'chap', 'pap' or 'none'")
+
+            def _pppoe_alloc(username, mac, _pools=pool_mgr):
+                pool = _pools.classify(0)
+                if pool is None:
+                    return None
+                try:
+                    return pool.allocate(f"pppoe:{mac.hex()}")
+                except Exception:
+                    return None  # exhaustion -> Service-Unavailable PADT
+
+            def _pppoe_release(ip, mac, _pools=pool_mgr):
+                pool = _pools.pool_for_ip(ip)
+                if pool is not None:
+                    pool.release(ip)
+
+            def _pppoe_open(sess, _acct=acct):
+                # a RADIUS Framed-IP-Address bypasses _pppoe_alloc
+                # (server.py _start_network prefers it); reserve it in the
+                # owning pool or DHCP could hand the same address out.
+                # allocate_specific is idempotent for the same owner, so
+                # pool-allocated sessions cost one no-op re-claim.
+                pool = pool_mgr.pool_for_ip(sess.assigned_ip)
+                if pool is not None:
+                    pool.allocate_specific(sess.assigned_ip,
+                                           f"pppoe:{sess.client_mac.hex()}")
+                pppoe_tables.session_up(sess)
+                if cfg.qos_enabled:
+                    qos_hook(sess.assigned_ip,
+                             sess.radius_attributes.get("qos_policy"))
+                if cfg.nat_enabled:
+                    nat.allocate_nat(sess.assigned_ip, int(self.clock()))
+                if _acct is not None:
+                    sid = f"pppoe-{sess.session_id:04x}-{sess.client_mac.hex()}"
+                    _acct.start(sid, username=sess.username,
+                                framed_ip=sess.assigned_ip,
+                                mac="-".join(f"{b:02X}"
+                                             for b in sess.client_mac))
+
+            def _pppoe_close(event, _acct=acct):
+                sess = event.session
+                pppoe_tables.session_down(event)
+                if cfg.qos_enabled and sess.assigned_ip:
+                    qos.remove_subscriber(sess.assigned_ip)
+                if cfg.nat_enabled and sess.assigned_ip:
+                    nat.release_nat(sess.assigned_ip, int(self.clock()))
+                if _acct is not None:
+                    _acct.stop(f"pppoe-{sess.session_id:04x}-"
+                               f"{sess.client_mac.hex()}")
+
+            c["pppoe"] = PPPoEServer(
+                PPPoEServerConfig(
+                    ac_name=cfg.pppoe_ac_name,
+                    service_name=cfg.pppoe_service_name,
+                    server_mac=parse_mac(cfg.server_mac),
+                    our_ip=ip_to_u32(cfg.server_ip),
+                    dns_primary=ip_to_u32(cfg.dns_primary),
+                    dns_secondary=ip_to_u32(cfg.dns_secondary),
+                    auth_proto=auth_proto),
+                verifier, _pppoe_alloc, release_ip=_pppoe_release,
+                on_open=_pppoe_open, on_close=_pppoe_close)
+            self.log.info("pppoe server", ac_name=cfg.pppoe_ac_name,
+                          auth=cfg.pppoe_auth,
+                          backend="radius" if "radius" in c else "local")
+
         # 10b. slow-path demux: the reference runs one socket+goroutine
         # per protocol server; here every PASSed frame lands on the ring's
         # one slow queue, so the engine's slow_path becomes a dispatcher
         # over whatever servers are enabled (v4 handled even alone)
-        if cfg.dhcpv6_enabled or cfg.slaac_enabled:
+        if cfg.dhcpv6_enabled or cfg.slaac_enabled or cfg.pppoe_enabled:
             from bng_tpu.control.slowpath import SlowPathDemux
 
             demux = c["slowpath"] = SlowPathDemux(
                 dhcp=dhcp, dhcpv6=c.get("dhcpv6"), slaac=c.get("slaac"),
-                clock=self.clock)
+                pppoe=c.get("pppoe"), clock=self.clock)
             c["engine"].slow_path = demux
 
         # 11. HA pair (main.go:759-881)
@@ -584,6 +719,18 @@ class BNGApp:
         if self.config.synthetic_subs:
             self._push_synthetic(ring)
         moved = self.components["engine"].process_ring_pipelined(ring)
+        demux = self.components.get("slowpath")
+        if demux is not None:
+            # PPPoE negotiation extras beyond the one-inline-reply slow
+            # contract (CHAP-Success + IPCP Conf-Req in one beat). A full
+            # TX ring re-queues the frame for the next beat (the FSM
+            # retransmit would recover anyway, but without the drop).
+            for frame in demux.drain_pending():
+                if ring.tx_inject(frame, from_access=True):
+                    moved += 1
+                else:
+                    demux._pending.append(frame)
+                    break
         if att is not None and att.xsk is not None:
             pumped += att.xsk.pump()  # verdicts -> kernel after the step
         return moved + pumped
@@ -607,19 +754,87 @@ class BNGApp:
             if not ring.rx_push(f, from_access=True):
                 break  # ring full: back off until the engine drains
 
+    # maintenance cadences (seconds): how often each slow sweep runs when
+    # tick() is called every second. Mirrors the reference's goroutine
+    # intervals: lease cleanup 60s (pkg/dhcp/server.go:1100), NAT expiry
+    # 60s (the bpf timeout sweep role), garden 30s, accounting interim
+    # honors its own interval so tick just has to fire it regularly.
+    EXPIRE_EVERY_S = 60.0
+    GARDEN_EVERY_S = 30.0
+    ACCT_SYNC_EVERY_S = 60.0
+    ACCT_RETRY_EVERY_S = 30.0
+
     def tick(self, now: float | None = None) -> None:
-        """Periodic cluster maintenance: standby reconnects (backoff) and
-        CRDT anti-entropy. The run loop calls this once a second; the
-        anti-entropy round honors the store's sync_interval (a full-digest
-        exchange per peer per second would be pure waste at scale)."""
+        """The run loop's 1 Hz maintenance heartbeat — every periodic
+        goroutine of the reference's runBNG collapsed into one driver:
+
+        - HA standby reconnect (backoff) + CRDT anti-entropy
+        - DHCP lease cleanup (server.go:1100-1163) and NAT session expiry
+          against device-authoritative last-seen (nat44.c:49-53 timeouts)
+        - RADIUS accounting interim + spool retry (accounting.go:410-497)
+        - walled-garden expiry checker (walledgarden/manager.go role)
+        - PPPoE keepalive/timeout sweep + SLAAC unsolicited RAs, whose
+          generated frames TX-inject on the ring (socket-write role)
+        """
         now = now if now is not None else self.clock()
-        ha = self.components.get("ha")
+        c = self.components
+        ha = c.get("ha")
         if ha is not None and hasattr(ha, "tick"):  # StandbySyncer only
             ha.tick(now)
-        cstore = self.components.get("cluster_store")
+        cstore = c.get("cluster_store")
         if cstore is not None and now - self._last_sync >= cstore.sync_interval:
             self._last_sync = now
             cstore.tick()
+
+        ring = c.get("ring")
+
+        # protocol-server ticks that EMIT frames: PPPoE echo/teardown,
+        # SLAAC periodic RAs. Without a ring (pure control-plane app, or
+        # tests poking tick directly) the frames are dropped — there is
+        # no wire to write to.
+        pppoe = c.get("pppoe")
+        if pppoe is not None:
+            for frame in pppoe.tick(now):
+                if ring is not None:
+                    ring.tx_inject(frame, from_access=True)
+        slaac = c.get("slaac")
+        if slaac is not None:
+            for frame in slaac.tick(now):
+                if ring is not None:
+                    ring.tx_inject(frame, from_access=True)
+
+        # slow sweeps on their own cadence
+        if now - self._last_expire >= self.EXPIRE_EVERY_S:
+            self._last_expire = now
+            c["dhcp"].cleanup_expired(int(now))
+            c["engine"].expire(int(now))
+        garden = c.get("walledgarden")
+        if garden is not None and now - self._last_garden >= self.GARDEN_EVERY_S:
+            self._last_garden = now
+            garden.check_expired()
+
+        acct = c.get("accounting")
+        if acct is not None:
+            # bridge device-authoritative NAT octet counters into the
+            # accounting sessions before interims fire, else every interim
+            # and stop reports zero usage (the reference reads its
+            # per-subscriber counters the same way before each interim)
+            if acct.sessions and now - self._last_acct_sync >= self.ACCT_SYNC_EVERY_S:
+                self._last_acct_sync = now
+                octets = c["engine"].nat.subscriber_octets(
+                    c["engine"].fetch_session_vals())
+                for s in list(acct.sessions.values()):
+                    got = octets.get(s.framed_ip)
+                    if got is not None:
+                        acct.update_counters(s.session_id, got[0], got[1],
+                                             got[2], got[3])
+            acct.interim_tick(now)
+            # spool retries are blocking sends (timeout x retries per
+            # record): run them on their own cadence, not 1 Hz, or a dead
+            # accounting server stalls the whole heartbeat
+            if now - self._last_acct_retry >= self.ACCT_RETRY_EVERY_S:
+                self._last_acct_retry = now
+                acct.retry_tick()
 
     def stats(self) -> dict:
         out = {"version": __version__, "node_id": self.config.node_id}

@@ -521,6 +521,28 @@ class NATManager:
             expired += 1
         return expired
 
+    def subscriber_octets(self, device_vals: np.ndarray | None = None
+                          ) -> dict[int, tuple[int, int, int, int]]:
+        """Per-subscriber (bytes_in, bytes_out, pkts_in, pkts_out) summed
+        over live sessions — the per-subscriber counter feed the reference
+        reads for interim accounting. device_vals: engine-fetched
+        device-authoritative rows (Engine.fetch_session_vals)."""
+        vals = device_vals if device_vals is not None else self.sessions.vals
+        occ = np.nonzero(self.sessions.used)[0]
+        if len(occ) == 0:
+            return {}
+        rows = vals[occ]
+        ips = rows[:, SV_ORIG_IP].astype(np.int64)
+        uniq, inv = np.unique(ips, return_inverse=True)
+        out: dict[int, tuple[int, int, int, int]] = {}
+        sums = [np.bincount(inv, weights=rows[:, w].astype(np.float64),
+                            minlength=len(uniq)).astype(np.int64)
+                for w in (SV_BYTES_IN, SV_BYTES_OUT, SV_PKTS_IN, SV_PKTS_OUT)]
+        for i, ip in enumerate(uniq):
+            out[int(ip)] = (int(sums[0][i]), int(sums[1][i]),
+                            int(sums[2][i]), int(sums[3][i]))
+        return out
+
     # -- hairpin / ALG config --
     def add_hairpin_ip(self, ip: int) -> None:
         free = np.nonzero(self.hairpin == 0)[0]

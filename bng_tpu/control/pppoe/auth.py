@@ -76,6 +76,49 @@ class LocalVerifier:
                           reason="" if ok else "bad chap response")
 
 
+class RadiusVerifier:
+    """CredentialVerifier over a control.radius.client.RadiusClient
+    (auth.go's RADIUS mode): PAP maps to User-Password Access-Requests,
+    CHAP to CHAP-Password/CHAP-Challenge (client.authenticate_chap).
+    RADIUS attributes (Framed-IP, Filter-Id policy, Session-Timeout)
+    ride back in AuthResult.attributes for the session-open hooks."""
+
+    def __init__(self, client, mac_source=None):
+        self.client = client
+        # optional callable returning the CURRENT client MAC for
+        # Calling-Station-Id (the PPPoE server verifies per-frame; the
+        # verifier protocol doesn't carry the MAC)
+        self.mac_source = mac_source or (lambda: b"")
+
+    @staticmethod
+    def _result(username: str, res) -> AuthResult:
+        if res is None:  # every server timed out — fail closed
+            return AuthResult(ok=False, username=username,
+                              reason="radius timeout")
+        if not res.success:
+            return AuthResult(ok=False, username=username,
+                              reason=res.reply_message or "radius reject")
+        return AuthResult(ok=True, username=username, attributes={
+            "framed_ip": res.framed_ip,
+            "qos_policy": res.policy_name,
+            "session_timeout": res.session_timeout,
+            "idle_timeout": res.idle_timeout,
+            "radius_class": res.radius_class,
+        })
+
+    def verify_pap(self, username: str, password: bytes) -> AuthResult:
+        # raw bytes through: PAP passwords are arbitrary octets (RFC 1334)
+        res = self.client.authenticate(username, password,
+                                       mac=self.mac_source())
+        return self._result(username, res)
+
+    def verify_chap(self, username: str, ident: int, challenge: bytes,
+                    response: bytes) -> AuthResult:
+        res = self.client.authenticate_chap(username, ident, challenge,
+                                            response, mac=self.mac_source())
+        return self._result(username, res)
+
+
 @dataclass
 class RateLimiter:
     """Per-key auth attempt limiter (parity: auth.go:542-564)."""

@@ -125,33 +125,9 @@ class RadiusClient:
                 self.stats["failovers"] += 1
         return None
 
-    # ------------------------------------------------------------------
-    def authenticate(self, username: str, password: str = "",
-                     mac: bytes = b"", circuit_id: bytes = b"",
-                     nas_port: int = 0) -> AuthResult | None:
-        """PAP Access-Request. None = timeout everywhere (parity: the
-        degraded-auth trigger for resilience.RADIUSHandler)."""
-        if not self._rate_limit():
-            return None
-        pkt = RadiusPacket(rp.ACCESS_REQUEST, self._next_id(),
-                           rp.new_request_authenticator())
-        pkt.add(rp.USER_NAME, username)
-        pkt.add(rp.NAS_IDENTIFIER, self.nas_identifier)
-        if self.nas_ip:
-            pkt.add(rp.NAS_IP_ADDRESS, self.nas_ip)
-        if nas_port:
-            pkt.add(rp.NAS_PORT, nas_port)
-        if mac:
-            pkt.add(rp.CALLING_STATION_ID, "-".join(f"{b:02X}" for b in mac))
-        if circuit_id:
-            pkt.add(rp.CALLED_STATION_ID, circuit_id)
-
-        got = self._exchange(pkt, lambda s: s.auth_port,
-                             password=password.encode())
-        if got is None:
-            self.stats["auth_timeout"] += 1
-            return None
-        resp, _ = got
+    def _auth_result(self, resp: RadiusPacket) -> AuthResult:
+        """Access-Accept/Reject -> AuthResult (+ ok/reject stats) —
+        shared by the PAP and CHAP request paths."""
         if resp.code == rp.ACCESS_ACCEPT:
             self.stats["auth_ok"] += 1
             return AuthResult(
@@ -167,6 +143,64 @@ class RadiusClient:
         self.stats["auth_reject"] += 1
         return AuthResult(success=False,
                           reply_message=resp.get_str(rp.REPLY_MESSAGE) or "")
+
+    # ------------------------------------------------------------------
+    def authenticate(self, username: str, password: str | bytes = "",
+                     mac: bytes = b"", circuit_id: bytes = b"",
+                     nas_port: int = 0) -> AuthResult | None:
+        """PAP Access-Request. None = timeout everywhere (parity: the
+        degraded-auth trigger for resilience.RADIUSHandler). password
+        accepts raw bytes: PAP passwords are arbitrary octets (RFC 1334)
+        and must not round-trip through text."""
+        if not self._rate_limit():
+            return None
+        pkt = RadiusPacket(rp.ACCESS_REQUEST, self._next_id(),
+                           rp.new_request_authenticator())
+        pkt.add(rp.USER_NAME, username)
+        pkt.add(rp.NAS_IDENTIFIER, self.nas_identifier)
+        if self.nas_ip:
+            pkt.add(rp.NAS_IP_ADDRESS, self.nas_ip)
+        if nas_port:
+            pkt.add(rp.NAS_PORT, nas_port)
+        if mac:
+            pkt.add(rp.CALLING_STATION_ID, "-".join(f"{b:02X}" for b in mac))
+        if circuit_id:
+            pkt.add(rp.CALLED_STATION_ID, circuit_id)
+
+        pw = password if isinstance(password, bytes) else password.encode()
+        got = self._exchange(pkt, lambda s: s.auth_port, password=pw)
+        if got is None:
+            self.stats["auth_timeout"] += 1
+            return None
+        resp, _ = got
+        return self._auth_result(resp)
+
+    def authenticate_chap(self, username: str, ident: int, challenge: bytes,
+                          response: bytes, mac: bytes = b"") -> AuthResult | None:
+        """CHAP Access-Request (RFC 2865 §2.2): CHAP-Password carries the
+        ident + the client's MD5 response; CHAP-Challenge carries the
+        challenge the AC issued. The PPPoE CHAP handler delegates here
+        when RADIUS is the credential backend (auth.go's radius mode).
+        None = timeout everywhere (degraded-auth trigger, like PAP)."""
+        if not self._rate_limit():
+            return None
+        pkt = RadiusPacket(rp.ACCESS_REQUEST, self._next_id(),
+                           rp.new_request_authenticator())
+        pkt.add(rp.USER_NAME, username)
+        pkt.add(rp.NAS_IDENTIFIER, self.nas_identifier)
+        if self.nas_ip:
+            pkt.add(rp.NAS_IP_ADDRESS, self.nas_ip)
+        if mac:
+            pkt.add(rp.CALLING_STATION_ID, "-".join(f"{b:02X}" for b in mac))
+        pkt.add(rp.CHAP_PASSWORD, bytes([ident & 0xFF]) + response)
+        pkt.add(rp.CHAP_CHALLENGE, challenge)
+
+        got = self._exchange(pkt, lambda s: s.auth_port)
+        if got is None:
+            self.stats["auth_timeout"] += 1
+            return None
+        resp, _ = got
+        return self._auth_result(resp)
 
     def send_accounting(self, session_id: str, status: int, username: str = "",
                         framed_ip: int = 0, input_octets: int = 0,

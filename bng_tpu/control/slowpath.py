@@ -42,6 +42,10 @@ class SlowPathDemux:
         self.clock = clock or time.time
         self.stats = {"dhcp4": 0, "dhcp6": 0, "slaac": 0, "pppoe": 0,
                       "unmatched": 0}
+        # PPPoE negotiation can emit several frames per input (e.g.
+        # CHAP-Success + IPCP Conf-Req); the ring's slow contract is one
+        # inline reply, the rest queue here for drain_pending()
+        self._pending: list[bytes] = []
 
     def __call__(self, frame: bytes) -> bytes | None:
         if len(frame) < 14:
@@ -51,9 +55,8 @@ class SlowPathDemux:
         if ethertype in (0x8863, 0x8864) and self.pppoe is not None:
             self.stats["pppoe"] += 1
             replies = self.pppoe.handle_frame(frame, self.clock())
-            # the ring's slow contract is one reply per frame; PPPoE
-            # negotiation can emit several — the first goes back inline,
-            # the rest ride the server's pending queue drained by tick()
+            # one reply rides back inline; extras queue for drain_pending()
+            self._pending.extend(replies[1:])
             return replies[0] if replies else None
         if ethertype == ETH_P_IPV6:
             reply = self._try_dhcpv6(frame)
@@ -73,6 +76,14 @@ class SlowPathDemux:
                 return reply
         self.stats["unmatched"] += 1
         return None
+
+    def drain_pending(self) -> list[bytes]:
+        """Frames beyond the one-reply-per-input ring contract (PPPoE
+        multi-frame negotiation); the composition root TX-injects these
+        every beat (drive_once) — the socket-write role of the
+        reference's per-protocol goroutines."""
+        out, self._pending = self._pending, []
+        return out
 
     def _try_dhcpv6(self, frame: bytes) -> bytes | None:
         """Eth/IPv6/UDP:547 -> DHCPv6Server.handle_message -> framed reply."""

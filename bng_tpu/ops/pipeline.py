@@ -67,6 +67,14 @@ class PipelineTables(NamedTuple):
     # discipline, walledgarden/manager.go:113-116)
     garden: TableState | None = None
     garden_allowed: jax.Array | None = None  # [D, 3]
+    # PPPoE session tables (ops/pppoe.py; control plane =
+    # control/pppoe/server.py). None = no PPPoE stage compiled in — an
+    # IPoE-only deployment pays nothing per batch. by_sid keys upstream
+    # decap (session id -> MAC/IP row), by_ip keys downstream encap
+    # (post-DNAT subscriber IP -> session row).
+    pppoe_by_sid: TableState | None = None
+    pppoe_by_ip: TableState | None = None
+    pppoe_server_mac: jax.Array | None = None  # [2] uint32 (hi16, lo32)
 
 
 class PipelineGeom(NamedTuple):
@@ -75,6 +83,7 @@ class PipelineGeom(NamedTuple):
     qos: QoSGeom
     spoof: AntispoofGeom
     garden: TableGeom | None = None
+    pppoe: TableGeom | None = None
 
 
 class PipelineResult(NamedTuple):
@@ -90,6 +99,7 @@ class PipelineResult(NamedTuple):
     nat_punt: jax.Array  # [B] bool — new flow, host must create session
     spoof_violation: jax.Array  # [B] bool — host audit log
     garden_stats: jax.Array | None = None  # [GARDEN_NSTATS] when gated
+    pppoe_stats: jax.Array | None = None  # [PPPOE_NSTATS] when PPPoE on
 
 
 def pipeline_step(
@@ -101,6 +111,27 @@ def pipeline_step(
     now_s: jax.Array,
     now_us: jax.Array,
 ) -> PipelineResult:
+    # --- PPPoE decap pre-stage (session-stage upstream data; the
+    # AC-termination role of pkg/pppoe/server.go:466-529, moved on-device
+    # for DATA frames — control negotiation stays host-side and reaches it
+    # via PASS lanes). Runs BEFORE the main parse so NAT/QoS/antispoof see
+    # the inner IPv4 packet; PPPoE control/discovery and unknown-session
+    # frames keep their original bytes, parse as non-IP, and fall through
+    # every later stage to VERDICT_PASS (the slow-path punt).
+    pppoe_dec = None
+    if tables.pppoe_by_sid is not None:
+        from bng_tpu.ops.parse import eth_vlan
+        from bng_tpu.ops.pppoe import pppoe_decap
+
+        vo, et = eth_vlan(pkt)
+        # access-side only: a session ethertype arriving from the core is
+        # foreign traffic — leave it untouched (PASS, host decides)
+        et_gated = jnp.where(from_access, et, 0)
+        pppoe_dec = pppoe_decap(pkt, length, vo, et_gated,
+                                tables.pppoe_by_sid, geom.pppoe)
+        pkt = jnp.where(pppoe_dec.done[:, None], pppoe_dec.out_pkt, pkt)
+        length = jnp.where(pppoe_dec.done, pppoe_dec.out_len, length)
+
     parsed = parse_batch(pkt, length)
 
     # --- antispoof (TC ingress on access side; antispoof.c:188-293) ---
@@ -148,16 +179,37 @@ def pipeline_step(
                       tables.qos_down, geom.qos, now_us)
     qos_drop = (up.dropped & from_access) | (down.dropped & ~from_access)
 
+    # --- PPPoE encap post-stage: downstream data whose post-DNAT dst is
+    # an OPEN PPPoE session gets its AC framing here (the reference builds
+    # these frames host-side per packet, pkg/pppoe/server.go; batched
+    # on-device they ride the same program). Applies to nat.out_pkt —
+    # dhcp_tx lanes are access-side and disjoint.
+    pppoe_enc = None
+    if tables.pppoe_by_ip is not None:
+        from bng_tpu.ops.pppoe import pppoe_encap
+
+        enc_et = jnp.where(~from_access, parsed.ethertype, 0)
+        pppoe_enc = pppoe_encap(nat.out_pkt, length, parsed.vlan_offset,
+                                enc_et, dnat_dst, tables.pppoe_by_ip,
+                                geom.pppoe, tables.pppoe_server_mac)
+
     # --- verdict combination (precedence: TX > DROP > FWD > PASS) ---
     drop = (spoof_drop | qos_drop | garden_drop) & ~dhcp_tx
+    fwd = nat_fwd
+    out_pkt = jnp.where(dhcp_tx[:, None], dhcp.out_pkt, nat.out_pkt)
+    out_len = jnp.where(dhcp_tx, dhcp.out_len, length)
+    if pppoe_enc is not None:
+        enc_done = pppoe_enc.done & ~drop & ~dhcp_tx
+        out_pkt = jnp.where(enc_done[:, None], pppoe_enc.out_pkt, out_pkt)
+        out_len = jnp.where(enc_done, pppoe_enc.out_len, out_len)
+        # an encapsulated frame forwards even when NAT left it untouched
+        # (routed/IPoE-free deployments still need the PPP framing)
+        fwd = fwd | enc_done
     verdict = jnp.where(
         dhcp_tx, VERDICT_TX,
         jnp.where(drop, VERDICT_DROP,
-                  jnp.where(nat_fwd, VERDICT_FWD, VERDICT_PASS)),
+                  jnp.where(fwd, VERDICT_FWD, VERDICT_PASS)),
     ).astype(jnp.int32)
-
-    out_pkt = jnp.where(dhcp_tx[:, None], dhcp.out_pkt, nat.out_pkt)
-    out_len = jnp.where(dhcp_tx, dhcp.out_len, length)
 
     # NAT accounting only for lanes that actually forward: a packet the
     # pipeline drops (QoS/antispoof) must not advance session counters
@@ -182,4 +234,7 @@ def pipeline_step(
         nat_punt=nat_punt,
         spoof_violation=spoof.violation,
         garden_stats=garden_stats,
+        pppoe_stats=(None if pppoe_dec is None else
+                     pppoe_dec.stats + (0 if pppoe_enc is None
+                                        else pppoe_enc.stats)),
     )

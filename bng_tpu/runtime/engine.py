@@ -35,6 +35,7 @@ from bng_tpu.control.nat import NATManager, apply_nat_updates
 from bng_tpu.ops.antispoof import ANTISPOOF_NSTATS, AntispoofGeom
 from bng_tpu.ops.dhcp import NSTATS as DHCP_NSTATS
 from bng_tpu.ops.nat44 import NAT_NSTATS
+from bng_tpu.ops.pppoe import PPPOE_NSTATS
 from bng_tpu.ops.pipeline import (
     PipelineGeom,
     PipelineResult,
@@ -50,7 +51,8 @@ from bng_tpu.ops.antispoof import ANTISPOOF_WORDS
 from bng_tpu.ops.qtable import HostQTable, QTableGeom, apply_qupdate
 from bng_tpu.ops.table import HostTable, TableGeom, apply_update
 from bng_tpu.runtime.ring import FLAG_DHCP_CTRL
-from bng_tpu.runtime.tables import FastPathTables, apply_fastpath_updates
+from bng_tpu.runtime.tables import (FastPathTables, PPPoEFastPathTables,
+                                    apply_fastpath_updates)
 
 # default per-lane packet slot: a full MTU frame (1500) + headroom for
 # QinQ/PPPoE encap, like the reference's XDP frame slot. Engines that only
@@ -59,11 +61,19 @@ PKT_SLOT = 1536
 
 
 def _apply_all_updates(tables: PipelineTables, upd) -> PipelineTables:
-    fp_upd, nat_upd, qup, qdown, sp_upd, sp_ranges, sp_config, *garden = upd
+    """upd layout: 7 mandatory entries + optional named tails —
+    garden (garden_upd, allowed_rows) then pppoe (sid_upd, ip_upd) — each
+    present exactly when the corresponding device stage is compiled in."""
+    fp_upd, nat_upd, qup, qdown, sp_upd, sp_ranges, sp_config, *tails = upd
+    tails = list(tails)
     g_state, g_allowed = tables.garden, tables.garden_allowed
-    if garden:  # (garden_upd, allowed_rows) when the device gate is on
-        g_state = apply_update(tables.garden, garden[0])
-        g_allowed = garden[1]
+    if tables.garden is not None:
+        g_state = apply_update(tables.garden, tails.pop(0))
+        g_allowed = tails.pop(0)
+    p_sid, p_ip = tables.pppoe_by_sid, tables.pppoe_by_ip
+    if p_sid is not None:
+        p_sid = apply_update(p_sid, tails.pop(0))
+        p_ip = apply_update(p_ip, tails.pop(0))
     return PipelineTables(
         dhcp=apply_fastpath_updates(tables.dhcp, fp_upd),
         nat=apply_nat_updates(tables.nat, nat_upd),
@@ -74,6 +84,9 @@ def _apply_all_updates(tables: PipelineTables, upd) -> PipelineTables:
         spoof_config=sp_config,
         garden=g_state,
         garden_allowed=g_allowed,
+        pppoe_by_sid=p_sid,
+        pppoe_by_ip=p_ip,
+        pppoe_server_mac=tables.pppoe_server_mac,
     )
 
 
@@ -135,6 +148,8 @@ class EngineStats:
     spoof: np.ndarray = field(default_factory=lambda: np.zeros(ANTISPOOF_NSTATS, dtype=np.uint64))
     # device walled-garden gate: [gated_drops, allowed_hits] (ops/garden.py)
     garden: np.ndarray = field(default_factory=lambda: np.zeros(2, dtype=np.uint64))
+    # device PPPoE decap/encap (ops/pppoe.py)
+    pppoe: np.ndarray = field(default_factory=lambda: np.zeros(PPPOE_NSTATS, dtype=np.uint64))
     batches: int = 0
     tx: int = 0
     fwd: int = 0
@@ -283,6 +298,7 @@ class Engine:
         qos: QoSTables | None = None,
         antispoof: AntispoofTables | None = None,
         garden: "GardenTables | None" = None,
+        pppoe: "PPPoEFastPathTables | None" = None,
         batch_size: int = 256,
         pkt_slot: int = PKT_SLOT,
         slow_path: Callable[[bytes], bytes | None] | None = None,
@@ -298,6 +314,10 @@ class Engine:
         # composition root passes GardenTables only when the walled garden
         # is enabled (nil-safe optional maps, manager.go:113-116 role)
         self.garden = garden
+        # None = no PPPoE stage in the compiled pipeline (IPoE-only
+        # deployments pay nothing); the composition root passes
+        # PPPoEFastPathTables when the PPPoE server is constructed
+        self.pppoe = pppoe
         self.B = batch_size
         self.L = pkt_slot
         self.slow_path = slow_path
@@ -312,34 +332,16 @@ class Engine:
             dhcp=fastpath.geom, nat=nat.geom, qos=self.qos.geom,
             spoof=self.antispoof.geom,
             garden=self.garden.geom if self.garden else None,
+            pppoe=self.pppoe.geom if self.pppoe else None,
         )
-        self.tables: PipelineTables = PipelineTables(
-            dhcp=fastpath.device_tables(),
-            nat=nat.device_tables(),
-            qos_up=self.qos.up.device_state(),
-            qos_down=self.qos.down.device_state(),
-            spoof=self.antispoof.bindings.device_state(),
-            spoof_ranges=jnp.asarray(self.antispoof.ranges),
-            spoof_config=jnp.asarray(self.antispoof.config),
-            garden=(self.garden.subscribers.device_state()
-                    if self.garden else None),
-            garden_allowed=(jnp.asarray(self.garden.allowed)
-                            if self.garden else None),
-        )
+        self.tables: PipelineTables = self._device_tables()
         # jit cache is keyed on geometry so Engine instances with identical
         # table shapes share one compile (tests build many engines)
         self._step = _pipeline_jit(self.geom)
         self._dhcp_step = _dhcp_jit(fastpath.geom)
 
-    def resync_tables(self) -> None:
-        """Full device re-upload after a bulk host-table build.
-
-        A large bulk_insert abandons bounded-delta tracking (_dirty_all);
-        this refreshes every device table from the host mirrors so the
-        next step proceeds. Device-authoritative state written since the
-        last upload (QoS tokens, NAT/session counters) resets to the host
-        view — bulk installs are a provisioning-time operation."""
-        self.tables = PipelineTables(
+    def _device_tables(self) -> PipelineTables:
+        return PipelineTables(
             dhcp=self.fastpath.device_tables(),
             nat=self.nat.device_tables(),
             qos_up=self.qos.up.device_state(),
@@ -351,7 +353,23 @@ class Engine:
                     if self.garden else None),
             garden_allowed=(jnp.asarray(self.garden.allowed)
                             if self.garden else None),
+            pppoe_by_sid=(self.pppoe.by_sid.device_state()
+                          if self.pppoe else None),
+            pppoe_by_ip=(self.pppoe.by_ip.device_state()
+                         if self.pppoe else None),
+            pppoe_server_mac=(jnp.asarray(self.pppoe.server_mac)
+                              if self.pppoe else None),
         )
+
+    def resync_tables(self) -> None:
+        """Full device re-upload after a bulk host-table build.
+
+        A large bulk_insert abandons bounded-delta tracking (_dirty_all);
+        this refreshes every device table from the host mirrors so the
+        next step proceeds. Device-authoritative state written since the
+        last upload (QoS tokens, NAT/session counters) resets to the host
+        view — bulk installs are a provisioning-time operation."""
+        self.tables = self._device_tables()
 
     def _drain_with_resync(self, drain):
         """Run a make-updates drain; on the bulk-build "full upload" signal
@@ -377,6 +395,9 @@ class Engine:
             jnp.asarray(self.antispoof.config),
             *((self.garden.subscribers.make_update(self.garden.update_slots),
                jnp.asarray(self.garden.allowed)) if self.garden else ()),
+            *((self.pppoe.by_sid.make_update(self.pppoe.update_slots),
+               self.pppoe.by_ip.make_update(self.pppoe.update_slots))
+              if self.pppoe else ()),
         ))
 
     def _pack_frames(self, frames: list[bytes], B: int):
@@ -583,6 +604,9 @@ class Engine:
         gs = getattr(res, "garden_stats", None)  # DHCP-only batches have none
         if gs is not None:
             self.stats.garden += np.asarray(gs, dtype=np.uint64)
+        ps = getattr(res, "pppoe_stats", None)
+        if ps is not None:
+            self.stats.pppoe += np.asarray(ps, dtype=np.uint64)
 
     def _run_step(self, pkt, length, fa, now_s, now_us) -> PipelineResult:
         """Dispatch + fold (the synchronous step both process paths use)."""
@@ -752,11 +776,30 @@ class Engine:
         self._inflight = None
         return self._retire(entry)
 
+    @staticmethod
+    def _strip_pppoe_host(frame: bytes) -> bytes:
+        """Host-side mirror of the device decap for NAT punt frames: the
+        punt handler sees the ORIGINAL ring bytes, which for a PPPoE
+        subscriber still carry the session framing the device stripped.
+        Returns the inner Ethernet+IPv4 view (or the frame unchanged)."""
+        off = 12
+        et = int.from_bytes(frame[off : off + 2], "big")
+        while et in (0x8100, 0x88A8) and len(frame) >= off + 8:
+            off += 4
+            et = int.from_bytes(frame[off : off + 2], "big")
+        if et != 0x8864 or len(frame) < off + 10:
+            return frame
+        if int.from_bytes(frame[off + 8 : off + 10], "big") != 0x0021:
+            return frame
+        return frame[:off] + b"\x08\x00" + frame[off + 10 :]
+
     def _punt_new_flow(self, frame: bytes, now: int) -> None:
         """Device egress-miss: create the session host-side (packet 1 of a
         new flow; parity with the conntrack-hybrid slow path)."""
         from bng_tpu.control import packets as P
 
+        if self.pppoe is not None:
+            frame = self._strip_pppoe_host(frame)
         try:
             d = P.decode(frame)
         except Exception:

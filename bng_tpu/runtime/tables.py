@@ -242,13 +242,19 @@ class PPPoEFastPathTables:
     """
 
     def __init__(self, nbuckets: int = 1 << 12, stash: int = 64,
-                 update_slots: int = 128):
+                 update_slots: int = 128,
+                 server_mac: bytes = b"\x02\xbb\x00\x00\x00\x01"):
         self.by_sid = HostTable(nbuckets, key_words=1, val_words=PPPOE_WORDS,
                                 stash=stash, name="pppoe_by_sid")
         self.by_ip = HostTable(nbuckets, key_words=1, val_words=PPPOE_WORDS,
                                stash=stash, name="pppoe_by_ip")
         self.geom = TableGeom(nbuckets, stash)
         self.update_slots = update_slots
+        # AC MAC, stamped as L2 source of every encapped downstream frame
+        # (pppoe_encap's server_mac argument — (hi16, lo32) words)
+        self.server_mac = np.array(
+            [int.from_bytes(server_mac[:2], "big"),
+             int.from_bytes(server_mac[2:], "big")], dtype=np.uint32)
 
     def session_up(self, sess) -> None:
         """on_open hook: publish an OPEN session to the device tables."""

@@ -290,3 +290,115 @@ class TestControlPlaneIntegration:
             "bng_tpu.control.pppoe.session", fromlist=["TerminateCause"]
         ).TerminateCause.ADMIN_RESET, now=2000.0)
         assert fp.by_sid.count == 0 and fp.by_ip.count == 0
+
+
+class TestEnginePipelinePPPoE:
+    """The PPPoE stage inside the fused Engine pipeline (runtime.engine
+    pppoe=): upstream session data decaps + SNATs in one program, the
+    downstream reply DNATs + re-encaps, and PPPoE control punts to the
+    slow path. The reference terminates PPP in userspace per packet
+    (pkg/pppoe/server.go:466-529); here only negotiation is host-side."""
+
+    WAN_IP = ip_to_u32("8.8.8.8")
+    PUB_IP = ip_to_u32("203.0.113.1")
+
+    def _engine(self):
+        from bng_tpu.control.nat import NATManager
+        from bng_tpu.runtime.engine import Engine
+        from bng_tpu.runtime.tables import (FastPathTables,
+                                            PPPoEFastPathTables)
+
+        fastpath = FastPathTables(sub_nbuckets=64, vlan_nbuckets=64,
+                                  cid_nbuckets=64, max_pools=4)
+        fastpath.set_server_config(AC_MAC, ip_to_u32("10.0.0.1"))
+        nat = NATManager(public_ips=[self.PUB_IP], sessions_nbuckets=256,
+                         sub_nat_nbuckets=64)
+        pp = PPPoEFastPathTables(nbuckets=64, stash=8, server_mac=AC_MAC)
+        engine = Engine(fastpath, nat, pppoe=pp, batch_size=4,
+                        clock=lambda: 1000.0)
+
+        class Sess:
+            session_id = SID
+            client_mac = CLIENT_MAC
+            assigned_ip = CLIENT_IP
+
+        pp.session_up(Sess())
+        nat.allocate_nat(CLIENT_IP, now=1000)
+        return engine, nat, pp
+
+    def _upstream(self):
+        return pppoe_data_frame()
+
+    def test_upstream_decap_then_nat_fastpath(self):
+        engine, nat, pp = self._engine()
+        up = self._upstream()
+
+        # packet 1: decap on device, NAT misses -> punt creates session
+        r1 = engine.process([up], from_access=True)
+        assert len(r1["slow"]) == 1
+        assert nat.sessions.count == 1
+        assert int(engine.stats.pppoe[P.PST_DECAP]) == 1
+
+        # packet 2: decap + SNAT fully on device
+        r2 = engine.process([up], from_access=True)
+        assert len(r2["fwd"]) == 1
+        _, out = r2["fwd"][0]
+        d = packets.decode(out)
+        assert d.ethertype == 0x0800  # PPPoE framing gone
+        assert d.src_ip == self.PUB_IP  # SNAT applied to the inner packet
+        assert d.dst_ip == self.WAN_IP
+
+        # the NAT session key is the INNER flow (decap before NAT)
+        skey = nat._key(CLIENT_IP, self.WAN_IP, 40000, 53, 17)
+        assert nat.sessions.lookup(skey) is not None
+
+    def test_downstream_dnat_then_encap(self):
+        engine, nat, pp = self._engine()
+        up = self._upstream()
+        engine.process([up], from_access=True)  # punt -> session
+        r2 = engine.process([up], from_access=True)
+        d = packets.decode(r2["fwd"][0][1])
+        pub_port = d.src_port
+
+        # reply from the WAN to the public mapping, core side
+        down = packets.udp_packet(
+            bytes.fromhex("02deadbeef99"), AC_MAC, self.WAN_IP,
+            self.PUB_IP, 53, pub_port, b"a" * 16)
+        r3 = engine.process([down], from_access=False)
+        assert len(r3["fwd"]) == 1
+        out = r3["fwd"][0][1]
+        # outer: PPPoE session framing to the subscriber MAC, from AC MAC
+        assert out[0:6] == CLIENT_MAC and out[6:12] == AC_MAC
+        assert int.from_bytes(out[12:14], "big") == codec.ETH_PPPOE_SESSION
+        pkt6 = codec.PPPoEPacket.decode(out[14:])
+        assert pkt6.session_id == SID
+        proto, inner = codec.parse_ppp(pkt6.payload)
+        assert proto == P.PPP_IPV4
+        # inner: DNAT back to the subscriber private IP
+        din = packets.decode(b"\x00" * 12 + b"\x08\x00" + inner)
+        assert din.dst_ip == CLIENT_IP
+        assert din.src_ip == self.WAN_IP
+        assert int(engine.stats.pppoe[P.PST_ENCAP]) == 1
+
+    def test_pppoe_control_punts_to_slow_path(self):
+        got = []
+
+        def slow(frame):
+            got.append(frame)
+            return None
+
+        engine, nat, pp = self._engine()
+        engine.slow_path = slow
+        padi = codec.eth_frame(
+            b"\xff" * 6, CLIENT_MAC, codec.ETH_PPPOE_DISCOVERY,
+            codec.PPPoEPacket(code=codec.CODE_PADI, session_id=0,
+                              payload=b"").encode())
+        r = engine.process([padi], from_access=True)
+        assert len(r["slow"]) == 1
+        assert got and got[0] == padi
+
+    def test_unknown_session_data_passes(self):
+        engine, nat, pp = self._engine()
+        frame = pppoe_data_frame(sid=0x999)
+        r = engine.process([frame], from_access=True)
+        assert len(r["slow"]) == 1 and not r["fwd"]

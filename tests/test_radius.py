@@ -38,10 +38,22 @@ class FakeRadiusServer:
         self.requests.append((host, port, req))
         if req.code == rp.ACCESS_REQUEST:
             user = req.get_str(rp.USER_NAME)
-            pw = decrypt_password(req.get(rp.USER_PASSWORD), self.secret,
-                                  req.authenticator).decode()
             entry = self.users.get(user)
-            if entry and entry["password"] == pw:
+            chap = req.get(rp.CHAP_PASSWORD)
+            if chap is not None:
+                # CHAP (RFC 2865 §2.2): octet 0 = ident, rest = MD5
+                # response over (ident || secret || challenge)
+                import hashlib
+
+                challenge = req.get(rp.CHAP_CHALLENGE) or b""
+                ok = entry is not None and chap[1:] == hashlib.md5(
+                    chap[:1] + entry["password"].encode() + challenge
+                ).digest()
+            else:
+                pw = decrypt_password(req.get(rp.USER_PASSWORD), self.secret,
+                                      req.authenticator).decode()
+                ok = entry is not None and entry["password"] == pw
+            if ok:
                 resp = RadiusPacket(rp.ACCESS_ACCEPT, req.id)
                 for t, v in entry.get("attrs", []):
                     resp.add(t, v)
@@ -283,3 +295,54 @@ class TestPolicies:
         adhoc = pm.from_radius_attributes(vendor_rate_down=5_000_000, vendor_rate_up=1_000_000)
         assert adhoc.download_bps == 5_000_000
         assert pm.from_radius_attributes(filter_id="nope") is None
+
+
+class TestCHAPAuth:
+    """authenticate_chap + the PPPoE RadiusVerifier bridge (auth.go's
+    RADIUS mode: CHAP-Password/CHAP-Challenge Access-Requests)."""
+
+    def test_chap_accept_and_reject(self):
+        import hashlib
+
+        srv = FakeRadiusServer(users={"alice": {"password": "pw123", "attrs": [
+            (rp.FRAMED_IP_ADDRESS, 0x0A000042), (rp.FILTER_ID, "gold")]}})
+        client = make_client(srv)
+        challenge = b"C" * 16
+        good = hashlib.md5(bytes([7]) + b"pw123" + challenge).digest()
+        res = client.authenticate_chap("alice", 7, challenge, good)
+        assert res is not None and res.success
+        assert res.framed_ip == 0x0A000042 and res.policy_name == "gold"
+        # wire shape: CHAP-Password = ident byte + response
+        _, _, req = srv.requests[-1]
+        assert req.get(rp.CHAP_PASSWORD) == bytes([7]) + good
+        assert req.get(rp.CHAP_CHALLENGE) == challenge
+
+        bad = client.authenticate_chap("alice", 7, challenge, b"x" * 16)
+        assert bad is not None and not bad.success
+
+    def test_pppoe_radius_verifier(self):
+        """CredentialVerifier protocol over the RADIUS client: what the
+        composition root installs when both PPPoE and RADIUS are on."""
+        from bng_tpu.control.pppoe.auth import RadiusVerifier, chap_md5
+
+        srv = FakeRadiusServer(users={"bob": {"password": "s3cret", "attrs": [
+            (rp.SESSION_TIMEOUT, 1800)]}})
+        v = RadiusVerifier(make_client(srv))
+
+        res = v.verify_pap("bob", b"s3cret")
+        assert res.ok and res.attributes["session_timeout"] == 1800
+        assert not v.verify_pap("bob", b"wrong").ok
+
+        ch = b"Z" * 16
+        ok = v.verify_chap("bob", 3, ch, chap_md5(3, b"s3cret", ch))
+        assert ok.ok and ok.username == "bob"
+        assert not v.verify_chap("bob", 3, ch, b"n" * 16).ok
+
+    def test_chap_timeout_fails_closed(self):
+        srv = FakeRadiusServer(drop_first=99)
+        client = make_client(srv)
+        assert client.authenticate_chap("x", 1, b"c" * 16, b"r" * 16) is None
+        from bng_tpu.control.pppoe.auth import RadiusVerifier
+
+        res = RadiusVerifier(client).verify_chap("x", 1, b"c" * 16, b"r" * 16)
+        assert not res.ok and "timeout" in res.reason
