@@ -725,11 +725,13 @@ class BNGApp:
             # contract (CHAP-Success + IPCP Conf-Req in one beat). A full
             # TX ring re-queues the frame for the next beat (the FSM
             # retransmit would recover anyway, but without the drop).
-            for frame in demux.drain_pending():
+            pending = demux.drain_pending()
+            for i, frame in enumerate(pending):
                 if ring.tx_inject(frame, from_access=True):
                     moved += 1
                 else:
-                    demux._pending.append(frame)
+                    # re-queue the WHOLE un-injected remainder in order
+                    demux._pending[:0] = pending[i:]
                     break
         if att is not None and att.xsk is not None:
             pumped += att.xsk.pump()  # verdicts -> kernel after the step
@@ -828,12 +830,14 @@ class BNGApp:
                     if got is not None:
                         acct.update_counters(s.session_id, got[0], got[1],
                                              got[2], got[3])
-            acct.interim_tick(now)
-            # spool retries are blocking sends (timeout x retries per
-            # record): run them on their own cadence, not 1 Hz, or a dead
-            # accounting server stalls the whole heartbeat
+            # interims and spool retries are blocking sends (timeout x
+            # retries per record/session): run both on their own cadence,
+            # not 1 Hz, or a dead accounting server stalls the whole
+            # heartbeat every second (interim_tick re-blocks for every
+            # still-due session until the server answers)
             if now - self._last_acct_retry >= self.ACCT_RETRY_EVERY_S:
                 self._last_acct_retry = now
+                acct.interim_tick(now)
                 acct.retry_tick()
 
     def stats(self) -> dict:

@@ -213,7 +213,11 @@ class ShardedCluster:
         self._step = _sharded_step_jit(self.mesh, self.geom, self.n)
         self._dhcp_step = _sharded_dhcp_jit(self.mesh, self.geom, self.n)
         self.tables = None  # lazily built on first step / sync()
-        self._ring_bufs = None  # process_ring staging (lazy)
+        # ping-pong ring staging: the in-flight batch owns one buffer set
+        # while the next assembles into the other (Engine._staging role)
+        self._ring_bufs = [None, None]
+        self._stage_idx = 0
+        self._inflight = None  # process_ring_pipelined window
         # per-step psum deltas folded by process_ring (Engine.stats role)
         self.stats: dict = {"slow_errors": 0}
 
@@ -458,14 +462,9 @@ class ShardedCluster:
             per_shard.append(t)
         self.tables = self._stack_per_shard(per_shard)
 
-    def dhcp_step(self, pkt: np.ndarray, length: np.ndarray, now_s: int):
-        """One sharded DHCP-only step (the control-batch fast lane).
-
-        Same layout contract as step(); only the fastpath update drain
-        runs, and the shared dhcp table leaves thread through donated —
-        NAT/QoS/antispoof deltas stay queued for the next fused step.
-        Returns {"is_reply", "out_pkt", "out_len", "dhcp_stats"}.
-        """
+    def _dispatch_dhcp(self, pkt, length, now_s: int):
+        """device_put + fastpath drain + donated sharded DHCP step.
+        Outputs stay device futures (async half)."""
         if self.tables is None:
             self.sync_tables()
         sh = NamedSharding(self.mesh, P(AXIS))
@@ -475,6 +474,38 @@ class ShardedCluster:
         dhcp1, is_reply, out_pkt, out_len, stats = self._dhcp_step(
             self.tables.dhcp, upd, pkt_d, len_d, jnp.uint32(now_s))
         self.tables = self.tables._replace(dhcp=dhcp1)
+        return is_reply, out_pkt, out_len, stats
+
+    def _dispatch_fused(self, pkt, length, from_access, now_s: int,
+                        now_us: int):
+        """device_put + full drain + donated sharded step. The ONE owner
+        of the drain-before-tables-read donation invariant; outputs stay
+        device futures (async half)."""
+        if self.tables is None:
+            self.sync_tables()
+        sh = NamedSharding(self.mesh, P(AXIS))
+        pkt_d = jax.device_put(pkt, sh)
+        len_d = jax.device_put(length.astype(np.uint32), sh)
+        fa_d = jax.device_put(from_access, sh)
+        # drain FIRST: a bulk-build resync rebinds self.tables, and Python
+        # evaluates arguments left-to-right — reading self.tables before
+        # the drain would pass (and donate) the stale pre-resync reference
+        upd = self._drain_updates()
+        raw = self._step(self.tables, upd, pkt_d, len_d, fa_d,
+                         jnp.uint32(now_s), jnp.uint32(now_us))
+        self.tables = raw[3]
+        return raw
+
+    def dhcp_step(self, pkt: np.ndarray, length: np.ndarray, now_s: int):
+        """One sharded DHCP-only step (the control-batch fast lane).
+
+        Same layout contract as step(); only the fastpath update drain
+        runs, and the shared dhcp table leaves thread through donated —
+        NAT/QoS/antispoof deltas stay queued for the next fused step.
+        Returns {"is_reply", "out_pkt", "out_len", "dhcp_stats"}.
+        """
+        is_reply, out_pkt, out_len, stats = self._dispatch_dhcp(
+            pkt, length, now_s)
         return {
             "is_reply": np.asarray(is_reply),
             "out_pkt": out_pkt,
@@ -508,36 +539,129 @@ class ShardedCluster:
             raise ValueError(
                 f"pkt_slot {pkt_slot} < ring frame_size {ring.frame_size}: "
                 f"oversize frames would be silently truncated")
-        B = self.n * self.b
-        if self._ring_bufs is None or self._ring_bufs[0].shape != (B, pkt_slot):
-            self._ring_bufs = (np.zeros((B, pkt_slot), dtype=np.uint8),
-                               np.zeros((B,), dtype=np.uint32),
-                               np.zeros((B,), dtype=np.uint32))
-        pkt, length, flags = self._ring_bufs
+        if self._inflight is not None:
+            # a pipelined batch holds one of its ring's assemble windows;
+            # retire it — WITH this call's handlers, or its PASS frames
+            # would pop from the slow ring and vanish (Engine parity)
+            self.flush_pipeline(slow_path, violation_sink)
+        pkt, length, flags = self._staging(self._stage_idx, pkt_slot)
         got = ring.assemble_sharded(pkt, length, flags)
         if not got:
             return 0
-        from bng_tpu.runtime.ring import FLAG_DHCP_CTRL, VERDICT_PASS, VERDICT_TX
+        entry = self._dispatch_ring_batch(ring, pkt, length, flags, got,
+                                          now_s, now_us)
+        self._retire(entry, slow_path, violation_sink)
+        return got
+
+    def process_ring_pipelined(self, ring, now_s: int, now_us: int,
+                               pkt_slot: int = 2048, slow_path=None,
+                               violation_sink=None) -> int:
+        """Double-buffered multichip ring loop: dispatch batch k+1, THEN
+        retire k — host demux overlaps device execution, the same
+        two-window design as Engine.process_ring_pipelined (engine.py)
+        which the single-chip path uses to hold latency at load. Requires
+        ring backends tolerating two outstanding assemble..complete
+        windows (bngring MAX_INFLIGHT=2; complete() retires FIFO in this
+        loop's order). Call flush_pipeline() before reading final state.
+        Returns frames retired this call."""
+        if pkt_slot < ring.frame_size:
+            raise ValueError(
+                f"pkt_slot {pkt_slot} < ring frame_size {ring.frame_size}: "
+                f"oversize frames would be silently truncated")
+        prev = self._inflight
+        self._inflight = None
+        try:
+            # 1. feed the mesh first: assemble into the buffer prev is NOT
+            # using, so its frames stay intact until retirement
+            idx = 1 - self._stage_idx
+            pkt, length, flags = self._staging(idx, pkt_slot)
+            got = ring.assemble_sharded(pkt, length, flags)
+            if got:
+                try:
+                    entry = self._dispatch_ring_batch(
+                        ring, pkt, length, flags, got, now_s, now_us)
+                except BaseException:
+                    # fail closed: the assemble opened a ring window that
+                    # must not wedge. complete() retires FIFO, so the
+                    # previous (older) window must retire FIRST.
+                    from bng_tpu.runtime.ring import VERDICT_DROP
+
+                    self._retire(prev, slow_path, violation_sink)
+                    prev = None
+                    B = self.n * self.b
+                    ring.complete(np.full((B,), VERDICT_DROP, dtype=np.uint8),
+                                  pkt, length, B)
+                    raise
+                self._inflight = entry
+                self._stage_idx = idx
+        finally:
+            # 2. retire the previous batch (even if dispatch raised) while
+            # the mesh runs the new one
+            retired = self._retire(prev, slow_path, violation_sink)
+        return retired
+
+    def flush_pipeline(self, slow_path=None, violation_sink=None) -> int:
+        """Retire any in-flight pipelined batch (shutdown/test barrier)."""
+        entry = self._inflight
+        self._inflight = None
+        return self._retire(entry, slow_path, violation_sink)
+
+    def _staging(self, idx: int, pkt_slot: int):
+        B = self.n * self.b
+        if self._ring_bufs[idx] is None or \
+                self._ring_bufs[idx][0].shape != (B, pkt_slot):
+            self._ring_bufs[idx] = (np.zeros((B, pkt_slot), dtype=np.uint8),
+                                    np.zeros((B,), dtype=np.uint32),
+                                    np.zeros((B,), dtype=np.uint32))
+        return self._ring_bufs[idx]
+
+    def _dispatch_ring_batch(self, ring, pkt, length, flags, got,
+                             now_s: int, now_us: int):
+        """Dispatch one assembled window to the mesh WITHOUT forcing the
+        outputs (they stay device futures until _retire) — the async half
+        of the beat, so a pipelined caller overlaps demux with compute."""
+        from bng_tpu.runtime.ring import FLAG_DHCP_CTRL
 
         real = length > 0
         all_ctrl = bool(((flags[real] & FLAG_DHCP_CTRL) != 0).all())
         if all_ctrl:  # the multichip OFFER-latency fast lane
-            out = self.dhcp_step(pkt, length, now_s)
-            verdict = np.where(out["is_reply"], np.uint8(VERDICT_TX),
+            is_reply, out_pkt, out_len, stats = self._dispatch_dhcp(
+                pkt, length, now_s)
+            out = ("dhcp", is_reply, out_pkt, out_len, stats)
+        else:
+            out = ("fused", self._dispatch_fused(
+                pkt, length, (flags & 0x1) != 0, now_s, now_us))
+        return (ring, out, pkt, length, got, now_s)
+
+    def _retire(self, entry, slow_path, violation_sink) -> int:
+        """Force a dispatched window's outputs and demux verdicts back to
+        its ring (the sync half of the beat)."""
+        if entry is None:
+            return 0
+        from bng_tpu.runtime.ring import VERDICT_PASS, VERDICT_TX
+
+        ring, out, pkt, length, got, now_s = entry
+        B = self.n * self.b
+        real = length > 0
+        if out[0] == "dhcp":
+            _, is_reply, out_pkt, out_len, stats = out
+            verdict = np.where(np.asarray(is_reply), np.uint8(VERDICT_TX),
                                np.uint8(VERDICT_PASS))
-            out_pkt, out_len = out["out_pkt"], out["out_len"]
             punt = np.zeros((B,), dtype=bool)
             viol = np.zeros((B,), dtype=bool)
-            self._fold_stats(dhcp=out["dhcp_stats"])
+            self._fold_stats(dhcp=np.asarray(stats))
         else:
-            out = self.step(pkt, length, (flags & 0x1) != 0, now_s, now_us)
-            verdict = out["verdict"].astype(np.uint8)
-            out_pkt, out_len = out["out_pkt"], out["out_len"]
-            punt = out["nat_punt"]
-            viol = out["violation"]
-            self._fold_stats(dhcp=out["dhcp_stats"], nat=out["nat_stats"],
-                             qos=out["qos_stats"], spoof=out["spoof_stats"],
-                             garden=out.get("garden_stats"))
+            (verdict_d, out_pkt, out_len, _tables, dhcp_stats, nat_stats,
+             qos_stats, spoof_stats, nat_punt, viol_d, *garden_stats) = out[1]
+            verdict = np.asarray(verdict_d).astype(np.uint8)
+            punt = np.asarray(nat_punt)
+            viol = np.asarray(viol_d)
+            self._fold_stats(dhcp=np.asarray(dhcp_stats),
+                             nat=np.asarray(nat_stats),
+                             qos=np.asarray(qos_stats),
+                             spoof=np.asarray(spoof_stats),
+                             garden=(np.asarray(garden_stats[0])
+                                     if garden_stats else None))
         ring.complete(verdict, np.asarray(out_pkt),
                       np.asarray(out_len).astype(np.uint32), B)
 
@@ -596,21 +720,9 @@ class ShardedCluster:
         Returns (verdict, out_pkt, out_len, stats tuple...) — batch-sharded
         outputs are fetched to host.
         """
-        if self.tables is None:
-            self.sync_tables()
-        sh = NamedSharding(self.mesh, P(AXIS))
-        pkt_d = jax.device_put(pkt, sh)
-        len_d = jax.device_put(length.astype(np.uint32), sh)
-        fa_d = jax.device_put(from_access, sh)
-        # drain FIRST: a bulk-build resync rebinds self.tables, and Python
-        # evaluates arguments left-to-right — reading self.tables before
-        # the drain would pass (and donate) the stale pre-resync reference
-        upd = self._drain_updates()
-        out = self._step(self.tables, upd, pkt_d, len_d, fa_d,
-                         jnp.uint32(now_s), jnp.uint32(now_us))
-        (verdict, out_pkt, out_len, new_tables, dhcp_stats, nat_stats,
+        out = self._dispatch_fused(pkt, length, from_access, now_s, now_us)
+        (verdict, out_pkt, out_len, _new_tables, dhcp_stats, nat_stats,
          qos_stats, spoof_stats, nat_punt, viol, *garden_stats) = out
-        self.tables = new_tables
         return {
             "verdict": np.asarray(verdict),
             "out_pkt": out_pkt,

@@ -644,3 +644,89 @@ class TestClusterRingLoop:
         assert ring.rx_push(flow2, from_access=True)
         cl.process_ring(ring, self.T0 + 5, 5_000_000)
         assert ring.fwd_pending() == 1  # packet 2 SNATs on device
+
+
+class TestClusterRingPipelined:
+    """Double-buffered multichip ring loop (VERDICT r4 weak #4): the
+    sharded production beat overlaps host demux with mesh execution the
+    same way Engine.process_ring_pipelined does for one chip."""
+
+    T0 = 1_753_000_000
+
+    def _cluster(self):
+        cl = ShardedCluster(2, batch_per_shard=8)
+        cl.set_server_config_all(bytes.fromhex("02aabbccdd01"),
+                                 ip_to_u32("10.0.0.1"))
+        cl.add_pool_all(1, ip_to_u32("10.0.0.0"), 24, ip_to_u32("10.0.0.1"),
+                        lease_time=3600)
+        mac = bytes.fromhex("02c0ffee0099")
+        sub_ip = ip_to_u32("10.0.0.77")
+        cl.add_subscriber(mac, pool_id=1, ip=sub_ip,
+                          lease_expiry=self.T0 + 600)
+        cl.sync_tables()
+        return cl, mac, sub_ip
+
+    def _discover(self, mac, xid):
+        p = dhcp_codec.build_request(mac, dhcp_codec.DISCOVER, xid=xid)
+        return packets.udp_packet(mac, b"\xff" * 6, 0, 0xFFFFFFFF, 68, 67,
+                                  p.encode().ljust(320, b"\x00"))
+
+    def test_two_window_overlap_and_flush(self):
+        cl, mac, sub_ip = self._cluster()
+        ring = cl.make_ring(nframes=256, frame_size=2048, depth=64)
+
+        # call 1: dispatches batch A, retires nothing (pipe filling) —
+        # the overlap evidence: A's verdicts are NOT on the ring yet
+        assert ring.rx_push(self._discover(mac, 1), from_access=True)
+        assert cl.process_ring_pipelined(ring, self.T0 + 1, 1_000_000) == 0
+        assert ring.tx_pop() is None
+        assert cl._inflight is not None
+
+        # call 2: dispatches batch B, then retires A (device OFFER on TX)
+        assert ring.rx_push(self._discover(mac, 2), from_access=True)
+        assert cl.process_ring_pipelined(ring, self.T0 + 2, 2_000_000) == 1
+        got = ring.tx_pop()
+        assert got is not None
+        reply = dhcp_codec.decode(bytes(got[0])[42:])
+        assert reply.op == 2 and reply.xid == 1
+
+        # flush retires the tail window; idempotent after
+        assert cl.flush_pipeline() == 1
+        got2 = ring.tx_pop()
+        assert got2 is not None and dhcp_codec.decode(
+            bytes(got2[0])[42:]).xid == 2
+        assert cl.flush_pipeline() == 0
+        # empty beats are no-ops and leak no window
+        assert cl.process_ring_pipelined(ring, self.T0 + 3, 3_000_000) == 0
+        assert cl._inflight is None
+        # sync path still works after pipelined use (window accounting)
+        assert ring.rx_push(self._discover(mac, 3), from_access=True)
+        assert cl.process_ring(ring, self.T0 + 4, 4_000_000) == 1
+        assert ring.tx_pending() == 1
+
+    def test_pipelined_dispatch_failure_fails_closed(self):
+        cl, mac, sub_ip = self._cluster()
+        ring = cl.make_ring(nframes=256, frame_size=2048, depth=64)
+        assert ring.rx_push(self._discover(mac, 1), from_access=True)
+        assert cl.process_ring_pipelined(ring, self.T0 + 1, 1_000_000) == 0
+
+        real_step, real_dhcp = cl._step, cl._dhcp_step
+
+        def boom(*a, **k):
+            raise RuntimeError("synthetic device error")
+
+        cl._step = boom
+        cl._dhcp_step = boom
+        assert ring.rx_push(self._discover(mac, 2), from_access=True)
+        with pytest.raises(RuntimeError, match="synthetic"):
+            cl.process_ring_pipelined(ring, self.T0 + 2, 2_000_000)
+        cl._step, cl._dhcp_step = real_step, real_dhcp
+
+        # batch A's OFFER still arrived (FIFO retire before fail-close);
+        # batch B dropped fail-closed; no window leaked
+        got = ring.tx_pop()
+        assert got is not None
+        assert dhcp_codec.decode(bytes(got[0])[42:]).xid == 1
+        assert cl._inflight is None
+        assert ring.rx_push(self._discover(mac, 3), from_access=True)
+        assert cl.process_ring(ring, self.T0 + 3, 3_000_000) == 1
