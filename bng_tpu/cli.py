@@ -81,6 +81,18 @@ class BNGConfig:
     ha_peer: str = ""  # active's cluster URL (http://host:port) for standbys
     # clustering (control/cluster_http.py wire)
     cluster_listen: str = ""  # "host:port" ("" = no listener; port 0 = any)
+    # cluster-wire TLS (pkg/ha/sync.go:151-185 role). Listener side:
+    # cert+key -> the cluster listener speaks TLS; client-ca -> demands
+    # verified client certs (mTLS). Client side (ha_peer/store_peers over
+    # https): ca/pins verify the peer, client cert+key is our identity.
+    cluster_tls_cert: str = ""
+    cluster_tls_key: str = ""
+    cluster_tls_client_ca: str = ""
+    cluster_tls_ca: str = ""
+    cluster_tls_pins: list = dataclasses.field(default_factory=list)
+    cluster_tls_server_name: str = ""
+    cluster_tls_client_cert: str = ""
+    cluster_tls_client_key: str = ""
     store_mode: str = "memory"  # memory | read | write (control/crdt.py)
     store_peers: list = dataclasses.field(default_factory=list)  # peer URLs
     # BGP
@@ -576,7 +588,8 @@ class BNGApp:
                     def _peer():
                         return HTTPActiveProxy(
                             cfg.ha_peer,
-                            on_stream_end=lambda: c["ha"].disconnect())
+                            on_stream_end=lambda: c["ha"].disconnect(),
+                            tls=self._cluster_client_tls())
                 else:
                     def _peer():
                         raise ConnectionError(
@@ -593,12 +606,23 @@ class BNGApp:
             cstore = c["cluster_store"] = DistributedStore(
                 cfg.node_id, mode=cfg.store_mode, clock=self.clock)
             for url in cfg.store_peers:
-                cstore.add_peer(HTTPStorePeer(url))
+                cstore.add_peer(HTTPStorePeer(
+                    url, tls=(self._cluster_client_tls()
+                              if url.startswith("https") else None)))
         if cfg.cluster_listen:
             from bng_tpu.control.cluster_http import ClusterServer
 
+            server_tls = None
+            if cfg.cluster_tls_cert or cfg.cluster_tls_key:
+                from bng_tpu.control.ztp_tls import ServerTLSConfig
+
+                server_tls = ServerTLSConfig(
+                    cert_file=cfg.cluster_tls_cert,
+                    key_file=cfg.cluster_tls_key,
+                    client_ca_file=cfg.cluster_tls_client_ca)
             host, _, port = cfg.cluster_listen.rpartition(":")
-            srv = ClusterServer(host or "127.0.0.1", int(port or 0))
+            srv = ClusterServer(host or "127.0.0.1", int(port or 0),
+                                tls=server_tls)
             if cfg.ha_role == "active":
                 srv.mount_ha(c["ha"])
             if "cluster_store" in c:
@@ -693,6 +717,28 @@ class BNGApp:
             collector.add_source(lambda: metrics.collect_pools(
                 {str(pid): st for pid, st in pool_mgr.stats().items()}))
             self._on_close(collector.stop)
+
+    def _cluster_client_tls(self):
+        """Client-side TLSConfig for https cluster peers, or None when no
+        TLS material is configured (plaintext peers keep working)."""
+        cfg = self.config
+        if not (cfg.cluster_tls_ca or cfg.cluster_tls_pins
+                or cfg.cluster_tls_client_cert):
+            return None
+        from bng_tpu.control.ztp_tls import TLSConfig
+
+        return TLSConfig(
+            ca_cert_file=cfg.cluster_tls_ca,
+            pinned_certs=list(cfg.cluster_tls_pins),
+            server_name=cfg.cluster_tls_server_name,
+            # pins without a CA: self-signed cluster certs (the common
+            # operator deployment) — pinning carries the trust. With no
+            # pins the chain check must stay on (CA file or system roots)
+            # or the config would authenticate nobody.
+            require_valid_chain=not cfg.cluster_tls_pins
+            or bool(cfg.cluster_tls_ca),
+            client_cert_file=cfg.cluster_tls_client_cert,
+            client_key_file=cfg.cluster_tls_client_key)
 
     def close(self) -> None:
         """LIFO cleanup (main.go:1301-1379)."""

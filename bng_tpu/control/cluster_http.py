@@ -40,6 +40,7 @@ import base64
 import json
 import queue
 import threading
+import ssl
 import urllib.error
 import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -47,6 +48,7 @@ from typing import Callable
 from urllib.parse import parse_qs, urlparse
 
 from bng_tpu.control.ha import ActiveSyncer, HAChange, SessionState
+from bng_tpu.control.ztp_tls import CertificateValidationError
 from bng_tpu.control.peerpool import PeerPool, PeerPoolError
 
 __all__ = [
@@ -83,9 +85,14 @@ class ClusterServer:
     """One node's control-plane listener. Mount the services the node runs;
     unmounted paths 404. start() binds (port=0 picks a free port)."""
 
-    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, host: str = "127.0.0.1", port: int = 0, tls=None):
+        """tls: ztp_tls.ServerTLSConfig — the listener speaks TLS
+        (+ mutual TLS when the config carries a client CA). Plaintext
+        when None. Parity: pkg/ha/sync.go:151-185's TLS/mTLS modes on
+        the session-replication wire."""
         self.host = host
         self.port = port
+        self.tls = tls
         self.ha: ActiveSyncer | None = None
         self.pool: PeerPool | None = None
         self.store = None  # CLSetStore / DistributedStore
@@ -303,6 +310,18 @@ class ClusterServer:
                 self.wfile.flush()
 
         self._httpd = ThreadingHTTPServer((self.host, self.port), Handler)
+        if self.tls is not None:
+            from bng_tpu.control.ztp_tls import build_server_ssl_context
+
+            ctx = build_server_ssl_context(self.tls)
+            # handshake OFF the accept loop: with do_handshake_on_connect
+            # a half-open client (no ClientHello) would block accept()
+            # forever and wedge the whole control plane; deferred, the
+            # handshake runs in the per-connection handler thread on the
+            # first read (ThreadingHTTPServer), one thread per client
+            self._httpd.socket = ctx.wrap_socket(
+                self._httpd.socket, server_side=True,
+                do_handshake_on_connect=False)
         self.port = self._httpd.server_address[1]
         self._thread = threading.Thread(target=self._httpd.serve_forever,
                                         daemon=True, name=f"cluster-http-{self.port}")
@@ -311,7 +330,8 @@ class ClusterServer:
 
     @property
     def url(self) -> str:
-        return f"http://{self.host}:{self.port}"
+        scheme = "https" if self.tls is not None else "http"
+        return f"{scheme}://{self.host}:{self.port}"
 
     def close(self) -> None:
         self._closing.set()
@@ -324,20 +344,80 @@ class ClusterServer:
 # ---------------------------------------------------------------------------
 # client-side proxies
 # ---------------------------------------------------------------------------
+class _PinnedHTTPSConnection:
+    """http.client.HTTPSConnection whose connect() runs ztp_tls
+    verify_peer on the presented chain BEFORE any request bytes are sent
+    (the VerifyPeerCertificate role, tls.go:208-275) and performs SNI
+    against cfg.server_name when set (peer dialed by IP, cert names a
+    host). Built lazily — the class body needs http.client at def time."""
+
+    _cls = None
+
+    @classmethod
+    def make(cls, tls_cfg, ssl_ctx):
+        import http.client
+        import socket as _socket
+
+        from bng_tpu.control.ztp_tls import verify_wrapped_socket
+
+        class Conn(http.client.HTTPSConnection):
+            def connect(self):
+                sock = _socket.create_connection(
+                    (self.host, self.port), self.timeout)
+                if self._tunnel_host:  # pragma: no cover — no proxies here
+                    self.sock = sock
+                    self._tunnel()
+                    sock = self.sock
+                sn = tls_cfg.server_name or self.host
+                self.sock = ssl_ctx.wrap_socket(sock, server_hostname=sn)
+                verify_wrapped_socket(self.sock, tls_cfg)  # raises pre-request
+
+        return Conn
+
+
+def make_cluster_opener(tls_cfg) -> "urllib.request.OpenerDirector":
+    """An urllib opener whose https connections enforce the cluster TLS
+    config (pinning + optional mTLS client identity). Used for every
+    proxy request AND the SSE stream, so no wire path escapes the
+    verification."""
+    from bng_tpu.control.ztp_tls import build_ssl_context
+
+    ctx = build_ssl_context(tls_cfg)
+    conn_cls = _PinnedHTTPSConnection.make(tls_cfg, ctx)
+
+    class Handler(urllib.request.HTTPSHandler):
+        def https_open(self, req):
+            return self.do_open(conn_cls, req)
+
+    return urllib.request.build_opener(Handler())
+
+
 def _req(method: str, url: str, body: dict | None = None,
-         timeout: float = _TIMEOUT) -> tuple[int, dict]:
+         timeout: float = _TIMEOUT, opener=None) -> tuple[int, dict]:
     data = None if body is None else json.dumps(body).encode()
     req = urllib.request.Request(url, data=data, method=method,
                                  headers={"Content-Type": "application/json"})
+    open_ = opener.open if opener is not None else urllib.request.urlopen
     try:
-        with urllib.request.urlopen(req, timeout=timeout) as r:
+        with open_(req, timeout=timeout) as r:
             return r.status, json.loads(r.read() or b"{}")
     except urllib.error.HTTPError as e:
         try:
             return e.code, json.loads(e.read() or b"{}")
         except Exception:
             return e.code, {}
-    except (urllib.error.URLError, TimeoutError, ConnectionError, OSError) as e:
+    except CertificateValidationError:
+        # already a ConnectionError (by design) AND carries the why —
+        # don't flatten it into a generic transport failure
+        raise
+    except (urllib.error.URLError, TimeoutError, ConnectionError, OSError,
+            ssl.SSLError) as e:
+        # urllib wraps OSError-derived refusals (CertificateValidation-
+        # Error included) into URLError(reason=...): unwrap so the why
+        # survives to callers that assert on it
+        reason = getattr(e, "reason", None)
+        if isinstance(reason, CertificateValidationError):
+            raise reason
         raise ConnectionError(f"{method} {url}: {e}") from e
 
 
@@ -349,24 +429,32 @@ class HTTPActiveProxy:
     the stream drops, on_stream_end fires (wire it to standby.disconnect
     so the tick loop reconnects with backoff)."""
 
-    def __init__(self, url: str, on_stream_end: Callable[[], None] | None = None):
+    def __init__(self, url: str, on_stream_end: Callable[[], None] | None = None,
+                 tls=None):
+        """tls: ztp_tls.TLSConfig — verify (and pin) the active's cert on
+        every request including the SSE stream; carries our client
+        identity when the active demands mTLS."""
         self.url = url.rstrip("/")
         self.on_stream_end = on_stream_end
+        self._opener = make_cluster_opener(tls) if tls is not None else None
         self._seen_seq = 0  # high-water mark from full_sync/replay_since
         # fail fast like an in-process transport: unreachable = raise now
-        status, _ = _req("GET", f"{self.url}/health")
+        status, _ = self._req("GET", f"{self.url}/health")
         if status != 200:
             raise ConnectionError(f"active unhealthy: {status}")
 
+    def _req(self, method, url, body=None, timeout=_TIMEOUT):
+        return _req(method, url, body, timeout, opener=self._opener)
+
     def full_sync(self):
-        status, body = _req("GET", f"{self.url}/ha/sessions")
+        status, body = self._req("GET", f"{self.url}/ha/sessions")
         if status != 200:
             raise ConnectionError(f"full_sync {status}")
         self._seen_seq = body["seq"]
         return ([SessionState.from_dict(d) for d in body["sessions"]], body["seq"])
 
     def replay_since(self, seq: int):
-        status, body = _req("GET", f"{self.url}/ha/replay?since={seq}")
+        status, body = self._req("GET", f"{self.url}/ha/replay?since={seq}")
         if status == 410:
             return None
         if status != 200:
@@ -385,7 +473,9 @@ class HTTPActiveProxy:
                 # anything newer into the stream, so the window between the
                 # sync GET and this connect cannot drop deltas
                 req = urllib.request.Request(f"{self.url}/ha/stream?since={since}")
-                with urllib.request.urlopen(req, timeout=60.0) as r:
+                open_ = (self._opener.open if self._opener is not None
+                         else urllib.request.urlopen)
+                with open_(req, timeout=60.0) as r:
                     for raw in r:
                         if stop.is_set():
                             return
@@ -422,12 +512,16 @@ class _RemoteBySubscriber:
 class HTTPPeerProxy:
     """PeerPool transport target: a remote peer's local pool slice."""
 
-    def __init__(self, url: str):
+    def __init__(self, url: str, tls=None):
         self.url = url.rstrip("/")
+        self._opener = make_cluster_opener(tls) if tls is not None else None
         self.by_subscriber = _RemoteBySubscriber(self)
 
+    def _req(self, method, url, body=None, timeout=_TIMEOUT):
+        return _req(method, url, body, timeout, opener=self._opener)
+
     def _allocate_local(self, subscriber_id: str) -> int:
-        status, body = _req("POST", f"{self.url}/pool/allocate",
+        status, body = self._req("POST", f"{self.url}/pool/allocate",
                             {"subscriber_id": subscriber_id})
         if status == 200:
             return body["value"]
@@ -436,7 +530,7 @@ class HTTPPeerProxy:
         raise ConnectionError(f"allocate {status}")
 
     def _release_local(self, subscriber_id: str) -> bool:
-        status, body = _req("POST", f"{self.url}/pool/release",
+        status, body = self._req("POST", f"{self.url}/pool/release",
                             {"subscriber_id": subscriber_id})
         if status != 200:
             raise ConnectionError(f"release {status}")
@@ -445,13 +539,13 @@ class HTTPPeerProxy:
     def get(self, subscriber_id: str):
         # ids are free-form operator strings (circuit IDs etc.) — quote them
         sid = urllib.parse.quote(subscriber_id, safe="")
-        status, body = _req("GET", f"{self.url}/pool/get?subscriber_id={sid}")
+        status, body = self._req("GET", f"{self.url}/pool/get?subscriber_id={sid}")
         if status != 200:
             raise ConnectionError(f"get {status}")
         return body["value"]
 
     def status(self) -> dict:
-        status, body = _req("GET", f"{self.url}/pool/status")
+        status, body = self._req("GET", f"{self.url}/pool/status")
         if status != 200:
             raise ConnectionError(f"status {status}")
         return body
@@ -460,17 +554,21 @@ class HTTPPeerProxy:
 class HTTPStorePeer:
     """DistributedStore.add_peer target: remote CLSet over HTTP."""
 
-    def __init__(self, url: str):
+    def __init__(self, url: str, tls=None):
         self.url = url.rstrip("/")
+        self._opener = make_cluster_opener(tls) if tls is not None else None
+
+    def _req(self, method, url, body=None, timeout=_TIMEOUT):
+        return _req(method, url, body, timeout, opener=self._opener)
 
     def digest(self):
-        status, body = _req("POST", f"{self.url}/crdt/digest", {})
+        status, body = self._req("POST", f"{self.url}/crdt/digest", {})
         if status != 200:
             raise ConnectionError(f"digest {status}")
         return {k: tuple(v) for k, v in body["digest"].items()}
 
     def entries_for(self, keys):
-        status, body = _req("POST", f"{self.url}/crdt/entries",
+        status, body = self._req("POST", f"{self.url}/crdt/entries",
                             {"keys": list(keys)})
         if status != 200:
             raise ConnectionError(f"entries {status}")
@@ -480,17 +578,18 @@ class HTTPStorePeer:
     def merge_entries(self, entries) -> int:
         wire = {k: [cl, ts, node, _b64(val)]
                 for k, (cl, ts, node, val) in entries.items()}
-        status, body = _req("POST", f"{self.url}/crdt/merge", {"entries": wire})
+        status, body = self._req("POST", f"{self.url}/crdt/merge", {"entries": wire})
         if status != 200:
             raise ConnectionError(f"merge {status}")
         return body["changed"]
 
 
-def http_nexus_transport(url: str) -> Callable:
+def http_nexus_transport(url: str, tls=None) -> Callable:
     """HTTPAllocator-shaped transport: (method, path, body) -> (status, body)."""
     base = url.rstrip("/")
+    opener = make_cluster_opener(tls) if tls is not None else None
 
     def transport(method: str, path: str, body: dict | None):
-        return _req(method, f"{base}{path}", body)
+        return _req(method, f"{base}{path}", body, opener=opener)
 
     return transport

@@ -8,9 +8,10 @@ lifecycle (manager.go:381-458), ETSI TS 102 232 HI2/HI3 PDU export
 (exporter.go:191-317), JSON and syslog exporters (exporter.go:319-513),
 warrant expiry.
 
-Exporters here write to pluggable sinks (callables) rather than opening
-TLS sockets directly, so delivery is testable offline; a TCP/TLS sink is a
-two-line lambda in the composition root.
+Exporters here write to pluggable sinks (callables); TLSDeliverySink is
+the production sink — a persistent pinned-TLS channel to the LEA
+collector (the exporter.go:191-317 TLS delivery role) with bounded
+buffering and reconnect-on-failure, built on control.ztp_tls.
 """
 
 from __future__ import annotations
@@ -484,6 +485,121 @@ def _pack_ip(ip: str) -> bytes:
         import ipaddress
         return ipaddress.IPv6Address(ip).packed
     return bytes(int(x) for x in ip.split("."))
+
+
+class TLSDeliverySink:
+    """Persistent TLS delivery channel to an LEA collector — the sink an
+    ETSIExporter (or JSONExporter) writes through in production.
+
+    Parity: exporter.go:191-317 — the reference dials the collector over
+    TLS, frames each PDU with a 4-byte big-endian length prefix, and
+    reconnects with backoff on failure. Verification (CA and/or pinning,
+    optional mTLS client identity) rides control.ztp_tls.TLSConfig: the
+    pin check runs post-handshake, BEFORE any intercept product leaves
+    the box — a mis-dialed collector sees zero bytes of HI2/HI3.
+
+    Delivery is synchronous with a bounded in-memory retry buffer:
+    records during an outage queue up to `buffer_max`, then the OLDEST
+    drop (counted) — lawful-intercept continuity prefers fresh product
+    over unbounded memory growth.
+    """
+
+    FRAME_HDR = 4  # uint32 length prefix per PDU
+
+    def __init__(self, host: str, port: int, tls_cfg, timeout: float = 5.0,
+                 reconnect_backoff_s: float = 2.0, buffer_max: int = 4096,
+                 clock=time.time):
+        from bng_tpu.control.ztp_tls import build_ssl_context
+
+        self.host = host
+        self.port = port
+        self.tls_cfg = tls_cfg
+        # built ONCE: validates the config at construction and keeps the
+        # cert/CA file I/O off the per-dial path (backoff retries included)
+        self._ctx = build_ssl_context(tls_cfg)
+        self.timeout = timeout
+        self.backoff_s = reconnect_backoff_s
+        self.buffer_max = buffer_max
+        self.clock = clock
+        self._sock = None
+        self._buffer: list[bytes] = []
+        self._next_dial = 0.0
+        self._lock = threading.Lock()
+        self.stats = {"delivered": 0, "buffered": 0, "dropped": 0,
+                      "connects": 0, "connect_failures": 0}
+
+    # -- the sink callable the exporters take --
+    def __call__(self, pdu: bytes) -> None:
+        self.send(pdu)
+
+    def send(self, pdu: bytes) -> None:
+        with self._lock:
+            self._buffer.append(pdu)
+            if len(self._buffer) > self.buffer_max:
+                self._buffer.pop(0)
+                self.stats["dropped"] += 1
+            else:
+                self.stats["buffered"] += 1
+            self._flush_locked()
+
+    def _connect_locked(self):
+        import socket as _socket
+
+        from bng_tpu.control.ztp_tls import verify_wrapped_socket
+
+        now = self.clock()
+        if now < self._next_dial:
+            return None
+        try:
+            raw = _socket.create_connection((self.host, self.port),
+                                            timeout=self.timeout)
+            sn = self.tls_cfg.server_name or self.host
+            tls = self._ctx.wrap_socket(raw, server_hostname=sn)
+            verify_wrapped_socket(tls, self.tls_cfg)  # raises pre-delivery
+            self.stats["connects"] += 1
+            self._sock = tls
+            return tls
+        except Exception:
+            self.stats["connect_failures"] += 1
+            self._next_dial = now + self.backoff_s
+            return None
+
+    def _flush_locked(self) -> None:
+        sock = self._sock or self._connect_locked()
+        if sock is None:
+            return
+        while self._buffer:
+            pdu = self._buffer[0]
+            try:
+                sock.sendall(struct.pack(">I", len(pdu)) + pdu)
+            except Exception:
+                # connection died mid-delivery: keep the PDU buffered,
+                # drop the socket, back off before redialing
+                try:
+                    sock.close()
+                except Exception:
+                    pass
+                self._sock = None
+                self._next_dial = self.clock() + self.backoff_s
+                return
+            self._buffer.pop(0)
+            self.stats["delivered"] += 1
+
+    def flush(self) -> bool:
+        """Retry buffered PDUs now (tick hook). True = buffer empty."""
+        with self._lock:
+            self._next_dial = 0.0  # an explicit flush overrides backoff
+            self._flush_locked()
+            return not self._buffer
+
+    def close(self) -> None:
+        with self._lock:
+            if self._sock is not None:
+                try:
+                    self._sock.close()
+                except Exception:
+                    pass
+                self._sock = None
 
 
 def parse_etsi_pdu(data: bytes) -> dict:

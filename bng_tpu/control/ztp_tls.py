@@ -45,9 +45,49 @@ class TLSConfig:
     insecure_skip_verify: bool = False
     cert_expiry_warning_days: int = 30
     require_valid_chain: bool = True
+    # our client identity, presented when the server demands mTLS
+    # (sync.go:151-185's mutual-TLS mode on the HA wire)
+    client_cert_file: str = ""
+    client_key_file: str = ""
 
 
-class CertificateValidationError(Exception):
+@dataclass
+class ServerTLSConfig:
+    """Listener-side TLS (the sync.go:151-185 server role): cert/key to
+    present; set client_ca_* to REQUIRE verified client certificates
+    (mutual TLS). Used by control.cluster_http.ClusterServer."""
+
+    cert_file: str = ""
+    key_file: str = ""
+    client_ca_file: str = ""
+    client_ca_pem: str = ""
+    min_version: str = "1.2"
+
+
+def build_server_ssl_context(cfg: ServerTLSConfig) -> ssl.SSLContext:
+    if not cfg.cert_file or not cfg.key_file:
+        raise ValueError("server TLS needs cert_file and key_file")
+    if cfg.min_version not in ("1.2", "1.3"):
+        raise ValueError(f"min_version {cfg.min_version!r}: expected 1.2/1.3")
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+    ctx.minimum_version = (ssl.TLSVersion.TLSv1_3 if cfg.min_version == "1.3"
+                           else ssl.TLSVersion.TLSv1_2)
+    ctx.load_cert_chain(cfg.cert_file, cfg.key_file)
+    if cfg.client_ca_file or cfg.client_ca_pem:
+        if cfg.client_ca_pem:
+            ctx.load_verify_locations(cadata=cfg.client_ca_pem)
+        else:
+            ctx.load_verify_locations(cafile=cfg.client_ca_file)
+        ctx.verify_mode = ssl.CERT_REQUIRED
+    return ctx
+
+
+class CertificateValidationError(ConnectionError):
+    # A ConnectionError subclass ON PURPOSE: a pin/validity refusal is a
+    # failed connection to every failover path (HA standby backoff, peer
+    # pool ranking, CRDT round skip) — the node stays up and retries,
+    # while callers that care about the WHY can still catch this type.
+
     def __init__(self, reason: str, subject: str = "", underlying=None):
         self.reason = reason
         self.subject = subject
@@ -345,6 +385,10 @@ def build_ssl_context(cfg: TLSConfig) -> ssl.SSLContext:
     ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
     ctx.minimum_version = (ssl.TLSVersion.TLSv1_3 if cfg.min_version == "1.3"
                            else ssl.TLSVersion.TLSv1_2)
+    if cfg.client_cert_file and cfg.client_key_file:
+        # our identity for servers demanding mutual TLS (loaded before the
+        # early returns: mTLS composes with every verification mode)
+        ctx.load_cert_chain(cfg.client_cert_file, cfg.client_key_file)
     if cfg.insecure_skip_verify:
         ctx.check_hostname = False
         ctx.verify_mode = ssl.CERT_NONE
@@ -366,6 +410,31 @@ def build_ssl_context(cfg: TLSConfig) -> ssl.SSLContext:
         # hostname checked against server_name by the caller's connect
         ctx.check_hostname = True
     return ctx
+
+
+def verify_wrapped_socket(tls_sock, cfg: TLSConfig) -> TLSValidationResult:
+    """Post-handshake pin/validity verification of an ssl-wrapped socket
+    — the one shared implementation for every TLS dial path
+    (https_get_json, the cluster proxies, the ETSI delivery sink).
+
+    Chain note: Python < 3.13 exposes only the leaf certificate
+    (no SSLSocket.get_unverified_chain), so pins must cover the LEAF
+    there; on 3.13+ a pinned intermediate/CA anywhere in the presented
+    chain also matches (the tls.go:208-229 rawCerts behavior)."""
+    chain: list[bytes] = []
+    if hasattr(tls_sock, "get_unverified_chain"):  # Python 3.13+
+        for c in tls_sock.get_unverified_chain() or []:
+            if hasattr(c, "public_bytes"):
+                # ssl.Certificate.public_bytes takes an _ssl encoding
+                # enum: DER == 2 (PEM == 1 — a str, which would break
+                # the DER parser and the fingerprint hash)
+                chain.append(c.public_bytes(2))
+            else:
+                chain.append(c)
+    if not chain:
+        der = tls_sock.getpeercert(binary_form=True)
+        chain = [der] if der else []
+    return verify_peer(chain, cfg)
 
 
 def extract_server_name_from_url(url: str) -> str:
@@ -401,14 +470,7 @@ def https_get_json(url: str, cfg: TLSConfig, timeout: float = 10.0,
     tls = None
     try:
         tls = ctx.wrap_socket(raw, server_hostname=sn)
-        chain: list[bytes] = []
-        if hasattr(tls, "get_unverified_chain"):  # Python 3.13+
-            chain = [c.public_bytes(1) if hasattr(c, "public_bytes") else c
-                     for c in (tls.get_unverified_chain() or [])]
-        if not chain:
-            der = tls.getpeercert(binary_form=True)
-            chain = [der] if der else []
-        res = verify_peer(chain, cfg)
+        res = verify_wrapped_socket(tls, cfg)
         path = u.path or "/"
         if u.query:
             path += "?" + u.query
