@@ -344,35 +344,30 @@ class ClusterServer:
 # ---------------------------------------------------------------------------
 # client-side proxies
 # ---------------------------------------------------------------------------
-class _PinnedHTTPSConnection:
-    """http.client.HTTPSConnection whose connect() runs ztp_tls
-    verify_peer on the presented chain BEFORE any request bytes are sent
-    (the VerifyPeerCertificate role, tls.go:208-275) and performs SNI
-    against cfg.server_name when set (peer dialed by IP, cert names a
-    host). Built lazily — the class body needs http.client at def time."""
+def _make_pinned_https_connection(tls_cfg, ssl_ctx):
+    """An http.client.HTTPSConnection subclass whose connect() runs
+    ztp_tls verification on the presented chain BEFORE any request bytes
+    are sent (the VerifyPeerCertificate role, tls.go:208-275) and
+    performs SNI against cfg.server_name when set (peer dialed by IP,
+    cert names a host)."""
+    import http.client
+    import socket as _socket
 
-    _cls = None
+    from bng_tpu.control.ztp_tls import verify_wrapped_socket
 
-    @classmethod
-    def make(cls, tls_cfg, ssl_ctx):
-        import http.client
-        import socket as _socket
+    class Conn(http.client.HTTPSConnection):
+        def connect(self):
+            sock = _socket.create_connection(
+                (self.host, self.port), self.timeout)
+            if self._tunnel_host:  # pragma: no cover — no proxies here
+                self.sock = sock
+                self._tunnel()
+                sock = self.sock
+            sn = tls_cfg.server_name or self.host
+            self.sock = ssl_ctx.wrap_socket(sock, server_hostname=sn)
+            verify_wrapped_socket(self.sock, tls_cfg)  # raises pre-request
 
-        from bng_tpu.control.ztp_tls import verify_wrapped_socket
-
-        class Conn(http.client.HTTPSConnection):
-            def connect(self):
-                sock = _socket.create_connection(
-                    (self.host, self.port), self.timeout)
-                if self._tunnel_host:  # pragma: no cover — no proxies here
-                    self.sock = sock
-                    self._tunnel()
-                    sock = self.sock
-                sn = tls_cfg.server_name or self.host
-                self.sock = ssl_ctx.wrap_socket(sock, server_hostname=sn)
-                verify_wrapped_socket(self.sock, tls_cfg)  # raises pre-request
-
-        return Conn
+    return Conn
 
 
 def make_cluster_opener(tls_cfg) -> "urllib.request.OpenerDirector":
@@ -383,7 +378,7 @@ def make_cluster_opener(tls_cfg) -> "urllib.request.OpenerDirector":
     from bng_tpu.control.ztp_tls import build_ssl_context
 
     ctx = build_ssl_context(tls_cfg)
-    conn_cls = _PinnedHTTPSConnection.make(tls_cfg, ctx)
+    conn_cls = _make_pinned_https_connection(tls_cfg, ctx)
 
     class Handler(urllib.request.HTTPSHandler):
         def https_open(self, req):

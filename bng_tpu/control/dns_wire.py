@@ -26,6 +26,7 @@ import os
 import socket
 import struct
 import threading
+import time
 
 from bng_tpu.control.dns import (
     CLASS_IN,
@@ -261,11 +262,26 @@ class UDPForwarder:
                 s.connect(addr)  # replies restricted to this upstream
                 s.send(pkt)
                 self.stats["sent"] += 1
+                # per-upstream DEADLINE (advisor r4): re-arming the full
+                # timeout per stale reply would let a mismatch flood hold
+                # this upstream far past its budget
+                deadline = time.monotonic() + self.timeout
                 while True:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        raise TimeoutError(f"upstream {addr} deadline")
+                    s.settimeout(remaining)
                     data = s.recv(MAX_UDP)
-                    rtxid, _q, resp = decode_response(data)
+                    rtxid, rq, resp = decode_response(data)
                     if rtxid != txid:
                         continue  # stale/spoofed id: keep waiting
+                    # the echoed question must match what we asked
+                    # (RFC 5452 §4.2 entropy checks: id AND question;
+                    # a qdcount=0 reply decodes to name="" and fails here)
+                    if (rq.name.rstrip(".").lower()
+                            != query.name.rstrip(".").lower()
+                            or rq.qtype != query.qtype):
+                        continue
                     resp.query = query
                     return resp
             except (TimeoutError, socket.timeout) as e:

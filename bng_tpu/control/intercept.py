@@ -498,10 +498,14 @@ class TLSDeliverySink:
     pin check runs post-handshake, BEFORE any intercept product leaves
     the box — a mis-dialed collector sees zero bytes of HI2/HI3.
 
-    Delivery is synchronous with a bounded in-memory retry buffer:
-    records during an outage queue up to `buffer_max`, then the OLDEST
-    drop (counted) — lawful-intercept continuity prefers fresh product
-    over unbounded memory growth.
+    Delivery is synchronous while the channel is HEALTHY (connected, or
+    never yet failed): each record writes through inline. The moment a
+    dial fails, send() stops dialing — records only buffer (bounded at
+    `buffer_max`, oldest dropped + counted) and reconnection happens in
+    flush(), which the owner drives from its tick loop. This keeps the
+    capture path free of connect() stalls for the whole outage: the
+    blocking dial cost lands on the 1 Hz maintenance heartbeat, not on
+    per-packet interception.
     """
 
     FRAME_HDR = 4  # uint32 length prefix per PDU
@@ -540,7 +544,11 @@ class TLSDeliverySink:
                 self.stats["dropped"] += 1
             else:
                 self.stats["buffered"] += 1
-            self._flush_locked()
+            # inline delivery only while healthy: _next_dial > 0 means a
+            # dial failed and hasn't been cleared by a successful flush —
+            # buffer without blocking; flush() (tick-driven) redials
+            if self._sock is not None or self._next_dial == 0.0:
+                self._flush_locked()
 
     def _connect_locked(self):
         import socket as _socket

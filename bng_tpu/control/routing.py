@@ -401,22 +401,44 @@ def vtysh_executor(binary: str = "vtysh", timeout: float = 10.0,
             raise RuntimeError(f"vtysh rc={res.returncode}: {err[:200]}")
         return res.stdout
 
+    # vtysh context-entering prefixes: a chunk boundary inside one of
+    # these blocks must REPLAY the block entry (advisor r4: replaying only
+    # the initial preamble re-entered the FIRST router context for lines
+    # belonging to a LATER one)
+    ENTER = ("router ", "address-family ", "interface ", "route-map ",
+             "vrf ")
+
     def execute(command: str) -> str:
         lines = command.split("\n")
         if len(lines) <= MAX_LINES:
             return _invoke(lines)
-        # preserve the session preamble (configure terminal [+ router ...])
-        # at the head of every chunk so later chunks still apply
-        preamble = []
-        while (len(preamble) < len(lines) - 1
-               and (lines[len(preamble)].startswith("configure")
-                    or lines[len(preamble)].startswith("router "))):
-            preamble.append(lines[len(preamble)])
-        body = lines[len(preamble):]
         out = []
-        step = MAX_LINES - len(preamble)
-        for i in range(0, len(body), step):
-            out.append(_invoke(preamble + body[i : i + step]))
+        stack: list[str] = []  # live context path, outermost first
+        chunk: list[str] = []
+
+        def track(line: str) -> None:
+            s = line.strip()
+            if s.startswith("configure"):
+                stack.clear()
+                stack.append(line)
+            elif any(s.startswith(p) for p in ENTER):
+                stack.append(line)
+            elif s in ("end", "quit"):
+                stack.clear()  # back to exec mode
+            elif s in ("exit", "exit-address-family", "exit-vrf"):
+                if stack:
+                    stack.pop()
+
+        for line in lines:
+            if not chunk and stack:
+                chunk.extend(stack)  # re-enter the CURRENT context
+            chunk.append(line)
+            track(line)
+            if len(chunk) >= MAX_LINES:
+                out.append(_invoke(chunk))
+                chunk = []
+        if chunk:
+            out.append(_invoke(chunk))
         return "".join(out)
 
     return execute

@@ -692,3 +692,83 @@ class TestDNSWireReviewFixes:
             time.sleep(0.05)
         names = [t.name for t in threading.enumerate()]
         assert "bng-dns-udp" not in names, names
+
+
+class TestForwarderDeadline:
+    """Advisor r5: the per-upstream recv loop honors one DEADLINE, not a
+    re-armed full timeout per stale reply, and rejects replies whose
+    echoed question does not match the query (RFC 5452 entropy checks)."""
+
+    def test_mismatch_flood_cannot_exceed_budget(self):
+        import socket as _socket
+        import struct
+        import threading
+        import time as _time
+
+        from bng_tpu.control.dns_wire import UDPForwarder
+        from bng_tpu.control.dns import Query
+
+        # a hostile upstream that streams wrong-txid replies forever
+        srv = _socket.socket(_socket.AF_INET, _socket.SOCK_DGRAM)
+        srv.bind(("127.0.0.1", 0))
+        port = srv.getsockname()[1]
+        stop = threading.Event()
+
+        def flood():
+            while not stop.is_set():
+                try:
+                    srv.settimeout(0.5)
+                    data, addr = srv.recvfrom(4096)
+                except OSError:
+                    continue
+                bad = struct.pack("!HHHHHH", 0xBAD0, 0x8180, 0, 0, 0, 0)
+                for _ in range(50):
+                    srv.sendto(bad, addr)
+                    _time.sleep(0.005)
+
+        t = threading.Thread(target=flood, daemon=True)
+        t.start()
+        try:
+            fwd = UDPForwarder([f"127.0.0.1:{port}"], timeout=0.4)
+            t0 = _time.monotonic()
+            with pytest.raises(RuntimeError, match="all upstreams"):
+                fwd(Query(name="x.test"))
+            elapsed = _time.monotonic() - t0
+            # old behavior: every stale reply re-armed 0.4s -> unbounded;
+            # with the deadline the whole attempt stays near one budget
+            assert elapsed < 1.5, f"deadline not honored: {elapsed:.1f}s"
+            assert fwd.stats["timeouts"] == 1
+        finally:
+            stop.set()
+            srv.close()
+
+    def test_wrong_question_echo_rejected(self):
+        import socket as _socket
+        import threading
+
+        from bng_tpu.control.dns_wire import (UDPForwarder, decode_query,
+                                              encode_response)
+        from bng_tpu.control.dns import Query, Record, Response
+
+        srv = _socket.socket(_socket.AF_INET, _socket.SOCK_DGRAM)
+        srv.bind(("127.0.0.1", 0))
+        port = srv.getsockname()[1]
+
+        def answer_wrong_name():
+            data, addr = srv.recvfrom(4096)
+            txid, q = decode_query(data)[0:2]
+            # same txid, DIFFERENT question: a cache-poisoning shape
+            wrong = Response(query=Query(name="evil.test", qtype=q.qtype),
+                             rcode=0,
+                             answers=[Record(name="evil.test", rtype=1,
+                                             ipv4="1.2.3.4")])
+            srv.sendto(encode_response(wrong, txid), addr)
+
+        t = threading.Thread(target=answer_wrong_name, daemon=True)
+        t.start()
+        try:
+            fwd = UDPForwarder([f"127.0.0.1:{port}"], timeout=0.5)
+            with pytest.raises(RuntimeError):
+                fwd(Query(name="real.test"))  # poisoned answer never accepted
+        finally:
+            srv.close()

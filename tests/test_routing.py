@@ -585,3 +585,31 @@ class TestCLIVtyshWiring:
         delivered = [x for call in calls for x in call[2::2]
                      if x.startswith("network ")]
         assert len(delivered) == 1000 and len(set(delivered)) == 1000
+
+    def test_chunk_boundary_reenters_current_context(self):
+        """Advisor r5: a multi-section config crossing the chunk boundary
+        must replay the CURRENT context (the second router block), not the
+        first chunk's preamble — or later lines would apply to the wrong
+        router/address-family."""
+        from bng_tpu.control.routing import vtysh_executor
+
+        calls = []
+        ex = vtysh_executor(runner=lambda a: (calls.append(a), _FakeProc())[1])
+        lines = (["configure terminal", "router bgp 65001"]
+                 + [f"network 10.0.{i & 255}.0/32" for i in range(200)]
+                 + ["exit", "router bgp 65002",
+                    "address-family ipv6 unicast"]
+                 + [f"network 2001:db8:{i:x}::/48" for i in range(300)])
+        ex("\n".join(lines))
+        assert len(calls) > 1
+        # the chunk containing the tail v6 networks re-enters bgp 65002 +
+        # the v6 address-family, NOT bgp 65001
+        last = calls[-1][2::2]  # the -c arguments
+        assert last[0] == "configure terminal"
+        assert last[1] == "router bgp 65002"
+        assert last[2] == "address-family ipv6 unicast"
+        assert "router bgp 65001" not in last
+        # nothing lost, nothing duplicated
+        delivered = [x for call in calls for x in call[2::2]
+                     if x.startswith("network ")]
+        assert len(delivered) == 500 and len(set(delivered)) == 500
