@@ -574,7 +574,12 @@ def _build_nat_flows(n_flows, n_subs, now, sub_nat_nbuckets=None):
     fi = np.arange(n_flows, dtype=np.int64)
     src_ips = ((10 << 24) + 2 + fi % n_subs).astype(np.uint32)
     dst_ips = (ip_to_u32("93.184.0.0") + fi // n_subs).astype(np.uint32)
-    sports = (20000 + fi // n_subs).astype(np.uint32)
+    # BNG_BENCH_EIM_SHARE=k: k flows share one internal endpoint
+    # (src_ip, src_port) — the reference's 4M-session/2M-EIM geometry
+    # (bpf/nat44.c:38-40) is share=2; default 1 = every flow its own
+    # endpoint (distinct dst per shared sport keeps 5-tuples unique)
+    share = max(1, int(os.environ.get("BNG_BENCH_EIM_SHARE", "1")))
+    sports = (20000 + (fi // n_subs) // share).astype(np.uint32)
     made = nat.bulk_allocate_nat(np.unique(src_ips), now)
     _, _, ok = nat.bulk_flows(src_ips, dst_ips, sports,
                               np.uint32(443), np.uint32(17), 100, now)
@@ -613,10 +618,15 @@ def config2_nat44(on_tpu):
     B = int(os.environ.get("BNG_BENCH_BATCH", 8192 if on_tpu else 256))
     STEPS = int(os.environ.get("BNG_BENCH_STEPS", 100 if on_tpu else 5))
     N = int(os.environ.get("BNG_BENCH_FLOWS", 100_000 if on_tpu else 2_000))
+    t_b = time.time()
     nat, pkt, length, now = _nat_fixture(N, B)
+    build_s = time.time() - t_b
+    t_u = time.time()
     tables = nat.device_tables()
+    hbm_gb = sum(x.nbytes for x in jax.tree.leaves(tables)) / 1e9
     pkt_d = jax.device_put(jnp.asarray(pkt))
     len_d = jax.device_put(jnp.asarray(length))
+    upload_s = time.time() - t_u
 
     # VERDICT r2 weak #4: the headline NAT number must include the
     # accounting pass (counter/TCP-state scatters), and the session table
@@ -634,7 +644,10 @@ def config2_nat44(on_tpu):
                                      carry=True)
     _emit("NAT44 Mpps @100k flows (config 2)", mpps, "Mpps", 12.5,
           batch=B, flows=N, p50_us=round(p50, 1), p99_us=round(p99, 1),
-          compile_s=round(cs, 1), includes_accounting=True)
+          compile_s=round(cs, 1), includes_accounting=True,
+          build_s=round(build_s, 1), upload_s=round(upload_s, 1),
+          nat_tables_gb=round(hbm_gb, 2),
+          eim_endpoints=len(nat.eim))
 
 
 def config3_qos(on_tpu):

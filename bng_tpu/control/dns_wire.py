@@ -272,7 +272,13 @@ class UDPForwarder:
                         raise TimeoutError(f"upstream {addr} deadline")
                     s.settimeout(remaining)
                     data = s.recv(MAX_UDP)
-                    rtxid, rq, resp = decode_response(data)
+                    try:
+                        rtxid, rq, resp = decode_response(data)
+                    except WireError:
+                        # an UNDECODABLE datagram is the same off-path
+                        # noise as a wrong txid: keep waiting for the
+                        # real answer, don't abandon a live upstream
+                        continue
                     if rtxid != txid:
                         continue  # stale/spoofed id: keep waiting
                     # the echoed question must match what we asked

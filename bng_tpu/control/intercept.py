@@ -512,7 +512,7 @@ class TLSDeliverySink:
 
     def __init__(self, host: str, port: int, tls_cfg, timeout: float = 5.0,
                  reconnect_backoff_s: float = 2.0, buffer_max: int = 4096,
-                 clock=time.time):
+                 clock=time.time, auto_flush: bool = True):
         from bng_tpu.control.ztp_tls import build_ssl_context
 
         self.host = host
@@ -529,8 +529,24 @@ class TLSDeliverySink:
         self._buffer: list[bytes] = []
         self._next_dial = 0.0
         self._lock = threading.Lock()
+        self._stop = threading.Event()
         self.stats = {"delivered": 0, "buffered": 0, "dropped": 0,
                       "connects": 0, "connect_failures": 0}
+        # self-healing: after a dial failure send() stops dialing (no
+        # connect stalls on the capture path), so SOMETHING must redial —
+        # this daemon retries every backoff while records are buffered.
+        # auto_flush=False hands that duty to the owner's explicit
+        # flush() (tests with fake clocks; apps with their own tick).
+        if auto_flush:
+            threading.Thread(target=self._flush_loop, daemon=True,
+                             name=f"etsi-tls-{host}:{port}").start()
+
+    def _flush_loop(self) -> None:
+        while not self._stop.wait(self.backoff_s):
+            with self._lock:
+                if self._buffer and self._sock is None:
+                    self._next_dial = 0.0  # scheduled retry beats backoff
+                    self._flush_locked()
 
     # -- the sink callable the exporters take --
     def __call__(self, pdu: bytes) -> None:
@@ -601,6 +617,7 @@ class TLSDeliverySink:
             return not self._buffer
 
     def close(self) -> None:
+        self._stop.set()
         with self._lock:
             if self._sock is not None:
                 try:

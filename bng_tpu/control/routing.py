@@ -416,12 +416,25 @@ def vtysh_executor(binary: str = "vtysh", timeout: float = 10.0,
         stack: list[str] = []  # live context path, outermost first
         chunk: list[str] = []
 
+        def depth(entry: str) -> int:
+            s = entry.strip()
+            if s.startswith("configure"):
+                return 0
+            return 2 if s.startswith("address-family ") else 1
+
         def track(line: str) -> None:
             s = line.strip()
             if s.startswith("configure"):
                 stack.clear()
                 stack.append(line)
             elif any(s.startswith(p) for p in ENTER):
+                # vtysh implicitly leaves a sibling stanza when the next
+                # one opens (consecutive `interface X` blocks carry no
+                # `exit`): pop to ABOVE this line's depth, then push —
+                # bounds the stack at [configure, level-1, addr-family]
+                d = depth(line)
+                while stack and depth(stack[-1]) >= d:
+                    stack.pop()
                 stack.append(line)
             elif s in ("end", "quit"):
                 stack.clear()  # back to exec mode

@@ -285,3 +285,34 @@ class TestBulkOnLiveStepLoop:
         ln[0] = len(f)
         out = cl.step(pkt, ln, fa, now + 1, 0)
         assert out["verdict"][0] == 2, "bulk-inserted subscriber not served post-resync"
+
+
+class TestReferenceCapacityGeometry:
+    """The reference's NAT geometry (bpf/nat44.c:38-40 — 4M sessions,
+    2M EIM endpoints, i.e. 2 flows per internal endpoint) stands up
+    through the bulk path. Scaled 20x down for CPU CI (the full 4M build
+    runs in the chip window via tpu_run.sh config2-4M); the STRUCTURE —
+    sessions:EIM = 2:1, unique 5-tuples, reverse rows per session — is
+    what this pins."""
+
+    def test_4m_geometry_scaled(self, monkeypatch):
+        import bench
+
+        monkeypatch.setenv("BNG_BENCH_EIM_SHARE", "2")
+        n_flows, n_subs = 200_000, 50_000
+        nat, flows = bench._build_nat_flows(n_flows, n_subs, NOW)
+        assert len(flows) == n_flows, bench._DIAG
+        assert nat.sessions.count == n_flows
+        assert nat.reverse.count == n_flows
+        # the reference ratio: half as many EIM endpoints as sessions
+        assert len(nat.eim) == n_flows // 2
+        # every endpoint carries exactly its two flows
+        refs = [m[2] for m in nat.eim.values()]
+        assert min(refs) == max(refs) == 2
+        # flows sharing an endpoint share ONE external mapping: the
+        # device reverse table must still resolve both 5-tuples
+        src, dst, sport = (int(x) for x in flows[0])
+        k = nat.sessions.lookup(nat._key(src, dst, sport, 443, 17))
+        k2 = nat.sessions.lookup(nat._key(src, dst + 1, sport, 443, 17))
+        if k2 is not None:  # its pair flow exists in the batch
+            assert (k[0], k[1]) == (k2[0], k2[1])  # same nat_ip/port

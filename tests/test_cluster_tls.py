@@ -256,7 +256,7 @@ class TestETSITLSDelivery:
             "127.0.0.1", lea.port,
             TLSConfig(pinned_certs=[certs["lea"]["pin"]],
                       require_valid_chain=False),
-            clock=lambda: t[0])
+            clock=lambda: t[0], auto_flush=False)  # test drives flush()
         request.addfinalizer(sink.close)
         exporter = ETSIExporter(sink)
         exporter.deliver_iri(self._record())
@@ -269,3 +269,22 @@ class TestETSITLSDelivery:
         assert wait_until(lambda: len(lea.pdus) == 2)
         assert sink.stats["delivered"] == 2
         assert parse_etsi_pdu(lea.pdus[1])["handover"] == ETSIExporter.HI3
+
+    def test_auto_flush_self_heals_after_outage(self, certs, request):
+        """Review r5: nothing external needs to drive flush() — the
+        sink's own backoff thread redials and drains once the collector
+        returns, so one transient outage cannot halt delivery forever."""
+        lea = _LEACollector(certs)
+        request.addfinalizer(lea.close)
+        lea.accepting = False
+        sink = TLSDeliverySink(
+            "127.0.0.1", lea.port,
+            TLSConfig(pinned_certs=[certs["lea"]["pin"]],
+                      require_valid_chain=False),
+            reconnect_backoff_s=0.2)
+        request.addfinalizer(sink.close)
+        ETSIExporter(sink).deliver_iri(self._record())
+        assert sink.stats["delivered"] == 0
+        lea.accepting = True  # collector recovers; NOBODY calls flush()
+        assert wait_until(lambda: sink.stats["delivered"] == 1, timeout=5.0)
+        assert len(lea.pdus) == 1

@@ -613,3 +613,35 @@ class TestCLIVtyshWiring:
         delivered = [x for call in calls for x in call[2::2]
                      if x.startswith("network ")]
         assert len(delivered) == 500 and len(set(delivered)) == 500
+
+    def test_sibling_stanzas_bound_the_replay_stack(self):
+        """Review r5: consecutive `interface X` stanzas carry no `exit`
+        (vtysh switches context implicitly) — the replay stack must stay
+        bounded, not accumulate every sibling into each chunk preamble."""
+        from bng_tpu.control.routing import vtysh_executor
+
+        calls = []
+        ex = vtysh_executor(runner=lambda a: (calls.append(a), _FakeProc())[1])
+        lines = ["configure terminal"]
+        for i in range(600):  # 600 sibling stanzas, no exits
+            lines += [f"interface eth{i}", "no shutdown"]
+        ex("\n".join(lines))
+        assert len(calls) > 1
+        for call in calls:
+            args = call[2::2]
+            # bounded preamble: at most configure + ONE interface context
+            assert len(args) <= 403, len(args)
+            ifaces = [a for a in args if a.startswith("interface ")]
+            # every `no shutdown` sits directly under its own interface
+            prev = None
+            for a in args:
+                if a.startswith("interface "):
+                    prev = a
+                elif a == "no shutdown":
+                    assert prev is not None
+        # each stanza applied exactly once
+        all_ifaces = [a for call in calls for a in call[2::2]
+                      if a.startswith("interface ")]
+        # replayed context duplicates one interface per boundary at most
+        assert len(set(all_ifaces)) == 600
+        assert len(all_ifaces) <= 600 + len(calls)

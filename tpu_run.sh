@@ -48,6 +48,10 @@ step "config2-nat"   900  "python bench.py --config 2"
 step "config6-dhcp"  900  "python bench.py --config 6"
 step "config4-pppoe" 900  "python bench.py --config 4"
 step "config5-shard" 900  "python bench.py --config 5"
+# reference NAT capacity (bpf/nat44.c:38-40): 4M sessions / 2M EIM
+# endpoints — VERDICT r4 item 8's no-throughput-cliff check vs the 100k
+# config-2 number. Build alone is ~75s host-side; budget accordingly.
+step "config2-4M"    1500 "BNG_BENCH_FLOWS=4000000 BNG_BENCH_EIM_SHARE=2 python bench.py --config 2"
 step "headline-1M"   2400 "BNG_BENCH_SUBS=1000000 BNG_BENCH_FLOWS=1000000 python bench.py"
 if [ "$FAILED" -ne 0 ]; then
   echo "DONE WITH FAILURES $(date -u +%H:%M:%S)" | tee -a "$LOG"; exit 1
