@@ -76,6 +76,21 @@ class BNGConfig:
     dns_listen: str = "0.0.0.0:53"
     dns_upstreams: list = dataclasses.field(
         default_factory=lambda: ["8.8.8.8:53", "1.1.1.1:53"])
+    # central Nexus allocator (pkg/nexus HTTPAllocator; main.go:628-756):
+    # DHCP allocation tries Nexus first, local pools as fallback; also
+    # the health signal the resilience partition FSM watches
+    nexus_url: str = ""
+    # peer-to-peer shared pool (pkg/pool, Demo G): the agreed range plus
+    # node-id -> cluster-URL map (YAML `peer-pool-nodes:
+    # [{node: n1, url: "http://..."}]`); "" cidr = peer pool off
+    peer_pool_cidr: str = ""
+    peer_pool_nodes: list = dataclasses.field(default_factory=list)
+    # device->Nexus identity (pkg/deviceauth): none | psk | mtls
+    device_auth_method: str = "none"
+    device_auth_psk: str = ""
+    device_auth_psk_file: str = ""
+    device_auth_cert: str = ""
+    device_auth_key: str = ""
     # HA
     ha_role: str = ""  # "", "active", "standby"
     ha_peer: str = ""  # active's cluster URL (http://host:port) for standbys
@@ -261,6 +276,105 @@ class BNGApp:
         c["nexus"] = NexusClient(node_id=cfg.node_id, clock=self.clock)
         c["subscribers"] = SubscriberManager(clock=self.clock)
 
+        # 4a. device identity for the Nexus wire (pkg/deviceauth;
+        # main.go's deviceauth construction slot)
+        if cfg.device_auth_method != "none":
+            from bng_tpu.control import deviceauth as da
+
+            if cfg.device_auth_method == "psk":
+                c["deviceauth"] = da.PSKAuthenticator(
+                    psk=cfg.device_auth_psk,
+                    psk_file=cfg.device_auth_psk_file)
+            elif cfg.device_auth_method == "mtls":
+                c["deviceauth"] = da.MTLSAuthenticator(
+                    cert_file=cfg.device_auth_cert,
+                    key_file=cfg.device_auth_key)
+            else:
+                raise ValueError(
+                    f"device_auth_method={cfg.device_auth_method!r}: "
+                    f"expected 'none', 'psk' or 'mtls'")
+
+        # 4b. central allocator client + partition resilience. The
+        # adapter narrows HTTPAllocator's ip-string API to the DHCP
+        # server's int contract, and goes straight to the local pool
+        # while partitioned (one timeout per DISCOVER would melt the
+        # slow path — the resilience FSM owns retry cadence instead).
+        nexus_alloc = None
+        resilience = None
+        if cfg.nexus_url:
+            from bng_tpu.control.cluster_http import http_nexus_transport
+            from bng_tpu.control.nexus import HTTPAllocator
+            from bng_tpu.control.resilience import ResilienceManager
+
+            nexus_tls = (self._cluster_client_tls()
+                         if cfg.nexus_url.startswith("https") else None)
+            nexus_http = c["nexus_allocator"] = HTTPAllocator(
+                cfg.nexus_url,
+                http_nexus_transport(cfg.nexus_url, tls=nexus_tls),
+                node_id=cfg.node_id)
+            resilience = c["resilience"] = ResilienceManager(
+                nexus_healthy=nexus_http.health_check)
+
+            class _NexusAlloc:
+                def allocate(self, owner):
+                    if resilience.partitioned:
+                        return None  # local-pool fallback, no timeout
+                    try:
+                        ip = nexus_http.allocate(owner)
+                    except Exception:
+                        return None
+                    return ip_to_u32(ip) if ip else None
+
+                def release(self, owner):
+                    if resilience.partitioned:
+                        return  # no 3s timeout per expired lease during
+                        # an outage; heal-time reconciliation covers it
+                    try:
+                        nexus_http.release(owner)
+                    except Exception:
+                        pass
+
+            nexus_alloc = _NexusAlloc()
+
+        # 4c. peer-to-peer shared pool (pkg/pool/peer.go; Demo G):
+        # HRW owner-or-forward over the cluster HTTP wire
+        if cfg.peer_pool_cidr and cfg.peer_pool_nodes:
+            from bng_tpu.control.cluster_http import HTTPPeerProxy
+            from bng_tpu.control.peerpool import PeerPool, PoolRange
+
+            net = ipaddress.ip_network(cfg.peer_pool_cidr)
+            node_urls = {str(n["node"]): str(n["url"])
+                         for n in cfg.peer_pool_nodes}
+            if cfg.node_id not in node_urls:
+                raise ValueError(
+                    f"peer_pool_nodes must include this node "
+                    f"({cfg.node_id!r}): peers agree on one member list")
+
+            # proxies built ONCE per node: each would otherwise rebuild
+            # its TLS context (cert/CA file reads) per forwarded request
+            peer_proxies: dict[str, object] = {}
+
+            def _peer_transport(node, _urls=node_urls):
+                proxy = peer_proxies.get(node)
+                if proxy is None:
+                    url = _urls.get(node)
+                    if url is None:
+                        raise ConnectionError(f"unknown peer {node}")
+                    proxy = peer_proxies[node] = HTTPPeerProxy(
+                        url, tls=(self._cluster_client_tls()
+                                  if url.startswith("https") else None))
+                return proxy
+
+            # PeerPool allocates network+1+idx (it skips the network
+            # address itself): pass the RAW base, usable = hosts only
+            c["peerpool"] = PeerPool(
+                cfg.node_id, sorted(node_urls),
+                PoolRange(network=int(net.network_address),
+                          size=max(net.num_addresses - 2, 1)),
+                transport=_peer_transport)
+            self.log.info("peer pool", nodes=sorted(node_urls),
+                          cidr=cfg.peer_pool_cidr)
+
         # 5. RADIUS (main.go:946-973)
         authenticator = None
         if cfg.radius_server:
@@ -277,8 +391,26 @@ class BNGApp:
                               circuit_id=b"", **kw):
                 res = radius.authenticate(username, password, mac=mac,
                                           circuit_id=circuit_id)
-                if res is None or not res.success:
+                key = username or mac.hex()
+                if res is None:
+                    # every server timed out: degraded auth from the
+                    # cached profile (radius_handler.go:134-489 role) —
+                    # an outage must not evict paying subscribers
+                    if resilience is not None:
+                        cached = resilience.radius_handler.degraded_auth(
+                            key, self.clock())
+                        if cached is not None:
+                            return {"qos_policy": cached.policy_name,
+                                    "framed_ip": cached.framed_ip}
                     return None
+                if not res.success:
+                    return None  # a real REJECT is never served from cache
+                if resilience is not None:
+                    from bng_tpu.control.resilience import CachedProfile
+
+                    resilience.radius_handler.cache_profile(CachedProfile(
+                        username=key, policy_name=res.policy_name,
+                        framed_ip=res.framed_ip, cached_at=self.clock()))
                 # keys DHCPServer._request actually consumes: qos_policy
                 # (Filter-Id -> policy, server.go:774-794 role) and
                 # lease_time (Session-Timeout caps the lease)
@@ -338,12 +470,56 @@ class BNGApp:
             server_mac=parse_mac(cfg.server_mac),
             server_ip=ip_to_u32(cfg.server_ip),
             pool_manager=pool_mgr, fastpath_tables=fastpath,
+            allocator=nexus_alloc,
             authenticator=authenticator, qos_hook=qos_hook,
             nat_hook=nat_hook, clock=self.clock)
+        if resilience is not None:
+            # heal-time reconciliation (manager.go:342-528): the central
+            # store answers who owns each partition-allocated IP, and the
+            # loser of a conflict gets force-renumbered (its lease is
+            # expired so the client re-DORAs onto a fresh address)
+            from bng_tpu.utils.net import mac_to_u64, u32_to_ip
+
+            def _central_lookup(ip_u32, _nx=c["nexus_allocator"]):
+                try:
+                    return _nx.lookup_by_ip(u32_to_ip(ip_u32))
+                except Exception:
+                    return None  # unreachable mid-heal: no verdict
+
+            def _renumber(subscriber_id, _dhcp=dhcp):
+                try:
+                    mac = bytes.fromhex(subscriber_id)
+                except ValueError:
+                    return False
+                lease = _dhcp.leases.get(mac_to_u64(mac))
+                if lease is None:
+                    return False
+                lease.expiry = 0
+                _dhcp.cleanup_expired(1)  # reaps only the forced lease
+                return True
+
+            resilience.central_lookup = _central_lookup
+            resilience.renumber = _renumber
+            # partition-time allocations feed the conflict detector so
+            # heal-time reconciliation can renumber losers
+            prev_res_acct = dhcp.accounting_hook
+
+            def _res_lease(event, lease, sid, _res=resilience):
+                if prev_res_acct is not None:
+                    prev_res_acct(event, lease, sid)
+                if event == "start":
+                    _res.record_allocation(lease.mac.hex(), lease.ip,
+                                           self.clock())
+
+            dhcp.accounting_hook = _res_lease
         if acct is not None:
             from bng_tpu.utils.net import u32_to_ip as _u32ip
 
+            prev_acct_hook = dhcp.accounting_hook  # chain (resilience 8a)
+
             def _acct_lease(event, lease, sid, _acct=acct):
+                if prev_acct_hook is not None:
+                    prev_acct_hook(event, lease, sid)
                 if event == "start":
                     _acct.start(sid, username=lease.username
                                 or _u32ip(lease.ip), framed_ip=lease.ip,
@@ -627,6 +803,8 @@ class BNGApp:
                 srv.mount_ha(c["ha"])
             if "cluster_store" in c:
                 srv.mount_store(c["cluster_store"])
+            if "peerpool" in c:
+                srv.mount_pool(c["peerpool"])
             c["cluster_server"] = srv.start()
             self.log.info("cluster listener up", url=srv.url,
                           ha=bool(srv.ha), store=srv.store is not None)
@@ -860,6 +1038,18 @@ class BNGApp:
         if garden is not None and now - self._last_garden >= self.GARDEN_EVERY_S:
             self._last_garden = now
             garden.check_expired()
+
+        # partition FSM (resilience/manager.go:221-341) + peer health
+        # (pool/peer.go:541-631); both rate-limit internally
+        res = c.get("resilience")
+        if res is not None:
+            acct_mgr = c.get("accounting")
+            res.tick(now, acct_send=(
+                (lambda rec: acct_mgr.client.send_accounting(**rec))
+                if acct_mgr is not None else None))
+        pool = c.get("peerpool")
+        if pool is not None:
+            pool.health_check(now)
 
         acct = c.get("accounting")
         if acct is not None:

@@ -185,6 +185,20 @@ class ClusterServer:
                         return self._json(200, {"ip": ip})
                     if u.path == "/api/v1/pools" and outer.allocator:
                         return self._json(200, outer.allocator.pool_info())
+                    if (u.path.startswith("/api/v1/allocation-by-ip/")
+                            and outer.allocator):
+                        # heal-time conflict detection asks who the
+                        # CENTRAL store thinks owns an IP
+                        # (conflict_detector.go:121-233's central view)
+                        fn = getattr(outer.allocator, "lookup_by_ip", None)
+                        if fn is None:
+                            return self._json(404, {})
+                        got = fn(u.path.rsplit("/", 1)[1])
+                        if got is None:
+                            return self._json(404, {})
+                        sid, at = got
+                        return self._json(200, {"subscriber_id": sid,
+                                                "allocated_at": at})
                     return self._json(404, {"error": "not found"})
                 except BrokenPipeError:
                     raise

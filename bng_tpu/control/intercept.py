@@ -544,9 +544,26 @@ class TLSDeliverySink:
     def _flush_loop(self) -> None:
         while not self._stop.wait(self.backoff_s):
             with self._lock:
-                if self._buffer and self._sock is None:
-                    self._next_dial = 0.0  # scheduled retry beats backoff
+                need = bool(self._buffer) and self._sock is None
+            if not need:
+                continue
+            # dial OUTSIDE the buffer lock: a blocked connect (up to
+            # `timeout`) must never stall a capture-path send() waiting
+            # on the lock — that would defeat the class's entire design
+            tls = self._dial()
+            with self._lock:
+                if tls is None:
+                    self.stats["connect_failures"] += 1
+                    self._next_dial = self.clock() + self.backoff_s
+                elif self._sock is None:
+                    self.stats["connects"] += 1
+                    self._sock = tls
                     self._flush_locked()
+                else:  # a send() beat us to it
+                    try:
+                        tls.close()
+                    except Exception:
+                        pass
 
     # -- the sink callable the exporters take --
     def __call__(self, pdu: bytes) -> None:
@@ -566,27 +583,35 @@ class TLSDeliverySink:
             if self._sock is not None or self._next_dial == 0.0:
                 self._flush_locked()
 
-    def _connect_locked(self):
+    def _dial(self):
+        """Dial + verify; returns the TLS socket or None. Takes NO locks
+        — callers decide how the result is installed."""
         import socket as _socket
 
         from bng_tpu.control.ztp_tls import verify_wrapped_socket
 
-        now = self.clock()
-        if now < self._next_dial:
-            return None
         try:
             raw = _socket.create_connection((self.host, self.port),
                                             timeout=self.timeout)
             sn = self.tls_cfg.server_name or self.host
             tls = self._ctx.wrap_socket(raw, server_hostname=sn)
             verify_wrapped_socket(tls, self.tls_cfg)  # raises pre-delivery
-            self.stats["connects"] += 1
-            self._sock = tls
             return tls
         except Exception:
+            return None
+
+    def _connect_locked(self):
+        now = self.clock()
+        if now < self._next_dial:
+            return None
+        tls = self._dial()
+        if tls is None:
             self.stats["connect_failures"] += 1
             self._next_dial = now + self.backoff_s
             return None
+        self.stats["connects"] += 1
+        self._sock = tls
+        return tls
 
     def _flush_locked(self) -> None:
         sock = self._sock or self._connect_locked()

@@ -274,6 +274,18 @@ class HTTPAllocator:
             return None
         raise ConnectionError(f"nexus {status}")
 
+    def lookup_by_ip(self, ip: str) -> tuple[str, float] | None:
+        """Who does the CENTRAL store think owns this IP? -> (subscriber,
+        allocated_at) — the heal-time conflict-detection query
+        (conflict_detector.go:121-233's central view)."""
+        status, body = self.transport(
+            "GET", f"/api/v1/allocation-by-ip/{ip}", None)
+        if status == 200 and body.get("subscriber_id"):
+            return body["subscriber_id"], float(body.get("allocated_at", 0))
+        if status == 404:
+            return None
+        raise ConnectionError(f"nexus {status}")
+
     def release(self, subscriber_id: str) -> bool:
         status, _ = self.transport("DELETE", f"/api/v1/allocations/{subscriber_id}", None)
         ok = status in (200, 204)

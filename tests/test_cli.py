@@ -520,3 +520,223 @@ class TestMaintenanceHeartbeat:
             assert r2["tx"] == [] and len(r2["slow"]) == 1
         finally:
             app.close()
+
+
+class TestNexusPeerResilienceWiring:
+    """The rest of runBNG's construction order (main.go:628-756): Nexus
+    HTTPAllocator feeding the DHCP allocation cascade, the peer pool on
+    the cluster wire, and the resilience partition FSM driven by
+    App.tick — all reachable from `bng run` flags."""
+
+    def _nexus(self):
+        """A mini central Nexus: our own ClusterServer + allocator mount."""
+        from bng_tpu.control.cluster_http import ClusterServer
+
+        class Backend:
+            def __init__(self):
+                self.ips = {}
+                self.next = 10
+                # heal-time conflict view: ip_str -> (subscriber, at)
+                self.by_ip = {}
+
+            def allocate(self, subscriber_id, pool_hint):
+                if subscriber_id not in self.ips:
+                    self.ips[subscriber_id] = f"10.77.0.{self.next}"
+                    self.next += 1
+                return self.ips[subscriber_id]
+
+            def lookup(self, sid):
+                return self.ips.get(sid)
+
+            def lookup_by_ip(self, ip):
+                return self.by_ip.get(ip)
+
+            def release(self, sid):
+                return self.ips.pop(sid, None) is not None
+
+            def pool_info(self):
+                return {"pools": []}
+
+        backend = Backend()
+        srv = ClusterServer().mount_allocator(backend).start()
+        return srv, backend
+
+    def test_nexus_first_allocation_then_partition_fallback(self):
+        from bng_tpu.control import dhcp_codec, packets
+        from bng_tpu.control.resilience import PartitionState
+        from bng_tpu.utils.net import u32_to_ip
+
+        class Clock:
+            now = 5_000_000.0
+
+            def __call__(self):
+                return Clock.now
+
+        srv, backend = self._nexus()
+        app = BNGApp(BNGConfig(
+            nexus_url=srv.url, pool_cidr="10.77.0.0/16",
+            metrics_enabled=False, dhcpv6_enabled=False, slaac_enabled=False,
+            walled_garden_enabled=False, nat_enabled=False,
+            qos_enabled=False), clock=Clock())
+        try:
+            assert "nexus_allocator" in app.components
+            assert "resilience" in app.components
+            dhcp = app.components["dhcp"]
+            mac = bytes.fromhex("02ae00000001".zfill(12))
+
+            def discover():
+                p = dhcp_codec.build_request(mac, dhcp_codec.DISCOVER)
+                return packets.udp_packet(mac, b"\xff" * 6, 0, 0xFFFFFFFF,
+                                          68, 67,
+                                          p.encode().ljust(320, b"\x00"))
+
+            # allocation rides Nexus FIRST: the offered IP is the
+            # backend's answer, reserved in the matching local pool
+            offer = dhcp_codec.decode(
+                packets.decode(dhcp.handle_frame(discover())).payload)
+            assert u32_to_ip(offer.yiaddr) == backend.ips[mac.hex()]
+
+            # Nexus dies -> FSM partitions after threshold ticks
+            srv.close()
+            for i in range(4):
+                Clock.now += 6.0
+                app.tick()
+            res = app.components["resilience"]
+            assert res.state == PartitionState.PARTITIONED
+            # allocation still works (local pool, no per-DISCOVER timeout)
+            mac = bytes.fromhex("02ae00000002")
+            offer2 = dhcp_codec.decode(
+                packets.decode(dhcp.handle_frame(discover())).payload)
+            assert offer2.yiaddr != 0
+            # commit the lease: the partition-time allocation is recorded
+            # for heal-time conflict resolution (hook fires on ACK)
+            from bng_tpu.utils.net import ip_to_u32 as _ip32
+            req = dhcp_codec.build_request(
+                mac, dhcp_codec.REQUEST, requested_ip=offer2.yiaddr,
+                server_id=_ip32(app.config.server_ip))
+            ack = dhcp.handle_frame(packets.udp_packet(
+                mac, b"\xff" * 6, 0, 0xFFFFFFFF, 68, 67,
+                req.encode().ljust(320, b"\x00")))
+            assert ack is not None
+            assert res.conflicts.count == 1
+
+            # ---- heal WITH a conflict: the central store claims the
+            # partition-allocated IP belongs to someone ELSE (earlier
+            # timestamp wins -> our local lease is the loser and gets
+            # force-renumbered, manager.go:342-528) ----
+            from bng_tpu.utils.net import u32_to_ip
+            from bng_tpu.control.cluster_http import ClusterServer as _CS
+
+            backend.by_ip[u32_to_ip(offer2.yiaddr)] = ("other-node-sub",
+                                                       Clock.now - 9999.0)
+            srv2 = _CS(srv.host, srv.port).mount_allocator(backend).start()
+            try:
+                for _ in range(4):
+                    Clock.now += 6.0
+                    app.tick()
+                from bng_tpu.control.resilience import PartitionState as _PS
+                assert res.state == _PS.NORMAL
+                assert res.events.conflicts_found == 1
+                assert res.events.renumbered == 1
+                # the loser lease is GONE: the client will re-DORA
+                assert dhcp.leases == {}
+            finally:
+                srv2.close()
+        finally:
+            app.close()
+            srv.close()
+
+    def test_peer_pool_forward_through_app(self):
+        from bng_tpu.control.cluster_http import ClusterServer
+        from bng_tpu.control.peerpool import PeerPool, PoolRange
+
+        # a real remote peer: bare PeerPool mounted on its own listener
+        remote = PeerPool("n2", ["n1", "n2"],
+                          PoolRange(network=0x0A640001, size=500))
+        remote_srv = ClusterServer().mount_pool(remote).start()
+
+        app = BNGApp(BNGConfig(
+            node_id="n1", cluster_listen="127.0.0.1:0",
+            peer_pool_cidr="10.100.0.0/23",
+            peer_pool_nodes=[{"node": "n1", "url": "http://unused:1"},
+                             {"node": "n2", "url": remote_srv.url}],
+            metrics_enabled=False, dhcpv6_enabled=False, slaac_enabled=False,
+            walled_garden_enabled=False))
+        try:
+            pool = app.components["peerpool"]
+            # our own listener serves the pool endpoints too
+            assert app.components["cluster_server"].pool is pool
+            # a subscriber owned by n2 forwards over real HTTP
+            sub = next(s for s in (f"sub{i}" for i in range(100))
+                       if pool.owner_ranked(s)[0] == "n2")
+            ip = pool.allocate(sub)
+            assert pool.stats["forwarded"] == 1
+            assert remote.by_subscriber[sub] == ip
+            app.tick()  # drives health_check without error
+        finally:
+            app.close()
+            remote_srv.close()
+
+    def test_degraded_auth_serves_cached_profile(self):
+        """RADIUS outage: a subscriber who authenticated before keeps
+        working from the cached profile (radius_handler.go role); a fresh
+        subscriber does not. Auth fires on REQUEST when no lease exists,
+        so the outage case needs the lease expired first."""
+        from bng_tpu.control import dhcp_codec, packets
+        from bng_tpu.control.radius import packet as rp
+        from bng_tpu.utils.net import ip_to_u32 as _ip32
+        from tests.test_radius import FakeRadiusServer
+
+        class Clock:
+            now = 6_000_000.0
+
+            def __call__(self):
+                return Clock.now
+
+        srv, _ = self._nexus()  # resilience needs a nexus health signal
+        app = BNGApp(BNGConfig(
+            nexus_url=srv.url, lease_time=300,
+            radius_server="10.0.0.5:1812", radius_secret="s3cr3t",
+            metrics_enabled=False, dhcpv6_enabled=False, slaac_enabled=False,
+            walled_garden_enabled=False, nat_enabled=False), clock=Clock())
+        try:
+            radius = app.components["radius"]
+            radius.transport = FakeRadiusServer(users={
+                "": {"password": "", "attrs": [(rp.FILTER_ID, "gold")]}})
+            dhcp = app.components["dhcp"]
+            mac = bytes.fromhex("02aa00000001")
+
+            def dora(m):
+                p = dhcp_codec.build_request(m, dhcp_codec.DISCOVER)
+                offer = dhcp.handle_frame(packets.udp_packet(
+                    m, b"\xff" * 6, 0, 0xFFFFFFFF, 68, 67,
+                    p.encode().ljust(320, b"\x00")))
+                if offer is None:
+                    return None
+                omsg = dhcp_codec.decode(packets.decode(offer).payload)
+                r = dhcp_codec.build_request(
+                    m, dhcp_codec.REQUEST, requested_ip=omsg.yiaddr,
+                    server_id=_ip32(app.config.server_ip))
+                return dhcp.handle_frame(packets.udp_packet(
+                    m, b"\xff" * 6, 0, 0xFFFFFFFF, 68, 67,
+                    r.encode().ljust(320, b"\x00")))
+
+            assert dora(mac) is not None  # auth OK -> profile cached
+            # lease expires, then the RADIUS outage begins
+            Clock.now += 400.0
+            app.tick()
+            assert dhcp.leases == {}
+            radius.transport = lambda *a: None  # timeout everywhere
+            # known subscriber: re-auth times out -> cached profile serves
+            assert dora(mac) is not None
+            stats = app.components["resilience"].radius_handler.stats
+            assert stats["cache_hits"] == 1
+            # known subscriber's reply is a real ACK
+            # unknown subscriber: no cache -> NAK
+            nak = dora(bytes.fromhex("02aa00000099"))
+            if nak is not None:
+                msg = dhcp_codec.decode(packets.decode(nak).payload)
+                assert msg.msg_type == dhcp_codec.NAK
+        finally:
+            app.close()
+            srv.close()
