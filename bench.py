@@ -700,21 +700,27 @@ def config4_pppoe(on_tpu):
     B = int(os.environ.get("BNG_BENCH_BATCH", 8192 if on_tpu else 256))
     STEPS = int(os.environ.get("BNG_BENCH_STEPS", 100 if on_tpu else 5))
     N = int(os.environ.get("BNG_BENCH_SUBS", 10_000 if on_tpu else 1_000))
+    from bng_tpu.runtime.tables import PPPoEFastPathTables
+
+    ac = bytes.fromhex("02aabbccdd01")
     nb = 1 << max(10, (N * 2 // 4).bit_length())
-    by_sid = HostTable(nb, 1, P.PPPOE_WORDS, stash=128, name="sid")
-    geom = TableGeom(nb, 128)
+    # the SAME host-table stack Engine(pppoe=...) runs — the bench must
+    # measure the production geometry, not a hand-built lookalike
+    pp = PPPoEFastPathTables(nbuckets=nb, stash=128, server_mac=ac)
+    by_sid, geom = pp.by_sid, pp.geom
+
+    class _Sess:
+        pass
+
     for i in range(N):
-        mac = (0x02B2 << 32 | i).to_bytes(6, "big")
-        row = np.zeros((P.PPPOE_WORDS,), dtype=np.uint32)
-        row[P.PS_SESSION_ID] = i + 1
-        row[P.PS_MAC_HI] = int.from_bytes(mac[:2], "big")
-        row[P.PS_MAC_LO] = int.from_bytes(mac[2:], "big")
-        row[P.PS_IP] = (10 << 24) | (i + 2)
-        by_sid.insert([i + 1], row)
+        s = _Sess()
+        s.session_id = i + 1
+        s.client_mac = (0x02B2 << 32 | i).to_bytes(6, "big")
+        s.assigned_ip = (10 << 24) | (i + 2)
+        pp.session_up(s)
     rng = np.random.default_rng(11)
     pkt = np.zeros((B, 512), dtype=np.uint8)
     length = np.zeros((B,), dtype=np.uint32)
-    ac = bytes.fromhex("02aabbccdd01")
     for rowi in range(B):
         i = int(rng.integers(N))
         mac = (0x02B2 << 32 | i).to_bytes(6, "big")
@@ -737,9 +743,64 @@ def config4_pppoe(on_tpu):
 
     mpps, p50, p99, cs = _timed_loop(
         step, (tab, jnp.asarray(pkt), jnp.asarray(length)), STEPS, B)
-    _emit("PPPoE+QinQ decap Mpps (config 4)", mpps, "Mpps", 12.5,
-          batch=B, sessions=N, p50_us=round(p50, 1), p99_us=round(p99, 1),
-          compile_s=round(cs, 1))
+    _DIAG["decap_only_mpps"] = round(mpps, 3)
+    _DIAG["decap_only_p50_us"] = round(p50, 1)
+
+    # ---- the PRODUCTION path: the same PPPoE data through the FULL
+    # fused pipeline (decap -> antispoof -> DHCP -> NAT SNAT -> QoS),
+    # i.e. what Engine(pppoe=...) actually runs per batch (round-5
+    # integration). The standalone decap number above isolates the op;
+    # this one is the deployable cost.
+    from bng_tpu.control.nat import NATManager
+    from bng_tpu.ops.pipeline import pipeline_step
+    from bng_tpu.runtime.engine import AntispoofTables, QoSTables
+    from bng_tpu.runtime.tables import FastPathTables
+    from bng_tpu.ops.pipeline import PipelineGeom, PipelineTables
+
+    now = 1_753_000_000
+    fp = FastPathTables(sub_nbuckets=1 << 10, vlan_nbuckets=64,
+                        cid_nbuckets=64, max_pools=4)
+    fp.set_server_config(ac, ip_to_u32("10.0.0.1"))
+    n_pub = max(4, -(-N // 1008) + 1)
+    nat = NATManager(public_ips=[ip_to_u32("203.0.113.1") + i
+                                 for i in range(n_pub)],
+                     ports_per_subscriber=64,
+                     sessions_nbuckets=nb, sub_nat_nbuckets=nb, stash=256)
+    sub_ips = ((10 << 24) + 2 + np.arange(N)).astype(np.uint32)
+    nat.bulk_allocate_nat(sub_ips, now)
+    _, _, ok = nat.bulk_flows(sub_ips, ip_to_u32("8.8.8.8"),
+                              np.uint32(5000), np.uint32(53), np.uint32(17),
+                              100, now)
+    qos = QoSTables(nbuckets=nb)
+    qos.bulk_set_subscribers(sub_ips, down_bps=1_000_000_000,
+                             up_bps=1_000_000_000)
+    spoof = AntispoofTables(nbuckets=256)
+    pgeom = PipelineGeom(dhcp=fp.geom, nat=nat.geom, qos=qos.geom,
+                         spoof=spoof.geom, pppoe=pp.geom)
+    ptables = PipelineTables(
+        dhcp=fp.device_tables(), nat=nat.device_tables(),
+        qos_up=qos.up.device_state(), qos_down=qos.down.device_state(),
+        spoof=spoof.bindings.device_state(),
+        spoof_ranges=jnp.asarray(spoof.ranges),
+        spoof_config=jnp.asarray(spoof.config),
+        pppoe_by_sid=pp.by_sid.device_state(),
+        pppoe_by_ip=pp.by_ip.device_state(),
+        pppoe_server_mac=jnp.asarray(pp.server_mac))
+    fa = jnp.ones((B,), dtype=bool)
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def fused(tables, pkt, ln):
+        res = pipeline_step(tables, pkt, ln, fa, pgeom,
+                            jnp.uint32(now), jnp.uint32(0))
+        return res.tables, res.verdict, res.out_pkt, res.pppoe_stats
+
+    fmpps, fp50, fp99, fcs = _timed_loop(
+        fused, (ptables, jnp.asarray(pkt), jnp.asarray(length)), STEPS, B,
+        carry=True)
+    _emit("PPPoE+QinQ decap Mpps (config 4)", fmpps, "Mpps", 12.5,
+          batch=B, sessions=N, p50_us=round(fp50, 1), p99_us=round(fp99, 1),
+          compile_s=round(fcs, 1), fused_pipeline=True,
+          includes=["decap", "antispoof", "dhcp", "nat44", "qos"])
 
 
 def config6_dhcp_fastpath(on_tpu):

@@ -28,6 +28,8 @@ RELAY_REPL = 13
 # option codes (RFC 8415 §21)
 OPT_CLIENTID = 1
 OPT_SERVERID = 2
+OPT_RELAY_MSG = 9  # RFC 8415 §21.10 — the encapsulated client message
+OPT_INTERFACE_ID = 18  # RFC 8415 §21.18 — echoed verbatim in the reply
 OPT_IA_NA = 3
 OPT_IA_TA = 4
 OPT_IAADDR = 5
@@ -267,3 +269,42 @@ class DHCPv6Message:
 
     def add_status(self, code: int, msg: str = "") -> None:
         self.add(OPT_STATUS_CODE, struct.pack(">H", code) + msg.encode())
+
+
+@dataclass
+class RelayMessage:
+    """RFC 8415 §9: Relay-Forward/Relay-Reply framing.
+
+    Parity: the reference defines the same shape (protocol.go:104-111)
+    — hop-count + link-address + peer-address + options, with the
+    client's message nested in OPT_RELAY_MSG (possibly through a chain
+    of relays). The fixed header is 34 bytes vs the client messages' 4.
+    """
+
+    msg_type: int  # RELAY_FORW | RELAY_REPL
+    hop_count: int
+    link_address: bytes  # 16
+    peer_address: bytes  # 16
+    options: list[tuple[int, bytes]] = field(default_factory=list)
+
+    def encode(self) -> bytes:
+        if len(self.link_address) != 16 or len(self.peer_address) != 16:
+            raise ValueError("relay addresses must be 16 bytes")
+        return (bytes([self.msg_type, self.hop_count & 0xFF])
+                + self.link_address + self.peer_address
+                + encode_options(self.options))
+
+    @classmethod
+    def decode(cls, raw: bytes) -> "RelayMessage":
+        if len(raw) < 34:
+            raise ValueError("relay message truncated")
+        if raw[0] not in (RELAY_FORW, RELAY_REPL):
+            raise ValueError(f"not a relay message: type {raw[0]}")
+        return cls(raw[0], raw[1], raw[2:18], raw[18:34],
+                   decode_options(raw[34:]))
+
+    def get(self, code: int) -> bytes | None:
+        for c, d in self.options:
+            if c == code:
+                return d
+        return None

@@ -156,6 +156,8 @@ class DHCPv6Stats:
     info_request: int = 0
     no_addrs: int = 0
     no_binding: int = 0
+    relay_forw: int = 0
+    relay_repl: int = 0
 
 
 class DHCPv6Server:
@@ -178,9 +180,16 @@ class DHCPv6Server:
         # bindings: (duid, iaid, is_pd) -> Lease6
         self.leases: dict[tuple[bytes, int, bool], Lease6] = {}
 
+    MAX_RELAY_HOPS = 8  # RFC 8415 HOP_COUNT_LIMIT discipline
+
     # ------------------------------------------------------------------
     def handle_message(self, raw: bytes) -> bytes | None:
-        """Dispatch (parity: handleMessage, server.go:420-447)."""
+        """Dispatch (parity: handleMessage, server.go:420-447). A
+        Relay-Forward chain (RFC 8415 §19) is unwrapped to the client
+        message and the reply re-wrapped in matching Relay-Replies —
+        hop/link/peer copied, Interface-Id echoed verbatim."""
+        if raw and raw[0] == p6.RELAY_FORW:
+            return self._handle_relay(raw, depth=0)
         try:
             msg = DHCPv6Message.decode(raw)
         except ValueError:
@@ -207,6 +216,38 @@ class DHCPv6Server:
             return None
         reply = handler(msg)
         return reply.encode() if reply is not None else None
+
+    def _handle_relay(self, raw: bytes, depth: int) -> bytes | None:
+        """Relay-Forward -> process nested message -> Relay-Reply.
+
+        Handles relay chains recursively (relay-of-relay), bounded at
+        MAX_RELAY_HOPS. The reply mirrors each level's hop-count,
+        link-address and peer-address, and echoes Interface-Id so the
+        relay can map the reply to the client-facing interface."""
+        if depth >= self.MAX_RELAY_HOPS:
+            return None
+        try:
+            fwd = p6.RelayMessage.decode(raw)
+        except ValueError:
+            return None
+        inner = fwd.get(p6.OPT_RELAY_MSG)
+        if not inner:
+            return None
+        self.stats.relay_forw += 1
+        if inner[0] == p6.RELAY_FORW:
+            inner_reply = self._handle_relay(inner, depth + 1)
+        else:
+            inner_reply = self.handle_message(inner)
+        if inner_reply is None:
+            return None
+        reply = p6.RelayMessage(p6.RELAY_REPL, fwd.hop_count,
+                                fwd.link_address, fwd.peer_address)
+        iface_id = fwd.get(p6.OPT_INTERFACE_ID)
+        if iface_id is not None:
+            reply.options.append((p6.OPT_INTERFACE_ID, iface_id))
+        reply.options.append((p6.OPT_RELAY_MSG, inner_reply))
+        self.stats.relay_repl += 1
+        return reply.encode()
 
     # ------------------------------------------------------------------
     def _base_reply(self, msg: DHCPv6Message, msg_type: int) -> DHCPv6Message:

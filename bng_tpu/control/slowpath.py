@@ -109,9 +109,15 @@ class SlowPathDemux:
         client_ip = frame[22:38]  # IPv6 source
         server_mac = getattr(self.dhcpv6.config, "server_mac",
                              b"\x02\xbb\x00\x00\x00\x01")
+        # RFC 8415 §7.2: clients listen on 546, RELAY AGENTS on 547 — a
+        # Relay-Reply framed to 546 would never reach the relay's socket
+        from bng_tpu.control.dhcpv6.protocol import RELAY_REPL
+
+        dport = (DHCP6_SERVER_PORT if reply and reply[0] == RELAY_REPL
+                 else DHCP6_CLIENT_PORT)
         return packets.udp6_packet(server_mac, client_mac,
                                    self._server_ip6(server_mac), client_ip,
-                                   DHCP6_SERVER_PORT, DHCP6_CLIENT_PORT,
+                                   DHCP6_SERVER_PORT, dport,
                                    reply)
 
     def _server_ip6(self, server_mac: bytes) -> bytes:

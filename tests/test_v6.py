@@ -475,3 +475,103 @@ class TestSlowPathDemux:
             assert adv.msg_type == p6.ADVERTISE
         finally:
             app.close()
+
+
+class TestRelay:
+    """RFC 8415 §19 relay handling (reference shape: protocol.go:104-111
+    RelayMessage; our server also PROCESSES the chain, which the
+    reference's types alone never did)."""
+
+    def _wrap(self, inner: bytes, hops=0, iface=b"eth0.100",
+              link=None, peer=None):
+        from bng_tpu.control.dhcpv6.protocol import RelayMessage
+
+        return RelayMessage(
+            p6.RELAY_FORW, hops,
+            link or bytes.fromhex("20010db8000000010000000000000001"),
+            peer or bytes.fromhex("fe80000000000000020000fffe000001"),
+            options=([(p6.OPT_INTERFACE_ID, iface)] if iface else [])
+            + [(p6.OPT_RELAY_MSG, inner)]).encode()
+
+    def test_codec_roundtrip(self):
+        from bng_tpu.control.dhcpv6.protocol import RelayMessage
+
+        raw = self._wrap(solicit().encode(), hops=3)
+        back = RelayMessage.decode(raw)
+        assert back.msg_type == p6.RELAY_FORW and back.hop_count == 3
+        assert back.get(p6.OPT_INTERFACE_ID) == b"eth0.100"
+        inner = DHCPv6Message.decode(back.get(p6.OPT_RELAY_MSG))
+        assert inner.msg_type == p6.SOLICIT
+
+    def test_framed_relay_reply_goes_to_port_547(self):
+        """RFC 8415 §7.2: relay agents listen on 547 — the framed
+        Relay-Reply must be addressed there, not the client port."""
+        from bng_tpu.control import packets as pk
+        from bng_tpu.control.dhcpv6.protocol import RelayMessage
+        from bng_tpu.control.slowpath import SlowPathDemux
+
+        demux, v6 = self._mkdemux()
+        relay_ip = bytes.fromhex("20010db80000000900000000000000fe")
+        frame = pk.udp6_packet(
+            bytes.fromhex("02e1a7000001"), bytes.fromhex("02bb0000 0001".replace(" ", "")),
+            relay_ip, bytes.fromhex("20010db8000000000000000000000001"),
+            547, 547, self._wrap(solicit().encode()))
+        reply = demux(frame)
+        assert reply is not None
+        dport = int.from_bytes(reply[56:58], "big")
+        assert dport == 547, f"Relay-Reply framed to {dport}"
+        rep = RelayMessage.decode(reply[62:])
+        assert rep.msg_type == p6.RELAY_REPL
+
+    def _mkdemux(self):
+        from bng_tpu.control.slowpath import SlowPathDemux
+
+        v6 = mkserver()
+        return SlowPathDemux(dhcpv6=v6), v6
+
+    def test_relayed_solicit_gets_relay_reply(self):
+        from bng_tpu.control.dhcpv6.protocol import RelayMessage
+
+        srv = mkserver()
+        out = srv.handle_message(self._wrap(solicit().encode()))
+        assert out is not None
+        rep = RelayMessage.decode(out)
+        assert rep.msg_type == p6.RELAY_REPL
+        assert rep.hop_count == 0
+        # link/peer mirrored so the relay can route the reply
+        assert rep.link_address.hex().startswith("20010db8")
+        assert rep.peer_address.hex().startswith("fe80")
+        # interface-id echoed VERBATIM (the relay's demux key)
+        assert rep.get(p6.OPT_INTERFACE_ID) == b"eth0.100"
+        adv = DHCPv6Message.decode(rep.get(p6.OPT_RELAY_MSG))
+        assert adv.msg_type == p6.ADVERTISE
+        assert len(adv.ia_nas()[0].addresses) == 1
+        assert srv.stats.relay_forw == 1 and srv.stats.relay_repl == 1
+
+    def test_nested_relay_chain(self):
+        from bng_tpu.control.dhcpv6.protocol import RelayMessage
+
+        srv = mkserver()
+        lvl1 = self._wrap(solicit().encode(), hops=0, iface=b"inner")
+        lvl2 = self._wrap(lvl1, hops=1, iface=b"outer",
+                          link=bytes.fromhex("20010db8" + "00" * 12))
+        out = srv.handle_message(lvl2)
+        rep = RelayMessage.decode(out)
+        assert rep.hop_count == 1
+        assert rep.get(p6.OPT_INTERFACE_ID) == b"outer"
+        inner_rep = RelayMessage.decode(rep.get(p6.OPT_RELAY_MSG))
+        assert inner_rep.msg_type == p6.RELAY_REPL
+        assert inner_rep.get(p6.OPT_INTERFACE_ID) == b"inner"
+        adv = DHCPv6Message.decode(inner_rep.get(p6.OPT_RELAY_MSG))
+        assert adv.msg_type == p6.ADVERTISE
+
+    def test_hop_limit_and_garbage(self):
+        srv = mkserver()
+        # a relay loop (chain deeper than MAX_RELAY_HOPS) is dropped
+        wrapped = solicit().encode()
+        for _ in range(srv.MAX_RELAY_HOPS + 2):
+            wrapped = self._wrap(wrapped, iface=None)
+        assert srv.handle_message(wrapped) is None
+        # truncated / empty relay frames never crash
+        assert srv.handle_message(bytes([p6.RELAY_FORW])) is None
+        assert srv.handle_message(self._wrap(b"")) is None
