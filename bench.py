@@ -771,6 +771,9 @@ def config4_pppoe(on_tpu):
     _, _, ok = nat.bulk_flows(sub_ips, ip_to_u32("8.8.8.8"),
                               np.uint32(5000), np.uint32(53), np.uint32(17),
                               100, now)
+    if not ok.all():
+        # punted lanes would silently dilute the fused Mpps number
+        _DIAG["pppoe_nat_flow_shortfall"] = int((~ok).sum())
     qos = QoSTables(nbuckets=nb)
     qos.bulk_set_subscribers(sub_ips, down_bps=1_000_000_000,
                              up_bps=1_000_000_000)

@@ -43,7 +43,8 @@ from bng_tpu.ops.pipeline import PipelineGeom, PipelineTables, pipeline_step
 from bng_tpu.ops.table import TableGeom, shard_owner
 from bng_tpu.runtime.engine import (AntispoofTables, GardenTables, QoSTables,
                                     _apply_all_updates)
-from bng_tpu.runtime.tables import FastPathTables
+from bng_tpu.runtime.tables import (FastPathTables,
+                                    PPPoEFastPathTables)
 from bng_tpu.utils.net import mac_to_u64, split_u64
 
 AXIS = "shard"
@@ -82,6 +83,7 @@ def _sharded_step_jit(mesh: Mesh, geom: PipelineGeom, n: int):
     geom_sh = _sharded_geom(geom, n)
 
     has_garden = geom.garden is not None
+    has_pppoe = geom.pppoe is not None
 
     def local_step(tables1, upd1, pkt, length, fa, now_s, now_us):
         # shard_map hands each chip a leading dim of 1: drop it
@@ -102,11 +104,15 @@ def _sharded_step_jit(mesh: Mesh, geom: PipelineGeom, n: int):
                res.nat_punt, res.spoof_violation)
         if has_garden:
             out += (jax.lax.psum(res.garden_stats, AXIS),)
+        if has_pppoe:
+            out += (jax.lax.psum(res.pppoe_stats, AXIS),)
         return out
 
     out_specs = (P(AXIS), P(AXIS), P(AXIS), P(AXIS), P(), P(), P(), P(),
                  P(AXIS), P(AXIS))
     if has_garden:
+        out_specs += (P(),)
+    if has_pppoe:
         out_specs += (P(),)
     sharded = jax.shard_map(
         local_step,
@@ -170,6 +176,9 @@ class ShardedCluster:
         spoof_nbuckets: int = 256,
         public_ips: list[int] | None = None,
         garden_enabled: bool = True,
+        pppoe_enabled: bool = False,
+        pppoe_nbuckets: int = 256,
+        server_mac: bytes = b"\x02\xbb\x00\x00\x00\x01",
     ):
         self.n = n_shards
         self.mesh = mesh if mesh is not None else make_mesh(n_shards)
@@ -203,12 +212,20 @@ class ShardedCluster:
         # compiles the kernel out, same as Engine's garden=None)
         self.garden = ([GardenTables(nbuckets=spoof_nbuckets)
                         for _ in range(n_shards)] if garden_enabled else None)
+        # PPPoE session tables, chip-local like NAT/QoS: by_sid AND by_ip
+        # rows live on the subscriber's affinity shard — the ring steers
+        # session DATA by the inner src IP (bngring.h steering spec), so
+        # the decap always happens where the session row is
+        self.pppoe = ([PPPoEFastPathTables(nbuckets=pppoe_nbuckets,
+                                           server_mac=server_mac)
+                       for _ in range(n_shards)] if pppoe_enabled else None)
         self.geom = PipelineGeom(
             dhcp=self.fastpath[0].geom,
             nat=self.nat[0].geom,
             qos=self.qos[0].geom,
             spoof=self.spoof[0].geom,
             garden=self.garden[0].geom if garden_enabled else None,
+            pppoe=self.pppoe[0].geom if pppoe_enabled else None,
         )
         self._step = _sharded_step_jit(self.mesh, self.geom, self.n)
         self._dhcp_step = _sharded_dhcp_jit(self.mesh, self.geom, self.n)
@@ -290,6 +307,24 @@ class ShardedCluster:
             raise RuntimeError("device garden gate disabled for this cluster")
         for g in self.garden:  # policy is global; membership is per-shard
             g.allow_destination(ip, port, proto)
+
+    def pppoe_session_up(self, sess) -> int:
+        """Publish an OPEN PPPoE session on its affinity shard (both
+        directions: by_sid for upstream decap, by_ip for downstream
+        encap — the ring steers both sides there)."""
+        if self.pppoe is None:
+            raise RuntimeError("PPPoE disabled for this cluster")
+        o = self.affinity_shard_ip(sess.assigned_ip)
+        self.pppoe[o].session_up(sess)
+        return o
+
+    def pppoe_session_down(self, event) -> int:
+        if self.pppoe is None:
+            raise RuntimeError("PPPoE disabled for this cluster")
+        sess = getattr(event, "session", event)
+        o = self.affinity_shard_ip(sess.assigned_ip)
+        self.pppoe[o].session_down(event)
+        return o
 
     def pub_ip_map(self) -> dict[int, int]:
         """NAT public IP -> owner shard (downstream ring steering).
@@ -425,6 +460,8 @@ class ShardedCluster:
                        self.garden[i].update_slots),
                    jnp.asarray(self.garden[i].allowed))
                   if self.garden is not None else ()),
+                *(self.pppoe[i].make_updates()
+                  if self.pppoe is not None else ()),
             )
             for i in range(self.n)
         ]))
@@ -458,6 +495,12 @@ class ShardedCluster:
                         if self.garden is not None else None),
                 garden_allowed=(jnp.asarray(self.garden[i].allowed)
                                 if self.garden is not None else None),
+                pppoe_by_sid=(self.pppoe[i].by_sid.device_state()
+                              if self.pppoe is not None else None),
+                pppoe_by_ip=(self.pppoe[i].by_ip.device_state()
+                             if self.pppoe is not None else None),
+                pppoe_server_mac=(jnp.asarray(self.pppoe[i].server_mac)
+                                  if self.pppoe is not None else None),
             )
             per_shard.append(t)
         self.tables = self._stack_per_shard(per_shard)
@@ -652,7 +695,10 @@ class ShardedCluster:
             self._fold_stats(dhcp=np.asarray(stats))
         else:
             (verdict_d, out_pkt, out_len, _tables, dhcp_stats, nat_stats,
-             qos_stats, spoof_stats, nat_punt, viol_d, *garden_stats) = out[1]
+             qos_stats, spoof_stats, nat_punt, viol_d, *tails) = out[1]
+            tails = list(tails)
+            g_stats = tails.pop(0) if self.garden is not None else None
+            p_stats = tails.pop(0) if self.pppoe is not None else None
             verdict = np.asarray(verdict_d).astype(np.uint8)
             punt = np.asarray(nat_punt)
             viol = np.asarray(viol_d)
@@ -660,8 +706,10 @@ class ShardedCluster:
                              nat=np.asarray(nat_stats),
                              qos=np.asarray(qos_stats),
                              spoof=np.asarray(spoof_stats),
-                             garden=(np.asarray(garden_stats[0])
-                                     if garden_stats else None))
+                             garden=(np.asarray(g_stats)
+                                     if g_stats is not None else None),
+                             pppoe=(np.asarray(p_stats)
+                                    if p_stats is not None else None))
         ring.complete(verdict, np.asarray(out_pkt),
                       np.asarray(out_len).astype(np.uint32), B)
 
@@ -722,7 +770,10 @@ class ShardedCluster:
         """
         out = self._dispatch_fused(pkt, length, from_access, now_s, now_us)
         (verdict, out_pkt, out_len, _new_tables, dhcp_stats, nat_stats,
-         qos_stats, spoof_stats, nat_punt, viol, *garden_stats) = out
+         qos_stats, spoof_stats, nat_punt, viol, *tails) = out
+        tails = list(tails)
+        garden_stats = [tails.pop(0)] if self.garden is not None else []
+        pppoe_stats = [tails.pop(0)] if self.pppoe is not None else []
         return {
             "verdict": np.asarray(verdict),
             "out_pkt": out_pkt,
@@ -735,4 +786,6 @@ class ShardedCluster:
             "violation": np.asarray(viol),
             **({"garden_stats": np.asarray(garden_stats[0])}
                if garden_stats else {}),
+            **({"pppoe_stats": np.asarray(pppoe_stats[0])}
+               if pppoe_stats else {}),
         }

@@ -105,6 +105,17 @@ def shard_of(frame: bytes, flags: int, n_shards: int,
                 if s is not None and s < n_shards:
                     return s
             return fnv1a32(dst) % n_shards
+        if (et == 0x8864 and (flags & FLAG_FROM_ACCESS)
+                and len(frame) >= off + 8 + 20
+                and frame[off] == 0x11 and frame[off + 1] == 0
+                and (frame[off + 6] << 8) | frame[off + 7] == 0x0021
+                and (frame[off + 8] >> 4) == 4):
+            # PPPoE session DATA (PPP proto IPv4): steer by the INNER
+            # source IP — the same affinity key the decap'd packet's
+            # chip-local NAT/QoS/session state is placed with. PPPoE
+            # control (discovery/LCP/auth/IPCP) falls through to the
+            # sticky MAC hash; any shard's slow path handles it.
+            return fnv1a32(frame[off + 8 + 12 : off + 8 + 16]) % n_shards
     return fnv1a32(frame[6:12]) % n_shards
 
 

@@ -387,6 +387,16 @@ uint32_t bng_ring_shard_of(bng_ring *r, const uint8_t *p, uint32_t len,
       }
       return fnv1a32_bytes(dst, 4) % n;
     }
+    /* PPPoE session DATA (PPP proto IPv4): steer by the INNER src IP —
+     * the affinity key the decap'd packet's chip-local NAT/QoS/session
+     * state is placed with.  PPPoE control falls through to the sticky
+     * MAC hash (any shard's slow path handles negotiation). */
+    if (et == 0x8864 && (flags & BNG_DESC_F_FROM_ACCESS) &&
+        len >= off + 8 + 20 && p[off] == 0x11 && p[off + 1] == 0 &&
+        ((static_cast<uint32_t>(p[off + 6]) << 8) | p[off + 7]) == 0x0021 &&
+        (p[off + 8] >> 4) == 4) {
+      return fnv1a32_bytes(p + off + 8 + 12, 4) % n;
+    }
   }
   /* DHCP control (any shard correct; MAC = sticky) and non-IPv4 */
   return fnv1a32_bytes(p + 6, 6) % n;

@@ -111,6 +111,11 @@ bng_ring *bng_ring_create(uint32_t nframes, uint32_t frame_size,
  *   - network-side IPv4: public-IP exact-match table (set per shard via
  *     bng_ring_steer_pub_ip — downstream NAT state lives on the shard
  *     that owns the public IP); miss -> FNV-1a32(4 dst-IP bytes) % n.
+ *   - access-side PPPoE session DATA (ethertype 0x8864, ver_type 0x11,
+ *     code 0, PPP proto 0x0021, inner version 4): FNV-1a32(4 INNER
+ *     src-IP bytes) % n — the decap'd packet's affinity key, so the
+ *     chip-local PPPoE session/NAT/QoS state and the traffic meet.
+ *     PPPoE control (discovery/LCP/auth/IPCP) falls to the MAC hash.
  *   - non-IPv4 / unparseable: FNV-1a32(src MAC) % n (len<14: shard 0).
  */
 bng_ring *bng_ring_create_sharded(uint32_t nframes, uint32_t frame_size,

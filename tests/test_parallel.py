@@ -730,3 +730,108 @@ class TestClusterRingPipelined:
         assert cl._inflight is None
         assert ring.rx_push(self._discover(mac, 3), from_access=True)
         assert cl.process_ring(ring, self.T0 + 3, 3_000_000) == 1
+
+
+class TestClusterPPPoE:
+    """PPPoE on the multichip path (round 5): session DATA steers by the
+    INNER src IP (bngring.h spec addition) to the shard holding the
+    session row, where it decaps + SNATs in the sharded fused step;
+    downstream DNATs + re-encaps on the public-IP owner shard."""
+
+    T0 = 1_753_000_000
+    AC = bytes.fromhex("02aabbccdd01")
+
+    def _data_frame(self, mac, sid, src_ip, dst_ip, sport):
+        from bng_tpu.control.pppoe import codec
+        from bng_tpu.ops import pppoe as P
+
+        inner = packets.udp_packet(mac, self.AC, src_ip, dst_ip,
+                                   sport, 443, b"d" * 48)[14:]
+        return codec.eth_frame(
+            self.AC, mac, codec.ETH_PPPOE_SESSION,
+            codec.PPPoEPacket(code=0, session_id=sid,
+                              payload=codec.ppp_frame(P.PPP_IPV4,
+                                                      inner)).encode())
+
+    def test_steering_and_device_data_path(self):
+        from bng_tpu.control.pppoe import codec
+
+        n = 2
+        cl = ShardedCluster(n, batch_per_shard=8, pppoe_enabled=True,
+                            server_mac=self.AC, garden_enabled=False)
+        cl.set_server_config_all(self.AC, ip_to_u32("10.0.0.1"))
+
+        class Sess:
+            session_id = 0x31
+            client_mac = bytes.fromhex("02c0ffee0aa1")
+            assigned_ip = ip_to_u32("10.0.0.111")
+
+        owner = cl.pppoe_session_up(Sess())
+        assert owner == cl.affinity_shard_ip(Sess.assigned_ip)
+        nat_owner, _ = cl.allocate_nat(Sess.assigned_ip, self.T0)
+        assert nat_owner == owner  # one affinity key places everything
+        cl.handle_new_flow(Sess.assigned_ip, ip_to_u32("9.9.9.9"),
+                           41000, 443, 17, 600, self.T0)
+        cl.sync_tables()
+        ring = cl.make_ring(nframes=256, frame_size=2048, depth=64)
+
+        up = self._data_frame(Sess.client_mac, 0x31, Sess.assigned_ip,
+                              ip_to_u32("9.9.9.9"), 41000)
+        # the ring steers the PPPoE DATA frame by the INNER src ip
+        assert ring.shard_of(up, 0x1) == owner
+        # ...and PPPoE CONTROL by the sticky MAC hash (any shard ok)
+        padi = codec.eth_frame(b"\xff" * 6, Sess.client_mac,
+                               codec.ETH_PPPOE_DISCOVERY,
+                               codec.PPPoEPacket(code=codec.CODE_PADI,
+                                                 session_id=0,
+                                                 payload=b"").encode())
+        from bng_tpu.runtime.ring import shard_of as py_shard
+        from bng_tpu.utils.net import fnv1a32
+        assert ring.shard_of(padi, 0x1) == fnv1a32(Sess.client_mac) % n
+
+        assert ring.rx_push(up, from_access=True)
+        got = cl.process_ring(ring, self.T0 + 1, 1_000_000)
+        assert got == 1
+        assert ring.fwd_pending() == 1
+        fwd, _fl = ring.fwd_pop()
+        d = packets.decode(bytes(fwd))
+        assert d.ethertype == 0x0800  # decapped on device
+        nat_pub = cl.nat[owner].public_ips[0]
+        assert d.src_ip == nat_pub  # SNAT'd on the OWNER shard
+        assert int(cl.stats["pppoe"][0]) == 1  # PST_DECAP, psum-reduced
+
+        # ---- downstream: to the public mapping, core side ----
+        down = packets.udp_packet(bytes.fromhex("02deadbeef99"), self.AC,
+                                  ip_to_u32("9.9.9.9"), nat_pub,
+                                  443, d.src_port, b"r" * 24)
+        assert ring.shard_of(down, 0x0) == owner  # public-IP ownership
+        assert ring.rx_push(down, from_access=False)
+        cl.process_ring(ring, self.T0 + 2, 2_000_000)
+        assert ring.fwd_pending() == 1
+        enc, _ = ring.fwd_pop()
+        enc = bytes(enc)
+        assert enc[0:6] == Sess.client_mac and enc[6:12] == self.AC
+        assert int.from_bytes(enc[12:14], "big") == codec.ETH_PPPOE_SESSION
+        pkt6 = codec.PPPoEPacket.decode(enc[14:])
+        assert pkt6.session_id == 0x31
+
+    def test_native_and_python_steering_agree_on_pppoe(self):
+        """The C++ classifier and the PyRing mirror must stay bit-for-bit
+        on the new PPPoE rule (spec: bngring.h)."""
+        from bng_tpu.runtime.ring import NativeRing, load_native, shard_of
+
+        if load_native() is None:
+            pytest.skip("native lib unavailable")
+        ring = NativeRing(nframes=64, frame_size=2048, depth=16, n_shards=4)
+        try:
+            rng = np.random.default_rng(5)
+            for i in range(64):
+                mac = bytes([0x02]) + bytes(rng.integers(0, 256, 5).tolist())
+                sid = int(rng.integers(1, 0xFFFF))
+                src = int(rng.integers(1, 2**32 - 1))
+                dst = int(rng.integers(1, 2**32 - 1))
+                f = self._data_frame(mac, sid, src, dst, 40000 + i)
+                for fl in (0x1, 0x0):  # access and core side
+                    assert ring.shard_of(f, fl) == shard_of(f, fl, 4, {})
+        finally:
+            ring.close()
