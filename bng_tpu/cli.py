@@ -48,6 +48,10 @@ class BNGConfig:
     # radius server is configured. Spool path "" = in-memory only.
     acct_interim_interval: int = 300
     acct_spool_path: str = ""
+    # CoA/Disconnect listener (RFC 5176; pkg/radius/coa.go role) — on by
+    # default when a radius server is configured, like the reference
+    coa_enabled: bool = True
+    coa_listen: str = "0.0.0.0:3799"
     # PPPoE (pkg/pppoe; wired like main.go:1063-1180)
     pppoe_enabled: bool = False
     pppoe_ac_name: str = "bng-tpu"
@@ -179,6 +183,12 @@ class BNGApp:
         self._last_garden = 0.0
         self._last_acct_sync = 0.0
         self._last_acct_retry = 0.0
+        # serializes CoA-listener-thread actions against the main loop's
+        # slow path + maintenance sweeps (lease dict, QoS tables, demux
+        # pending queue) — the goroutine-with-mutex role of the reference
+        import threading as _threading
+
+        self._ctl = _threading.Lock()
         self._syn_i = 0
         self.components: dict[str, object] = {}
         try:
@@ -746,6 +756,115 @@ class BNGApp:
                 pppoe=c.get("pppoe"), clock=self.clock)
             c["engine"].slow_path = demux
 
+        # 10d. CoA/Disconnect listener (RFC 5176; coa.go:119-240 +
+        # coa_handler.go:175-460): dynamic authorization reaches BOTH
+        # session kinds — DHCP leases (policy -> device QoS; disconnect
+        # force-expires the lease) and PPPoE sessions (disconnect runs
+        # the LCP/PADT teardown, frames ride the demux pending queue to
+        # the wire).
+        if cfg.radius_server and cfg.coa_enabled:
+            from bng_tpu.control.radius.coa import CoAProcessor, CoAServer
+            from bng_tpu.utils.net import mac_to_u64
+
+            pppoe_srv = c.get("pppoe")
+
+            def _find_by_ip(ip):
+                for lease in dhcp.leases.values():
+                    if lease.ip == ip:
+                        return ("dhcp", lease)
+                if pppoe_srv is not None:
+                    for s in pppoe_srv.sessions.all():
+                        if s.assigned_ip == ip:
+                            return ("pppoe", s)
+                return None
+
+            def _find_by_sid(sid):
+                for lease in dhcp.leases.values():
+                    if lease.session_id == sid:
+                        return ("dhcp", lease)
+                if pppoe_srv is not None and sid.startswith("pppoe-"):
+                    try:
+                        num = int(sid.split("-")[1], 16)
+                    except (IndexError, ValueError):
+                        return None
+                    s = pppoe_srv.sessions.get(num)
+                    if s is not None:
+                        return ("pppoe", s)
+                return None
+
+            def _find_by_mac(mac_str):
+                try:
+                    mac = bytes.fromhex(mac_str.replace("-", "")
+                                        .replace(":", ""))
+                except ValueError:
+                    return None
+                lease = dhcp.leases.get(mac_to_u64(mac))
+                if lease is not None:
+                    return ("dhcp", lease)
+                if pppoe_srv is not None:
+                    for s in pppoe_srv.sessions.all():
+                        if s.client_mac == mac:
+                            return ("pppoe", s)
+                return None
+
+            def _coa_qos(ip, policy_name):
+                if qos_hook is None:
+                    return False  # QoS disabled: a CoA rate change NAKs
+                qos_hook(ip, policy_name)  # processor pre-validates name
+                return True
+
+            def _coa_disconnect(handle):
+                kind, obj = handle
+                if kind == "dhcp":
+                    obj.expiry = 0
+                    dhcp.cleanup_expired(1)  # reaps only the forced lease
+                    return True
+                from bng_tpu.control.pppoe.session import TerminateCause
+
+                frames = pppoe_srv.terminate(
+                    obj.session_id, TerminateCause.ADMIN_RESET,
+                    now=self.clock())
+                if "slowpath" in c:
+                    # PADT/LCP teardown frames ride the demux pending
+                    # queue; drive_once injects them on the TX ring
+                    c["slowpath"]._pending.extend(frames)
+                return True
+
+            class _CoASession:  # adapt (kind, obj) to processor's .ip read
+                pass
+
+            def _wrap(found):
+                if found is None:
+                    return None
+                kind, obj = found
+                h = _CoASession()
+                h.kind, h.obj = kind, obj
+                h.ip = obj.ip if kind == "dhcp" else obj.assigned_ip
+                return h
+
+            def _locked(fn):
+                def run(*a):
+                    with self._ctl:
+                        return fn(*a)
+                return run
+
+            proc = CoAProcessor(
+                find_by_session_id=_locked(lambda sid: _wrap(_find_by_sid(sid))),
+                find_by_ip=_locked(lambda ip: _wrap(_find_by_ip(ip))),
+                find_by_mac=_locked(lambda m: _wrap(_find_by_mac(m))),
+                qos_update=_locked(_coa_qos),
+                disconnect=_locked(
+                    lambda h: _coa_disconnect((h.kind, h.obj))),
+                policy_manager=policies)
+            host, _, port = cfg.coa_listen.rpartition(":")
+            coa = c["coa"] = CoAServer(
+                resolve_secret(cfg.radius_secret,
+                               cfg.radius_secret_file).encode(),
+                proc, bind=(host or "0.0.0.0", int(port or 3799)))
+            coa.start()
+            self._on_close(coa.stop)
+            self.log.info("coa listener", addr=f"{coa.addr[0]}:{coa.addr[1]}")
+
         # 11. HA pair (main.go:759-881)
         if cfg.ha_role:
             from bng_tpu.control.ha import (ActiveSyncer, InMemorySessionStore,
@@ -942,21 +1061,25 @@ class BNGApp:
             pumped = att.xsk.pump()  # kernel -> ring before the step
         if self.config.synthetic_subs:
             self._push_synthetic(ring)
-        moved = self.components["engine"].process_ring_pipelined(ring)
+        with self._ctl:
+            moved = self.components["engine"].process_ring_pipelined(ring)
         demux = self.components.get("slowpath")
         if demux is not None:
             # PPPoE negotiation extras beyond the one-inline-reply slow
             # contract (CHAP-Success + IPCP Conf-Req in one beat). A full
             # TX ring re-queues the frame for the next beat (the FSM
             # retransmit would recover anyway, but without the drop).
-            pending = demux.drain_pending()
-            for i, frame in enumerate(pending):
-                if ring.tx_inject(frame, from_access=True):
-                    moved += 1
-                else:
-                    # re-queue the WHOLE un-injected remainder in order
-                    demux._pending[:0] = pending[i:]
-                    break
+            # Under _ctl: a CoA disconnect may extend the queue
+            # concurrently, and drain's swap must not lose its frames.
+            with self._ctl:
+                pending = demux.drain_pending()
+                for i, frame in enumerate(pending):
+                    if ring.tx_inject(frame, from_access=True):
+                        moved += 1
+                    else:
+                        # re-queue the WHOLE un-injected remainder
+                        demux._pending[:0] = pending[i:]
+                        break
         if att is not None and att.xsk is not None:
             pumped += att.xsk.pump()  # verdicts -> kernel after the step
         return moved + pumped
@@ -1003,6 +1126,10 @@ class BNGApp:
           generated frames TX-inject on the ring (socket-write role)
         """
         now = now if now is not None else self.clock()
+        with self._ctl:
+            self._tick_locked(now)
+
+    def _tick_locked(self, now: float) -> None:
         c = self.components
         ha = c.get("ha")
         if ha is not None and hasattr(ha, "tick"):  # StandbySyncer only

@@ -180,7 +180,7 @@ class DHCPv6Server:
         # bindings: (duid, iaid, is_pd) -> Lease6
         self.leases: dict[tuple[bytes, int, bool], Lease6] = {}
 
-    MAX_RELAY_HOPS = 32  # RFC 8415 §7.6 HOP_COUNT_LIMIT
+    MAX_RELAY_HOPS = 8  # RFC 8415 §7.6 HOP_COUNT_LIMIT (8; RFC 3315's 32 is obsolete)
 
     # ------------------------------------------------------------------
     def handle_message(self, raw: bytes) -> bytes | None:

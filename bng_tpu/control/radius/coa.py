@@ -118,6 +118,7 @@ class CoAServer:
     def start(self) -> None:
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
         self._sock.bind(self.bind)
+        self.addr = self._sock.getsockname()  # bind=port 0 -> real port
         self._sock.settimeout(0.5)
         self._running = True
         self._thread = threading.Thread(target=self._loop, daemon=True)

@@ -748,7 +748,13 @@ class ShardedCluster:
         """Device egress-miss: create the session on the OWNER shard
         (Engine._punt_new_flow with owner routing in front)."""
         from bng_tpu.control import packets as P
+        from bng_tpu.runtime.engine import Engine
 
+        if self.pppoe is not None:
+            # the punt carries the ORIGINAL ring bytes — for a PPPoE
+            # subscriber still session-framed; strip to the inner IPv4
+            # view or the flow permanently blackholes (Engine parity)
+            frame = Engine._strip_pppoe_host(frame)
         try:
             d = P.decode(frame)
         except Exception:

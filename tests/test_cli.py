@@ -740,3 +740,117 @@ class TestNexusPeerResilienceWiring:
         finally:
             app.close()
             srv.close()
+
+
+class TestCoAThroughApp:
+    """RFC 5176 dynamic authorization reaches both session kinds from
+    `bng run` (cmd/bng wiring of coa.go + coa_handler.go): a Disconnect
+    tears down a live PPPoE session (PADT to the wire) and a CoA
+    policy change rewrites a DHCP subscriber's device QoS row."""
+
+    def _coa_send(self, app, pkt_bytes):
+        import socket as so
+
+        coa = app.components["coa"]
+        s = so.socket(so.AF_INET, so.SOCK_DGRAM)
+        s.settimeout(3.0)
+        s.sendto(pkt_bytes, ("127.0.0.1", coa.addr[1]))
+        data, _ = s.recvfrom(4096)
+        s.close()
+        return data
+
+    def test_disconnect_pppoe_and_coa_dhcp_policy(self):
+        from bng_tpu.control import dhcp_codec, packets
+        from bng_tpu.control.radius import packet as rp
+        from bng_tpu.control.radius.packet import (RadiusPacket,
+                                                   new_request_authenticator)
+        from bng_tpu.runtime.ring import PyRing
+        from bng_tpu.utils.net import ip_to_u32
+        from tests.test_pppoe import SimClient
+
+        app = BNGApp(BNGConfig(
+            pppoe_enabled=True, pppoe_auth="chap",
+            pppoe_users=[{"username": "alice", "password": "secret123"}],
+            radius_server="10.0.0.5:1812", radius_secret="s3cr3t",
+            coa_listen="127.0.0.1:0",
+            dhcpv6_enabled=False, slaac_enabled=False,
+            walled_garden_enabled=False, metrics_enabled=False,
+            batch_size=8))
+        try:
+            # RADIUS auth server is unreachable; PPPoE falls back? No —
+            # with radius configured the verifier is RADIUS-backed, so
+            # use a working fake transport for the CHAP exchange
+            from tests.test_radius import FakeRadiusServer
+            app.components["radius"].transport = FakeRadiusServer(users={
+                "alice": {"password": "secret123"},
+                "": {"password": ""}})  # MAC-auth DHCP subscribers
+
+            ring = PyRing(nframes=128, frame_size=2048, depth=32)
+            app.components["ring"] = ring
+
+            class RingClient(SimClient):
+                def _pump(cli, frames, now):
+                    pending = list(frames)
+                    while pending:
+                        for f in pending:
+                            assert ring.rx_push(f, from_access=True)
+                        pending = []
+                        for _ in range(4):
+                            app.drive_once()
+                        while (got := ring.tx_pop()) is not None:
+                            pending.extend(cli._react(got[0], now))
+
+            cli = RingClient(app.components["pppoe"])
+            cli.connect()
+            assert cli.session_id and cli.ipcp_done
+
+            # ---- Disconnect-Request by Framed-IP over the REAL socket
+            req = RadiusPacket(rp.DISCONNECT_REQUEST, 7)
+            req.add(rp.FRAMED_IP_ADDRESS, cli.ip)
+            data = self._coa_send(app, req.encode(b"s3cr3t"))
+            resp = RadiusPacket.decode(data)
+            assert resp.code == rp.DISCONNECT_ACK
+            assert app.components["pppoe"].sessions.get(cli.session_id) is None
+            # the PADT rides the demux pending queue to the TX ring
+            for _ in range(2):
+                app.drive_once()
+            padt_seen = False
+            from bng_tpu.control.pppoe.codec import (CODE_PADT,
+                                                     ETH_PPPOE_DISCOVERY,
+                                                     PPPoEPacket)
+            while (got := ring.tx_pop()) is not None:
+                f = got[0]
+                if int.from_bytes(f[12:14], "big") == ETH_PPPOE_DISCOVERY:
+                    if PPPoEPacket.decode(f[14:]).code == CODE_PADT:
+                        padt_seen = True
+            assert padt_seen, "no PADT reached the wire"
+
+            # ---- CoA policy change for a DHCP subscriber ----
+            dhcp = app.components["dhcp"]
+            mac = bytes.fromhex("02cc00000001")
+            p = dhcp_codec.build_request(mac, dhcp_codec.DISCOVER)
+            offer = dhcp.handle_frame(packets.udp_packet(
+                mac, b"\xff" * 6, 0, 0xFFFFFFFF, 68, 67,
+                p.encode().ljust(320, b"\x00")))
+            o = dhcp_codec.decode(packets.decode(offer).payload)
+            r = dhcp_codec.build_request(
+                mac, dhcp_codec.REQUEST, requested_ip=o.yiaddr,
+                server_id=ip_to_u32(app.config.server_ip))
+            assert dhcp.handle_frame(packets.udp_packet(
+                mac, b"\xff" * 6, 0, 0xFFFFFFFF, 68, 67,
+                r.encode().ljust(320, b"\x00"))) is not None
+
+            coa = RadiusPacket(rp.COA_REQUEST, 9)
+            coa.add(rp.FRAMED_IP_ADDRESS, o.yiaddr)
+            coa.add(rp.FILTER_ID, "business-100mbps")
+            data = self._coa_send(app, coa.encode(b"s3cr3t"))
+            assert RadiusPacket.decode(data).code == rp.COA_ACK
+            # device QoS row carries the new policy's rate
+            qos = app.components["qos"]
+            row = qos.down.lookup(o.yiaddr)
+            pol = app.components["policies"].get("business-100mbps")
+            assert row is not None and pol is not None
+            assert row["rate_bps"] == pol.download_bps
+            assert row["priority"] == pol.priority
+        finally:
+            app.close()
