@@ -11,7 +11,12 @@ eBPF-free full-lifecycle simulation (demo.go:46-120).
 
 The TPU twist: where runBNG loads XDP programs, run() builds the device
 Engine (fused Pallas/jnp pipeline + HBM tables) and drives it from a
-packet source; everything else stays host-side control plane.
+packet source; everything else stays host-side control plane. As of
+round 5 the full construction order is wired: deviceauth (4a), Nexus
+HTTPAllocator + resilience FSM (4b), peer pool (4c), RADIUS accounting
+(7b), PPPoE with the device data path (10c), the CoA/Disconnect
+listener (10d), TLS/mTLS on the cluster wire, and App.tick as the 1 Hz
+maintenance heartbeat for every periodic goroutine of the reference.
 """
 
 from __future__ import annotations
@@ -1218,6 +1223,26 @@ class BNGApp:
         pools = self.components.get("pools")
         if pools is not None:
             out["pools"] = pools.stats()
+        pppoe = self.components.get("pppoe")
+        if pppoe is not None and eng is not None:
+            out["pppoe"] = {
+                "sessions": len(pppoe.sessions),  # atomic vs CoA thread
+                "opened": pppoe.stats.sessions_opened,
+                "closed": pppoe.stats.sessions_closed,
+                "auth_failures": pppoe.stats.auth_failure,
+                "device": {"decap": int(eng.stats.pppoe[0]),
+                           "encap": int(eng.stats.pppoe[1])}}
+        nat = self.components.get("nat")
+        if nat is not None:  # registered only when nat_enabled
+            out["nat"] = {"sessions": nat.sessions.count,
+                          "blocks": len(nat.blocks)}
+        res = self.components.get("resilience")
+        if res is not None:
+            out["resilience"] = {"state": res.state.value,
+                                 "degraded_auth": res.degraded_auth_active}
+        coa = self.components.get("coa")
+        if coa is not None:
+            out["coa"] = {**coa.stats, **coa.processor.stats}
         return out
 
 
