@@ -152,6 +152,13 @@ class BNGConfig:
     node_id: str = "bng0"
 
 
+def pppoe_sid(sess) -> str:
+    """One Acct-Session-Id format for a PPPoE session — shared by
+    accounting start/stop, the CoA locator, and HA replication keys
+    (drifting copies would strand sessions in the standby store)."""
+    return f"pppoe-{sess.session_id:04x}-{sess.client_mac.hex()}"
+
+
 def resolve_secret(value: str, file_path: str) -> str:
     """main.go:1567: prefer --*-file so secrets stay out of ps."""
     if file_path:
@@ -539,8 +546,8 @@ class BNGApp:
                     _acct.start(sid, username=lease.username
                                 or _u32ip(lease.ip), framed_ip=lease.ip,
                                 mac="-".join(f"{b:02X}" for b in lease.mac))
-                else:
-                    _acct.stop(sid)
+                elif event == "stop":
+                    _acct.stop(sid)  # renew extends, it never stops
 
             dhcp.accounting_hook = _acct_lease
 
@@ -631,10 +638,10 @@ class BNGApp:
                             _resolver=resolver, _gt=gt):
                 if prev_acct is not None:
                     prev_acct(event, lease, sid)
-                if event == "start":
+                if event in ("start", "renew"):
                     _apply_garden_ip(_garden.get_subscriber_state(lease.mac),
                                      lease.ip)
-                else:
+                elif event == "stop":
                     _gt.set_gardened(lease.ip, False)
                     if _resolver is not None:
                         _resolver.remove_walled_garden_client(
@@ -717,8 +724,7 @@ class BNGApp:
                 if cfg.nat_enabled:
                     nat.allocate_nat(sess.assigned_ip, int(self.clock()))
                 if _acct is not None:
-                    sid = f"pppoe-{sess.session_id:04x}-{sess.client_mac.hex()}"
-                    _acct.start(sid, username=sess.username,
+                    _acct.start(pppoe_sid(sess), username=sess.username,
                                 framed_ip=sess.assigned_ip,
                                 mac="-".join(f"{b:02X}"
                                              for b in sess.client_mac))
@@ -731,8 +737,7 @@ class BNGApp:
                 if cfg.nat_enabled and sess.assigned_ip:
                     nat.release_nat(sess.assigned_ip, int(self.clock()))
                 if _acct is not None:
-                    _acct.stop(f"pppoe-{sess.session_id:04x}-"
-                               f"{sess.client_mac.hex()}")
+                    _acct.stop(pppoe_sid(sess))
 
             c["pppoe"] = PPPoEServer(
                 PPPoEServerConfig(
@@ -788,6 +793,7 @@ class BNGApp:
                     if lease.session_id == sid:
                         return ("dhcp", lease)
                 if pppoe_srv is not None and sid.startswith("pppoe-"):
+                    # inverse of pppoe_sid() — keep in lockstep
                     try:
                         num = int(sid.split("-")[1], 16)
                     except (IndexError, ValueError):
@@ -816,6 +822,17 @@ class BNGApp:
                 if qos_hook is None:
                     return False  # QoS disabled: a CoA rate change NAKs
                 qos_hook(ip, policy_name)  # processor pre-validates name
+                # record the new plan on the lease and re-push through
+                # the hook chain so HA replication (and any other
+                # lease-state consumer) sees the change — else failover
+                # restores the PRE-CoA policy
+                lease = next((l for l in dhcp.leases.values()
+                              if l.ip == ip), None)
+                if lease is not None:
+                    lease.qos_policy = policy_name
+                    if dhcp.accounting_hook is not None:
+                        dhcp.accounting_hook("renew", lease,
+                                             lease.session_id)
                 return True
 
             def _coa_disconnect(handle):
@@ -873,12 +890,74 @@ class BNGApp:
         # 11. HA pair (main.go:759-881)
         if cfg.ha_role:
             from bng_tpu.control.ha import (ActiveSyncer, InMemorySessionStore,
-                                            Role, StandbySyncer)
+                                            Role, SessionState, StandbySyncer)
             store = c["ha_store"] = InMemorySessionStore()
             if cfg.ha_role == "active":
-                c["ha"] = ActiveSyncer(store)
+                ha_sync = c["ha"] = ActiveSyncer(store)
                 self.log.info("ha role active")
                 c["ha_role"] = Role.ACTIVE
+
+                # feed the syncer from BOTH session lifecycles (the
+                # reference integrates HASyncer with its servers —
+                # sync.go:456 PushChange callers): without this the pair
+                # replicates an always-empty store.
+                def _nat_fields(ip):
+                    blk = nat.blocks.get(ip) if cfg.nat_enabled else None
+                    if blk is None:
+                        return {}
+                    return {"nat_public_ip": blk["public_ip"],
+                            "nat_port_start": blk["port_start"],
+                            "nat_port_end": blk["port_end"]}
+
+                prev_ha_hook = dhcp.accounting_hook
+
+                def _ha_lease(event, lease, sid, _ha=ha_sync):
+                    if prev_ha_hook is not None:
+                        prev_ha_hook(event, lease, sid)
+                    if event in ("start", "renew"):
+                        # renewals RE-push: the standby's lease_expiry
+                        # must track extensions or failover treats a
+                        # live subscriber as long-expired
+                        _ha.push_change(SessionState(
+                            session_id=sid, mac=lease.mac.hex(),
+                            ip=lease.ip, pool_id=lease.pool_id,
+                            circuit_id=lease.circuit_id.hex(),
+                            username=lease.username,
+                            lease_expiry=float(lease.expiry),
+                            s_tag=lease.s_tag, c_tag=lease.c_tag,
+                            qos_policy=lease.qos_policy,
+                            session_kind="ipoe",
+                            updated_at=self.clock(),
+                            **_nat_fields(lease.ip)))
+                    elif event == "stop":
+                        _ha.push_change(None, session_id=sid)
+
+                dhcp.accounting_hook = _ha_lease
+
+                if "pppoe" in c:
+                    pppoe_srv2 = c["pppoe"]
+                    prev_po, prev_pc = pppoe_srv2.on_open, pppoe_srv2.on_close
+
+                    def _ha_pppoe_open(sess, _ha=ha_sync):
+                        if prev_po is not None:
+                            prev_po(sess)
+                        _ha.push_change(SessionState(
+                            session_id=pppoe_sid(sess),
+                            mac=sess.client_mac.hex(),
+                            ip=sess.assigned_ip,
+                            username=sess.username,
+                            session_kind="pppoe",
+                            updated_at=self.clock(),
+                            **_nat_fields(sess.assigned_ip)))
+
+                    def _ha_pppoe_close(event, _ha=ha_sync):
+                        if prev_pc is not None:
+                            prev_pc(event)
+                        _ha.push_change(None,
+                                        session_id=pppoe_sid(event.session))
+
+                    pppoe_srv2.on_open = _ha_pppoe_open
+                    pppoe_srv2.on_close = _ha_pppoe_close
             else:
                 if cfg.ha_peer.startswith("http"):
                     # real wire: full sync + SSE deltas from the active's

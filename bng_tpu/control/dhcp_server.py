@@ -57,6 +57,7 @@ class Lease:
     session_id: str = ""
     client_class: int = 0
     username: str = ""
+    qos_policy: str = ""  # applied rate plan (HA failover restores it)
 
 
 @dataclass
@@ -274,6 +275,7 @@ class DHCPServer:
                 s_tag=profile.get("s_tag", 0), c_tag=profile.get("c_tag", 0),
                 session_id=f"bng-{now:x}-{self._session_seq:06x}",
                 username=profile.get("username", ""),
+                qos_policy=profile.get("qos_policy", ""),
             )
         self.leases[mk] = lease
         if cid:
@@ -291,6 +293,12 @@ class DHCPServer:
                 self.nat_hook(ip, now)
             if self.accounting_hook is not None:
                 self.accounting_hook("start", lease, lease.session_id)
+        elif self.accounting_hook is not None:
+            # renewals fire their own event: no new accounting session,
+            # but consumers tracking lease state (HA replication's
+            # lease_expiry) must see the extension or a standby holds a
+            # stale expiry forever
+            self.accounting_hook("renew", lease, lease.session_id)
 
         self.stats.ack += 1
         return self._build_reply(req, ACK, ip, pool, lease_time=lease_time)

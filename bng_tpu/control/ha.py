@@ -88,15 +88,28 @@ class ActiveSyncer:
     """
 
     def __init__(self, store: InMemorySessionStore, replay_buffer: int = 1024):
+        import threading
+
         self.store = store
         self._seq = 0
         self._replay: list[HAChange] = []
         self._replay_cap = replay_buffer
         self._subscribers: list[Callable[[HAChange], None]] = []
         self.stats = {"changes": 0, "full_syncs": 0}
+        # push_change runs on the main loop; full_sync/replay_since on
+        # the cluster listener's HTTP threads. Without this lock a push
+        # landing between the snapshot read and the seq read hands a
+        # connecting standby "snapshot WITHOUT the session, seq AFTER
+        # it" — the delta is skipped and the session is silently absent
+        # until its next lifecycle event.
+        self._lock = threading.Lock()
 
     def push_change(self, session: SessionState | None, session_id: str = "") -> None:
         """Parity: HASyncer.PushChange (sync.go:456)."""
+        with self._lock:
+            return self._push_change_locked(session, session_id)
+
+    def _push_change_locked(self, session, session_id):
         self._seq += 1
         if session is not None:
             self.store.put(session)
@@ -119,18 +132,21 @@ class ActiveSyncer:
                     self._subscribers.remove(cb)
 
     def full_sync(self) -> tuple[list[SessionState], int]:
-        """GET /sessions role: snapshot + high-water seq."""
-        self.stats["full_syncs"] += 1
-        return self.store.all(), self._seq
+        """GET /sessions role: snapshot + high-water seq — ATOMIC vs
+        push_change (see __init__'s lock note)."""
+        with self._lock:
+            self.stats["full_syncs"] += 1
+            return self.store.all(), self._seq
 
     def replay_since(self, seq: int) -> list[HAChange] | None:
         """Deltas after `seq`, or None if the gap fell out of the buffer."""
-        if seq == self._seq:
-            return []
-        missing = [c for c in self._replay if c.seq > seq]
-        if not missing or missing[0].seq != seq + 1:
-            return None  # gap: standby must full-sync
-        return missing
+        with self._lock:
+            if seq == self._seq:
+                return []
+            missing = [c for c in self._replay if c.seq > seq]
+            if not missing or missing[0].seq != seq + 1:
+                return None  # gap: standby must full-sync
+            return missing
 
     def subscribe(self, cb: Callable[[HAChange], None]) -> Callable[[], None]:
         self._subscribers.append(cb)

@@ -854,3 +854,169 @@ class TestCoAThroughApp:
             assert row["priority"] == pol.priority
         finally:
             app.close()
+
+
+class TestHAFedBySessions:
+    """VERDICT-grade gap closed in round 5: the active's HA syncer is FED
+    by real session lifecycles — a DORA on the active appears in the
+    standby's replicated store (with NAT block fields), and the lease's
+    release deletes it. Previously ActiveSyncer replicated an
+    always-empty store in a production run."""
+
+    def test_lease_lifecycle_replicates_to_standby(self):
+        import time as _time
+
+        from bng_tpu.control import dhcp_codec, packets
+        from bng_tpu.utils.net import ip_to_u32
+
+        active = BNGApp(BNGConfig(
+            ha_role="active", cluster_listen="127.0.0.1:0",
+            metrics_enabled=False, dhcpv6_enabled=False, slaac_enabled=False,
+            walled_garden_enabled=False))
+        standby = None
+        try:
+            url = active.components["cluster_server"].url
+            standby = BNGApp(BNGConfig(
+                ha_role="standby", ha_peer=url,
+                metrics_enabled=False, dhcpv6_enabled=False,
+                slaac_enabled=False, walled_garden_enabled=False))
+            standby.tick()
+            assert standby.components["ha"].connected
+
+            dhcp = active.components["dhcp"]
+            mac = bytes.fromhex("02ha00000001".replace("h", "b"))
+
+            def frame(msg, **kw):
+                p = dhcp_codec.build_request(mac, msg, **kw)
+                return packets.udp_packet(mac, b"\xff" * 6, 0, 0xFFFFFFFF,
+                                          68, 67,
+                                          p.encode().ljust(320, b"\x00"))
+
+            offer = dhcp_codec.decode(packets.decode(
+                dhcp.handle_frame(frame(dhcp_codec.DISCOVER))).payload)
+            assert dhcp.handle_frame(frame(
+                dhcp_codec.REQUEST, requested_ip=offer.yiaddr,
+                server_id=ip_to_u32(active.config.server_ip))) is not None
+            sid = next(iter(dhcp.leases.values())).session_id
+
+            # the session rides the SSE wire into the standby's store
+            store = standby.components["ha_store"]
+            for _ in range(100):
+                if store.get(sid) is not None:
+                    break
+                _time.sleep(0.05)
+            repl = store.get(sid)
+            assert repl is not None, "session never replicated"
+            assert repl.ip == offer.yiaddr and repl.mac == mac.hex()
+            assert repl.session_kind == "ipoe"
+            assert repl.nat_public_ip != 0  # NAT block fields rode along
+
+            # release -> delete delta reaches the standby
+            rel = dhcp_codec.build_request(mac, dhcp_codec.RELEASE,
+                                           ciaddr=offer.yiaddr)
+            dhcp.handle_frame(packets.udp_packet(
+                mac, b"\xff" * 6, offer.yiaddr,
+                ip_to_u32(active.config.server_ip), 68, 67,
+                rel.encode().ljust(320, b"\x00")))
+            for _ in range(100):
+                if store.get(sid) is None:
+                    break
+                _time.sleep(0.05)
+            assert store.get(sid) is None, "release never replicated"
+        finally:
+            if standby is not None:
+                standby.close()
+            active.close()
+
+    def test_renewal_and_coa_repush_track_in_standby(self):
+        """Renewals re-push (stale lease_expiry on the standby = failover
+        treats live subscribers as expired) and a CoA policy change
+        re-pushes with the new plan."""
+        import time as _time
+
+        from bng_tpu.control import dhcp_codec, packets
+        from bng_tpu.control.radius import packet as rp
+        from bng_tpu.control.radius.packet import RadiusPacket
+        from bng_tpu.utils.net import ip_to_u32
+        from tests.test_radius import FakeRadiusServer
+
+        class Clock:
+            now = 8_000_000.0
+
+            def __call__(self):
+                return Clock.now
+
+        active = BNGApp(BNGConfig(
+            ha_role="active", cluster_listen="127.0.0.1:0",
+            radius_server="10.0.0.5:1812", radius_secret="s3cr3t",
+            coa_listen="127.0.0.1:0", lease_time=600,
+            metrics_enabled=False, dhcpv6_enabled=False, slaac_enabled=False,
+            walled_garden_enabled=False), clock=Clock())
+        standby = None
+        try:
+            active.components["radius"].transport = FakeRadiusServer(
+                users={"": {"password": ""}})
+            url = active.components["cluster_server"].url
+            standby = BNGApp(BNGConfig(
+                ha_role="standby", ha_peer=url, metrics_enabled=False,
+                dhcpv6_enabled=False, slaac_enabled=False,
+                walled_garden_enabled=False))
+            standby.tick()
+            store = standby.components["ha_store"]
+            dhcp = active.components["dhcp"]
+            mac = bytes.fromhex("02ba00000077")
+
+            def request():
+                p = dhcp_codec.build_request(mac, dhcp_codec.DISCOVER)
+                offer = dhcp_codec.decode(packets.decode(
+                    dhcp.handle_frame(packets.udp_packet(
+                        mac, b"\xff" * 6, 0, 0xFFFFFFFF, 68, 67,
+                        p.encode().ljust(320, b"\x00")))).payload)
+                r = dhcp_codec.build_request(
+                    mac, dhcp_codec.REQUEST, requested_ip=offer.yiaddr,
+                    server_id=ip_to_u32(active.config.server_ip))
+                dhcp.handle_frame(packets.udp_packet(
+                    mac, b"\xff" * 6, 0, 0xFFFFFFFF, 68, 67,
+                    r.encode().ljust(320, b"\x00")))
+                return offer.yiaddr
+
+            ip = request()
+            sid = next(iter(dhcp.leases.values())).session_id
+
+            def wait(pred, what):
+                for _ in range(120):
+                    if pred():
+                        return
+                    _time.sleep(0.05)
+                raise AssertionError(what)
+
+            wait(lambda: store.get(sid) is not None, "no initial session")
+            first_expiry = store.get(sid).lease_expiry
+
+            Clock.now += 300.0  # half-life renewal (same sid, same ip)
+            assert request() == ip
+            assert next(iter(dhcp.leases.values())).session_id == sid
+            wait(lambda: store.get(sid) is not None
+                 and store.get(sid).lease_expiry > first_expiry,
+                 "renewal never re-pushed the extended expiry")
+
+            # CoA policy change re-pushes with the new plan
+            coa = RadiusPacket(rp.COA_REQUEST, 3)
+            coa.add(rp.FRAMED_IP_ADDRESS, ip)
+            coa.add(rp.FILTER_ID, "business-100mbps")
+            import socket as so
+
+            s = so.socket(so.AF_INET, so.SOCK_DGRAM)
+            s.settimeout(3.0)
+            s.sendto(coa.encode(b"s3cr3t"),
+                     ("127.0.0.1", active.components["coa"].addr[1]))
+            resp = RadiusPacket.decode(s.recvfrom(4096)[0])
+            s.close()
+            assert resp.code == rp.COA_ACK
+            wait(lambda: store.get(sid) is not None
+                 and store.get(sid).qos_policy == "business-100mbps",
+                 "CoA policy change never reached the standby")
+        finally:
+            if standby is not None:
+                standby.close()
+            active.close()
