@@ -188,6 +188,56 @@ class NATManager:
             return block
         return None  # pool exhausted
 
+    def restore_block(self, private_ip: int, public_ip: int,
+                      port_start: int, port_end: int, now: int = 0) -> bool:
+        """Re-install a subscriber's EXACT port block — the HA-failover
+        restore path (failover.go:400-500 consumes the replicated
+        SessionState's nat fields): the promoted node must answer for
+        the same public mappings the failed active advertised, or every
+        established flow's return traffic blackholes. Returns False if
+        the block is unknown geometry or already claimed."""
+        if private_ip in self.blocks:
+            return True  # idempotent
+        if public_ip not in self._next_block:
+            return False  # not one of OUR public IPs
+        if port_end - port_start + 1 != self.ports_per_subscriber:
+            return False
+        # carve the range out of the allocator's bookkeeping so later
+        # fresh allocations can never hand the same ports out again
+        if port_start in self._free_blocks[public_ip]:
+            self._free_blocks[public_ip].remove(port_start)
+        elif port_start >= self._next_block[public_ip]:
+            # advance the cursor past the restored block, returning any
+            # skipped-over blocks to the free list
+            cur = self._next_block[public_ip]
+            while cur < port_start:
+                self._free_blocks[public_ip].append(cur)
+                cur += self.ports_per_subscriber
+            self._next_block[public_ip] = port_start + self.ports_per_subscriber
+        else:
+            return False  # inside an already-allocated region
+        sub_id = self._sub_id_seq
+        self._sub_id_seq += 1
+        block = {
+            "public_ip": public_ip,
+            "port_start": port_start,
+            "port_end": port_end,
+            "next_port": port_start,
+            "subscriber_id": sub_id,
+            "private_ip": private_ip,
+        }
+        self.blocks[private_ip] = block
+        row = np.zeros((SUBNAT_WORDS,), dtype=np.uint32)
+        row[BV_PUBLIC_IP] = public_ip
+        row[BV_PORT_START] = port_start
+        row[BV_PORT_END] = port_end
+        row[BV_NEXT_PORT] = port_start
+        row[BV_SUB_ID] = sub_id
+        self.sub_nat.insert([private_ip], row)
+        self._log(LOG_PORT_BLOCK_ASSIGN, sub_id, private_ip, public_ip,
+                  0, port_start, 0, port_end, 0, now)
+        return True
+
     def bulk_allocate_nat(self, private_ips, now: int = 0) -> int:
         """Carve port blocks for many subscribers at once (1M-scale build).
 
