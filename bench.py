@@ -920,6 +920,206 @@ def config5_sharded(on_tpu):
           hits_per_step=hit, compile_s=round(compile_s, 1))
 
 
+def scheduler_bench(on_tpu: bool) -> None:
+    """`--scheduler`: latency mode through the tiered scheduler.
+
+    Publishes the quantity the <50us OFFER p99 target actually constrains:
+    profiler-isolated per-execution device time of the express-lane
+    program (`offer_device_p99_us`), ALONGSIDE the blocked end-to-end
+    numbers (`offer_p99_us`) — on the axon tunnel the two differ by the
+    ~63ms completion-poll artifact (PERF_NOTES §1), and BENCH JSON that
+    only carries blocked numbers cannot support any honest p99 headline.
+    Also measures express OFFER latency while the bulk lane is saturated
+    (the interleaving claim) and per-lane scheduler stats.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from bng_tpu.control import packets
+    from bng_tpu.ops.dhcp import dhcp_fastpath
+    from bng_tpu.ops.parse import parse_batch
+    from bng_tpu.runtime.engine import Engine
+    from bng_tpu.runtime.scheduler import SchedulerConfig, TieredScheduler
+    from bng_tpu.runtime.verify import verify_tpu_lowering
+    from bng_tpu.utils.profiling import profile_step_durations
+
+    # lowering gate FIRST: scheduler mode refuses to publish latency
+    # numbers for programs that do not lower for the target backend
+    _mark("scheduler mode: verifying program lowering...")
+    results = verify_tpu_lowering(verbose=True, tpu=on_tpu)
+    failures = [n for n, e in results if e is not None]
+    if failures:
+        print(json.dumps({
+            "metric": "OFFER p99 device-isolated (scheduler)", "value": 0.0,
+            "unit": "us", "vs_baseline": 0.0,
+            "error": "scheduler mode refused: lowering verification failed "
+                     f"for {failures} — fix the programs or run without "
+                     "--scheduler", "failures": failures, **_DIAG}))
+        sys.exit(2)
+
+    dev = jax.devices()[0]
+    B_BULK = int(os.environ.get("BNG_BENCH_BATCH", 4096 if on_tpu else 256))
+    B_EXPR = int(os.environ.get("BNG_SCHED_EXPRESS_BATCH", 64))
+    N_SUBS = int(os.environ.get("BNG_BENCH_SUBS", 1_000_000 if on_tpu else 2_000))
+    LAT_STEPS = int(os.environ.get("BNG_BENCH_LAT_STEPS", 400 if on_tpu else 30))
+    SUSTAIN = int(os.environ.get("BNG_SCHED_SUSTAIN_STEPS", 60 if on_tpu else 6))
+    depth = int(os.environ.get("BNG_SCHED_BULK_DEPTH", 2))
+    drain_every = int(os.environ.get("BNG_SCHED_DRAIN_EVERY", 4))
+    # the scheduler stamps dispatches with the engine's wall clock, so
+    # the leases must be built against it (a fixed epoch would read as
+    # expired and every warm DISCOVER would miss to the slow path)
+    now = int(time.time())
+    rng = np.random.default_rng(42)
+
+    t_setup = time.time()
+    _mark(f"scheduler bench: {N_SUBS} subscribers, express B={B_EXPR}, "
+          f"bulk B={B_BULK} depth={depth}...")
+    fp, macs, sub_nb = _build_dhcp_tables(N_SUBS, now)
+    nat, flows = _build_nat_flows(max(1000, N_SUBS), max(250, N_SUBS // 4),
+                                  now, sub_nat_nbuckets=sub_nb)
+    engine = Engine(fp, nat, batch_size=B_BULK, pkt_slot=512)
+    sched = TieredScheduler(engine, SchedulerConfig(
+        express_batch=B_EXPR, bulk_batch=B_BULK, bulk_depth=depth,
+        drain_every=drain_every))
+    setup_s = time.time() - t_setup
+
+    def discover_batch(base_xid):
+        return [_discover_row(macs[int(rng.integers(N_SUBS))], base_xid + k)
+                for k in range(B_EXPR)]
+
+    def bulk_batch():
+        out = []
+        for k in range(B_BULK):
+            src_ip, dst_ip, sport = (int(x) for x in
+                                     flows[int(rng.integers(len(flows)))])
+            out.append(packets.udp_packet(b"\x02" * 6, b"\x04" * 6, src_ip,
+                                          dst_ip, sport, 443, b"x" * 180))
+        return out
+
+    _mark("compiling express program (scheduler path)...")
+    t_c = time.time()
+    warm = sched.process(discover_batch(0x8000))
+    express_compile_s = time.time() - t_c
+    offer_hits = len(warm["tx"])
+    _mark(f"express warm: {offer_hits}/{B_EXPR} on-device OFFERs, "
+          f"compile {express_compile_s:.1f}s; compiling bulk program...")
+    t_c = time.time()
+    sched.process(bulk_batch())
+    bulk_compile_s = time.time() - t_c
+
+    # ---- blocked end-to-end OFFER latency through the scheduler ----
+    _mark(f"blocked OFFER latency: {LAT_STEPS} express batches...")
+    llat = []
+    for k in range(LAT_STEPS):
+        frames = discover_batch(0x9000 + k * B_EXPR)
+        t1 = time.perf_counter()
+        sched.process(frames)
+        llat.append(time.perf_counter() - t1)
+    llat_us = np.asarray(llat) * 1e6
+    offer_p50 = float(np.percentile(llat_us, 50))
+    offer_p99 = float(np.percentile(llat_us, 99))
+
+    # ---- profiler-isolated device time of the express program ----
+    # a non-donating twin over the live (already express-placed) dhcp
+    # chain: the trace's per-execution events carry pure program time,
+    # free of host dispatch, demux, and tunnel sync artifacts
+    _mark("profiling express program executions...")
+    lpkt = np.zeros((B_EXPR, 512), dtype=np.uint8)
+    llen = np.zeros((B_EXPR,), dtype=np.uint32)
+    for row, f in enumerate(discover_batch(0xA000)):
+        lpkt[row, : len(f)] = np.frombuffer(f, dtype=np.uint8)
+        llen[row] = len(f)
+    def place(x):
+        return (jax.device_put(x, sched._express_dev)
+                if sched._express_dev is not None else x)
+
+    lpkt_d, llen_d = place(jnp.asarray(lpkt)), place(jnp.asarray(llen))
+    dtables = engine.tables.dhcp
+
+    @jax.jit
+    def dhcp_step(dt, pkt, ln, now_s):
+        par = parse_batch(pkt, ln)
+        res = dhcp_fastpath(pkt, ln, par, dt, fp.geom, now_s)
+        return res.is_reply, res.out_pkt, res.out_len
+
+    jax.block_until_ready(dhcp_step(dtables, lpkt_d, llen_d, jnp.uint32(now)))
+    offer_device_p50 = offer_device_p99 = 0.0
+    device_source = "none"
+    try:
+        sd = profile_step_durations(
+            lambda: dhcp_step(dtables, lpkt_d, llen_d, jnp.uint32(now)),
+            iters=max(20, min(LAT_STEPS, 200)))
+        if sd.us:
+            offer_device_p50 = sd.percentile(50)
+            offer_device_p99 = sd.percentile(99)
+            device_source = sd.source
+        else:
+            _DIAG["sched_profile_error"] = "no per-execution events in trace"
+    except Exception as e:  # profiling must never sink the benchmark
+        _DIAG["sched_profile_error"] = f"{type(e).__name__}: {e}"
+
+    # ---- express latency while the bulk lane is saturated ----
+    _mark(f"two-lane sustained load: {SUSTAIN} bulk batches + express trickle...")
+    sched.drain_completions()
+    t0 = time.time()
+    bulk_frames_sent = 0
+    express_lat = []
+
+    def drain_express_lat():
+        # drain every round: at TPU batch sizes the full run's completion
+        # stream would overflow the scheduler's bounded deque and silently
+        # evict the EARLIEST express samples, biasing the percentiles
+        express_lat.extend(c.latency_s * 1e6 for c in
+                           sched.drain_completions() if c.lane == "express")
+
+    for k in range(SUSTAIN):
+        for f in bulk_batch():
+            sched.submit(f, from_access=True)
+        bulk_frames_sent += B_BULK
+        for f in discover_batch(0xB000 + k * B_EXPR):
+            sched.submit(f, from_access=True)
+        sched.poll()
+        drain_express_lat()
+    sched.flush()
+    sustain_s = time.time() - t0
+    drain_express_lat()
+    under_load_p50 = (float(np.percentile(express_lat, 50))
+                      if express_lat else 0.0)
+    under_load_p99 = (float(np.percentile(express_lat, 99))
+                      if express_lat else 0.0)
+    bulk_mpps = bulk_frames_sent / sustain_s / 1e6 if sustain_s else 0.0
+
+    line = {
+        "metric": "OFFER p99 device-isolated (scheduler)",
+        "value": round(offer_device_p99, 1),
+        "unit": "us",
+        # <50us target (BASELINE.json): >=1.0 beats it; lower latency = higher
+        "vs_baseline": round(50.0 / offer_device_p99, 3) if offer_device_p99 else 0.0,
+        "offer_p50_us": round(offer_p50, 1),
+        "offer_p99_us": round(offer_p99, 1),
+        "offer_device_p50_us": round(offer_device_p50, 1),
+        "offer_device_p99_us": round(offer_device_p99, 1),
+        "device_time_source": device_source,
+        "offer_hits_warm": offer_hits,
+        "express_under_load_p50_us": round(under_load_p50, 1),
+        "express_under_load_p99_us": round(under_load_p99, 1),
+        "express_offers_under_load": len(express_lat),
+        "bulk_mpps_sustained": round(bulk_mpps, 3),
+        "express_batch": B_EXPR,
+        "bulk_batch": B_BULK,
+        "bulk_depth": depth,
+        "drain_every": drain_every,
+        "subscribers": N_SUBS,
+        "sched": sched.stats_snapshot(),
+        "device": str(dev),
+        "compile_s": round(express_compile_s + bulk_compile_s, 1),
+        "setup_s": round(setup_s, 1),
+        **_DIAG,
+    }
+    print(json.dumps(line))
+    _persist(line)
+
+
 _CONFIG_METRICS = {
     0: ("Mpps/chip DHCP+NAT44 fast path", "Mpps"),
     1: ("DHCP slow-path req/s (config 1)", "req/s"),
@@ -962,10 +1162,11 @@ def _run_lowering_gate(strict: bool) -> None:
         _mark(f"lowering gate FAILURES (continuing): {failures}")
 
 
-def _child_dispatch(config: int, verify_lowering: bool = False) -> None:
+def _child_dispatch(config: int, verify_lowering: bool = False,
+                    scheduler: bool = False) -> None:
     """Run one benchmark config in this process (the supervised child)."""
     try:
-        if config == 1 and not verify_lowering:
+        if config == 1 and not verify_lowering and not scheduler:
             config1_dhcp_slowpath()
             return
 
@@ -995,6 +1196,16 @@ def _child_dispatch(config: int, verify_lowering: bool = False) -> None:
         if err:
             _DIAG["backend_fallback"] = "cpu"
             _DIAG["backend_error"] = err
+        # persistent XLA compile cache: repeat bench runs skip the
+        # minutes-long compile phase (verdict weakness 5; BNG_JAX_CACHE_DIR=0 off)
+        from bng_tpu.utils.jaxenv import enable_compilation_cache
+
+        cache_dir = enable_compilation_cache()
+        if cache_dir:
+            _mark(f"compilation cache: {cache_dir}")
+        if scheduler:
+            scheduler_bench(on_tpu)
+            return
         if verify_lowering:
             if not on_tpu:
                 print(json.dumps({
@@ -1044,10 +1255,15 @@ def main_dispatch() -> None:
                     help="BASELINE.json config number (1-6); 0 = headline mix")
     ap.add_argument("--verify-lowering", action="store_true",
                     help="run the TPU-lowering gate only (CI pre-step; rc=1 on failure)")
+    ap.add_argument("--scheduler", action="store_true",
+                    help="latency mode through the tiered scheduler: "
+                         "device-isolated OFFER p50/p99 + per-lane stats "
+                         "(rc=2 if lowering verification fails)")
     args = ap.parse_args()
 
     if os.environ.get("BNG_BENCH_CHILD") == "1":
-        _child_dispatch(args.config, verify_lowering=args.verify_lowering)
+        _child_dispatch(args.config, verify_lowering=args.verify_lowering,
+                        scheduler=args.scheduler)
         return
 
     # BNG_BENCH_TIMEOUT bounds the benchmark itself; the probe window is
@@ -1069,16 +1285,18 @@ def main_dispatch() -> None:
         else:
             print(_error_line(args.config,
                               f"child rc={res.returncode}, no JSON emitted"))
-        if args.verify_lowering:  # CI pre-step: propagate the gate verdict
+        if args.verify_lowering or args.scheduler:
+            # CI pre-step / scheduler mode: propagate the child verdict
+            # (scheduler exits 2 when lowering verification refused it)
             sys.exit(res.returncode)
     except subprocess.TimeoutExpired:
         print(_error_line(args.config,
                           f"benchmark child timed out after {timeout_s:.0f}s"))
-        if args.verify_lowering:  # a gate that never ran is a failed gate
-            sys.exit(1)
+        if args.verify_lowering or args.scheduler:
+            sys.exit(1)  # a gate that never ran is a failed gate
     except Exception as e:  # pragma: no cover - spawn failure
         print(_error_line(args.config, f"supervisor error: {type(e).__name__}: {e}"))
-        if args.verify_lowering:
+        if args.verify_lowering or args.scheduler:
             sys.exit(1)
 
 

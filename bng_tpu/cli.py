@@ -38,6 +38,13 @@ class BNGConfig:
     server_ip: str = "10.0.0.1"
     server_mac: str = "02:aa:bb:cc:dd:01"
     batch_size: int = 256
+    # latency-tiered scheduler (runtime/scheduler.py): express DHCP lane +
+    # depth-pipelined bulk lane instead of the monolithic pipelined loop
+    scheduler_enabled: bool = False
+    sched_express_batch: int = 64
+    sched_express_max_wait_us: float = 200.0
+    sched_bulk_depth: int = 2
+    sched_drain_every: int = 1
     # pools (single primary pool via flags; more via YAML `pools:`)
     pool_cidr: str = "10.0.0.0/16"
     pool_gateway: str = ""
@@ -573,6 +580,24 @@ class BNGApp:
         self.log.info("engine built", batch_size=cfg.batch_size,
                       nat=cfg.nat_enabled, qos=cfg.qos_enabled)
 
+        # 9a. latency-tiered scheduler over the engine's two programs
+        # (express DHCP / depth-pipelined bulk) — opt-in; drive_once then
+        # feeds it frame-wise instead of the monolithic pipelined step
+        if cfg.scheduler_enabled:
+            from bng_tpu.runtime.scheduler import (SchedulerConfig,
+                                                   TieredScheduler)
+
+            c["scheduler"] = TieredScheduler(c["engine"], SchedulerConfig(
+                express_batch=cfg.sched_express_batch,
+                express_max_wait_us=cfg.sched_express_max_wait_us,
+                bulk_batch=cfg.batch_size,
+                bulk_depth=cfg.sched_bulk_depth,
+                drain_every=cfg.sched_drain_every), clock=self.clock)
+            self._on_close(c["scheduler"].close)
+            self.log.info("scheduler built",
+                          express_batch=cfg.sched_express_batch,
+                          bulk_depth=cfg.sched_bulk_depth)
+
         # 9b. walled-garden enforcement sync. One MAC-state feed drives
         # BOTH enforcement points: the DEVICE gate (engine.garden — a
         # pre-auth subscriber's data traffic drops on-chip; beyond the
@@ -1021,7 +1046,22 @@ class BNGApp:
             from bng_tpu.runtime import xsk as xsk_mod
             from bng_tpu.runtime.ring import make_ring
 
-            ring = c["ring"] = make_ring(frame_size=2048)
+            # the tiered scheduler consumes frames via rx_pop (two lanes
+            # retire out of dispatch order — the native ring's FIFO
+            # assemble..complete contract can't express that), so prefer
+            # the Python ring when the scheduler owns the loop. A real
+            # wire attach needs the native UMEM, which wins: forcing a
+            # PyRing would silently downgrade the NIC to in-memory mode,
+            # so with wire_if set the ring stays native and drive_once
+            # falls back to the pipelined engine loop (warned there).
+            if cfg.wire_if and "scheduler" in c:
+                self.log.warning(
+                    "scheduler enabled with a wire interface: native ring "
+                    "required for AF_XDP, scheduler will be bypassed in "
+                    "the drive loop")
+            ring = c["ring"] = make_ring(
+                frame_size=2048,
+                prefer_native=bool(cfg.wire_if) or "scheduler" not in c)
             att = xsk_mod.open_wire(ring, ifname=cfg.wire_if,
                                     queue=cfg.wire_queue)
             c["wire_attachment"] = att
@@ -1092,6 +1132,13 @@ class BNGApp:
             if cfg.walled_garden_enabled:
                 collector.add_source(
                     lambda: metrics.collect_garden(engine.stats))
+            if "scheduler" in c:
+                sched = c["scheduler"]
+                # histograms are fed live at dispatch/retire; the gauges
+                # come from the 5s scrape like every other source
+                sched.metrics = metrics
+                collector.add_source(
+                    lambda: metrics.collect_scheduler(sched))
             if cfg.dns_enabled:
                 collector.add_source(lambda: metrics.collect_dns(
                     dns_srv.stats, resolver.stats()))
@@ -1145,8 +1192,20 @@ class BNGApp:
             pumped = att.xsk.pump()  # kernel -> ring before the step
         if self.config.synthetic_subs:
             self._push_synthetic(ring)
-        with self._ctl:
-            moved = self.components["engine"].process_ring_pipelined(ring)
+        sched = self.components.get("scheduler")
+        if sched is not None and hasattr(ring, "rx_pop"):
+            with self._ctl:
+                moved = self._drive_scheduler(ring, sched)
+        else:
+            # scheduler off, or a native ring (batch assemble..complete is
+            # its contract; the two-lane out-of-order retire needs the
+            # frame-wise rx_pop only PyRing provides)
+            if sched is not None and not self._warned_no_rx_pop:
+                self._warned_no_rx_pop = True
+                self.log.warning("scheduler enabled but ring has no rx_pop; "
+                                 "using pipelined engine loop")
+            with self._ctl:
+                moved = self.components["engine"].process_ring_pipelined(ring)
         demux = self.components.get("slowpath")
         if demux is not None:
             # PPPoE negotiation extras beyond the one-inline-reply slow
@@ -1167,6 +1226,51 @@ class BNGApp:
         if att is not None and att.xsk is not None:
             pumped += att.xsk.pump()  # verdicts -> kernel after the step
         return moved + pumped
+
+    _warned_no_rx_pop = False
+
+    def _drive_scheduler(self, ring, sched) -> int:
+        """One scheduler beat over the ring: RX frames into the lanes,
+        poll (express first, bulk ring-managed), completions back out.
+        TX/FWD device output and slow-path replies are injected on the TX
+        ring; PASS frames were already handled inside the scheduler's
+        retire (slow path runs there), so nothing touches the slow ring.
+        """
+        from bng_tpu.runtime.ring import FLAG_DHCP_CTRL, FLAG_FROM_ACCESS
+        from bng_tpu.runtime.scheduler import LANE_BULK, LANE_EXPRESS
+
+        moved = 0
+        budget = sched.bulk.cfg.batch * sched.bulk.cfg.depth
+        for _ in range(budget):
+            got = ring.rx_pop()
+            if got is None:
+                break
+            frame, fl = got
+            fa = (fl & FLAG_FROM_ACCESS) != 0
+            # the ring already classified at rx_push (FLAG_DHCP_CTRL) —
+            # pass the lane so submit() skips a second header parse
+            lane = (LANE_EXPRESS if fa and (fl & FLAG_DHCP_CTRL)
+                    else LANE_BULK)
+            sched.submit(frame, from_access=fa, lane=lane)
+            # ingested frames count as movement even before their lane
+            # closes — otherwise the run loop's moved==0 idle sleep (1ms)
+            # would stretch a sub-ms express deadline close
+            moved += 1
+        moved += sched.poll()
+        if moved == 0 and (len(sched.express) or len(sched.bulk)):
+            # frames are waiting on a deadline close: keep the run loop
+            # hot (no idle sleep) so the close fires at max_wait_us, not
+            # at sleep granularity
+            moved = 1
+        for c in sched.drain_completions():
+            if c.frame is None:
+                continue
+            if c.verdict in ("tx", "fwd", "slow"):
+                # slow completions carry the handler's reply frame; a full
+                # TX ring drops it (the client's retransmit recovers, the
+                # reference's socket-write failure mode)
+                ring.tx_inject(c.frame, from_access=c.from_access)
+        return moved
 
     def _push_synthetic(self, ring, per_beat: int = 16) -> None:
         """Rotating-MAC DISCOVER source (the loadtest generator's role,
@@ -1435,13 +1539,19 @@ def run_loadtest(args) -> int:
     server = DHCPServer(server_mac, server_ip, pools, fastpath_tables=fastpath)
     engine = Engine(fastpath, nat, batch_size=args.batch_size,
                     slow_path=server.handle_frame)
+    target = engine
+    if getattr(args, "scheduler", False):
+        from bng_tpu.runtime.scheduler import SchedulerConfig, TieredScheduler
+
+        target = TieredScheduler(engine, SchedulerConfig(
+            bulk_batch=args.batch_size))
 
     cfg = BenchmarkConfig(
         batch_size=args.batch_size, duration_s=args.duration,
         warmup_s=args.warmup, unique_macs=args.macs,
         enable_renewals=args.renewals, renewal_ratio=args.renewal_ratio,
         rps_limit=args.rps)
-    bench = DHCPBenchmark(engine, cfg, log=lambda s: print(s, file=sys.stderr))
+    bench = DHCPBenchmark(target, cfg, log=lambda s: print(s, file=sys.stderr))
     res = bench.run()
 
     if args.json_out:
@@ -1525,6 +1635,9 @@ def main(argv: list[str] | None = None) -> int:
     loadp.add_argument("--json", action="store_true", dest="json_out")
     loadp.add_argument("--validate", action="store_true",
                        help="exit non-zero if performance targets not met")
+    loadp.add_argument("--scheduler", action="store_true",
+                       help="drive the latency-tiered scheduler instead of "
+                            "the engine's batch interface")
 
     sub.add_parser("version", help="print version")
 

@@ -275,6 +275,39 @@ class BNGMetrics:
             "bng_dns_cache_hit_rate", "DNS cache hit rate")
         self.dns_overloaded = r.counter(
             "bng_dns_overloaded_total", "DNS queries dropped under overload")
+        # latency-tiered scheduler (runtime/scheduler.py). No reference
+        # analog: per-packet XDP has no batches to schedule; these are the
+        # observability surface the two-lane design earns trust with.
+        lbl_lane = ("lane",)
+        self.sched_queue_depth = r.gauge(
+            "bng_sched_queue_depth", "Frames staged per scheduler lane",
+            lbl_lane)
+        self.sched_inflight = r.gauge(
+            "bng_sched_inflight_batches",
+            "Dispatched-but-unretired device batches per lane", lbl_lane)
+        self.sched_dispatches = r.counter(
+            "bng_sched_dispatches_total",
+            "Device dispatches per lane and batch-close reason",
+            ("lane", "close"))
+        self.sched_frames = r.counter(
+            "bng_sched_frames_total", "Frames retired per lane", lbl_lane)
+        self.sched_dropped = r.counter(
+            "bng_sched_dropped_total",
+            "Frames dropped at lane backpressure bound", lbl_lane)
+        self.sched_oversize_dropped = r.counter(
+            "bng_sched_oversize_dropped_total",
+            "Frames dropped at submit for exceeding the engine pkt slot")
+        self.sched_completions_evicted = r.counter(
+            "bng_sched_completions_evicted_total",
+            "Completions evicted from the bounded delivery deque")
+        self.sched_batch_occupancy = r.histogram(
+            "bng_sched_batch_occupancy_ratio",
+            "Dispatched batch fill ratio (1.0 = full close)", lbl_lane,
+            buckets=(0.0625, 0.125, 0.25, 0.5, 0.75, 0.875, 1.0))
+        self.sched_dispatch_latency = r.histogram(
+            "bng_sched_dispatch_latency_seconds",
+            "Oldest-frame submit->retire latency per dispatched batch",
+            lbl_lane)
 
     # -- collection (metrics.go:555-623) -------------------------------
 
@@ -316,6 +349,22 @@ class BNGMetrics:
             return
         self.garden_gated_drops.set_total(int(g[0]))
         self.garden_allowed_hits.set_total(int(g[1]))
+
+    def collect_scheduler(self, scheduler) -> None:
+        """TieredScheduler.stats_snapshot() -> bng_sched_* gauges/counters
+        (the histograms are fed live at dispatch/retire by the scheduler
+        itself — a 5s scrape cannot reconstruct a latency distribution)."""
+        snap = scheduler.stats_snapshot()
+        for lane in ("express", "bulk"):
+            s = snap.get(lane)
+            if not s:
+                continue
+            self.sched_queue_depth.set(s["queue_depth"], lane=lane)
+            self.sched_inflight.set(s["inflight"], lane=lane)
+            self.sched_dropped.set_total(s["dropped_overflow"], lane=lane)
+        self.sched_oversize_dropped.set_total(snap.get("oversize_dropped", 0))
+        self.sched_completions_evicted.set_total(
+            snap.get("completions_dropped", 0))
 
     def collect_dns(self, server_stats: dict, resolver_stats: dict) -> None:
         """DNSServer.stats + Resolver.stats() -> bng_dns_* families."""

@@ -630,3 +630,19 @@ class NATManager:
             jnp.asarray(self.alg),
             jnp.asarray(self.config_array()),
         )
+
+    def empty_updates(self) -> tuple:
+        """No-op table-delta batch (dirty tracking untouched) for the
+        scheduler's no-drain bulk steps; pending session deltas stay
+        queued for the next drain-cadence step. The scatter buffers come
+        from the empty_update caches; hairpin/alg/config are re-read per
+        call because the step applies them wholesale (a cached snapshot
+        would revert live NAT config between drains)."""
+        return (
+            self.sessions.empty_update(self.update_slots),
+            self.reverse.empty_update(self.update_slots),
+            self.sub_nat.empty_update(self.update_slots),
+            jnp.asarray(self.hairpin),
+            jnp.asarray(self.alg),
+            jnp.asarray(self.config_array()),
+        )

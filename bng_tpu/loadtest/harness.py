@@ -154,13 +154,20 @@ class DHCPBenchmark:
     def _program(self) -> str:
         """Which device program _process will use (recorded in the result —
         a fused-step fallback must be visible, not silent)."""
+        if getattr(self.engine, "is_scheduler", False):
+            # the tiered scheduler classifies per frame: pure-DHCP load
+            # all rides its express lane (the DHCP-only program)
+            return "tiered_scheduler"
         if self.cfg.dhcp_only_program and hasattr(self.engine, "process_dhcp"):
             return "dhcp_fastpath"
         return "fused_pipeline"
 
     def _process(self, frames: list[bytes]) -> dict:
         """Route the batch to the configured device program."""
-        if self._program() == "dhcp_fastpath":
+        program = self._program()
+        if program == "tiered_scheduler":
+            return self.engine.process(frames)
+        if program == "dhcp_fastpath":
             return self.engine.process_dhcp(frames, batch=self.cfg.batch_size)
         return self.engine.process(frames)
 

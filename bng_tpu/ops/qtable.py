@@ -359,3 +359,17 @@ class HostQTable:
             slot[:n] = ss
             rows[:n] = self.rows[ss]
         return QTableUpdate(slot=jnp.asarray(slot), rows=jnp.asarray(rows))
+
+    def empty_update(self, max_slots: int) -> QTableUpdate:
+        """All-padding QTableUpdate (no-op scatter), built without touching
+        dirty tracking and cached per size — see HostTable.empty_update
+        for the scheduler no-drain-step rationale."""
+        cache = getattr(self, "_empty_upd_cache", None)
+        if cache is None:
+            cache = self._empty_upd_cache = {}
+        upd = cache.get(max_slots)
+        if upd is None:
+            upd = cache[max_slots] = QTableUpdate(
+                slot=jnp.full((max_slots,), self.S, dtype=jnp.int32),
+                rows=jnp.zeros((max_slots, SLOT_W), dtype=jnp.uint32))
+        return upd

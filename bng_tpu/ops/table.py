@@ -539,6 +539,32 @@ class HostTable:
             idx=jnp.asarray(idx), vals=jnp.asarray(vv),
         )
 
+    def empty_update(self, max_slots: int) -> TableUpdate:
+        """An all-padding TableUpdate (applying it is a no-op scatter).
+
+        Built WITHOUT touching dirty tracking — the latency scheduler's
+        no-drain bulk steps pass this instead of make_update() so pending
+        host deltas stay queued for the next drain-cadence step rather
+        than being consumed by a step that won't ship them. The result is
+        cached per size: update buffers are not donated by the jitted
+        step, so one device-resident copy serves every no-drain step
+        (zero host->HBM traffic, the entire point of the cadence)."""
+        cache = getattr(self, "_empty_upd_cache", None)
+        if cache is None:
+            cache = self._empty_upd_cache = {}
+        upd = cache.get(max_slots)
+        if upd is None:
+            U = max_slots
+            upd = cache[max_slots] = TableUpdate(
+                bidx=jnp.full((U,), self.nbuckets, dtype=jnp.int32),
+                brows=jnp.zeros((U, WAYS * self.KW), dtype=jnp.uint32),
+                sidx=jnp.full((U,), self.stash, dtype=jnp.int32),
+                srows=jnp.zeros((U, self.KW), dtype=jnp.uint32),
+                idx=jnp.full((U,), self.S, dtype=jnp.int32),
+                vals=jnp.zeros((U, self.V), dtype=jnp.uint32),
+            )
+        return upd
+
     def lookup_batch_host(self, queries: np.ndarray) -> np.ndarray:
         """Reference host-side batched lookup (for tests)."""
         out = np.zeros((len(queries), self.V), dtype=np.uint32)

@@ -57,6 +57,27 @@ def make_mesh(n_devices: int) -> Mesh:
     return Mesh(np.array(devs), (AXIS,))
 
 
+def _shard_map(f, mesh, in_specs, out_specs):
+    """jax.shard_map across jax versions: the top-level API (check_vma
+    kwarg) landed after 0.4.x; older jaxlibs ship it as
+    jax.experimental.shard_map (check_rep kwarg). Replication checking is
+    off either way — the stats psums are deliberately cross-chip."""
+    sm = getattr(jax, "shard_map", None)
+    if sm is not None:
+        try:
+            return sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                      check_vma=False)
+        except TypeError:
+            # jax versions where shard_map is top-level but the kwarg is
+            # still the older check_rep spelling
+            return sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                      check_rep=False)
+    from jax.experimental.shard_map import shard_map as sm_exp
+
+    return sm_exp(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                  check_rep=False)
+
+
 def _sharded_geom(geom: PipelineGeom, n: int) -> PipelineGeom:
     """Mark the DHCP lookup tables as hash-sharded over the mesh axis.
 
@@ -114,12 +135,11 @@ def _sharded_step_jit(mesh: Mesh, geom: PipelineGeom, n: int):
         out_specs += (P(),)
     if has_pppoe:
         out_specs += (P(),)
-    sharded = jax.shard_map(
+    sharded = _shard_map(
         local_step,
         mesh=mesh,
         in_specs=(P(AXIS), P(AXIS), P(AXIS), P(AXIS), P(AXIS), P(), P()),
         out_specs=out_specs,
-        check_vma=False,
     )
     return jax.jit(sharded, donate_argnums=(0,))
 
@@ -149,12 +169,11 @@ def _sharded_dhcp_jit(mesh: Mesh, geom: PipelineGeom, n: int):
         return (jax.tree.map(lambda x: x[None], dhcp), res.is_reply,
                 res.out_pkt, res.out_len, jax.lax.psum(res.stats, AXIS))
 
-    sharded = jax.shard_map(
+    sharded = _shard_map(
         local,
         mesh=mesh,
         in_specs=(P(AXIS), P(AXIS), P(AXIS), P(AXIS), P()),
         out_specs=(P(AXIS), P(AXIS), P(AXIS), P(AXIS), P()),
-        check_vma=False,
     )
     return jax.jit(sharded, donate_argnums=(0,))
 
@@ -237,6 +256,10 @@ class ShardedCluster:
         self._inflight = None  # process_ring_pipelined window
         # per-step psum deltas folded by process_ring (Engine.stats role)
         self.stats: dict = {"slow_errors": 0}
+        # count AND log slow-path failures (rate-limited; Engine parity)
+        from bng_tpu.utils.structlog import SlowPathErrorLog
+
+        self._slow_err_log = SlowPathErrorLog("sharded")
 
     # ---- owner routing (must match device shard_owner) ----
     def dhcp_sub_shard(self, mac) -> int:
@@ -730,8 +753,9 @@ class ShardedCluster:
                     reply = slow_path(frame)
                     if reply is not None:
                         ring.tx_inject(reply, from_access=(fl & 0x1) != 0)
-            except Exception:  # noqa: BLE001 — slow path is untrusted input
+            except Exception as e:  # noqa: BLE001 — slow path is untrusted input
                 self.stats["slow_errors"] += 1
+                self._slow_err_log.report(e, path="ring", lane=int(lane))
         return got
 
     def _fold_stats(self, **deltas) -> None:

@@ -53,6 +53,7 @@ from bng_tpu.ops.table import HostTable, TableGeom, apply_update
 from bng_tpu.runtime.ring import FLAG_DHCP_CTRL
 from bng_tpu.runtime.tables import (FastPathTables, PPPoEFastPathTables,
                                     apply_fastpath_updates)
+from bng_tpu.utils.structlog import SlowPathErrorLog
 
 # default per-lane packet slot: a full MTU frame (1500) + headroom for
 # QinQ/PPPoE encap, like the reference's XDP frame slot. Engines that only
@@ -327,6 +328,12 @@ class Engine:
         self._inflight = None  # pipelined ring mode (process_ring_pipelined)
         self._stage_bufs = [None, None]  # ping-pong staging (lazy alloc)
         self._stage_idx = 0
+        # slow-path failures are counted AND logged (rate-limited): the
+        # counter alone dropped the traceback (server.go:330 logs each)
+        self._slow_err_log = SlowPathErrorLog("engine")
+        # bumped by resync_tables(); the scheduler watches it to know its
+        # bulk-lane DHCP replica / express placement went stale
+        self.resync_count = 0
 
         self.geom = PipelineGeom(
             dhcp=fastpath.geom, nat=nat.geom, qos=self.qos.geom,
@@ -370,6 +377,7 @@ class Engine:
         last upload (QoS tokens, NAT/session counters) resets to the host
         view — bulk installs are a provisioning-time operation."""
         self.tables = self._device_tables()
+        self.resync_count += 1
 
     def _drain_with_resync(self, drain):
         """Run a make-updates drain; on the bulk-build "full upload" signal
@@ -399,6 +407,88 @@ class Engine:
                self.pppoe.by_ip.make_update(self.pppoe.update_slots))
               if self.pppoe else ()),
         ))
+
+    # -- latency-tiered scheduler support (runtime/scheduler.py) ----------
+    #
+    # The scheduler splits the steady-state loop into an express lane
+    # (DHCP-only program, authoritative dhcp chain = self.tables.dhcp) and
+    # a bulk lane (fused pipeline over a dhcp READ REPLICA, so a bulk
+    # dispatch never rebinds — and an express dispatch never waits on —
+    # the dhcp leaves). These helpers keep the donation bookkeeping here,
+    # next to the invariants they must preserve.
+
+    def _make_bulk_updates(self):
+        """Update drain for a scheduler bulk step: real deltas for every
+        bulk-owned table, a NO-OP for the fastpath tables — the express
+        lane is the single consumer of the fastpath drain (one
+        authoritative device DHCP chain, never forked)."""
+        return (
+            self.fastpath.empty_updates(),
+            self.nat.make_updates(),
+            self.qos.up.make_update(self.qos.update_slots),
+            self.qos.down.make_update(self.qos.update_slots),
+            self.antispoof.bindings.make_update(self.antispoof.update_slots),
+            jnp.asarray(self.antispoof.ranges),
+            jnp.asarray(self.antispoof.config),
+            *((self.garden.subscribers.make_update(self.garden.update_slots),
+               jnp.asarray(self.garden.allowed)) if self.garden else ()),
+            *((self.pppoe.by_sid.make_update(self.pppoe.update_slots),
+               self.pppoe.by_ip.make_update(self.pppoe.update_slots))
+              if self.pppoe else ()),
+        )
+
+    def _empty_updates(self):
+        """No-op update batch for scheduler bulk steps between
+        drain-cadence points. The big scatter buffers (update_slots x row
+        words per table — the real per-step host->HBM traffic) come from
+        the per-table empty_update caches; the small dense config arrays
+        (spoof ranges/config, garden allowlist, NAT hairpin/alg/config,
+        DHCP pools/server) are re-read from host state EVERY call because
+        the step applies them wholesale — a cached snapshot would revert
+        live config changes on every no-drain step."""
+        return (
+            self.fastpath.empty_updates(),
+            self.nat.empty_updates(),
+            self.qos.up.empty_update(self.qos.update_slots),
+            self.qos.down.empty_update(self.qos.update_slots),
+            self.antispoof.bindings.empty_update(self.antispoof.update_slots),
+            jnp.asarray(self.antispoof.ranges),
+            jnp.asarray(self.antispoof.config),
+            *((self.garden.subscribers.empty_update(self.garden.update_slots),
+               jnp.asarray(self.garden.allowed)) if self.garden else ()),
+            *((self.pppoe.by_sid.empty_update(self.pppoe.update_slots),
+               self.pppoe.by_ip.empty_update(self.pppoe.update_slots))
+              if self.pppoe else ()),
+        )
+
+    def dispatch_scheduled_bulk(self, pkt, length, fa, now: float,
+                                dhcp_replica, drain: bool = True):
+        """Async bulk-lane dispatch for the tiered scheduler.
+
+        Runs the fused step over `dhcp_replica` instead of the
+        authoritative dhcp chain: self.tables.dhcp is NOT an input, so the
+        express program's next dispatch has no data dependency on this
+        step. The replica is donated and threaded bulk->bulk by the
+        caller. drain=False passes the cached no-op update batch — the
+        scheduler owns the drain cadence. Returns (res, new_replica);
+        outputs are futures (retire at the completion ring, never here).
+        """
+        now_s = np.uint32(int(now))
+        now_us = np.uint32(int(now * 1e6) & 0xFFFFFFFF)
+        if drain:
+            upd = self._drain_with_resync(self._make_bulk_updates)
+        else:
+            upd = self._empty_updates()
+        # read self.tables AFTER the drain (a bulk-build resync rebinds it)
+        tables_in = self.tables._replace(dhcp=dhcp_replica)
+        res: PipelineResult = self._step(
+            tables_in, upd, jnp.asarray(pkt), jnp.asarray(length),
+            jnp.asarray(fa), now_s, now_us)
+        # keep the authoritative dhcp chain out of the bulk rebind; the
+        # replica-out threads back to the scheduler
+        self.tables = res.tables._replace(dhcp=self.tables.dhcp)
+        self.stats.batches += 1
+        return res, res.tables.dhcp
 
     def _pack_frames(self, frames: list[bytes], B: int):
         """Stage a frame list into device-shaped [B, L] + lengths."""
@@ -470,8 +560,9 @@ class Engine:
                         self._punt_new_flow(frames[i], int(now))
                     elif self.slow_path is not None:
                         reply = self.slow_path(frames[i])
-                except Exception:  # noqa: BLE001 — slow path is untrusted input
+                except Exception as e:  # noqa: BLE001 — slow path is untrusted input
                     self.stats.slow_errors += 1
+                    self._slow_err_log.report(e, path="process", lane=i)
                 out["slow"].append((i, reply))
             if viol[i] and self.violation_sink is not None:
                 self.violation_sink(i, frames[i])
@@ -544,22 +635,45 @@ class Engine:
                 try:
                     if self.slow_path is not None:
                         rep = self.slow_path(frames[i])
-                except Exception:  # noqa: BLE001 — slow path is untrusted input
+                except Exception as e:  # noqa: BLE001 — slow path is untrusted input
                     self.stats.slow_errors += 1
+                    self._slow_err_log.report(e, path="process_dhcp", lane=i)
                 out["slow"].append((i, rep))
         return out
 
-    def _run_dhcp_batch(self, pkt, length, now: float) -> "_DhcpBatchResult":
+    def _place_dhcp_chain(self, device) -> None:
+        """Migrate the authoritative dhcp chain to `device` (the
+        scheduler's express-lane isolation: its own execution stream, so
+        an express dispatch cannot queue behind bulk work). Idempotent —
+        and self-healing after a resync_tables() rebind put the fresh
+        upload back on the default device."""
+        leaf = jax.tree_util.tree_leaves(self.tables.dhcp)[0]
+        if device in leaf.devices():
+            return
+        self.tables = self.tables._replace(
+            dhcp=jax.device_put(self.tables.dhcp, device))
+
+    def _run_dhcp_batch(self, pkt, length, now: float,
+                        device=None) -> "_DhcpBatchResult":
         """Dispatch one staged batch to the DHCP-only device program,
         threading (and donating) the shared dhcp table leaves. Outputs are
         futures (async, like _dispatch_step) — the caller folds stats and
         forces verdicts when it needs them (TX for on-device replies,
         PASS otherwise; no NAT punts or spoof violations exist on this
-        program)."""
+        program). `device` pins the dispatch (tables + inputs) to a
+        specific device — the scheduler's express lane."""
         B = pkt.shape[0]
         upd = self._drain_with_resync(self.fastpath.make_updates)
+        pkt_d, len_d = jnp.asarray(pkt), jnp.asarray(length)
+        if device is not None:
+            # placement AFTER the drain: a bulk-build resync inside it
+            # rebinds self.tables onto the default device
+            self._place_dhcp_chain(device)
+            upd = jax.device_put(upd, device)
+            pkt_d = jax.device_put(pkt_d, device)
+            len_d = jax.device_put(len_d, device)
         dhcp_tables, is_reply, out_pkt, out_len, stats = self._dhcp_step(
-            self.tables.dhcp, upd, jnp.asarray(pkt), jnp.asarray(length),
+            self.tables.dhcp, upd, pkt_d, len_d,
             np.uint32(int(now)))
         self.tables = self.tables._replace(dhcp=dhcp_tables)
         self.stats.batches += 1
@@ -690,8 +804,9 @@ class Engine:
                     reply = self.slow_path(frame)
                     if reply is not None:
                         ring.tx_inject(reply, from_access=(fl & 0x1) != 0)
-            except Exception:  # noqa: BLE001 — slow path is untrusted input
+            except Exception as e:  # noqa: BLE001 — slow path is untrusted input
                 self.stats.slow_errors += 1
+                self._slow_err_log.report(e, path="ring", lane=int(lane))
 
     def _staging(self, idx: int):
         """Ping-pong staging buffers (allocated once; the in-flight batch

@@ -550,6 +550,26 @@ class PyRing:
     def slow_pop(self):
         return self._pop(self._slow)
 
+    def rx_pop(self):
+        """Frame-level RX consumer (round-robin over shard queues) for
+        the tiered scheduler, which stages frames in its own lanes
+        instead of the ring's FIFO assemble..complete windows (two lanes
+        retire out of order — FIFO complete would deadlock them).
+        Returns (frame, flags) or None. PyRing only: the native ring's
+        batch assemble is its contract, so the CLI falls back to the
+        engine's pipelined loop there."""
+        for off in range(self.n_shards):
+            s = (self._rx_pop_next + off) % self.n_shards
+            if self._rx[s]:
+                self._rx_pop_next = (s + 1) % self.n_shards
+                frame, fl = self._rx[s].popleft()
+                self._free += 1
+                self._stats["rx"] += 1
+                return frame, fl
+        return None
+
+    _rx_pop_next = 0  # round-robin cursor for rx_pop
+
     def rx_pending(self) -> int:
         return sum(len(q) for q in self._rx)
 

@@ -1,0 +1,486 @@
+"""Latency-tiered dataplane scheduler: express DHCP + depth-pipelined bulk.
+
+The round-5 verdict's architectural gap: the engine ran one monolithic
+fused step, so a DHCP OFFER queued behind a 512-frame NAT44+QoS batch and
+every benchmark blocked per step — conflating the axon tunnel's ~66 ms
+completion-poll artifact (PERF_NOTES §1) with device time. The reference
+BNG never sees this shape because per-packet XDP has no batches; an
+inference server solves it with iteration-level scheduling and latency
+classes (Orca-style continuous batching). This module is that scheduler
+for the TPU re-host:
+
+- **express lane** — frames classifying as genuine access-side DHCP
+  (ring.classify_dhcp, the dhcp_fastpath.c parity classifier) run the
+  pre-compiled DHCP-only program at a small fixed batch with
+  deadline-based close: dispatch when full OR when the oldest frame has
+  waited max_wait_us. The lane owns the authoritative device DHCP chain
+  and, when >1 device is attached, its OWN device — so an express
+  dispatch has neither a data dependency nor an execution-stream
+  dependency on in-flight bulk work (XLA runs one FIFO stream per
+  device; a same-device express dispatch would still queue behind an
+  enqueued bulk step no matter how it is interleaved).
+
+- **bulk lane** — everything else runs the fused NAT44+QoS+antispoof
+  pipeline at large batch with depth-N async pipelining: dispatches
+  enter a completion ring as futures and `block_until_ready` happens
+  only when the ring overflows its depth (>= 2), never per step. The
+  bulk program consumes a READ REPLICA of the dhcp tables (refreshed on
+  a cadence), which is what breaks the data dependency: a bulk dispatch
+  never rebinds the dhcp leaves the express program consumes.
+
+The scheduler also owns the cadence of the engine's bounded table-update
+drain: the express lane drains the fastpath delta before every dispatch
+(an OFFER must see the newest lease), while bulk steps apply real
+NAT/QoS/antispoof deltas only every `drain_every` dispatches and cached
+no-op update batches in between (zero host->HBM traffic on non-drain
+steps).
+
+Single-process, poll-driven: `submit()` frames, `poll()` each beat (the
+CLI run loop), or use `process()` — the batch-synchronous facade the
+loadtest harness drives.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from dataclasses import dataclass
+from typing import Callable, NamedTuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from bng_tpu.ops.pipeline import VERDICT_DROP, VERDICT_FWD, VERDICT_TX
+from bng_tpu.runtime.lanes import (CLOSE_FLUSH, CompletionRing, InflightEntry,
+                                   Lane, LaneConfig, LANE_BULK, LANE_EXPRESS)
+from bng_tpu.runtime.ring import classify_dhcp
+
+
+@dataclass
+class SchedulerConfig:
+    """Knobs for the two lanes + drain/replica cadences."""
+
+    express_batch: int = 64
+    express_max_wait_us: float = 200.0
+    express_depth: int = 1  # in-flight express dispatches within one poll
+    bulk_batch: int | None = None  # None = engine.B
+    bulk_max_wait_us: float = 2000.0
+    bulk_depth: int = 2  # completion-ring depth (>=2: never block per step)
+    drain_every: int = 1  # bulk host-update drain cadence (1 = every step)
+    dhcp_refresh_every: int = 16  # bulk dhcp-replica refresh cadence
+    express_max_queue: int = 1 << 14
+    bulk_max_queue: int = 1 << 16
+    # express device isolation: None = auto (second attached device when
+    # one exists, else share). An int pins jax.devices()[i]; -1 forces
+    # same-device mode (single-chip: interleave-only isolation).
+    express_device_index: int | None = None
+
+
+class Completion(NamedTuple):
+    """One frame's terminal outcome, delivered at retire time."""
+
+    tag: object
+    lane: str
+    verdict: str  # "tx" | "fwd" | "drop" | "slow"
+    frame: bytes | None  # device output (tx/fwd) or slow-path reply
+    from_access: bool
+    latency_s: float  # submit -> retire (queue wait + device + demux)
+
+
+class TieredScheduler:
+    """Owns the steady-state device loop over an Engine's two programs."""
+
+    is_scheduler = True  # duck-type marker (loadtest harness routing)
+
+    def __init__(self, engine, cfg: SchedulerConfig | None = None,
+                 metrics=None, clock: Callable[[], float] | None = None):
+        self.engine = engine
+        self.cfg = cfg or SchedulerConfig()
+        self.metrics = metrics
+        self.clock = clock or engine.clock
+        bulk_batch = self.cfg.bulk_batch or engine.B
+        self.express = Lane(LaneConfig(
+            LANE_EXPRESS, self.cfg.express_batch,
+            self.cfg.express_max_wait_us, self.cfg.express_depth,
+            self.cfg.express_max_queue), self.clock)
+        self.bulk = Lane(LaneConfig(
+            LANE_BULK, bulk_batch, self.cfg.bulk_max_wait_us,
+            self.cfg.bulk_depth, self.cfg.bulk_max_queue), self.clock)
+        self._express_ring = CompletionRing(self.cfg.express_depth)
+        self._bulk_ring = CompletionRing(self.cfg.bulk_depth)
+        self.completions: deque[Completion] = deque()
+        self.completions_dropped = 0
+        self.oversize_dropped = 0
+        self._seq = 0
+        # bulk-lane dhcp read replica (lazy; refreshed on cadence/resync)
+        self._bulk_dhcp = None
+        self._replica_resync = -1
+        self._bulk_seq = 0
+        self._drains_applied = 0
+        self._replica_refreshes = 0
+        self._express_dev = self._pick_express_device()
+        self._bulk_dev = jax.devices()[0]
+
+    def _pick_express_device(self):
+        idx = self.cfg.express_device_index
+        devs = jax.devices()
+        if idx is None:
+            return devs[1] if len(devs) > 1 else None
+        if idx < 0:
+            return None
+        return devs[idx]
+
+    # -- ingress ---------------------------------------------------------
+
+    def classify(self, frame: bytes, from_access: bool) -> str:
+        """DHCP discover/request from the access side -> express;
+        everything else -> bulk (the ring classifier, bit-for-bit the
+        dhcp_fastpath.c attach condition)."""
+        if from_access and classify_dhcp(frame):
+            return LANE_EXPRESS
+        return LANE_BULK
+
+    def submit(self, frame: bytes, from_access: bool = True,
+               now: float | None = None, tag: object = None,
+               lane: str | None = None) -> str | None:
+        """Classify + enqueue one frame. Returns the lane name, or None
+        when the frame is dropped (lane over its backpressure bound, or
+        frame larger than the engine's packet slot). Callers that already
+        classified (the ring stamps FLAG_DHCP_CTRL at rx_push) pass
+        `lane` to skip the second Python header parse."""
+        now = now if now is not None else self.clock()
+        if tag is None:
+            tag = self._seq
+        self._seq += 1
+        if len(frame) > self.engine.L:
+            # rings admit frames up to their frame_size, which can exceed
+            # the engine slot; _pack_frames refuses to truncate silently,
+            # so the drop (counted) happens here, not as a dispatch crash
+            self.oversize_dropped += 1
+            return None
+        lane_name = lane or self.classify(frame, from_access)
+        lane_obj = self.express if lane_name == LANE_EXPRESS else self.bulk
+        return lane_name if lane_obj.push(frame, from_access, now, tag) else None
+
+    # -- the beat --------------------------------------------------------
+
+    def poll(self, now: float | None = None) -> int:
+        """One scheduler beat: express strictly first (an express dispatch
+        is never queued behind a bulk close waiting in THIS beat), then
+        bulk ring management. Returns frames retired."""
+        now = now if now is not None else self.clock()
+        retired = 0
+        retired += self._pump_express(now)
+        retired += self._pump_bulk(now)
+        return retired
+
+    def flush(self, now: float | None = None) -> int:
+        """Ship every queued frame (partial batches close immediately)
+        and retire everything in flight — the shutdown/test barrier."""
+        now = now if now is not None else self.clock()
+        retired = 0
+        while len(self.express):
+            # let the close policy label full/aged batches honestly; only
+            # the partial tail is a forced flush close (the close-reason
+            # stats feed the bench JSON — they must stay meaningful in
+            # the process() facade, which flushes every batch)
+            reason = self.express.close_reason(now) or CLOSE_FLUSH
+            pend, reason = self.express.close_batch(now, reason)
+            retired += self._dispatch_express(pend, now, reason)
+        retired += self._retire_express_all()
+        while len(self.bulk):
+            reason = self.bulk.close_reason(now) or CLOSE_FLUSH
+            pend, reason = self.bulk.close_batch(now, reason)
+            over = self._dispatch_bulk(pend, now, reason)
+            if over is not None:
+                retired += self._retire_bulk(over)
+        for entry in self._bulk_ring.drain():
+            retired += self._retire_bulk(entry)
+        return retired
+
+    close = flush  # CLI cleanup symmetry
+
+    # -- express lane ----------------------------------------------------
+
+    def _pump_express(self, now: float) -> int:
+        retired = 0
+        while True:
+            reason = self.express.close_reason(now)
+            if reason is None:
+                break
+            pend, reason = self.express.close_batch(now, reason)
+            retired += self._dispatch_express(pend, now, reason)
+        return retired + self._retire_express_all()
+
+    def _dispatch_express(self, pend, now: float, reason: str) -> int:
+        """Dispatch one express batch; returns frames retired as a side
+        effect of the completion ring overflowing its depth."""
+        if not pend:
+            return 0
+        eng = self.engine
+        pkt, length = eng._pack_frames([p.frame for p in pend],
+                                       self.express.cfg.batch)
+        res = eng._run_dhcp_batch(pkt, length, now, device=self._express_dev)
+        self._observe_dispatch(LANE_EXPRESS, len(pend), reason)
+        over = self._express_ring.push(
+            InflightEntry(res, pend, now, reason))
+        return self._retire_express(over) if over is not None else 0
+
+    def _retire_express_all(self) -> int:
+        n = 0
+        while True:
+            entry = self._express_ring.pop_oldest()
+            if entry is None:
+                return n
+            n += self._retire_express(entry)
+
+    def _retire_express(self, entry: InflightEntry) -> int:
+        """Force + demux one express batch (TX replies / PASS to the slow
+        path). Blocks only on the express program's own outputs."""
+        eng = self.engine
+        res = entry.res
+        n = len(entry.pending)
+        verdict = np.asarray(res.verdict)[:n]
+        out_len = np.asarray(res.out_len)
+        out_rows = None
+        eng._fold_stats(res)
+        now = self.clock()
+        for i, p in enumerate(entry.pending):
+            if verdict[i] == VERDICT_TX:
+                if out_rows is None:
+                    out_rows = np.asarray(res.out_pkt)
+                frame = bytes(out_rows[i, : int(out_len[i])])
+                eng.stats.tx += 1
+                self._complete(p, LANE_EXPRESS, "tx", frame, now)
+            else:
+                eng.stats.passed += 1
+                reply = None
+                try:
+                    if eng.slow_path is not None:
+                        reply = eng.slow_path(p.frame)
+                except Exception as e:  # noqa: BLE001 — untrusted input
+                    eng.stats.slow_errors += 1
+                    eng._slow_err_log.report(e, path="sched_express", lane=i)
+                self._complete(p, LANE_EXPRESS, "slow", reply, now)
+        self._observe_retire(LANE_EXPRESS, entry, now)
+        return n
+
+    # -- bulk lane -------------------------------------------------------
+
+    def _pump_bulk(self, now: float) -> int:
+        retired = 0
+        # opportunistic: retire the already-finished FIFO prefix
+        for entry in self._bulk_ring.pop_ready(self._entry_ready):
+            retired += self._retire_bulk(entry)
+        while True:
+            reason = self.bulk.close_reason(now)
+            if reason is None:
+                break
+            pend, reason = self.bulk.close_batch(now, reason)
+            over = self._dispatch_bulk(pend, now, reason)
+            if over is not None:
+                # the completion ring overflowed its depth: the single
+                # place the bulk lane blocks on device results
+                retired += self._retire_bulk(over)
+        return retired
+
+    @staticmethod
+    def _entry_ready(entry: InflightEntry) -> bool:
+        is_ready = getattr(entry.res.verdict, "is_ready", None)
+        return bool(is_ready()) if is_ready is not None else False
+
+    def _ensure_bulk_replica(self) -> None:
+        eng = self.engine
+        refresh_due = (self.cfg.dhcp_refresh_every > 0
+                       and self._bulk_seq % self.cfg.dhcp_refresh_every == 0)
+        if (self._bulk_dhcp is not None and not refresh_due
+                and self._replica_resync == eng.resync_count):
+            return
+        self._bulk_dhcp = jax.tree_util.tree_map(self._copy_to_bulk,
+                                                 eng.tables.dhcp)
+        self._replica_resync = eng.resync_count
+        self._replica_refreshes += 1
+
+    def _copy_to_bulk(self, x):
+        """A buffer the bulk chain may freely donate: device transfer when
+        the authority lives elsewhere, a fresh same-device copy otherwise
+        (device_put to the same device can alias, and donating an aliased
+        buffer would consume the express chain's live tables)."""
+        if self._bulk_dev not in x.devices():
+            return jax.device_put(x, self._bulk_dev)
+        return jnp.copy(x)
+
+    def _dispatch_bulk(self, pend, now: float,
+                       reason: str) -> InflightEntry | None:
+        """Dispatch one bulk batch (async); returns the completion-ring
+        overflow entry the caller must retire, if any."""
+        if not pend:
+            return None
+        eng = self.engine
+        B = self.bulk.cfg.batch
+        pkt, length = eng._pack_frames([p.frame for p in pend], B)
+        fa = np.zeros((B,), dtype=bool)
+        fa[: len(pend)] = [p.from_access for p in pend]
+        self._ensure_bulk_replica()
+        drain = (self.cfg.drain_every <= 1
+                 or self._bulk_seq % self.cfg.drain_every == 0)
+        before = eng.resync_count
+        res, self._bulk_dhcp = eng.dispatch_scheduled_bulk(
+            pkt, length, fa, now, self._bulk_dhcp, drain=drain)
+        if eng.resync_count != before:
+            # a bulk-build resync fired inside the drain: the replica we
+            # just threaded derives from pre-resync leaves; rebuild next
+            # dispatch (this step's results stay valid)
+            self._replica_resync = -1
+        self._bulk_seq += 1
+        if drain:
+            self._drains_applied += 1
+        self._observe_dispatch(LANE_BULK, len(pend), reason)
+        return self._bulk_ring.push(InflightEntry(res, pend, now, reason))
+
+    def _retire_bulk(self, entry: InflightEntry) -> int:
+        """Force + demux one bulk batch's verdicts (the completion-ring
+        block point)."""
+        eng = self.engine
+        res = entry.res
+        n = len(entry.pending)
+        vv = np.asarray(res.verdict)[:n]
+        out_len = np.asarray(res.out_len)
+        punt = np.asarray(res.nat_punt)[:n]
+        viol = np.asarray(res.spoof_violation)[:n]
+        out_rows = None
+        eng._fold_stats(res)
+        now = self.clock()
+        for i, p in enumerate(entry.pending):
+            v = int(vv[i])
+            if v == VERDICT_TX or v == VERDICT_FWD:
+                if out_rows is None:
+                    out_rows = np.asarray(res.out_pkt)
+                frame = bytes(out_rows[i, : int(out_len[i])])
+                kind = "tx" if v == VERDICT_TX else "fwd"
+                if v == VERDICT_TX:
+                    eng.stats.tx += 1
+                else:
+                    eng.stats.fwd += 1
+                self._complete(p, LANE_BULK, kind, frame, now)
+            elif v == VERDICT_DROP:
+                eng.stats.dropped += 1
+                self._complete(p, LANE_BULK, "drop", None, now)
+            else:
+                eng.stats.passed += 1
+                reply = None
+                try:
+                    if punt[i]:
+                        eng._punt_new_flow(p.frame, int(entry.dispatch_t))
+                    elif eng.slow_path is not None:
+                        reply = eng.slow_path(p.frame)
+                except Exception as e:  # noqa: BLE001 — untrusted input
+                    eng.stats.slow_errors += 1
+                    eng._slow_err_log.report(e, path="sched_bulk", lane=i)
+                self._complete(p, LANE_BULK, "slow", reply, now)
+            if viol[i] and eng.violation_sink is not None:
+                eng.violation_sink(i, p.frame)
+        self._observe_retire(LANE_BULK, entry, now)
+        return n
+
+    # -- completion delivery / observability -----------------------------
+
+    _COMPLETIONS_CAP = 1 << 17
+
+    def _complete(self, p, lane: str, verdict: str, frame, now: float) -> None:
+        if len(self.completions) >= self._COMPLETIONS_CAP:
+            self.completions.popleft()
+            self.completions_dropped += 1
+        self.completions.append(Completion(
+            p.tag, lane, verdict, frame, p.from_access, now - p.enq_t))
+
+    def drain_completions(self) -> list[Completion]:
+        out = list(self.completions)
+        self.completions.clear()
+        return out
+
+    def _observe_dispatch(self, lane: str, n: int, reason: str) -> None:
+        m = self.metrics
+        if m is None:
+            return
+        batch = (self.express if lane == LANE_EXPRESS else self.bulk).cfg.batch
+        m.sched_dispatches.inc(lane=lane, close=reason)
+        m.sched_batch_occupancy.observe(n / batch, lane=lane)
+
+    def _observe_retire(self, lane: str, entry: InflightEntry,
+                        now: float) -> None:
+        m = self.metrics
+        if m is None:
+            return
+        # oldest frame of the batch = the batch's worst-case latency
+        if entry.pending:
+            m.sched_dispatch_latency.observe(now - entry.pending[0].enq_t,
+                                             lane=lane)
+        m.sched_frames.inc(len(entry.pending), lane=lane)
+
+    def stats_snapshot(self) -> dict:
+        """Poll-style counters for metrics collection / bench JSON."""
+        out = {}
+        for name, lane, ring in ((LANE_EXPRESS, self.express, self._express_ring),
+                                 (LANE_BULK, self.bulk, self._bulk_ring)):
+            s = lane.stats
+            out[name] = {
+                "queue_depth": len(lane),
+                "inflight": len(ring),
+                "enqueued": s.enqueued,
+                "dropped_overflow": s.dropped_overflow,
+                "frames_dispatched": s.frames_dispatched,
+                "batches": s.batches,
+                "batches_full": s.batches_full,
+                "batches_deadline": s.batches_deadline,
+                "batches_flush": s.batches_flush,
+                "occupancy_avg": round(s.occupancy_avg(), 4),
+            }
+        out["bulk"]["drains_applied"] = self._drains_applied
+        out["bulk"]["replica_refreshes"] = self._replica_refreshes
+        out["express"]["own_device"] = (str(self._express_dev)
+                                        if self._express_dev is not None
+                                        else None)
+        out["completions_dropped"] = self.completions_dropped
+        out["oversize_dropped"] = self.oversize_dropped
+        return out
+
+    # -- batch-synchronous facade (loadtest harness / tests) -------------
+
+    # Engine.process-shaped surface so DHCPBenchmark can drive the
+    # scheduler unmodified (it reads .stats/.fastpath for counters).
+    @property
+    def stats(self):
+        return self.engine.stats
+
+    @property
+    def fastpath(self):
+        return self.engine.fastpath
+
+    def process(self, frames: list[bytes],
+                from_access: list[bool] | bool = True,
+                now: float | None = None) -> dict:
+        """Submit a frame list, flush, and return Engine.process-shaped
+        verdict lists keyed by submission index. The express/bulk split
+        still applies inside — a mixed batch fans out to both programs."""
+        out = {"tx": [], "fwd": [], "dropped": [], "slow": []}
+        start = self._seq
+        for i, f in enumerate(frames):
+            fa = from_access if isinstance(from_access, bool) else from_access[i]
+            if self.submit(f, fa, now=now) is None:
+                out["dropped"].append(i)
+        self.flush(now=now)
+        for c in self.drain_completions():
+            if not isinstance(c.tag, int) or c.tag < start:
+                continue  # a stray completion from earlier poll-mode use
+            i = c.tag - start
+            if c.verdict in ("tx", "fwd"):
+                out[c.verdict].append((i, c.frame))
+            elif c.verdict == "drop":
+                out["dropped"].append(i)
+            else:
+                out["slow"].append((i, c.frame))
+        for k in ("tx", "fwd", "slow"):
+            out[k].sort(key=lambda t: t[0])
+        out["dropped"].sort()
+        return out

@@ -226,6 +226,25 @@ class FastPathTables:
             server=jnp.asarray(self.server),
         )
 
+    def empty_updates(self) -> FastPathUpdates:
+        """A no-op table-delta batch that does NOT consume dirty tracking.
+
+        The latency scheduler's bulk lane passes this on every step: the
+        express lane is the single consumer of the real fastpath drain
+        (one authoritative device DHCP chain), and the bulk lane's DHCP
+        leaves are a read replica. The sub/vlan/cid scatter buffers are
+        cached (they are the per-step transfer cost); pools/server are
+        re-read every call — the step applies those dense arrays
+        wholesale, so the replica tracks live pool/server config even
+        between replica refreshes."""
+        return FastPathUpdates(
+            sub=self.sub.empty_update(self.update_slots),
+            vlan=self.vlan.empty_update(self.update_slots),
+            cid=self.cid.empty_update(self.update_slots),
+            pools=jnp.asarray(self.pools),
+            server=jnp.asarray(self.server),
+        )
+
     def dirty_count(self) -> int:
         return self.sub.dirty_count() + self.vlan.dirty_count() + self.cid.dirty_count()
 
@@ -277,3 +296,8 @@ class PPPoEFastPathTables:
     def make_updates(self):
         return (self.by_sid.make_update(self.update_slots),
                 self.by_ip.make_update(self.update_slots))
+
+    def empty_updates(self):
+        """No-op update pair for scheduler no-drain bulk steps (cached)."""
+        return (self.by_sid.empty_update(self.update_slots),
+                self.by_ip.empty_update(self.update_slots))

@@ -81,6 +81,55 @@ def force_cpu(n_devices: int = 8) -> None:
         pass
 
 
+def enable_compilation_cache(cache_dir: str | None = None) -> str | None:
+    """Turn on JAX's persistent compilation cache (works on CPU too).
+
+    The test suite and bench are compile-dominated (VERDICT weakness 5:
+    92 core tests spent ~265s, nearly all XLA compiles); a warm disk
+    cache collapses repeat runs. Thresholds drop to zero so the many
+    small per-geometry pipeline compiles are cached, not just the big
+    ones. Resolution order: explicit arg > $BNG_JAX_CACHE_DIR > a stable
+    per-user default. Set BNG_JAX_CACHE_DIR=0 to disable. Returns the
+    cache dir, or None when disabled/unsupported (old jaxlibs) — callers
+    never fail because caching was unavailable.
+
+    CPU GUARD (measured, round 6): on jaxlib 0.4.37 XLA:CPU, executables
+    DESERIALIZED from the cache compute wrong results for the donated
+    fused-pipeline programs (cold-write runs pass, warm-read runs fail
+    NAT/fast-lane e2e and SIGABRT the sharded step; see PERF_NOTES §4).
+    Accelerator backends use the mature serialization path. So: enabled
+    by default only off-CPU; BNG_JAX_CACHE_CPU=1 opts CPU in for jaxlibs
+    where the bug is fixed.
+    """
+    cache_dir = cache_dir or os.environ.get("BNG_JAX_CACHE_DIR")
+    if cache_dir in ("0", "off", "none"):
+        return None
+    try:
+        import jax
+
+        backend = jax.default_backend()
+    except Exception:  # pragma: no cover - no usable backend at all
+        return None
+    if backend == "cpu" and os.environ.get("BNG_JAX_CACHE_CPU") != "1":
+        return None
+    if not cache_dir:
+        cache_dir = os.path.join(
+            os.path.expanduser("~"), ".cache", "bng-tpu", "jax-cache")
+    try:
+        os.makedirs(cache_dir, exist_ok=True)
+        import jax
+
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+        try:  # newer jaxlibs only; the size threshold is best-effort
+            jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+        except Exception:
+            pass
+        return cache_dir
+    except Exception:  # pragma: no cover - cache is an optimization only
+        return None
+
+
 def probe_accelerator(timeout_s: float = 120.0) -> tuple[str, str]:
     """Probe backend availability in a subprocess with a hard timeout.
 

@@ -107,6 +107,83 @@ def profile_op_times(fn: Callable[[], object], iters: int = 10,
                          ops=ops, trace_dir=trace_dir)
 
 
+@dataclass
+class StepDurations:
+    """Per-execution program durations from one profiled run.
+
+    source: which trace signal supplied them —
+      "device"   top-level jit_/pjit events on the accelerator track
+                 (true device time, the <50us OFFER target's quantity)
+      "cpu-exec" TfrtCpuExecutable::ExecuteHelper on the host track
+                 (XLA:CPU per-execution runtime — no separate device
+                 track exists there, this is the closest isolate)
+    """
+
+    us: list[float]
+    source: str
+
+    def percentile(self, q: float) -> float:
+        import numpy as _np
+
+        return float(_np.percentile(_np.asarray(self.us), q)) if self.us else 0.0
+
+
+def profile_step_durations(fn: Callable[[], object], iters: int = 50,
+                           trace_dir: str | None = None) -> StepDurations:
+    """Per-iteration execution durations of fn's jitted program.
+
+    Where profile_op_times aggregates (mean us/iter), this keeps the
+    DISTRIBUTION — the p99 the latency targets constrain is a tail
+    statistic that an aggregate cannot recover. Blocked wall-clock
+    timing includes host dispatch + sync artifacts (the axon tunnel's
+    ~63ms completion-poll bucket, PERF_NOTES §1); the profiler events
+    isolate the execution itself. fn must be pre-compiled and should run
+    exactly ONE jitted program per call (extra programs would interleave
+    into the sample list).
+    """
+    import shutil
+
+    import jax
+
+    keep = trace_dir is not None
+    trace_dir = trace_dir or tempfile.mkdtemp(prefix="bng-prof-")
+    try:
+        with jax.profiler.trace(trace_dir):
+            out = None
+            for _ in range(iters):
+                out = fn()
+            jax.block_until_ready(out)
+        traces = sorted(glob.glob(
+            os.path.join(trace_dir, "plugins", "profile", "*",
+                         "*.trace.json.gz")))
+        if not traces:
+            return StepDurations([], "none")
+        with gzip.open(traces[-1]) as f:
+            tr = json.load(f)
+    finally:
+        if not keep:
+            shutil.rmtree(trace_dir, ignore_errors=True)
+    ev = tr.get("traceEvents", [])
+    pids = {e["pid"]: e["args"].get("name", "") for e in ev
+            if e.get("ph") == "M" and e.get("name") == "process_name"}
+    device, cpu_exec = [], []
+    for e in ev:
+        if e.get("ph") != "X" or "dur" not in e:
+            continue
+        where = pids.get(e["pid"], "")
+        name = e["name"]
+        if ("TPU" in where or "GPU" in where or "device" in where.lower()):
+            if name.startswith("jit_") or name.startswith("pjit"):
+                device.append((e.get("ts", 0), float(e["dur"])))
+        elif name == "TfrtCpuExecutable::ExecuteHelper":
+            cpu_exec.append((e.get("ts", 0), float(e["dur"])))
+    for samples, source in ((device, "device"), (cpu_exec, "cpu-exec")):
+        if samples:
+            samples.sort()  # execution order, so warmup skew trims cleanly
+            return StepDurations([d for _, d in samples], source)
+    return StepDurations([], "none")
+
+
 def format_report(r: ProfileReport, top: int = 15) -> str:
     lines = [f"device program: {r.device_total_us:9.1f} us/iter   "
              f"(host dispatch {r.host_total_us:.1f} us)   trace: {r.trace_dir}"]

@@ -12,6 +12,14 @@ use. Keep the logic there; this file just invokes it before any backend
 initialization.
 """
 
-from bng_tpu.utils.jaxenv import force_cpu
+from bng_tpu.utils.jaxenv import enable_compilation_cache, force_cpu
 
 force_cpu(8)
+# Persistent XLA compilation cache: the suite is compile-dominated
+# (verdict weakness 5 — ~265s, nearly all compiles), and the tier-1 gate
+# runs under a hard timeout. The helper self-guards: on this jaxlib's
+# XLA:CPU, cache-DESERIALIZED executables compute wrong results for the
+# donated pipeline programs (PERF_NOTES §4), so CPU runs stay uncached
+# unless BNG_JAX_CACHE_CPU=1; accelerator runs get the cache. The CPU
+# time win comes from the @pytest.mark.slow tier instead.
+enable_compilation_cache()

@@ -225,6 +225,7 @@ class TestBulkOnLiveStepLoop:
         return packets.udp_packet(mac, b"\xff" * 6, 0, 0xFFFFFFFF, 68, 67,
                                   p.encode().ljust(320, b"\x00"))
 
+    @pytest.mark.slow  # compile-heavy; tier-1 runs -m 'not slow'
     def test_engine_step_after_bulk_serves_new_subscribers(self):
         from bng_tpu.control.nat import NATManager
         from bng_tpu.runtime.engine import Engine

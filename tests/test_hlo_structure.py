@@ -11,6 +11,7 @@ probe or a gather explosion fails CI — PERF_NOTES.md §2 has the numbers.
 import re
 
 import numpy as np
+import pytest
 import jax
 import jax.numpy as jnp
 
@@ -155,7 +156,7 @@ class TestShardedExchangeShape:
         from jax.sharding import PartitionSpec as P
 
         from bng_tpu.ops.table import HostTable, TableGeom, lookup
-        from bng_tpu.parallel.sharded import AXIS, make_mesh
+        from bng_tpu.parallel.sharded import AXIS, _shard_map, make_mesh
 
         N = 4
         mesh = make_mesh(N)
@@ -170,8 +171,8 @@ class TestShardedExchangeShape:
             r = lookup(tabs, q, g)
             return r.found, r.vals
 
-        f = jax.shard_map(local, mesh=mesh, in_specs=(P(AXIS), P(AXIS)),
-                          out_specs=(P(AXIS), P(AXIS)), check_vma=False)
+        f = _shard_map(local, mesh=mesh, in_specs=(P(AXIS), P(AXIS)),
+                       out_specs=(P(AXIS), P(AXIS)))
         hlo = _stablehlo(f, st, q)
         n_a2a = _count(r"all_to_all", hlo)
         assert n_a2a == 2, f"expected 2 all_to_alls, got {n_a2a}"
@@ -193,6 +194,7 @@ class TestFastLaneCompileShapeBudget:
         for n in range(1, 8193, 11):
             assert n <= Engine.dhcp_batch_bucket(n)
 
+    @pytest.mark.slow  # compile-heavy; tier-1 runs -m 'not slow'
     def test_engine_reuses_bucket_shapes(self):
         """Distinct frame counts in one bucket must share one compiled
         program (counted via the jit cache of the DHCP-only step)."""

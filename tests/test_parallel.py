@@ -21,7 +21,8 @@ from bng_tpu.parallel.hashring import (
     rendezvous_owner,
     rendezvous_ranked,
 )
-from bng_tpu.parallel.sharded import AXIS, ShardedCluster, make_mesh
+from bng_tpu.parallel.sharded import (AXIS, ShardedCluster, _shard_map,
+                                      make_mesh)
 from bng_tpu.utils.net import ip_to_u32
 
 N = 4
@@ -96,11 +97,10 @@ class TestShardedLookup:
             r = lookup(tabs, q, g)
             return r.found, r.vals
 
-        f = jax.jit(jax.shard_map(
+        f = jax.jit(_shard_map(
             local, mesh=mesh,
             in_specs=(P(AXIS), P(AXIS)),
             out_specs=(P(AXIS), P(AXIS)),
-            check_vma=False,
         ))
         found, vals = f(jax.tree.map(lambda *xs: jnp.stack(xs), *[s.device_state() for s in shards]),
                         jnp.asarray(qs))
@@ -292,9 +292,9 @@ class TestShardedExchangeCapacity:
             r = lookup(tabs, q, g)
             return r.found, r.punted
 
-        f = jax.jit(jax.shard_map(
+        f = jax.jit(_shard_map(
             local, mesh=mesh, in_specs=(P(AXIS), P(AXIS)),
-            out_specs=(P(AXIS), P(AXIS)), check_vma=False))
+            out_specs=(P(AXIS), P(AXIS))))
         found, punted = f(
             jax.tree.map(lambda *xs: jnp.stack(xs),
                          *[s.device_state() for s in shards]),
@@ -335,9 +335,9 @@ class TestShardedExchangeCapacity:
             r = lookup(tabs, q, g)
             return r.found, r.punted, r.vals[:, 0]
 
-        f = jax.jit(jax.shard_map(
+        f = jax.jit(_shard_map(
             local, mesh=mesh, in_specs=(P(AXIS), P(AXIS)),
-            out_specs=(P(AXIS), P(AXIS), P(AXIS)), check_vma=False))
+            out_specs=(P(AXIS), P(AXIS), P(AXIS))))
         found, punted, v0 = f(
             jax.tree.map(lambda *xs: jnp.stack(xs),
                          *[s.device_state() for s in shards]),

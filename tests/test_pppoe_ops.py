@@ -329,6 +329,7 @@ class TestEnginePipelinePPPoE:
     def _upstream(self):
         return pppoe_data_frame()
 
+    @pytest.mark.slow  # compile-heavy; tier-1 runs -m 'not slow'
     def test_upstream_decap_then_nat_fastpath(self):
         engine, nat, pp = self._engine()
         up = self._upstream()

@@ -1,0 +1,496 @@
+"""Latency-tiered scheduler (runtime/scheduler.py + runtime/lanes.py).
+
+Covers the ISSUE-1 acceptance surface on the CPU backend:
+- deadline-close semantics: a partial express batch dispatches at
+  max-wait, not before;
+- express-never-behind-bulk: an express dispatch while a bulk step is in
+  flight has no data dependency on it (the dhcp chain is never rebound
+  by bulk), runs on its own device when one is available, and completes
+  while the bulk step is still in flight;
+- pipelining depth: never more than N bulk dispatches in flight;
+- update-drain cadence: bulk host-table drains happen every
+  `drain_every` dispatches only, express drains the fastpath every
+  dispatch;
+- bng_sched_* metric families exported;
+- slow-path exceptions are logged (rate-limited), not swallowed.
+
+Table geometry mirrors tests/test_e2e.py so the fused-pipeline compile
+is shared across modules within one pytest process.
+"""
+
+from __future__ import annotations
+
+import logging
+
+import pytest
+
+import jax
+
+from bng_tpu.control import dhcp_codec, packets
+from bng_tpu.control.dhcp_server import DHCPServer
+from bng_tpu.control.metrics import BNGMetrics
+from bng_tpu.control.nat import NATManager
+from bng_tpu.control.pool import Pool, PoolManager
+from bng_tpu.runtime.engine import AntispoofTables, Engine, QoSTables
+from bng_tpu.runtime.lanes import (CLOSE_DEADLINE, CLOSE_FULL, CompletionRing,
+                                   InflightEntry, Lane, LaneConfig)
+from bng_tpu.runtime.scheduler import (LANE_BULK, LANE_EXPRESS,
+                                       SchedulerConfig, TieredScheduler)
+from bng_tpu.runtime.tables import FastPathTables
+from bng_tpu.utils.net import ip_to_u32, parse_mac
+from bng_tpu.utils.structlog import RateLimiter
+
+SERVER_MAC = parse_mac("02:aa:bb:cc:dd:01")
+SERVER_IP = ip_to_u32("10.0.0.1")
+
+
+class FakeClock:
+    def __init__(self, t=1_700_000_000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def build_stack(batch_size=8, clock=None, slow_path="server"):
+    clock = clock or FakeClock()
+    fastpath = FastPathTables(sub_nbuckets=512, vlan_nbuckets=64,
+                              cid_nbuckets=64, max_pools=16)
+    fastpath.set_server_config(SERVER_MAC, SERVER_IP)
+    pools = PoolManager(fastpath)
+    pools.add_pool(Pool(pool_id=1, network=ip_to_u32("10.0.0.0"),
+                        prefix_len=24, gateway=SERVER_IP,
+                        dns_primary=ip_to_u32("1.1.1.1"), lease_time=3600))
+    nat = NATManager(public_ips=[ip_to_u32("203.0.113.1")],
+                     sessions_nbuckets=256, sub_nat_nbuckets=64)
+    qos = QoSTables(nbuckets=256)
+    spoof = AntispoofTables(nbuckets=256)
+    server = DHCPServer(SERVER_MAC, SERVER_IP, pools,
+                        fastpath_tables=fastpath, clock=clock)
+    sp = server.handle_frame if slow_path == "server" else slow_path
+    engine = Engine(fastpath, nat, qos, spoof, batch_size=batch_size,
+                    slow_path=sp, clock=clock)
+    return engine, server, clock
+
+
+def discover(mac: bytes, xid: int) -> bytes:
+    p = dhcp_codec.build_request(mac, dhcp_codec.DISCOVER, xid=xid)
+    p.options.append((dhcp_codec.OPT_PARAM_REQ_LIST, bytes([1, 3, 6, 51, 54])))
+    return packets.udp_packet(mac, b"\xff" * 6, 0, 0xFFFFFFFF, 68, 67,
+                              p.encode().ljust(300, b"\x00"))
+
+
+def data_frame(i: int) -> bytes:
+    mac = (0x02C0 << 32 | i).to_bytes(6, "big")
+    return packets.udp_packet(mac, SERVER_MAC, ip_to_u32("10.0.0.9") + i,
+                              ip_to_u32("93.184.216.34"), 40000 + i, 443,
+                              b"x" * 64)
+
+
+def mac_of(i: int) -> bytes:
+    return (0x02B0 << 32 | i).to_bytes(6, "big")
+
+
+# ---------------------------------------------------------------------------
+# lanes: pure host-side policy (no device)
+# ---------------------------------------------------------------------------
+
+class TestLanePolicy:
+    def test_full_close(self):
+        lane = Lane(LaneConfig("x", batch=4, max_wait_us=1000, depth=2))
+        now = 100.0
+        for i in range(4):
+            assert lane.push(b"f%d" % i, True, now, tag=i)
+        assert lane.close_reason(now) == CLOSE_FULL
+        pend, reason = lane.close_batch(now)
+        assert reason == CLOSE_FULL and len(pend) == 4
+        assert lane.stats.batches_full == 1
+
+    def test_deadline_close_only_after_max_wait(self):
+        lane = Lane(LaneConfig("x", batch=4, max_wait_us=200, depth=2))
+        lane.push(b"f", True, 100.0)
+        assert lane.close_reason(100.0 + 100e-6) is None  # 100us < 200us
+        assert lane.close_reason(100.0 + 250e-6) == CLOSE_DEADLINE
+        pend, reason = lane.close_batch(100.0 + 250e-6)
+        assert reason == CLOSE_DEADLINE and len(pend) == 1
+        assert lane.stats.batches_deadline == 1
+        assert lane.stats.occupancy_avg() == pytest.approx(0.25)
+
+    def test_overflow_drops(self):
+        lane = Lane(LaneConfig("x", batch=2, max_wait_us=10, depth=1,
+                               max_queue=3))
+        assert all(lane.push(b"f", True, 1.0) for _ in range(3))
+        assert not lane.push(b"f", True, 1.0)
+        assert lane.stats.dropped_overflow == 1
+
+    def test_completion_ring_overflow_is_fifo(self):
+        ring = CompletionRing(depth=2)
+        e = [InflightEntry(None, [], float(i), "full") for i in range(4)]
+        assert ring.push(e[0]) is None
+        assert ring.push(e[1]) is None
+        assert ring.push(e[2]) is e[0]  # overflow hands back the OLDEST
+        assert ring.push(e[3]) is e[1]
+        assert len(ring) == 2
+
+
+# ---------------------------------------------------------------------------
+# scheduler over a live engine (CPU backend)
+# ---------------------------------------------------------------------------
+
+class TestClassification:
+    def test_access_dhcp_express_else_bulk(self):
+        engine, _, clock = build_stack()
+        sched = TieredScheduler(engine, SchedulerConfig(), clock=clock)
+        d = discover(mac_of(1), 0x11)
+        assert sched.classify(d, from_access=True) == LANE_EXPRESS
+        # core-side port-67 transit must NOT ride the express lane
+        assert sched.classify(d, from_access=False) == LANE_BULK
+        assert sched.classify(data_frame(1), from_access=True) == LANE_BULK
+
+
+class TestOversizeFrames:
+    def test_frame_over_pkt_slot_dropped_not_crash(self):
+        """Rings admit frames up to frame_size (2048) but the engine slot
+        is smaller; the scheduler must drop-and-count at submit, not blow
+        up _pack_frames at dispatch (a wire frame must never kill the
+        drive loop)."""
+        engine, _, clock = build_stack(batch_size=8)
+        sched = TieredScheduler(engine, SchedulerConfig(bulk_batch=8),
+                                clock=clock)
+        big = data_frame(0) + b"\x00" * engine.L  # > pkt_slot
+        assert sched.submit(big) is None
+        assert sched.oversize_dropped == 1
+        assert len(sched.bulk) == 0
+        sched.poll()  # nothing queued, nothing raises
+
+
+class TestDeadlineClose:
+    def test_partial_express_batch_ships_at_max_wait(self):
+        engine, _, clock = build_stack()
+        sched = TieredScheduler(engine, SchedulerConfig(
+            express_batch=64, express_max_wait_us=200.0), clock=clock)
+        for i in range(3):
+            assert sched.submit(discover(mac_of(i), 0x20 + i)) == LANE_EXPRESS
+        sched.poll()
+        assert sched.express.stats.batches == 0  # neither full nor aged
+        clock.advance(100e-6)
+        sched.poll()
+        assert sched.express.stats.batches == 0  # 100us < max_wait
+        clock.advance(150e-6)
+        sched.poll()
+        assert sched.express.stats.batches == 1  # deadline close fired
+        assert sched.express.stats.batches_deadline == 1
+        assert sched.express.stats.frames_dispatched == 3
+        done = sched.drain_completions()
+        assert len(done) == 3  # OFFERs from the slow path (fresh MACs)
+        assert {c.lane for c in done} == {LANE_EXPRESS}
+        replies = [c.frame for c in done if c.frame is not None]
+        assert replies, "slow path should have produced OFFERs"
+
+
+class TestExpressNeverBehindBulk:
+    def test_express_completes_while_bulk_in_flight(self):
+        engine, _, clock = build_stack(batch_size=8)
+        sched = TieredScheduler(engine, SchedulerConfig(
+            express_batch=64, bulk_batch=8, bulk_depth=2), clock=clock)
+
+        # fill + dispatch exactly one bulk batch (manually, so nothing
+        # retires it behind our back)
+        for i in range(8):
+            assert sched.submit(data_frame(i)) == LANE_BULK
+        dhcp_before = jax.tree_util.tree_leaves(engine.tables.dhcp)
+        now = clock()
+        pend, reason = sched.bulk.close_batch(now)
+        assert reason == CLOSE_FULL
+        assert sched._dispatch_bulk(pend, now, reason) is None
+        assert len(sched._bulk_ring) == 1  # bulk step in flight
+
+        # the bulk dispatch must NOT have rebound the dhcp chain: that is
+        # the data-dependency the replica design removes
+        dhcp_after = jax.tree_util.tree_leaves(engine.tables.dhcp)
+        assert all(a is b for a, b in zip(dhcp_before, dhcp_after))
+
+        # express dispatch + retire with the bulk step still in flight
+        for i in range(64):
+            sched.submit(discover(mac_of(100 + i), 0x3000 + i))
+        retired = sched._pump_express(clock())
+        assert retired == 64
+        done = sched.drain_completions()
+        assert len(done) == 64
+        assert {c.lane for c in done} == {LANE_EXPRESS}
+        # ...and the bulk step is STILL in flight: express completion did
+        # not wait for (or retire) it
+        assert len(sched._bulk_ring) == 1
+
+        # multi-device mesh: the express program ran on its own device,
+        # so it did not even share an execution stream with bulk
+        if len(jax.devices()) > 1:
+            express_devs = {d for leaf in
+                            jax.tree_util.tree_leaves(engine.tables.dhcp)
+                            for d in leaf.devices()}
+            bulk_entry = sched._bulk_ring._ring[0]
+            bulk_devs = set(bulk_entry.res.verdict.devices())
+            assert express_devs == {sched._express_dev}
+            assert express_devs.isdisjoint(bulk_devs)
+
+        # the flush barrier retires the bulk step
+        sched.flush()
+        bulk_done = sched.drain_completions()
+        assert len(bulk_done) == 8
+        assert {c.lane for c in bulk_done} == {LANE_BULK}
+
+    def test_poll_services_express_before_bulk(self):
+        engine, _, clock = build_stack(batch_size=8)
+        sched = TieredScheduler(engine, SchedulerConfig(
+            express_batch=8, bulk_batch=8, bulk_depth=2), clock=clock)
+        # both lanes have a full batch queued; one poll must dispatch
+        # express first (completion order proves service order)
+        for i in range(8):
+            sched.submit(data_frame(i))
+        for i in range(8):
+            sched.submit(discover(mac_of(200 + i), 0x4000 + i))
+        sched.poll()
+        sched.flush()
+        lanes_in_order = [c.lane for c in sched.drain_completions()]
+        assert lanes_in_order.index(LANE_EXPRESS) < lanes_in_order.index(LANE_BULK)
+
+
+class TestPipelineDepth:
+    def test_no_more_than_depth_in_flight(self):
+        engine, _, clock = build_stack(batch_size=8)
+        sched = TieredScheduler(engine, SchedulerConfig(
+            bulk_batch=8, bulk_depth=2, drain_every=1), clock=clock)
+        max_seen = 0
+        orig_push = sched._bulk_ring.push
+
+        def spy_push(entry):
+            nonlocal max_seen
+            out = orig_push(entry)
+            max_seen = max(max_seen, len(sched._bulk_ring))
+            return out
+
+        sched._bulk_ring.push = spy_push
+        for i in range(5 * 8):  # five full bulk batches
+            sched.submit(data_frame(i))
+        retired = sched.poll()
+        assert sched.bulk.stats.batches == 5
+        # the ring may transiently hold depth+1 inside push(); what the
+        # scheduler leaves in flight is bounded by depth
+        assert max_seen <= 3
+        assert len(sched._bulk_ring) <= 2
+        retired += sched.flush()
+        assert retired == 40
+
+
+class TestUpdateDrainCadence:
+    def test_bulk_drains_every_n_dispatches(self):
+        engine, _, clock = build_stack(batch_size=8)
+        sched = TieredScheduler(engine, SchedulerConfig(
+            bulk_batch=8, bulk_depth=2, drain_every=3), clock=clock)
+        nat_calls = []
+        orig = engine.nat.make_updates
+        engine.nat.make_updates = lambda: (nat_calls.append(1), orig())[1]
+        for i in range(6 * 8):  # six bulk dispatches under sustained load
+            sched.submit(data_frame(i))
+        sched.poll()
+        sched.flush()
+        assert sched.bulk.stats.batches == 6
+        # drains at bulk_seq 0, 3 — every third dispatch only
+        assert len(nat_calls) == 2
+        assert sched._drains_applied == 2
+        # the no-drain steps reused the cached no-op scatter buffers
+        assert engine.nat.sessions._empty_upd_cache
+
+    def test_no_drain_steps_carry_live_dense_config(self):
+        """The no-op batch must NOT snapshot the dense config arrays: the
+        step applies them wholesale, so a cached copy would revert live
+        antispoof/garden/NAT config on every no-drain step."""
+        engine, _, clock = build_stack()
+        engine._empty_updates()  # primes the scatter caches
+        engine.antispoof.add_allowed_range(ip_to_u32("172.16.0.0"), 12)
+        after = engine._empty_updates()
+        import numpy as np
+
+        # upd layout: spoof ranges ride at index 5; a no-drain batch
+        # built after the config change must carry it (no build-time
+        # snapshot; jnp.asarray may or may not alias host memory, so
+        # only the fresh-batch property is contractual)
+        sp_ranges = np.asarray(after[5])
+        assert (sp_ranges[:, 1] == ip_to_u32("172.16.0.0")).any()
+
+    def test_express_drains_fastpath_every_dispatch(self):
+        engine, _, clock = build_stack()
+        sched = TieredScheduler(engine, SchedulerConfig(
+            express_batch=8), clock=clock)
+        fp_calls = []
+        orig = engine.fastpath.make_updates
+        engine.fastpath.make_updates = lambda: (fp_calls.append(1), orig())[1]
+        for i in range(16):
+            sched.submit(discover(mac_of(300 + i), 0x5000 + i))
+        sched.poll()
+        assert sched.express.stats.batches == 2
+        assert len(fp_calls) == 2
+
+    def test_pending_lease_reaches_device_via_express_drain(self):
+        """A lease installed host-side between steps is visible to the
+        very next express dispatch (the OFFER-correctness invariant the
+        always-drain express rule protects)."""
+        engine, _, clock = build_stack()
+        sched = TieredScheduler(engine, SchedulerConfig(express_batch=8),
+                                clock=clock)
+        mac = mac_of(400)
+        engine.fastpath.add_subscriber(mac, pool_id=1,
+                                       ip=ip_to_u32("10.0.0.77"),
+                                       lease_expiry=int(clock()) + 3600)
+        out = sched.process([discover(mac, 0x6001)])
+        assert len(out["tx"]) == 1  # on-device OFFER: the update landed
+
+
+class TestSchedulerDHCPCorrectness:
+    def test_dora_then_fastpath_hit(self):
+        engine, server, clock = build_stack()
+        sched = TieredScheduler(engine, SchedulerConfig(express_batch=8),
+                                clock=clock)
+        mac = mac_of(500)
+        out = sched.process([discover(mac, 0x7001)])
+        assert len(out["slow"]) == 1
+        offer = out["slow"][0][1]
+        assert offer is not None
+        od = packets.decode(offer)
+        op = dhcp_codec.decode(od.payload)
+        assert op.msg_type == dhcp_codec.OFFER
+        req = dhcp_codec.build_request(mac, dhcp_codec.REQUEST, xid=0x7002,
+                                       requested_ip=op.yiaddr,
+                                       server_id=od.src_ip)
+        req.options.append((dhcp_codec.OPT_PARAM_REQ_LIST,
+                            bytes([1, 3, 6, 51, 54])))
+        rf = packets.udp_packet(mac, b"\xff" * 6, 0, 0xFFFFFFFF, 68, 67,
+                                req.encode().ljust(300, b"\x00"))
+        out2 = sched.process([rf])
+        ack = out2["slow"][0][1]
+        assert ack is not None
+        assert dhcp_codec.decode(packets.decode(ack).payload).msg_type \
+            == dhcp_codec.ACK
+        # the lease is now in the device cache: next DISCOVER answers
+        # on-device through the express lane (TX, no slow path)
+        out3 = sched.process([discover(mac, 0x7003)])
+        assert len(out3["tx"]) == 1 and not out3["slow"]
+
+    def test_mixed_batch_fans_out_to_both_lanes(self):
+        engine, _, clock = build_stack(batch_size=8)
+        sched = TieredScheduler(engine, SchedulerConfig(
+            express_batch=8, bulk_batch=8), clock=clock)
+        frames = [discover(mac_of(600 + i), 0x8000 + i) for i in range(3)]
+        frames += [data_frame(700 + i) for i in range(5)]
+        out = sched.process(frames)
+        done = {i for lst in (out["tx"], out["slow"], out["fwd"])
+                for i, _ in lst} | set(out["dropped"])
+        assert done == set(range(8))
+        assert sched.express.stats.frames_dispatched == 3
+        assert sched.bulk.stats.frames_dispatched == 5
+
+
+class TestSchedulerMetrics:
+    def test_bng_sched_families_exported(self):
+        engine, _, clock = build_stack(batch_size=8)
+        metrics = BNGMetrics()
+        sched = TieredScheduler(engine, SchedulerConfig(
+            express_batch=8, bulk_batch=8), metrics=metrics, clock=clock)
+        for i in range(8):
+            sched.submit(discover(mac_of(800 + i), 0x9000 + i))
+        for i in range(8):
+            sched.submit(data_frame(900 + i))
+        sched.poll()
+        sched.flush()
+        metrics.collect_scheduler(sched)
+        text = metrics.expose()
+        assert 'bng_sched_dispatches_total{lane="express",close="full"} 1' in text
+        assert 'bng_sched_dispatches_total{lane="bulk",close="full"} 1' in text
+        assert 'bng_sched_queue_depth{lane="express"} 0' in text
+        assert 'bng_sched_frames_total{lane="bulk"} 8' in text
+        assert "bng_sched_batch_occupancy_ratio_bucket" in text
+        assert "bng_sched_dispatch_latency_seconds_bucket" in text
+
+
+class TestSlowPathErrorsLogged:
+    def _capture(self):
+        records = []
+
+        class H(logging.Handler):
+            def emit(self, record):
+                records.append(record)
+
+        h = H()
+        logging.getLogger("bng.slowpath").addHandler(h)
+        return records, h
+
+    def test_engine_process_logs_not_swallows(self):
+        def boom(frame):
+            raise ValueError("poisoned frame")
+
+        engine, _, clock = build_stack(slow_path=boom)
+        records, h = self._capture()
+        try:
+            out = engine.process([data_frame(0)])
+            assert len(out["slow"]) == 1
+            assert engine.stats.slow_errors == 1
+            assert len(records) == 1
+            assert records[0].bng_fields["error"].startswith("ValueError")
+            assert records[0].exc_info is not None  # traceback preserved
+        finally:
+            logging.getLogger("bng.slowpath").removeHandler(h)
+
+    def test_scheduler_lanes_log_and_rate_limit(self):
+        def boom(frame):
+            raise RuntimeError("handler down")
+
+        engine, _, clock = build_stack(slow_path=boom)
+        # deterministic limiter: 2-token bucket, no refill w/ fake clock
+        engine._slow_err_log._limit = RateLimiter(rate=1.0, burst=2,
+                                                  clock=clock)
+        sched = TieredScheduler(engine, SchedulerConfig(express_batch=8),
+                                clock=clock)
+        records, h = self._capture()
+        try:
+            sched.process([discover(mac_of(950 + i), 0xA100 + i)
+                           for i in range(8)])
+            assert engine.stats.slow_errors == 8  # every failure counted
+            assert len(records) == 2  # ...but the log is rate-limited
+        finally:
+            logging.getLogger("bng.slowpath").removeHandler(h)
+
+
+class TestRateLimiter:
+    def test_burst_then_refill(self):
+        clock = FakeClock(0.0)
+        rl = RateLimiter(rate=1.0, burst=2, clock=clock)
+        assert rl.allow() == (True, 0)
+        assert rl.allow() == (True, 0)
+        ok, _ = rl.allow()
+        assert not ok
+        ok, _ = rl.allow()
+        assert not ok
+        clock.advance(1.0)  # one token refilled
+        ok, suppressed = rl.allow()
+        assert ok and suppressed == 2  # the two denied events reported
+
+
+class TestLoadtestHarnessScheduler:
+    def test_harness_routes_through_scheduler(self):
+        from bng_tpu.loadtest import BenchmarkConfig, DHCPBenchmark
+
+        engine, _, clock = build_stack(batch_size=8)
+        sched = TieredScheduler(engine, SchedulerConfig(
+            express_batch=8, bulk_batch=8), clock=clock)
+        cfg = BenchmarkConfig(batch_size=8, duration_s=0.05, warmup_s=0.02,
+                              unique_macs=8, enable_renewals=False)
+        import time as _t
+
+        bench = DHCPBenchmark(sched, cfg, clock=_t.perf_counter)
+        res = bench.run()
+        assert res.program == "tiered_scheduler"
+        assert res.requests > 0
+        assert res.responses > 0

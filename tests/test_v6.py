@@ -449,6 +449,7 @@ class TestSlowPathDemux:
         assert demux(b"\x02" * 12 + b"\x12\x34" + b"x" * 40) is None
         assert demux.stats["unmatched"] == 2
 
+    @pytest.mark.slow  # compile-heavy; tier-1 runs -m 'not slow'
     def test_cli_wires_demux_and_engine_ring_serves_v6(self):
         """End to end through the ENGINE ring: a DHCPv6 SOLICIT frame
         PASSes the device pipeline, the demux answers, the ADVERTISE
