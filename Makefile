@@ -1,0 +1,26 @@
+# Test tiers (see ROADMAP.md "Tier-1 verify" and pytest.ini markers).
+#
+#   make verify       — the tier-1 gate: fast suite (-m 'not slow') under
+#                       the hard timeout the CI driver enforces.
+#   make verify-slow  — the compile-heavy tier (-m slow): the checkpoint
+#                       round-trip, full DORA e2e, and every other test
+#                       excluded from tier-1 to keep it under its timeout.
+#   make verify-all   — both tiers.
+
+SHELL := /bin/bash
+PY ?= python
+TIER1_TIMEOUT ?= 870
+PYTEST_FLAGS = -q --continue-on-collection-errors -p no:cacheprovider \
+               -p no:xdist -p no:randomly
+
+.PHONY: verify verify-slow verify-all
+
+verify:
+	set -o pipefail; rm -f /tmp/_t1.log; \
+	timeout -k 10 $(TIER1_TIMEOUT) env JAX_PLATFORMS=cpu \
+	$(PY) -m pytest tests/ $(PYTEST_FLAGS) -m 'not slow' 2>&1 | tee /tmp/_t1.log
+
+verify-slow:
+	env JAX_PLATFORMS=cpu $(PY) -m pytest tests/ $(PYTEST_FLAGS) -m slow
+
+verify-all: verify verify-slow

@@ -920,7 +920,7 @@ def config5_sharded(on_tpu):
           hits_per_step=hit, compile_s=round(compile_s, 1))
 
 
-def scheduler_bench(on_tpu: bool) -> None:
+def scheduler_bench(on_tpu: bool, checkpoint_interval_s: float = 0.0) -> None:
     """`--scheduler`: latency mode through the tiered scheduler.
 
     Publishes the quantity the <50us OFFER p99 target actually constrains:
@@ -983,6 +983,27 @@ def scheduler_bench(on_tpu: bool) -> None:
         drain_every=drain_every))
     setup_s = time.time() - t_setup
 
+    # optional checkpoint cadence riding the measured loops: the
+    # acceptance question is whether quiesce+snapshot+write on a live
+    # scheduler moves offer_device_p99_us / express-under-load latency
+    ckptr = None
+    if checkpoint_interval_s > 0:
+        import tempfile
+
+        from bng_tpu.control.statestore import (CheckpointStore,
+                                                PeriodicCheckpointer)
+        from bng_tpu.runtime.checkpoint import build_checkpoint
+
+        ckpt_dir = (os.environ.get("BNG_CKPT_DIR")
+                    or tempfile.mkdtemp(prefix="bng-ckpt-bench-"))
+        ckptr = PeriodicCheckpointer(
+            CheckpointStore(ckpt_dir),
+            lambda seq, t: build_checkpoint(seq, t, engine=engine,
+                                            scheduler=sched),
+            interval_s=checkpoint_interval_s)
+        _mark(f"checkpoint cadence: every {checkpoint_interval_s}s "
+              f"-> {ckpt_dir}")
+
     def discover_batch(base_xid):
         return [_discover_row(macs[int(rng.integers(N_SUBS))], base_xid + k)
                 for k in range(B_EXPR)]
@@ -1011,6 +1032,8 @@ def scheduler_bench(on_tpu: bool) -> None:
     _mark(f"blocked OFFER latency: {LAT_STEPS} express batches...")
     llat = []
     for k in range(LAT_STEPS):
+        if ckptr is not None:
+            ckptr.tick()  # cadence interleaves OUTSIDE the timed window
         frames = discover_batch(0x9000 + k * B_EXPR)
         t1 = time.perf_counter()
         sched.process(frames)
@@ -1079,6 +1102,11 @@ def scheduler_bench(on_tpu: bool) -> None:
         for f in discover_batch(0xB000 + k * B_EXPR):
             sched.submit(f, from_access=True)
         sched.poll()
+        if ckptr is not None:
+            # INSIDE the sustained window: a due save quiesces the live
+            # scheduler mid-load, and the express latency samples that
+            # straddle it show (or clear) the barrier cost
+            ckptr.tick()
         drain_express_lat()
     sched.flush()
     sustain_s = time.time() - t0
@@ -1109,6 +1137,11 @@ def scheduler_bench(on_tpu: bool) -> None:
         "bulk_batch": B_BULK,
         "bulk_depth": depth,
         "drain_every": drain_every,
+        "checkpoint_interval_s": checkpoint_interval_s,
+        "checkpoints_saved": ckptr.stats["saves"] if ckptr else 0,
+        "checkpoint_failures": ckptr.stats["failures"] if ckptr else 0,
+        "checkpoint_last_duration_s": (round(ckptr.stats["last_duration_s"], 3)
+                                       if ckptr else 0.0),
         "subscribers": N_SUBS,
         "sched": sched.stats_snapshot(),
         "device": str(dev),
@@ -1163,7 +1196,8 @@ def _run_lowering_gate(strict: bool) -> None:
 
 
 def _child_dispatch(config: int, verify_lowering: bool = False,
-                    scheduler: bool = False) -> None:
+                    scheduler: bool = False,
+                    checkpoint_interval_s: float = 0.0) -> None:
     """Run one benchmark config in this process (the supervised child)."""
     try:
         if config == 1 and not verify_lowering and not scheduler:
@@ -1204,7 +1238,7 @@ def _child_dispatch(config: int, verify_lowering: bool = False,
         if cache_dir:
             _mark(f"compilation cache: {cache_dir}")
         if scheduler:
-            scheduler_bench(on_tpu)
+            scheduler_bench(on_tpu, checkpoint_interval_s=checkpoint_interval_s)
             return
         if verify_lowering:
             if not on_tpu:
@@ -1259,11 +1293,16 @@ def main_dispatch() -> None:
                     help="latency mode through the tiered scheduler: "
                          "device-isolated OFFER p50/p99 + per-lane stats "
                          "(rc=2 if lowering verification fails)")
+    ap.add_argument("--checkpoint-interval-s", type=float, default=0.0,
+                    help="with --scheduler: run the warm-restart snapshot "
+                         "cadence during the measured loops (quiesce + "
+                         "save every N seconds) to price the barrier")
     args = ap.parse_args()
 
     if os.environ.get("BNG_BENCH_CHILD") == "1":
         _child_dispatch(args.config, verify_lowering=args.verify_lowering,
-                        scheduler=args.scheduler)
+                        scheduler=args.scheduler,
+                        checkpoint_interval_s=args.checkpoint_interval_s)
         return
 
     # BNG_BENCH_TIMEOUT bounds the benchmark itself; the probe window is

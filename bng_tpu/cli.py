@@ -138,6 +138,13 @@ class BNGConfig:
     # routing platform: "stub" (in-memory) | "linux" (iproute2/netlink —
     # real kernel routes/rules; needs CAP_NET_ADMIN)
     routing_platform: str = "stub"
+    # checkpoint/warm-restart (runtime/checkpoint.py +
+    # control/statestore.py): dir set -> restore-at-start (cold-start
+    # fallback on reject) + SIGTERM snapshot; interval > 0 adds the
+    # background cadence off the 1 Hz tick
+    checkpoint_dir: str = ""
+    checkpoint_interval_s: float = 0.0
+    checkpoint_keep: int = 3
     # metrics
     metrics_port: int = 9090
     metrics_enabled: bool = True
@@ -1146,6 +1153,54 @@ class BNGApp:
                 {str(pid): st for pid, st in pool_mgr.stats().items()}))
             self._on_close(collector.stop)
 
+        # 14. checkpoint/warm-restart (runtime/checkpoint.py +
+        # control/statestore.py). Restore-at-start hydrates the host
+        # mirrors + lease book + HA store and re-uploads via the bulk
+        # path (zero slow-path DHCP exchanges); a corrupt or mismatched
+        # checkpoint is REJECTED and the process cold-starts, logged. A
+        # standby bootstraps its session store + last_seq from the
+        # checkpoint, then catches up via replay_since on first connect.
+        if cfg.checkpoint_dir:
+            from bng_tpu.control.statestore import (CheckpointStore,
+                                                    PeriodicCheckpointer)
+            from bng_tpu.runtime import checkpoint as ckpt_mod
+
+            store = c["checkpoint_store"] = CheckpointStore(
+                cfg.checkpoint_dir)
+            engine = c["engine"]
+            ha_sync = c.get("ha")
+            if store.has_checkpoints():
+                try:
+                    snap, path = store.load_latest()
+                    rows = ckpt_mod.restore_checkpoint(
+                        snap, engine=engine, dhcp=dhcp, ha=ha_sync)
+                    c["checkpoint_restored"] = rows
+                    self.log.info("warm restart from checkpoint",
+                                  path=str(path), seq=snap.seq,
+                                  rows={k: v for k, v in rows.items() if v})
+                    if "metrics" in c:
+                        c["metrics"].record_restore(rows)
+                except ckpt_mod.CheckpointError as e:
+                    c["checkpoint_error"] = str(e)
+                    self.log.warning(
+                        "checkpoint restore rejected; cold start",
+                        error=str(e))
+                    if "metrics" in c:
+                        c["metrics"].record_restore({}, outcome="rejected")
+
+            def _snapshot(seq, now, _eng=engine, _dhcp=dhcp, _ha=ha_sync):
+                return ckpt_mod.build_checkpoint(
+                    seq, now, engine=_eng, scheduler=c.get("scheduler"),
+                    dhcp=_dhcp, ha=_ha, node_id=cfg.node_id)
+
+            ckptr = c["checkpointer"] = PeriodicCheckpointer(
+                store, _snapshot, interval_s=cfg.checkpoint_interval_s,
+                keep=cfg.checkpoint_keep, metrics=c.get("metrics"),
+                clock=self.clock)
+            if "collector" in c:
+                c["collector"].add_source(
+                    lambda: c["metrics"].collect_checkpoint(ckptr))
+
     def _cluster_client_tls(self):
         """Client-side TLSConfig for https cluster peers, or None when no
         TLS material is configured (plaintext peers keep working)."""
@@ -1366,6 +1421,12 @@ class BNGApp:
         if pool is not None:
             pool.health_check(now)
 
+        # background checkpoint cadence (never raises; failures count +
+        # rate-limited log inside PeriodicCheckpointer.tick)
+        ckptr = c.get("checkpointer")
+        if ckptr is not None:
+            ckptr.tick(now)
+
         acct = c.get("accounting")
         if acct is not None:
             # bridge device-authoritative NAT octet counters into the
@@ -1566,6 +1627,56 @@ def run_loadtest(args) -> int:
     return 0
 
 
+def run_checkpoint(args) -> int:
+    """`bng checkpoint save|restore|info` — operator verbs over the
+    warm-restart store. save/restore build the full app from the same
+    flag surface as `run` (the snapshot must see the same table
+    geometry the running process uses); info only reads headers."""
+    from bng_tpu.control.statestore import CheckpointStore
+
+    cfg = _config_from_args(args)
+    if not cfg.checkpoint_dir:
+        print("checkpoint: --checkpoint-dir is required", file=sys.stderr)
+        return 2
+    if args.ckpt_cmd == "info":
+        infos = [i._asdict() for i in CheckpointStore(cfg.checkpoint_dir).list()]
+        print(json.dumps(infos, indent=2))
+        return 0
+
+    app = BNGApp(cfg)
+    try:
+        if args.ckpt_cmd == "save":
+            # snapshot of THIS freshly-built process (warm-restored from
+            # the dir's newest checkpoint when one exists) — it cannot
+            # see a separately-running daemon's live state; a running
+            # `bng run` snapshots via SIGTERM or its own cadence
+            print("checkpoint save: snapshotting a freshly built app "
+                  "(not any running daemon — use SIGTERM or "
+                  "--checkpoint-interval-s for that)", file=sys.stderr)
+            ckptr = app.components["checkpointer"]
+            path = ckptr.save_now(reason="cli")
+            s = ckptr.stats
+            print(json.dumps({
+                "path": str(path), "seq": s["last_seq"],
+                "bytes": s["last_bytes"],
+                "duration_s": round(s["last_duration_s"], 3)}))
+            return 0
+        # restore: _build already hydrated (or rejected) — report it
+        err = app.components.get("checkpoint_error")
+        if err:
+            print(f"checkpoint restore REJECTED: {err}", file=sys.stderr)
+            return 1
+        rows = app.components.get("checkpoint_restored")
+        if rows is None:
+            print(f"checkpoint restore: no checkpoint in "
+                  f"{cfg.checkpoint_dir}", file=sys.stderr)
+            return 1
+        print(json.dumps({"restored_rows": rows}, indent=2))
+        return 0
+    finally:
+        app.close()
+
+
 # ---------------------------------------------------------------------------
 # entry points
 # ---------------------------------------------------------------------------
@@ -1639,6 +1750,22 @@ def main(argv: list[str] | None = None) -> int:
                        help="drive the latency-tiered scheduler instead of "
                             "the engine's batch interface")
 
+    # warm-restart snapshots (runtime/checkpoint.py + statestore.py)
+    ckptp = sub.add_parser("checkpoint",
+                           help="save/restore/inspect warm-restart "
+                                "snapshots of the device tables")
+    ckpt_sub = ckptp.add_subparsers(dest="ckpt_cmd", required=True)
+    for verb, hlp in (("save", "build a fresh app (warm-restored from "
+                               "the dir if possible) and snapshot IT — "
+                               "a running daemon snapshots via SIGTERM "
+                               "or --checkpoint-interval-s"),
+                      ("restore", "build the app, hydrate from the "
+                                  "latest checkpoint, report row counts"),
+                      ("info", "list checkpoints in --checkpoint-dir "
+                               "(header-only; flags corrupt files)")):
+        vp = ckpt_sub.add_parser(verb, help=hlp)
+        _add_run_flags(vp)
+
     sub.add_parser("version", help="print version")
 
     args = parser.parse_args(argv)
@@ -1651,6 +1778,8 @@ def main(argv: list[str] | None = None) -> int:
         return 0
     if args.command == "loadtest":
         return run_loadtest(args)
+    if args.command == "checkpoint":
+        return run_checkpoint(args)
     if args.command in ("run", "stats"):
         app = BNGApp(_config_from_args(args))
         try:
@@ -1668,11 +1797,26 @@ def main(argv: list[str] | None = None) -> int:
             srv = app.components.get("cluster_server")
             if srv is not None:
                 print(f"cluster on {srv.url}", file=sys.stderr)
+            # SIGTERM -> final checkpoint then clean exit. The handler
+            # only sets a flag: the save runs on the loop thread below,
+            # never from signal context (the drive loop may hold _ctl —
+            # a snapshot from the handler would deadlock on it).
+            ckptr = app.components.get("checkpointer")
+            if ckptr is not None:
+                import signal
+
+                stop_flag = {"sigterm": False}
+                signal.signal(signal.SIGTERM,
+                              lambda *_: stop_flag.update(sigterm=True))
             # main loop: busy-drive the ring when one exists, 1 Hz
             # cluster maintenance either way
             has_ring = app.components.get("ring") is not None
             last_tick = 0.0
             while True:
+                if ckptr is not None and stop_flag["sigterm"]:
+                    with app._ctl:
+                        ckptr.save_now(reason="sigterm")
+                    return 0
                 moved = app.drive_once()
                 now_t = time.time()
                 if now_t - last_tick >= 1.0:

@@ -374,6 +374,61 @@ class DHCPServer:
                 lease_expiry=lease.expiry, client_class=lease.client_class,
             )
 
+    # -- checkpoint/warm-restart (runtime/checkpoint.py) ----------------
+    def export_leases(self) -> dict:
+        """JSON-serializable lease book for the checkpoint meta blob.
+        Bytes fields go out as hex; _offers (unanswered OFFERs) are
+        transient and deliberately dropped — a client mid-DORA across a
+        restart just re-DISCOVERs."""
+        return {
+            "session_seq": self._session_seq,
+            "leases": [{
+                "mac": l.mac.hex(), "ip": l.ip, "pool_id": l.pool_id,
+                "expiry": l.expiry, "circuit_id": l.circuit_id.hex(),
+                "remote_id": l.remote_id.hex(), "s_tag": l.s_tag,
+                "c_tag": l.c_tag, "session_id": l.session_id,
+                "client_class": l.client_class, "username": l.username,
+                "qos_policy": l.qos_policy,
+            } for l in self.leases.values()],
+        }
+
+    @staticmethod
+    def parse_lease_state(state: dict) -> tuple[int, list["Lease"]]:
+        """export_leases() output -> (session_seq, Lease list), touching
+        no server state. The restore pre-check runs this before any
+        mutation so a corrupt lease book rejects all-or-nothing."""
+        leases = [Lease(
+            mac=bytes.fromhex(d["mac"]), ip=int(d["ip"]),
+            pool_id=int(d["pool_id"]), expiry=int(d["expiry"]),
+            circuit_id=bytes.fromhex(d.get("circuit_id", "")),
+            remote_id=bytes.fromhex(d.get("remote_id", "")),
+            s_tag=int(d.get("s_tag", 0)), c_tag=int(d.get("c_tag", 0)),
+            session_id=d.get("session_id", ""),
+            client_class=int(d.get("client_class", 0)),
+            username=d.get("username", ""),
+            qos_policy=d.get("qos_policy", ""))
+            for d in state.get("leases", [])]
+        return int(state.get("session_seq", 0)), leases
+
+    def restore_leases(self, state: dict) -> int:
+        """Rebuild the lease book from export_leases() output: the lease
+        dict, the circuit-id index, and pool occupancy (each restored IP
+        is re-claimed in its pool so fresh DORAs can never double-assign
+        an address a restored subscriber still holds). The fast-path
+        device rows ride the table checkpoint, not this path. Returns
+        the number of leases restored."""
+        seq, leases = self.parse_lease_state(state)
+        self._session_seq = max(self._session_seq, seq)
+        for lease in leases:
+            mk = mac_to_u64(lease.mac)
+            self.leases[mk] = lease
+            if lease.circuit_id:
+                self.leases_by_cid[lease.circuit_id] = mk
+            pool = self.pools.pools.get(lease.pool_id)
+            if pool is not None:
+                pool.allocate_specific(lease.ip, lease.mac.hex())
+        return len(leases)
+
     def cleanup_expired(self, now: int | None = None) -> int:
         """Lease expiry sweep (parity: server.go:1100-1163)."""
         now = now if now is not None else self._now()

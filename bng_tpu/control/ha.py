@@ -79,6 +79,15 @@ class HAChange:
     seq: int = 0
 
 
+def parse_ha_checkpoint_state(state: dict) -> tuple[int, list[SessionState]]:
+    """Checkpoint HA blob -> (seq, SessionState list), touching no
+    syncer state. The restore pre-check runs this before any store
+    mutation so a corrupt session dict rejects all-or-nothing
+    (KeyError/TypeError/ValueError propagate)."""
+    sessions = [SessionState.from_dict(d) for d in state.get("sessions", [])]
+    return int(state.get("seq", 0)), sessions
+
+
 class ActiveSyncer:
     """Active side: records changes, serves full syncs + deltas.
 
@@ -148,6 +157,30 @@ class ActiveSyncer:
                 return None  # gap: standby must full-sync
             return missing
 
+    # -- checkpoint/warm-restart (control/statestore.py) ---------------
+    def checkpoint_state(self) -> dict:
+        """Session store + high-water seq, atomically vs push_change —
+        the payload a checkpoint carries so a restarted active (or a
+        bootstrapping standby) resumes from a consistent cut."""
+        with self._lock:
+            return {"seq": self._seq,
+                    "sessions": [s.to_dict() for s in self.store.all()]}
+
+    parse_checkpoint_state = staticmethod(parse_ha_checkpoint_state)
+
+    def restore_state(self, state: dict) -> int:
+        """Hydrate a restarted ACTIVE from a checkpoint. The seq resumes
+        at the checkpointed high-water mark so a standby that bootstrapped
+        from the same (or older) checkpoint replays forward cleanly; the
+        replay buffer starts empty, so any standby further behind gets
+        the correct None -> full-resync answer."""
+        seq, sessions = parse_ha_checkpoint_state(state)
+        with self._lock:
+            for s in sessions:
+                self.store.put(s)
+            self._seq = max(self._seq, seq)
+            return len(sessions)
+
     def subscribe(self, cb: Callable[[HAChange], None]) -> Callable[[], None]:
         self._subscribers.append(cb)
 
@@ -178,7 +211,31 @@ class StandbySyncer:
         self._backoff_initial = backoff_initial_s
         self._backoff_max = backoff_max_s
         self._next_attempt = 0.0
-        self.stats = {"full_syncs": 0, "deltas": 0, "reconnects": 0}
+        self.stats = {"full_syncs": 0, "deltas": 0, "reconnects": 0,
+                      "bootstraps": 0}
+
+    parse_checkpoint_state = staticmethod(parse_ha_checkpoint_state)
+
+    def bootstrap_state(self, state: dict) -> int:
+        """Hydrate from an ActiveSyncer.checkpoint_state() payload BEFORE
+        the first connect: the store fills from the snapshot and last_seq
+        jumps to its high-water mark, so the first _connect asks
+        replay_since(seq) and ships only the delta since the checkpoint —
+        full_sync() is the fallback only when the active's replay buffer
+        has already wrapped past that seq."""
+        seq, sessions = parse_ha_checkpoint_state(state)
+        for s in sessions:
+            self.store.put(s)
+        self.last_seq = max(self.last_seq, seq)
+        self.stats["bootstraps"] += 1
+        return len(sessions)
+
+    def checkpoint_state(self) -> dict:
+        """Snapshot the standby's replicated view (its own checkpoints
+        make a standby restart a local bootstrap instead of a full
+        resync off the active)."""
+        return {"seq": self.last_seq,
+                "sessions": [s.to_dict() for s in self.store.all()]}
 
     def _on_change(self, ch: HAChange) -> None:
         if ch.op == "put":

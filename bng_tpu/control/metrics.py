@@ -308,6 +308,30 @@ class BNGMetrics:
             "bng_sched_dispatch_latency_seconds",
             "Oldest-frame submit->retire latency per dispatched batch",
             lbl_lane)
+        # checkpoint/warm-restart subsystem (runtime/checkpoint.py +
+        # control/statestore.py). The reference needs none of this — its
+        # state survives in kernel-pinned maps; here snapshot health IS
+        # restart safety, so it gets first-class observability.
+        self.ckpt_saves = r.counter(
+            "bng_ckpt_saves_total", "Checkpoints written successfully")
+        self.ckpt_failures = r.counter(
+            "bng_ckpt_failures_total", "Checkpoint save attempts that failed")
+        self.ckpt_last_success_age = r.gauge(
+            "bng_ckpt_last_success_age_seconds",
+            "Seconds since the last successful checkpoint")
+        self.ckpt_bytes = r.gauge(
+            "bng_ckpt_bytes", "Size of the last written checkpoint")
+        self.ckpt_seq = r.gauge(
+            "bng_ckpt_seq", "Sequence number of the last written checkpoint")
+        self.ckpt_duration = r.histogram(
+            "bng_ckpt_duration_seconds",
+            "Quiesce+snapshot+write duration per checkpoint", ("reason",))
+        self.ckpt_restore_rows = r.gauge(
+            "bng_ckpt_restore_rows",
+            "Rows recovered per table by the startup restore", ("table",))
+        self.ckpt_restores = r.counter(
+            "bng_ckpt_restores_total",
+            "Startup restore outcomes", ("outcome",))
 
     # -- collection (metrics.go:555-623) -------------------------------
 
@@ -365,6 +389,30 @@ class BNGMetrics:
         self.sched_oversize_dropped.set_total(snap.get("oversize_dropped", 0))
         self.sched_completions_evicted.set_total(
             snap.get("completions_dropped", 0))
+
+    def collect_checkpoint(self, checkpointer, now: float | None = None) -> None:
+        """PeriodicCheckpointer.stats -> bng_ckpt_* gauges/counters (the
+        duration histogram is fed live at save time)."""
+        s = checkpointer.stats
+        self.ckpt_saves.set_total(s["saves"])
+        self.ckpt_failures.set_total(s["failures"])
+        # before the first success, age counts from checkpointer start:
+        # a dir that has NEVER taken a save must trip staleness alerts,
+        # not read as perpetually fresh
+        origin = s["last_success_t"] or getattr(checkpointer,
+                                                "started_at", 0.0)
+        if origin:
+            now = now if now is not None else time.time()
+            self.ckpt_last_success_age.set(max(0.0, now - origin))
+        if s["last_success_t"]:
+            self.ckpt_bytes.set(s["last_bytes"])
+            self.ckpt_seq.set(s["last_seq"])
+
+    def record_restore(self, rows: dict, outcome: str = "ok") -> None:
+        """Startup-restore result -> bng_ckpt_restore_rows / restores."""
+        self.ckpt_restores.inc(outcome=outcome)
+        for table, n in rows.items():
+            self.ckpt_restore_rows.set(n, table=table)
 
     def collect_dns(self, server_stats: dict, resolver_stats: dict) -> None:
         """DNSServer.stats + Resolver.stats() -> bng_dns_* families."""

@@ -523,31 +523,35 @@ class NATManager:
         return nat_ip, nat_port
 
     # -- expiry (host sweep over device-authoritative last_seen) --
-    @staticmethod
-    def _timeout_for(proto: int, state: int) -> int:
-        if proto == PROTO_TCP:
-            return TCP_EST_TIMEOUT_S if state == 1 else TCP_TRANSIENT_TIMEOUT_S
-        if proto == PROTO_ICMP:
-            return ICMP_TIMEOUT_S
-        return UDP_TIMEOUT_S
-
     def expire_sessions(self, now: int, device_vals: np.ndarray | None = None) -> int:
         """Remove idle sessions. device_vals: fetched session value array
-        (device-authoritative counters/last_seen); defaults to host mirror."""
+        (device-authoritative counters/last_seen); defaults to host mirror.
+
+        The candidate scan is vectorized: per-slot timeouts come from one
+        numpy pass over the occupied rows' proto/state words, and the
+        Python loop below runs only over the already-expired indices — at
+        the 1M-session target a full sweep with a per-slot Python body
+        was the cost of the sweep, not the deletions."""
         vals = device_vals if device_vals is not None else self.sessions.vals
         used = self.sessions.used
         expired = 0
         occupied = np.nonzero(used)[0]
-        for s in occupied:
+        if len(occupied) == 0:
+            return 0
+        rows = vals[occupied]
+        proto_c = rows[:, SV_PROTO]
+        state_c = rows[:, SV_STATE]
+        last_c = rows[:, SV_LAST_SEEN].astype(np.int64)
+        timeout_c = np.full(len(occupied), UDP_TIMEOUT_S, dtype=np.int64)
+        timeout_c[proto_c == PROTO_ICMP] = ICMP_TIMEOUT_S
+        timeout_c[proto_c == PROTO_TCP] = np.where(
+            state_c[proto_c == PROTO_TCP] == 1,
+            TCP_EST_TIMEOUT_S, TCP_TRANSIENT_TIMEOUT_S)
+        timeout_c = np.where(state_c == NAT_STATE_CLOSING,
+                             np.minimum(timeout_c, TCP_TRANSIENT_TIMEOUT_S),
+                             timeout_c)
+        for s in occupied[(now - last_c) > timeout_c]:
             v = vals[s]
-            proto = int(v[SV_PROTO])
-            state = int(v[SV_STATE])
-            last = int(v[SV_LAST_SEEN])
-            timeout = self._timeout_for(proto, state)
-            if state == NAT_STATE_CLOSING:
-                timeout = min(timeout, TCP_TRANSIENT_TIMEOUT_S)
-            if now - last <= timeout:
-                continue
             key = self.sessions.keys[s].copy()
             src_ip, dst_ip = int(key[0]), int(key[1])
             ports = int(key[2])
@@ -630,6 +634,101 @@ class NATManager:
             jnp.asarray(self.alg),
             jnp.asarray(self.config_array()),
         )
+
+    # -- checkpoint/warm-restart (runtime/checkpoint.py) ----------------
+    _CKPT_TABLES = ("sessions", "reverse", "sub_nat")
+
+    def checkpoint_state(self) -> tuple[dict, dict]:
+        """(meta, arrays): the three cuckoo mirrors slot-exact, the dense
+        hairpin/alg config, and ALL of the Python allocator bookkeeping —
+        block cursors, free lists, EIM refcounts, per-subscriber blocks.
+        A restore that kept only the table rows would re-hand out ports
+        that live sessions still map (the restore_block hazard)."""
+        meta = {
+            "geom": {t: getattr(self, t).checkpoint_geom()
+                     for t in self._CKPT_TABLES},
+            "flags": int(self.flags),
+            "port_range": list(self.port_range),
+            "ports_per_subscriber": int(self.ports_per_subscriber),
+            "public_ips": [int(ip) for ip in self.public_ips],
+            "next_block": [[int(ip), int(p)]
+                           for ip, p in self._next_block.items()],
+            "free_blocks": [[int(ip), [int(s) for s in starts]]
+                            for ip, starts in self._free_blocks.items()],
+            "ip_round_robin": int(self._ip_round_robin),
+            "sub_id_seq": int(self._sub_id_seq),
+            "eim": [[int(k[0]), int(k[1]), int(k[2]),
+                     int(m[0]), int(m[1]), int(m[2])]
+                    for k, m in self.eim.items()],
+            "blocks": [[int(ip), int(b["public_ip"]), int(b["port_start"]),
+                        int(b["port_end"]), int(b["next_port"]),
+                        int(b["subscriber_id"])]
+                       for ip, b in self.blocks.items()],
+        }
+        arrays = {f"{t}.{k}": v
+                  for t in self._CKPT_TABLES
+                  for k, v in getattr(self, t).checkpoint_arrays().items()}
+        arrays["hairpin"] = self.hairpin
+        arrays["alg"] = self.alg
+        return meta, arrays
+
+    @staticmethod
+    def parse_checkpoint_meta(meta: dict) -> dict:
+        """Parse/validate the checkpointed allocator bookkeeping into
+        plain structures WITHOUT touching self. The restore pre-check
+        runs this before any mirror mutates (KeyError/ValueError/
+        TypeError propagate to the all-or-nothing gate); restore_state
+        applies the result."""
+        return {
+            "flags": int(meta["flags"]),
+            "port_range": (int(meta["port_range"][0]),
+                           int(meta["port_range"][1])),
+            "ports_per_subscriber": int(meta["ports_per_subscriber"]),
+            "public_ips": [int(ip) for ip in meta["public_ips"]],
+            "next_block": {int(ip): int(p) for ip, p in meta["next_block"]},
+            "free_blocks": {int(ip): [int(s) for s in starts]
+                            for ip, starts in meta["free_blocks"]},
+            "ip_round_robin": int(meta["ip_round_robin"]),
+            "sub_id_seq": int(meta["sub_id_seq"]),
+            "eim": {(int(a), int(b), int(c)): [int(d), int(e), int(f)]
+                    for a, b, c, d, e, f in meta["eim"]},
+            "blocks": {
+                int(ip): {"public_ip": int(pub), "port_start": int(start),
+                          "port_end": int(end), "next_port": int(nxt),
+                          "subscriber_id": int(sid), "private_ip": int(ip)}
+                for ip, pub, start, end, nxt, sid in meta["blocks"]},
+        }
+
+    def restore_state(self, meta: dict, arrays: dict) -> dict[str, int]:
+        """Hydrate from a checkpoint (reject-on-mismatch on table
+        geometry). NAT policy knobs (flags, port range, public IPs) come
+        from the checkpoint — the restored mappings are only valid under
+        the configuration that created them. Caller must follow with a
+        full device upload (resync_tables)."""
+        parsed = self.parse_checkpoint_meta(meta)  # parse BEFORE mutating
+        rows = {}
+        for t in self._CKPT_TABLES:
+            rows[t] = getattr(self, t).restore_arrays(
+                {k: arrays[f"{t}.{k}"] for k in ("keys", "vals", "used")},
+                meta["geom"][t])
+        self.hairpin[:] = arrays["hairpin"]
+        self.alg[:] = arrays["alg"]
+        self.flags = parsed["flags"]
+        self.port_range = parsed["port_range"]
+        self.ports_per_subscriber = parsed["ports_per_subscriber"]
+        self.public_ips = parsed["public_ips"]
+        self._next_block = parsed["next_block"]
+        self._free_blocks = parsed["free_blocks"]
+        self._ip_round_robin = parsed["ip_round_robin"]
+        self._sub_id_seq = parsed["sub_id_seq"]
+        self.eim = parsed["eim"]
+        # _ext_ports is derived state: rebuild, never trust two copies
+        self._ext_ports = {(m[0], m[1], k[2]): k
+                           for k, m in self.eim.items()}
+        self.blocks = parsed["blocks"]
+        rows["blocks"] = len(self.blocks)
+        rows["eim"] = len(self.eim)
+        return rows
 
     def empty_updates(self) -> tuple:
         """No-op table-delta batch (dirty tracking untouched) for the

@@ -334,6 +334,36 @@ class HostQTable:
             self._dirty.clear()
             self._dirty_all = True
 
+    # -- checkpoint/warm-restart (runtime/checkpoint.py) ----------------
+    def checkpoint_geom(self) -> dict:
+        return {"nbuckets": self.nbuckets}
+
+    def checkpoint_arrays(self) -> dict[str, np.ndarray]:
+        """The packed way rows carry policy AND token state — one array
+        is the whole mirror."""
+        return {"rows": self.rows}
+
+    def restore_arrays(self, arrays: dict[str, np.ndarray],
+                       geom: dict) -> int:
+        """Overwrite the mirror from a checkpoint (reject-on-mismatch;
+        abandons delta tracking like bulk_insert — caller must follow
+        with a full device upload). Returns the restored policy count."""
+        if geom != self.checkpoint_geom():
+            raise ValueError(
+                f"qos table {self.name!r}: checkpoint geometry {geom} != "
+                f"live geometry {self.checkpoint_geom()}")
+        src = arrays["rows"]
+        if src.shape != self.rows.shape or src.dtype != self.rows.dtype:
+            raise ValueError(
+                f"qos table {self.name!r}: checkpoint rows are "
+                f"{src.dtype}{src.shape}, expected "
+                f"{self.rows.dtype}{self.rows.shape}")
+        self.rows[:] = src
+        self.count = int(np.count_nonzero(self.rows[:, QW_FLAGS] & 1))
+        self._dirty.clear()
+        self._dirty_all = True
+        return self.count
+
     # -- device synchronization --
     def device_state(self) -> QTableState:
         self._dirty.clear()

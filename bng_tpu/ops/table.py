@@ -565,6 +565,44 @@ class HostTable:
             )
         return upd
 
+    # -- checkpoint/warm-restart (runtime/checkpoint.py) ----------------
+    def checkpoint_geom(self) -> dict:
+        """Geometry signature a checkpoint must match to be restorable:
+        slot indices/hashes are only meaningful at identical shape."""
+        return {"nbuckets": self.nbuckets, "key_words": self.K,
+                "val_words": self.V, "stash": self.stash}
+
+    def checkpoint_arrays(self) -> dict[str, np.ndarray]:
+        """The complete host-authoritative mirror state (slot-exact, so a
+        restore needs no rehash and preserves cuckoo/stash placement)."""
+        return {"keys": self.keys, "vals": self.vals, "used": self.used}
+
+    def restore_arrays(self, arrays: dict[str, np.ndarray],
+                       geom: dict) -> int:
+        """Overwrite the mirror from checkpointed arrays. Raises
+        ValueError on any geometry/shape mismatch (reject-on-mismatch —
+        a silently reshaped table would corrupt every later probe).
+        Abandons delta tracking like bulk_insert: the caller must follow
+        with a full device upload (device_state / resync_tables).
+        Returns the restored row count."""
+        if geom != self.checkpoint_geom():
+            raise ValueError(
+                f"table {self.name!r}: checkpoint geometry {geom} != "
+                f"live geometry {self.checkpoint_geom()}")
+        for name, target in (("keys", self.keys), ("vals", self.vals),
+                             ("used", self.used)):
+            src = arrays[name]
+            if src.shape != target.shape or src.dtype != target.dtype:
+                raise ValueError(
+                    f"table {self.name!r}: checkpoint array {name!r} is "
+                    f"{src.dtype}{src.shape}, expected "
+                    f"{target.dtype}{target.shape}")
+            target[:] = src
+        self.count = int(np.count_nonzero(self.used))
+        self._dirty.clear()
+        self._dirty_all = True
+        return self.count
+
     def lookup_batch_host(self, queries: np.ndarray) -> np.ndarray:
         """Reference host-side batched lookup (for tests)."""
         out = np.zeros((len(queries), self.V), dtype=np.uint32)

@@ -1,0 +1,491 @@
+"""Versioned snapshots of the HBM device tables — warm restart.
+
+The reference BNG survives a userspace restart for free: its state lives
+in kernel-pinned eBPF maps that outlive the agent. The TPU re-host has no
+kernel to pin into — a crash or deploy threw away every lease row, NAT
+session and QoS bucket, and recovery meant re-DORA-ing the subscriber
+base through the slow path. This module is the replacement, shaped like
+ML training checkpointing (snapshot device-resident arrays without
+stalling the step loop):
+
+- **snapshot** (`build_checkpoint`): at a scheduler drain barrier
+  (`TieredScheduler.quiesce()` / `Engine.quiesce()` — flush pending
+  dispatches, block until the threaded table state materializes, so a
+  snapshot never interleaves with an in-flight scatter), fold the
+  device-authoritative words back into the host mirrors
+  (`Engine.fold_device_authoritative`: NAT session counters/last_seen,
+  QoS token buckets) and collect every host authority slot-exact: the
+  DHCP fast-path tables, NAT tables + allocator bookkeeping, QoS policy
+  rows, antispoof bindings, garden membership, PPPoE session tables, the
+  DHCP lease book and the HA session store.
+
+- **format** (`encode_checkpoint` / `decode_checkpoint`): one file =
+  magic + JSON header (schema version, monotonic seq, array manifest
+  with shapes/dtypes, payload CRC32) + raw array payload. Loads REJECT
+  on any mismatch — wrong magic, unknown schema, truncated payload, bad
+  checksum — with a `CheckpointError` naming the reason; the process
+  falls back to cold start instead of hydrating garbage.
+
+- **restore** (`restore_checkpoint`): hydrate the host mirrors, then one
+  full device upload via the existing bulk path
+  (`Engine.resync_tables()` — the same startup upload a cold boot does),
+  recovering leases, NAT blocks, sessions and EIM mappings with zero
+  slow-path DHCP exchanges.
+
+File lifecycle (directories, atomic rename, retention, the periodic
+cadence, HA standby hydration) lives in `control/statestore.py`.
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+import zlib
+from typing import NamedTuple
+
+import numpy as np
+
+MAGIC = b"BNGCKPT1"
+SCHEMA_VERSION = 1
+# layout: MAGIC + u32 header_len + u32 header_crc32 + header JSON + payload
+_HDR_LEN = struct.Struct("<II")
+# hard bound on the header blob, enforced symmetrically at encode AND
+# decode: the header only carries schema/seq/geometry dicts (the big
+# per-row state — arrays, lease book, NAT bookkeeping, HA sessions —
+# lives in the CRC-covered payload), so a header anywhere near this is a
+# bug, and a corrupt length prefix must not make the decoder json-parse
+# gigabytes
+_MAX_HEADER = 1 << 26
+
+# marker for dict components too large for the header: the JSON blob is
+# stored as a uint8 array named '<component>/__json__' in the payload
+# (CRC32-covered, unlike the header) and the header keeps only this stub
+_JSON_MARKER = "__payload_json__"
+_PAYLOAD_JSON_COMPONENTS = ("nat", "dhcp", "ha")
+
+
+class CheckpointError(RuntimeError):
+    """A checkpoint that must not be restored (corrupt, truncated, or
+    schema/geometry mismatched). Callers catch this to fall back to a
+    cold start."""
+
+
+class Checkpoint(NamedTuple):
+    """Decoded checkpoint: JSON-safe meta + named numpy arrays."""
+
+    meta: dict
+    arrays: dict[str, np.ndarray]
+
+    @property
+    def seq(self) -> int:
+        return int(self.meta.get("seq", 0))
+
+
+# ---------------------------------------------------------------------------
+# binary format
+# ---------------------------------------------------------------------------
+
+def encode_checkpoint(ckpt: Checkpoint) -> bytes:
+    """Checkpoint -> file bytes (magic + JSON header + array payload)."""
+    names = sorted(ckpt.arrays)
+    manifest = []
+    chunks = []
+    offset = 0
+    for name in names:
+        arr = np.ascontiguousarray(ckpt.arrays[name])
+        raw = arr.tobytes()
+        manifest.append({"name": name, "dtype": arr.dtype.str,
+                         "shape": list(arr.shape), "offset": offset,
+                         "nbytes": len(raw)})
+        chunks.append(raw)
+        offset += len(raw)
+    payload = b"".join(chunks)
+    header = json.dumps({
+        "schema_version": SCHEMA_VERSION,
+        "meta": ckpt.meta,
+        "arrays": manifest,
+        "payload_len": len(payload),
+        "payload_crc32": zlib.crc32(payload) & 0xFFFFFFFF,
+    }, separators=(",", ":")).encode()
+    if len(header) > _MAX_HEADER:
+        # symmetric with decode_header's bound: a save that could never
+        # be restored must fail HERE, not at the restore that needed it
+        raise CheckpointError(
+            f"checkpoint header is {len(header)} bytes (> {_MAX_HEADER}): "
+            "oversized meta belongs in the payload")
+    return (MAGIC
+            + _HDR_LEN.pack(len(header), zlib.crc32(header) & 0xFFFFFFFF)
+            + header + payload)
+
+
+def decode_header(data: bytes) -> tuple[dict, int]:
+    """Parse + validate the header only -> (header dict, payload offset).
+    Raises CheckpointError on structural problems; does NOT touch the
+    payload (the cheap path for `checkpoint info` listings)."""
+    if len(data) < len(MAGIC) + _HDR_LEN.size:
+        raise CheckpointError("not a checkpoint: file shorter than header")
+    if data[: len(MAGIC)] != MAGIC:
+        raise CheckpointError(
+            f"not a checkpoint: bad magic {data[:len(MAGIC)]!r}")
+    hlen, want_crc = _HDR_LEN.unpack_from(data, len(MAGIC))
+    if hlen > _MAX_HEADER or len(MAGIC) + _HDR_LEN.size + hlen > len(data):
+        raise CheckpointError("corrupt checkpoint: truncated header")
+    start = len(MAGIC) + _HDR_LEN.size
+    raw = data[start : start + hlen]
+    crc = zlib.crc32(raw) & 0xFFFFFFFF
+    if crc != want_crc:
+        raise CheckpointError(
+            f"corrupt checkpoint: header crc32 {crc:#010x} != "
+            f"{want_crc:#010x}")
+    try:
+        header = json.loads(raw)
+    except ValueError as e:
+        raise CheckpointError(f"corrupt checkpoint header: {e}") from e
+    got = header.get("schema_version")
+    if got != SCHEMA_VERSION:
+        raise CheckpointError(
+            f"checkpoint schema version {got} != supported "
+            f"{SCHEMA_VERSION}: refusing to restore")
+    return header, start + hlen
+
+
+def verify_checkpoint_bytes(data: bytes) -> tuple[dict, int]:
+    """Full structural validation (header + payload length + CRC32)
+    without materializing any array -> (header, payload offset). The
+    shared gate for decode_checkpoint and store listings. Checksumming
+    goes through a memoryview — a multi-hundred-MB payload is never
+    copied just to validate it."""
+    header, payload_off = decode_header(data)
+    payload = memoryview(data)[payload_off:]
+    want_len = int(header.get("payload_len", -1))
+    if len(payload) != want_len:
+        raise CheckpointError(
+            f"corrupt checkpoint: payload is {len(payload)} bytes, "
+            f"header promises {want_len} (truncated write?)")
+    crc = zlib.crc32(payload) & 0xFFFFFFFF
+    if crc != int(header.get("payload_crc32", -1)):
+        raise CheckpointError(
+            f"corrupt checkpoint: payload crc32 {crc:#010x} != header "
+            f"{int(header.get('payload_crc32', -1)):#010x}")
+    return header, payload_off
+
+
+def decode_checkpoint(data: bytes) -> Checkpoint:
+    """File bytes -> Checkpoint, rejecting truncation and corruption.
+    Peak memory = the input buffer + one owned copy per array (the
+    copies detach the result from `data` so the caller can drop it)."""
+    header, payload_off = verify_checkpoint_bytes(data)
+    payload = memoryview(data)[payload_off:]
+    arrays = {}
+    try:
+        for ent in header["arrays"]:
+            off, nbytes = int(ent["offset"]), int(ent["nbytes"])
+            buf = payload[off : off + nbytes]
+            arr = np.frombuffer(buf, dtype=np.dtype(ent["dtype"])).copy()
+            arrays[ent["name"]] = arr.reshape(ent["shape"])
+    except (KeyError, TypeError, ValueError) as e:
+        # a CRC-valid payload with an inconsistent manifest is still a
+        # corrupt checkpoint, not an internal error
+        raise CheckpointError(f"corrupt checkpoint manifest: {e}") from e
+    return Checkpoint(meta=header["meta"], arrays=arrays)
+
+
+# ---------------------------------------------------------------------------
+# snapshot / restore
+# ---------------------------------------------------------------------------
+
+def _ns(prefix: str, arrays: dict) -> dict:
+    return {f"{prefix}/{k}": v for k, v in arrays.items()}
+
+
+def _denamespace(prefix: str, arrays: dict) -> dict:
+    plen = len(prefix) + 1
+    return {k[plen:]: v for k, v in arrays.items()
+            if k.startswith(prefix + "/")}
+
+
+def build_checkpoint(seq: int, now: float, *, engine=None, scheduler=None,
+                     fastpath=None, nat=None, qos=None, antispoof=None,
+                     garden=None, pppoe=None, dhcp=None, ha=None,
+                     node_id: str = "") -> Checkpoint:
+    """Collect a consistent snapshot of the authoritative state.
+
+    With an `engine`, the table managers default from it, and the
+    snapshot runs the full consistency protocol first: quiesce the
+    scheduler (or the engine's pipelined loop) so nothing is in flight,
+    then fold the device-authoritative words into the host mirrors.
+    Without an engine (control-plane-only callers, tests) the host
+    mirrors are taken as-is.
+    """
+    if engine is not None:
+        fastpath = fastpath if fastpath is not None else engine.fastpath
+        nat = nat if nat is not None else engine.nat
+        qos = qos if qos is not None else engine.qos
+        antispoof = antispoof if antispoof is not None else engine.antispoof
+        garden = garden if garden is not None else engine.garden
+        pppoe = pppoe if pppoe is not None else engine.pppoe
+        if scheduler is not None:
+            scheduler.quiesce()
+        else:
+            engine.quiesce()
+        engine.fold_device_authoritative()
+
+    meta: dict = {"seq": int(seq), "created_at": float(now),
+                  "node_id": node_id, "components": {}}
+    arrays: dict[str, np.ndarray] = {}
+
+    if fastpath is not None:
+        m, a = fastpath.checkpoint_state()
+        meta["components"]["fastpath"] = m
+        arrays.update(_ns("fastpath", a))
+    if nat is not None:
+        m, a = nat.checkpoint_state()
+        meta["components"]["nat"] = m
+        arrays.update(_ns("nat", a))
+    if qos is not None:
+        meta["components"]["qos"] = {
+            "geom": {"up": qos.up.checkpoint_geom(),
+                     "down": qos.down.checkpoint_geom()}}
+        arrays.update(_ns("qos", {"up.rows": qos.up.rows,
+                                  "down.rows": qos.down.rows}))
+    if antispoof is not None:
+        meta["components"]["antispoof"] = {
+            "geom": antispoof.bindings.checkpoint_geom()}
+        arrays.update(_ns("antispoof", {
+            **{f"bindings.{k}": v
+               for k, v in antispoof.bindings.checkpoint_arrays().items()},
+            "ranges": antispoof.ranges, "config": antispoof.config}))
+    if garden is not None:
+        meta["components"]["garden"] = {
+            "geom": garden.subscribers.checkpoint_geom()}
+        arrays.update(_ns("garden", {
+            **{f"subscribers.{k}": v
+               for k, v in garden.subscribers.checkpoint_arrays().items()},
+            "allowed": garden.allowed}))
+    if pppoe is not None:
+        m, a = pppoe.checkpoint_state()
+        meta["components"]["pppoe"] = m
+        arrays.update(_ns("pppoe", a))
+    if dhcp is not None:
+        meta["components"]["dhcp"] = dhcp.export_leases()
+    if ha is not None:
+        meta["components"]["ha"] = ha.checkpoint_state()
+    # per-row dict state (NAT allocator bookkeeping, lease book, HA
+    # sessions) scales with the subscriber count: it rides the payload
+    # as a uint8 JSON blob — CRC32-covered, and the header stays small
+    # (its size bound is enforced at encode AND decode)
+    for name in _PAYLOAD_JSON_COMPONENTS:
+        comp = meta["components"].get(name)
+        if comp is None:
+            continue
+        blob = json.dumps(comp, separators=(",", ":")).encode()
+        arrays[f"{name}/{_JSON_MARKER}"] = np.frombuffer(
+            blob, dtype=np.uint8).copy()
+        meta["components"][name] = {_JSON_MARKER: True}
+    return Checkpoint(meta=meta, arrays=arrays)
+
+
+def _resolve_component_meta(ckpt: Checkpoint, comps: dict, name: str):
+    """Return a component's meta dict, inflating the payload-JSON stub
+    when present (CheckpointError on a missing/corrupt blob)."""
+    m = comps.get(name)
+    if not (isinstance(m, dict) and m.get(_JSON_MARKER)):
+        return m
+    blob = ckpt.arrays.get(f"{name}/{_JSON_MARKER}")
+    if blob is None:
+        raise CheckpointError(
+            f"{name}: header stub points at a missing payload meta blob")
+    try:
+        return json.loads(bytes(np.asarray(blob, dtype=np.uint8)))
+    except ValueError as e:
+        raise CheckpointError(f"{name}: corrupt payload meta: {e}") from e
+
+
+def _check_table(table, arrays: dict, geom: dict, label: str) -> None:
+    """Geometry + array shape/dtype pre-check for one cuckoo/QoS mirror,
+    mutating nothing."""
+    if geom != table.checkpoint_geom():
+        raise CheckpointError(
+            f"{label}: checkpoint geometry {geom} != live "
+            f"{table.checkpoint_geom()}")
+    for k, live in table.checkpoint_arrays().items():
+        src = arrays.get(k)
+        if src is None:
+            raise CheckpointError(f"{label}: checkpoint missing array {k!r}")
+        if src.shape != live.shape or src.dtype != live.dtype:
+            raise CheckpointError(
+                f"{label}: checkpoint array {k!r} is {src.dtype}{src.shape},"
+                f" expected {live.dtype}{live.shape}")
+
+
+def _check_dense(arrays: dict, name: str, live: np.ndarray,
+                 label: str) -> None:
+    src = arrays.get(name)
+    if src is None:
+        raise CheckpointError(f"{label}: checkpoint missing array {name!r}")
+    if src.shape != live.shape:
+        raise CheckpointError(
+            f"{label}: checkpoint array {name!r} shape {src.shape} != "
+            f"live {live.shape}")
+
+
+def _verify_components(ckpt: Checkpoint, comps: dict, targets: dict) -> None:
+    """All-or-nothing gate: raise CheckpointError on ANY mismatch before
+    a single host-mirror write happens."""
+    if "fastpath" in comps:
+        fp, a = targets["fastpath"], _denamespace("fastpath", ckpt.arrays)
+        for t in fp._CKPT_TABLES:
+            _check_table(getattr(fp, t),
+                         {k: a.get(f"{t}.{k}")
+                          for k in ("keys", "vals", "used")},
+                         comps["fastpath"]["geom"][t], f"fastpath.{t}")
+        _check_dense(a, "pools", fp.pools, "fastpath")
+        _check_dense(a, "server", fp.server, "fastpath")
+    if "nat" in comps:
+        nm, a = targets["nat"], _denamespace("nat", ckpt.arrays)
+        for t in nm._CKPT_TABLES:
+            _check_table(getattr(nm, t),
+                         {k: a.get(f"{t}.{k}")
+                          for k in ("keys", "vals", "used")},
+                         comps["nat"]["geom"][t], f"nat.{t}")
+        _check_dense(a, "hairpin", nm.hairpin, "nat")
+        _check_dense(a, "alg", nm.alg, "nat")
+    if "qos" in comps:
+        q, a = targets["qos"], _denamespace("qos", ckpt.arrays)
+        _check_table(q.up, {"rows": a.get("up.rows")},
+                     comps["qos"]["geom"]["up"], "qos.up")
+        _check_table(q.down, {"rows": a.get("down.rows")},
+                     comps["qos"]["geom"]["down"], "qos.down")
+    if "antispoof" in comps:
+        sp, a = targets["antispoof"], _denamespace("antispoof", ckpt.arrays)
+        _check_table(sp.bindings,
+                     {k: a.get(f"bindings.{k}")
+                      for k in ("keys", "vals", "used")},
+                     comps["antispoof"]["geom"], "antispoof.bindings")
+        _check_dense(a, "ranges", sp.ranges, "antispoof")
+        _check_dense(a, "config", sp.config, "antispoof")
+    if "garden" in comps:
+        gd, a = targets["garden"], _denamespace("garden", ckpt.arrays)
+        _check_table(gd.subscribers,
+                     {k: a.get(f"subscribers.{k}")
+                      for k in ("keys", "vals", "used")},
+                     comps["garden"]["geom"], "garden.subscribers")
+        _check_dense(a, "allowed", gd.allowed, "garden")
+    if "pppoe" in comps:
+        pe, a = targets["pppoe"], _denamespace("pppoe", ckpt.arrays)
+        for t in ("by_sid", "by_ip"):
+            _check_table(getattr(pe, t),
+                         {k: a.get(f"{t}.{k}")
+                          for k in ("keys", "vals", "used")},
+                         comps["pppoe"]["geom"][t], f"pppoe.{t}")
+        _check_dense(a, "server_mac", pe.server_mac, "pppoe")
+    # dry-parse the dict-driven components: their meta is consumed
+    # during mutation, so a parse fault there must be caught HERE or the
+    # reject would leave the process half-hydrated
+    if "nat" in comps:
+        try:
+            targets["nat"].parse_checkpoint_meta(comps["nat"])
+        except (KeyError, ValueError, TypeError) as e:
+            raise CheckpointError(
+                f"nat: corrupt checkpoint meta: {e!r}") from e
+    if "dhcp" in comps:
+        try:
+            targets["dhcp"].parse_lease_state(comps["dhcp"])
+        except (KeyError, ValueError, TypeError) as e:
+            raise CheckpointError(
+                f"dhcp: corrupt checkpoint lease book: {e!r}") from e
+    if "ha" in comps:
+        try:
+            targets["ha"].parse_checkpoint_state(comps["ha"])
+        except (KeyError, ValueError, TypeError, AttributeError) as e:
+            raise CheckpointError(
+                f"ha: corrupt checkpoint session store: {e!r}") from e
+
+
+def restore_checkpoint(ckpt: Checkpoint, *, engine=None, fastpath=None,
+                       nat=None, qos=None, antispoof=None, garden=None,
+                       pppoe=None, dhcp=None, ha=None) -> dict[str, int]:
+    """Hydrate the host mirrors from a decoded checkpoint and re-upload.
+
+    Reject-on-mismatch: every table component present in the checkpoint
+    must have a matching live target with identical geometry, or the
+    whole restore raises `CheckpointError` and NOTHING is uploaded to
+    the device (engine.resync_tables runs only after every component
+    hydrated). A live subsystem absent from the checkpoint (enabled
+    after the snapshot was taken) simply starts empty. Returns restored
+    row counts per component (the bng_ckpt_restore_rows feed).
+    """
+    if engine is not None:
+        fastpath = fastpath if fastpath is not None else engine.fastpath
+        nat = nat if nat is not None else engine.nat
+        qos = qos if qos is not None else engine.qos
+        antispoof = antispoof if antispoof is not None else engine.antispoof
+        garden = garden if garden is not None else engine.garden
+        pppoe = pppoe if pppoe is not None else engine.pppoe
+    comps = dict(ckpt.meta.get("components", {}))
+    for name in _PAYLOAD_JSON_COMPONENTS:
+        if name in comps:
+            comps[name] = _resolve_component_meta(ckpt, comps, name)
+    targets = {"fastpath": fastpath, "nat": nat, "qos": qos,
+               "antispoof": antispoof, "garden": garden, "pppoe": pppoe,
+               "dhcp": dhcp, "ha": ha}
+    missing = [name for name in comps if targets.get(name) is None]
+    if missing:
+        raise CheckpointError(
+            f"checkpoint carries {sorted(missing)} but the live process "
+            f"has no such component(s): refusing a partial restore")
+    # verify EVERY component before mutating ANY host mirror: a reject
+    # halfway through would leave the process half-hydrated — worse than
+    # the cold start the caller falls back to
+    _verify_components(ckpt, comps, targets)
+
+    rows: dict[str, int] = {}
+    try:
+        if "fastpath" in comps:
+            got = fastpath.restore_state(comps["fastpath"],
+                                         _denamespace("fastpath", ckpt.arrays))
+            rows.update({f"fastpath.{k}": v for k, v in got.items()})
+        if "nat" in comps:
+            got = nat.restore_state(comps["nat"],
+                                    _denamespace("nat", ckpt.arrays))
+            rows.update({f"nat.{k}": v for k, v in got.items()})
+        if "qos" in comps:
+            a = _denamespace("qos", ckpt.arrays)
+            g = comps["qos"]["geom"]
+            rows["qos.up"] = qos.up.restore_arrays({"rows": a["up.rows"]},
+                                                   g["up"])
+            rows["qos.down"] = qos.down.restore_arrays(
+                {"rows": a["down.rows"]}, g["down"])
+        if "antispoof" in comps:
+            a = _denamespace("antispoof", ckpt.arrays)
+            rows["antispoof.bindings"] = antispoof.bindings.restore_arrays(
+                {k: a[f"bindings.{k}"] for k in ("keys", "vals", "used")},
+                comps["antispoof"]["geom"])
+            antispoof.ranges[:] = a["ranges"]
+            antispoof.config[:] = a["config"]
+        if "garden" in comps:
+            a = _denamespace("garden", ckpt.arrays)
+            rows["garden.subscribers"] = garden.subscribers.restore_arrays(
+                {k: a[f"subscribers.{k}"] for k in ("keys", "vals", "used")},
+                comps["garden"]["geom"])
+            garden.allowed[:] = a["allowed"]
+        if "pppoe" in comps:
+            got = pppoe.restore_state(comps["pppoe"],
+                                      _denamespace("pppoe", ckpt.arrays))
+            rows.update({f"pppoe.{k}": v for k, v in got.items()})
+        if "dhcp" in comps:
+            rows["dhcp.leases"] = dhcp.restore_leases(comps["dhcp"])
+        if "ha" in comps:
+            # role decides the direction: a restarted active resumes its
+            # seq; a standby bootstraps then catches up via replay_since
+            if hasattr(ha, "bootstrap_state"):
+                rows["ha.sessions"] = ha.bootstrap_state(comps["ha"])
+            else:
+                rows["ha.sessions"] = ha.restore_state(comps["ha"])
+    except (ValueError, KeyError, TypeError, AttributeError) as e:
+        raise CheckpointError(f"checkpoint restore rejected: {e}") from e
+
+    if engine is not None:
+        # one full device upload — the same bulk path a cold start takes
+        engine.resync_tables()
+    return rows

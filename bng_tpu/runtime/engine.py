@@ -930,6 +930,58 @@ class Engine:
         """Device-authoritative session counters for accounting/expiry."""
         return np.asarray(self.tables.nat.sessions.vals)
 
+    # -- checkpoint/warm-restart support (runtime/checkpoint.py) ---------
+
+    def quiesce(self) -> int:
+        """Drain barrier for the engine-driven loops (no scheduler):
+        retire any in-flight pipelined batch, then block until the
+        threaded device table state has materialized — after this no
+        scatter is in flight, so a checkpoint can fetch HBM arrays
+        without interleaving with an update. Returns frames retired."""
+        n = self.flush_pipeline()
+        jax.block_until_ready(jax.tree_util.tree_leaves(self.tables))
+        return n
+
+    @staticmethod
+    def _uploaded_mask(table, live: np.ndarray) -> np.ndarray:
+        """Slots whose host row has actually SHIPPED to the device: live
+        minus the pending dirty set (a host insert the bounded drain has
+        not scattered yet reads back as zeros/stale from HBM — folding
+        it would destroy the newer host row). A _dirty_all table has
+        shipped nothing since its bulk build."""
+        if table._dirty_all:
+            return np.zeros_like(live)
+        if not table._dirty:
+            return live
+        mask = live.copy()
+        mask[np.fromiter(table._dirty, dtype=np.int64,
+                         count=len(table._dirty))] = False
+        return mask
+
+    def fold_device_authoritative(self) -> None:
+        """Pull the device-WRITTEN words back into the host mirrors — the
+        pre-checkpoint fetch. Two tables carry device-authoritative
+        state: NAT session rows (counters + last_seen, written by the
+        NAT44 kernel) and the QoS token buckets (tokens + last_us words
+        of the packed way rows). Everything else is host-authoritative
+        already. Only slots whose host row has shipped are folded (see
+        _uploaded_mask); not-yet-drained host writes stay authoritative.
+        Call behind quiesce(): a fetch that overlaps an in-flight
+        scatter could tear a row."""
+        from bng_tpu.ops.qtable import QW_FLAGS, QW_LAST_US, QW_TOKENS
+
+        dev = self.fetch_session_vals()
+        mask = self._uploaded_mask(self.nat.sessions,
+                                   self.nat.sessions.used.astype(bool))
+        self.nat.sessions.vals[mask] = dev[mask]
+        for host, dev_rows in ((self.qos.up, self.tables.qos_up.rows),
+                               (self.qos.down, self.tables.qos_down.rows)):
+            rows = np.asarray(dev_rows)
+            live = self._uploaded_mask(host,
+                                       (host.rows[:, QW_FLAGS] & 1) != 0)
+            host.rows[live, QW_TOKENS] = rows[live, QW_TOKENS]
+            host.rows[live, QW_LAST_US] = rows[live, QW_LAST_US]
+
     def expire(self, now: int | None = None) -> int:
         now = int(now if now is not None else self.clock())
         return self.nat.expire_sessions(now, device_vals=self.fetch_session_vals())

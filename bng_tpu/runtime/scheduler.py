@@ -200,6 +200,19 @@ class TieredScheduler:
 
     close = flush  # CLI cleanup symmetry
 
+    def quiesce(self, now: float | None = None) -> int:
+        """Checkpoint drain barrier: ship every queued frame, retire every
+        in-flight dispatch on BOTH completion rings (flush), then block
+        until the threaded device table state (express dhcp chain AND the
+        bulk-threaded tables) has materialized. After quiesce() returns,
+        no table scatter is in flight and no pending FastPathUpdates wait
+        in a dispatched-but-unretired step — a snapshot taken now can
+        fetch the HBM arrays without interleaving with an update. The
+        lanes stay usable; traffic resumes on the next submit/poll."""
+        retired = self.flush(now)
+        jax.block_until_ready(jax.tree_util.tree_leaves(self.engine.tables))
+        return retired
+
     # -- express lane ----------------------------------------------------
 
     def _pump_express(self, now: float) -> int:

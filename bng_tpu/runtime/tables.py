@@ -248,6 +248,40 @@ class FastPathTables:
     def dirty_count(self) -> int:
         return self.sub.dirty_count() + self.vlan.dirty_count() + self.cid.dirty_count()
 
+    # -- checkpoint/warm-restart (runtime/checkpoint.py) ----------------
+    _CKPT_TABLES = ("sub", "vlan", "cid")
+
+    def checkpoint_state(self) -> tuple[dict, dict]:
+        """(meta, arrays) for the whole DHCP fast-path authority: the
+        three cuckoo mirrors slot-exact plus the dense pool/server
+        config. Array keys are '<table>.<array>' namespaced."""
+        meta = {"geom": {t: getattr(self, t).checkpoint_geom()
+                         for t in self._CKPT_TABLES},
+                "max_pools": len(self.pools)}
+        arrays = {f"{t}.{k}": v
+                  for t in self._CKPT_TABLES
+                  for k, v in getattr(self, t).checkpoint_arrays().items()}
+        arrays["pools"] = self.pools
+        arrays["server"] = self.server
+        return meta, arrays
+
+    def restore_state(self, meta: dict, arrays: dict) -> dict[str, int]:
+        """Hydrate from a checkpoint; ValueError on geometry mismatch.
+        Caller must follow with a full device upload (resync_tables)."""
+        rows = {}
+        for t in self._CKPT_TABLES:
+            rows[t] = getattr(self, t).restore_arrays(
+                {k: arrays[f"{t}.{k}"] for k in ("keys", "vals", "used")},
+                meta["geom"][t])
+        if arrays["pools"].shape != self.pools.shape:
+            raise ValueError(
+                f"checkpoint pools shape {arrays['pools'].shape} != "
+                f"{self.pools.shape}")
+        self.pools[:] = arrays["pools"]
+        self.server[:] = arrays["server"]
+        rows["pools"] = int(np.count_nonzero(self.pools[:, PV_VALID]))
+        return rows
+
 
 class PPPoEFastPathTables:
     """Host side of the device PPPoE session tables (ops.pppoe).
@@ -301,3 +335,22 @@ class PPPoEFastPathTables:
         """No-op update pair for scheduler no-drain bulk steps (cached)."""
         return (self.by_sid.empty_update(self.update_slots),
                 self.by_ip.empty_update(self.update_slots))
+
+    # -- checkpoint/warm-restart (runtime/checkpoint.py) ----------------
+    def checkpoint_state(self) -> tuple[dict, dict]:
+        meta = {"geom": {"by_sid": self.by_sid.checkpoint_geom(),
+                         "by_ip": self.by_ip.checkpoint_geom()}}
+        arrays = {f"{t}.{k}": v
+                  for t in ("by_sid", "by_ip")
+                  for k, v in getattr(self, t).checkpoint_arrays().items()}
+        arrays["server_mac"] = self.server_mac
+        return meta, arrays
+
+    def restore_state(self, meta: dict, arrays: dict) -> dict[str, int]:
+        rows = {}
+        for t in ("by_sid", "by_ip"):
+            rows[t] = getattr(self, t).restore_arrays(
+                {k: arrays[f"{t}.{k}"] for k in ("keys", "vals", "used")},
+                meta["geom"][t])
+        self.server_mac[:] = arrays["server_mac"]
+        return rows

@@ -97,6 +97,48 @@ class TestHASync:
         assert standby.stats["full_syncs"] == 2
         assert len(standby.store) == 11
 
+    def test_replay_exact_wrap_boundary(self):
+        """The off-by-one that silently loses sessions: a standby whose
+        seq+1 is the OLDEST buffered change replays completely; a standby
+        whose seq+1 just fell off must get None (full resync), never a
+        truncated list that skips the evicted change."""
+        active = ActiveSyncer(InMemorySessionStore(), replay_buffer=4)
+        for i in range(1, 11):  # seqs 1..10; buffer holds 7,8,9,10
+            active.push_change(sess(i))
+        # seq=6: successor (7) is the oldest buffered change -> complete
+        replay = active.replay_since(6)
+        assert replay is not None
+        assert [c.seq for c in replay] == [7, 8, 9, 10]
+        # seq=5: successor (6) was evicted -> None, NOT [7..10]
+        assert active.replay_since(5) is None
+        # fully caught up -> empty delta, not a resync signal
+        assert active.replay_since(10) == []
+
+    def test_incremental_replay_resumes_after_wrap_resync(self):
+        """After a wrap forces a full resync, the standby's next
+        reconnect gap (within the buffer) must ride replay again."""
+        active, standby, up = self._pair()
+        active._replay_cap = 4
+        active.push_change(sess(1))
+        standby.tick(0.0)
+        standby.disconnect()
+        for i in range(10, 20):  # wrap the buffer while away
+            active.push_change(sess(i))
+        standby.tick(10.0)
+        assert standby.stats["full_syncs"] == 2  # wrap -> resync
+        # disconnect again; miss a SMALL number of changes (< cap)
+        standby.disconnect()
+        active.push_change(sess(30))
+        active.push_change(sess(31))
+        deltas_before = standby.stats["deltas"]
+        standby.tick(20.0)
+        assert standby.connected
+        assert standby.stats["full_syncs"] == 2  # no third resync
+        assert standby.stats["deltas"] == deltas_before + 2
+        assert standby.store.get("s30") is not None
+        assert standby.store.get("s31") is not None
+        assert standby.last_seq == active._seq
+
 
 class TestHealthFailover:
     def test_threshold_and_recovery(self):
