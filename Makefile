@@ -6,6 +6,10 @@
 #                       round-trip, full DORA e2e, and every other test
 #                       excluded from tier-1 to keep it under its timeout.
 #   make verify-all   — both tiers.
+#   make verify-load  — slow-path fleet loadtest smoke: 2 worker
+#                       processes, a few thousand exchanges, CPU-only,
+#                       < 60 s — fleet regressions fail fast outside the
+#                       slow tier.
 
 SHELL := /bin/bash
 PY ?= python
@@ -13,7 +17,7 @@ TIER1_TIMEOUT ?= 870
 PYTEST_FLAGS = -q --continue-on-collection-errors -p no:cacheprovider \
                -p no:xdist -p no:randomly
 
-.PHONY: verify verify-slow verify-all
+.PHONY: verify verify-slow verify-all verify-load
 
 verify:
 	set -o pipefail; rm -f /tmp/_t1.log; \
@@ -24,3 +28,14 @@ verify-slow:
 	env JAX_PLATFORMS=cpu $(PY) -m pytest tests/ $(PYTEST_FLAGS) -m slow
 
 verify-all: verify verify-slow
+
+verify-load:
+	set -o pipefail; \
+	timeout -k 10 60 env JAX_PLATFORMS=cpu $(PY) -m bng_tpu.cli loadtest \
+	  --workers 2 --duration 2 --warmup 1 --macs 2000 --batch-size 256 \
+	  --json \
+	| $(PY) -c "import json,sys; r=json.load(sys.stdin); \
+	assert r['responses'] >= 2000 and r['errors'] == 0, r; \
+	assert r['fleet']['workers'] == 2, r['fleet']; \
+	print('verify-load OK: %d req/s, %d responses, fleet admitted %d' \
+	% (r['rps'], r['responses'], r['fleet']['admission']['admitted']))"

@@ -504,24 +504,35 @@ def _emit(metric, value, unit, baseline, **extra):
 
 
 def config1_dhcp_slowpath():
-    """BASELINE config 1: DHCP standalone slow path, 1k MACs, CPU-only.
+    """BASELINE config 1: DHCP slow path through the worker FLEET.
 
-    Reference target: 50k req/s combined; slow-path share is the control
-    plane's ceiling (README Performance table: <10ms P99 slow path).
+    Reference target: 50k req/s combined — the reference gets there with
+    concurrent Go; the slow-path fleet (control/fleet.py) is the
+    architecture this gate assumes, so the headline number drives the
+    fleet (BNG_BENCH_WORKERS processes, default 4; 1 = legacy
+    single-thread path). The single-worker run is always measured too
+    and published alongside (single_rps / fleet_speedup).
+
+    Env knobs: BNG_BENCH_WORKERS, BNG_BENCH_FLEET_BATCH, BNG_BENCH_SECS.
     """
     from bng_tpu.control import dhcp_codec, packets
     from bng_tpu.control.dhcp_server import DHCPServer
+    from bng_tpu.control.fleet import FleetSpec, SlowPathFleet
     from bng_tpu.control.pool import Pool, PoolManager
     from bng_tpu.utils.net import ip_to_u32
 
     smac = bytes.fromhex("02aabbccdd01")
     sip = ip_to_u32("10.0.1.1")
-    pools = PoolManager(None)
-    pools.add_pool(Pool(pool_id=1, network=ip_to_u32("10.0.1.0"), prefix_len=24,
-                        gateway=sip, dns_primary=ip_to_u32("1.1.1.1"),
-                        lease_time=3600))
-    server = DHCPServer(smac, sip, pools)
-    macs = [(0x02B1 << 32 | i).to_bytes(6, "big") for i in range(200)]
+
+    def mkpools(prefix_len=16):
+        pools = PoolManager(None)
+        pools.add_pool(Pool(pool_id=1, network=ip_to_u32("10.0.0.0"),
+                            prefix_len=prefix_len, gateway=sip,
+                            dns_primary=ip_to_u32("1.1.1.1"),
+                            lease_time=3600))
+        return pools
+
+    macs = [(0x02B1 << 32 | i).to_bytes(6, "big") for i in range(1000)]
 
     def discover(mac, xid):
         p = dhcp_codec.build_request(mac, dhcp_codec.DISCOVER, xid=xid)
@@ -532,11 +543,14 @@ def config1_dhcp_slowpath():
     # (the reference's load harness generates client traffic outside the
     # server process entirely)
     frames = [discover(m, 1000 + i) for i, m in enumerate(macs)]
+    secs = float(os.environ.get("BNG_BENCH_SECS", 5))
 
+    # -- single-thread baseline (the pre-fleet architecture) --
+    server = DHCPServer(smac, sip, mkpools())
     n = 0
     lat = []
     t0 = time.perf_counter()
-    deadline = t0 + float(os.environ.get("BNG_BENCH_SECS", 5))
+    deadline = t0 + secs
     while time.perf_counter() < deadline:
         f = frames[n % len(frames)]
         t1 = time.perf_counter()
@@ -545,14 +559,73 @@ def config1_dhcp_slowpath():
         assert reply is not None
         n += 1
     dt = time.perf_counter() - t0
+    single_rps = n / dt
     lat_us = np.asarray(lat) * 1e6
-    # busy_rps = server capacity from time actually spent in handle_frame
-    # (wall-clock rps on a shared host is scheduler-noise-bound; both are
-    # published so the artifact shows which is which)
-    _emit("DHCP slow-path req/s (config 1)", n / dt, "req/s", 50_000.0,
-          p50_us=round(float(np.percentile(lat_us, 50)), 1),
-          p99_us=round(float(np.percentile(lat_us, 99)), 1), requests=n,
-          server_busy_rps=round(n / float(np.sum(lat)), 1))
+    extra = {
+        "p50_us": round(float(np.percentile(lat_us, 50)), 1),
+        "p99_us": round(float(np.percentile(lat_us, 99)), 1),
+        "requests": n,
+        # busy_rps = server capacity from time actually spent in
+        # handle_frame (wall-clock rps on a shared host is
+        # scheduler-noise-bound; both are published)
+        "server_busy_rps": round(n / float(np.sum(lat)), 1),
+        "single_rps": round(single_rps, 1),
+    }
+
+    # default: drive the fleet only where it can win (>= 4 real cores).
+    # Below that the parent's serial section leaves no headroom, and on
+    # syscall-virtualized kernels (gVisor-style sandboxes) the pipe
+    # ping-pong collapses outright (PERF_NOTES §6) — the published
+    # headline must not regress just because the host is small.
+    # BNG_BENCH_WORKERS overrides either way.
+    ncpu = os.cpu_count() or 1
+    workers = int(os.environ.get("BNG_BENCH_WORKERS",
+                                 "4" if ncpu >= 4 else "1"))
+    if workers <= 1:
+        _emit("DHCP slow-path req/s (config 1)", single_rps, "req/s",
+              50_000.0, workers=1, **extra)
+        return
+
+    # -- the fleet (big per-worker messages: the pipe write overlaps the
+    # children's compute — PERF_NOTES §6) --
+    B = int(os.environ.get("BNG_BENCH_FLEET_BATCH", 2048))
+    pools = mkpools()
+    from bng_tpu.control.admission import AdmissionConfig
+
+    fleet = SlowPathFleet(
+        FleetSpec.from_pool_manager(smac, sip, pools, slice_size=4096,
+                                    low_watermark=512),
+        n_workers=workers, pools=pools, mode="process",
+        # inbox >= the bench batch: shedding is a correctness feature,
+        # not something a throughput bench should silently trip
+        admission=AdmissionConfig(inbox_capacity=max(512, B)))
+    _mark(f"fleet up: {workers} workers")
+    try:
+        n = 0
+        i = 0
+        blat = []
+        t0 = time.perf_counter()
+        deadline = t0 + secs
+        while time.perf_counter() < deadline:
+            batch = [(k, frames[(i + k) % len(frames)]) for k in range(B)]
+            t1 = time.perf_counter()
+            out = fleet.handle_batch(batch)
+            blat.append(time.perf_counter() - t1)
+            n += sum(1 for _lane, r in out if r is not None)
+            i += B
+        dt = time.perf_counter() - t0
+        snap = fleet.stats_snapshot()
+    finally:
+        fleet.close()
+    fleet_rps = n / dt
+    per_req_us = np.asarray(blat) * 1e6 / B
+    _emit("DHCP slow-path req/s (config 1)", fleet_rps, "req/s", 50_000.0,
+          workers=workers, fleet_batch=B,
+          fleet_speedup=round(fleet_rps / single_rps, 2),
+          fleet_p50_us=round(float(np.percentile(per_req_us, 50)), 1),
+          fleet_p99_us=round(float(np.percentile(per_req_us, 99)), 1),
+          fleet_shed=sum(snap["admission"]["shed"].values()),
+          fleet_refills=snap["refills"], **extra)
 
 
 def _build_nat_flows(n_flows, n_subs, now, sub_nat_nbuckets=None):

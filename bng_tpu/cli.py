@@ -45,6 +45,15 @@ class BNGConfig:
     sched_express_max_wait_us: float = 200.0
     sched_bulk_depth: int = 2
     sched_drain_every: int = 1
+    # slow-path fleet (control/fleet.py + control/admission.py): N
+    # shared-nothing workers sharded by the ring's MAC hash, with
+    # admission control in front. workers=1 keeps the single in-process
+    # slow path (every integration supported); >1 fans DHCPv4 out.
+    slowpath_workers: int = 1
+    slowpath_worker_mode: str = "process"  # process | inline
+    slowpath_inbox: int = 512  # per-worker admission inbox bound
+    slowpath_deadline_ms: float = 50.0  # stale-DISCOVER shed deadline
+    slowpath_slice: int = 1024  # per-worker lease-slice target size
     # pools (single primary pool via flags; more via YAML `pools:`)
     pool_cidr: str = "10.0.0.0/16"
     pool_gateway: str = ""
@@ -798,6 +807,50 @@ class BNGApp:
                 pppoe=c.get("pppoe"), clock=self.clock)
             c["engine"].slow_path = demux
 
+        # 10b2. slow-path fleet: shard DHCPv4 across N shared-nothing
+        # workers (control/fleet.py). Workers own per-worker lease
+        # slices carved from the parent pools and relay table writes
+        # back through the single-writer drain; non-DHCPv4 slow frames
+        # (v6/SLAAC/PPPoE) stay on the parent demux via the fallback.
+        # Integrations that live on the parent's per-lease state (RADIUS
+        # auth, HA replication, Nexus allocation, CoA lease lookups)
+        # are not yet fleet-aware: with any of them configured the
+        # fleet is skipped so no integration silently degrades.
+        if cfg.slowpath_workers > 1:
+            blockers = [name for flag, name in (
+                (cfg.radius_server, "radius"), (cfg.nexus_url, "nexus"),
+                (cfg.ha_role, "ha"), (cfg.pppoe_enabled, "pppoe"),
+                (cfg.peer_pool_cidr, "peer-pool")) if flag]
+            if blockers:
+                self.log.warning(
+                    "slowpath fleet disabled: per-lease integrations "
+                    "not yet fleet-aware", blockers=blockers,
+                    workers=cfg.slowpath_workers)
+            else:
+                from bng_tpu.control.admission import AdmissionConfig
+                from bng_tpu.control.fleet import FleetSpec, SlowPathFleet
+
+                fallback = c.get("slowpath") or dhcp.handle_frame
+                fleet = c["fleet"] = SlowPathFleet(
+                    FleetSpec.from_pool_manager(
+                        parse_mac(cfg.server_mac), ip_to_u32(cfg.server_ip),
+                        pool_mgr, slice_size=cfg.slowpath_slice,
+                        low_watermark=max(1, cfg.slowpath_slice // 4)),
+                    n_workers=cfg.slowpath_workers, pools=pool_mgr,
+                    mode=cfg.slowpath_worker_mode,
+                    admission=AdmissionConfig(
+                        inbox_capacity=cfg.slowpath_inbox,
+                        deadline_ms=cfg.slowpath_deadline_ms),
+                    table_sink=fastpath, qos_hook=qos_hook,
+                    nat_hook=nat_hook, fallback=fallback,
+                    clock=self.clock)
+                c["engine"].slow_path_batch = fleet.handle_batch
+                self._on_close(fleet.close)
+                self.log.info("slowpath fleet up",
+                              workers=cfg.slowpath_workers,
+                              mode=cfg.slowpath_worker_mode,
+                              inbox=cfg.slowpath_inbox)
+
         # 10d. CoA/Disconnect listener (RFC 5176; coa.go:119-240 +
         # coa_handler.go:175-460): dynamic authorization reaches BOTH
         # session kinds — DHCP leases (policy -> device QoS; disconnect
@@ -1146,6 +1199,10 @@ class BNGApp:
                 sched.metrics = metrics
                 collector.add_source(
                     lambda: metrics.collect_scheduler(sched))
+            if "fleet" in c:
+                fleet_c = c["fleet"]
+                collector.add_source(
+                    lambda: metrics.collect_fleet(fleet_c))
             if cfg.dns_enabled:
                 collector.add_source(lambda: metrics.collect_dns(
                     dns_srv.stats, resolver.stats()))
@@ -1173,7 +1230,8 @@ class BNGApp:
                 try:
                     snap, path = store.load_latest()
                     rows = ckpt_mod.restore_checkpoint(
-                        snap, engine=engine, dhcp=dhcp, ha=ha_sync)
+                        snap, engine=engine, dhcp=dhcp, ha=ha_sync,
+                        fleet=c.get("fleet"))
                     c["checkpoint_restored"] = rows
                     self.log.info("warm restart from checkpoint",
                                   path=str(path), seq=snap.seq,
@@ -1191,7 +1249,8 @@ class BNGApp:
             def _snapshot(seq, now, _eng=engine, _dhcp=dhcp, _ha=ha_sync):
                 return ckpt_mod.build_checkpoint(
                     seq, now, engine=_eng, scheduler=c.get("scheduler"),
-                    dhcp=_dhcp, ha=_ha, node_id=cfg.node_id)
+                    dhcp=_dhcp, ha=_ha, fleet=c.get("fleet"),
+                    node_id=cfg.node_id)
 
             ckptr = c["checkpointer"] = PeriodicCheckpointer(
                 store, _snapshot, interval_s=cfg.checkpoint_interval_s,
@@ -1404,6 +1463,11 @@ class BNGApp:
             self._last_expire = now
             c["dhcp"].cleanup_expired(int(now))
             c["engine"].expire(int(now))
+            fleet = c.get("fleet")
+            if fleet is not None:
+                # fleet workers own their lease books; the sweep fans
+                # out and the release table-events replay here
+                fleet.expire(int(now))
         garden = c.get("walledgarden")
         if garden is not None and now - self._last_garden >= self.GARDEN_EVERY_S:
             self._last_garden = now
@@ -1480,6 +1544,9 @@ class BNGApp:
         if nat is not None:  # registered only when nat_enabled
             out["nat"] = {"sessions": nat.sessions.count,
                           "blocks": len(nat.blocks)}
+        fleet = self.components.get("fleet")
+        if fleet is not None:
+            out["slowpath_fleet"] = fleet.stats_snapshot()
         res = self.components.get("resilience")
         if res is not None:
             out["resilience"] = {"state": res.state.value,
@@ -1575,7 +1642,7 @@ def run_loadtest(args) -> int:
     from bng_tpu.control.dhcp_server import DHCPServer
     from bng_tpu.control.nat import NATManager
     from bng_tpu.control.pool import Pool, PoolManager
-    from bng_tpu.loadtest import BenchmarkConfig, DHCPBenchmark, result_json
+    from bng_tpu.loadtest import BenchmarkConfig, DHCPBenchmark
     from bng_tpu.runtime.engine import Engine
     from bng_tpu.runtime.tables import FastPathTables
     from bng_tpu.utils.net import ip_to_u32, parse_mac
@@ -1600,6 +1667,26 @@ def run_loadtest(args) -> int:
     server = DHCPServer(server_mac, server_ip, pools, fastpath_tables=fastpath)
     engine = Engine(fastpath, nat, batch_size=args.batch_size,
                     slow_path=server.handle_frame)
+    fleet = None
+    workers = getattr(args, "workers", 1) or 1
+    if workers > 1:
+        # slow-path fleet: DHCPv4 slow lanes fan out to N worker
+        # processes; the parent DHCPServer above is bypassed (workers
+        # own the lease books) but stays as the engine's per-frame
+        # fallback for anything the fleet doesn't shard
+        from bng_tpu.control.admission import AdmissionConfig
+        from bng_tpu.control.fleet import FleetSpec, SlowPathFleet
+
+        fleet = SlowPathFleet(
+            FleetSpec.from_pool_manager(server_mac, server_ip, pools),
+            n_workers=workers, pools=pools,
+            mode=getattr(args, "fleet_mode", "process"),
+            # inbox sized past the harness batch: the loadtest measures
+            # throughput, the dedicated overload tests measure shedding
+            admission=AdmissionConfig(
+                inbox_capacity=max(512, 2 * args.batch_size)),
+            table_sink=fastpath)
+        engine.slow_path_batch = fleet.handle_batch
     target = engine
     if getattr(args, "scheduler", False):
         from bng_tpu.runtime.scheduler import SchedulerConfig, TieredScheduler
@@ -1613,12 +1700,25 @@ def run_loadtest(args) -> int:
         enable_renewals=args.renewals, renewal_ratio=args.renewal_ratio,
         rps_limit=args.rps)
     bench = DHCPBenchmark(target, cfg, log=lambda s: print(s, file=sys.stderr))
-    res = bench.run()
+    try:
+        res = bench.run()
+    finally:
+        if fleet is not None:
+            fleet_snap = fleet.stats_snapshot()
+            fleet.close()
 
     if args.json_out:
-        print(result_json(res))
+        out = res.to_dict()
+        if fleet is not None:
+            out["fleet"] = fleet_snap
+        print(json.dumps(out, indent=2))
     else:
         print(res.summary())
+        if fleet is not None:
+            adm = fleet_snap["admission"]
+            print(f"Fleet:             {fleet_snap['workers']} workers, "
+                  f"{adm['admitted']} admitted, "
+                  f"{sum(adm['shed'].values())} shed")
     if args.validate:
         failures = res.meets_targets(cfg)
         for f in failures:
@@ -1749,6 +1849,13 @@ def main(argv: list[str] | None = None) -> int:
     loadp.add_argument("--scheduler", action="store_true",
                        help="drive the latency-tiered scheduler instead of "
                             "the engine's batch interface")
+    loadp.add_argument("--workers", type=int, default=1,
+                       help="slow-path fleet worker count (>1 fans DHCPv4 "
+                            "slow lanes out to worker processes)")
+    loadp.add_argument("--fleet-mode", default="process",
+                       choices=("process", "inline"),
+                       help="fleet execution mode (inline = deterministic, "
+                            "no child processes)")
 
     # warm-restart snapshots (runtime/checkpoint.py + statestore.py)
     ckptp = sub.add_parser("checkpoint",

@@ -63,11 +63,30 @@ class DHCPPacket:
     # identity fast path keeps the cached-suffix case O(1).
     options_raw: bytes | None = None
     _options_raw_snap: tuple | None = None
+    # whole-payload fast path: a ReplyTemplate render of this packet
+    # (fixed header + options already assembled). Same snapshot guard as
+    # options_raw: any later mutation of `options` falls back to the
+    # full field-by-field encode.
+    encoded: bytes | None = None
+    _encoded_snap: tuple | None = None
 
     def set_options_raw(self, raw: bytes) -> None:
         """Install pre-encoded option bytes for the CURRENT `options` list."""
         self.options_raw = raw
         self._options_raw_snap = tuple(self.options)
+
+    def set_encoded(self, raw: bytes) -> None:
+        """Install the complete pre-rendered payload (ReplyTemplate
+        output) for the CURRENT `options` list. Header fields must
+        already match the render — the slow-path server renders and
+        installs in one place (_build_reply)."""
+        self.encoded = raw
+        self._encoded_snap = tuple(self.options)
+
+    @staticmethod
+    def _snap_matches(snap: tuple | None, options: list) -> bool:
+        return (snap is not None and len(snap) == len(options)
+                and all(a is b or a == b for a, b in zip(snap, options)))
 
     # -- option helpers --
     def opt(self, code: int) -> bytes | None:
@@ -112,6 +131,9 @@ class DHCPPacket:
         return circuit, remote
 
     def encode(self) -> bytes:
+        if (self.encoded is not None
+                and self._snap_matches(self._encoded_snap, self.options)):
+            return self.encoded
         fixed = struct.pack(
             "!BBBBIHHIIII",
             self.op, self.htype, self.hlen, self.hops,
@@ -121,11 +143,9 @@ class DHCPPacket:
         chaddr = (self.chaddr + b"\x00" * 16)[:16]
         sname = (self.sname + b"\x00" * 64)[:64]
         bfile = (self.file + b"\x00" * 128)[:128]
-        snap = self._options_raw_snap
-        use_raw = (self.options_raw is not None and snap is not None
-                   and len(snap) == len(self.options)
-                   and all(a is b or a == b
-                           for a, b in zip(snap, self.options)))
+        use_raw = (self.options_raw is not None
+                   and self._snap_matches(self._options_raw_snap,
+                                          self.options))
         opts = self.options_raw if use_raw else encode_options(self.options)
         return fixed + chaddr + sname + bfile + struct.pack("!I", DHCP_MAGIC) + opts
 
@@ -142,6 +162,58 @@ def encode_options(options: list[tuple[int, bytes]]) -> bytes:
             parts.append(bytes((code, len(val))) + val)
     parts.append(bytes((OPT_END,)))
     return b"".join(parts)
+
+
+# fixed-field offsets in the BOOTP payload (RFC 2131 figure 1)
+_OFF_XID = 4
+_OFF_FLAGS = 10
+_OFF_CIADDR = 12
+_OFF_YIADDR = 16
+_OFF_SIADDR = 20
+_OFF_GIADDR = 24
+_OFF_CHADDR = 28
+_OFF_MAGIC = 236
+_OPTIONS_START = 240
+
+
+class ReplyTemplate:
+    """Preassembled BOOTREPLY payload: the fixed 240-byte header, magic
+    cookie and the full option bytes are built ONCE; per-reply `render`
+    copies the prototype and patches only the per-client words
+    (xid/flags/ciaddr/yiaddr/giaddr/chaddr). This replaces the hot
+    path's per-reply struct.pack + pad + per-option concatenation with
+    one memcpy and five fixed-offset writes — the slow-path encode cost
+    that dominated config 1's run-to-run variance.
+
+    The prototype bakes op=BOOTREPLY, htype/hlen, siaddr (per-server
+    static) and the option bytes (per-pool static, END included)."""
+
+    __slots__ = ("_proto", "options")
+
+    def __init__(self, options: list[tuple[int, bytes]], siaddr: int = 0,
+                 options_raw: bytes | None = None):
+        raw = options_raw if options_raw is not None else encode_options(options)
+        proto = bytearray(_OPTIONS_START + len(raw))
+        proto[0] = 2  # op: BOOTREPLY
+        proto[1] = 1  # htype: Ethernet
+        proto[2] = 6  # hlen
+        struct.pack_into("!I", proto, _OFF_SIADDR, siaddr)
+        struct.pack_into("!I", proto, _OFF_MAGIC, DHCP_MAGIC)
+        proto[_OPTIONS_START:] = raw
+        self._proto = bytes(proto)
+        # the decoded view of the baked options, so callers building a
+        # DHCPPacket around a render keep a truthful .options list
+        self.options = list(options)
+
+    def render(self, xid: int, chaddr: bytes, yiaddr: int = 0,
+               flags: int = 0, ciaddr: int = 0, giaddr: int = 0) -> bytes:
+        buf = bytearray(self._proto)
+        struct.pack_into("!I", buf, _OFF_XID, xid)
+        struct.pack_into("!H", buf, _OFF_FLAGS, flags)
+        struct.pack_into("!II", buf, _OFF_CIADDR, ciaddr, yiaddr)
+        struct.pack_into("!I", buf, _OFF_GIADDR, giaddr)
+        buf[_OFF_CHADDR : _OFF_CHADDR + 16] = (chaddr + b"\x00" * 16)[:16]
+        return bytes(buf)
 
 
 def decode(data: bytes) -> DHCPPacket:

@@ -109,6 +109,10 @@ class DHCPServer:
         self._session_seq = 0
         # (pool_id, lease_time, include_lease) -> (options list, TLV bytes)
         self._reply_opts_cache: dict[tuple, tuple[list, bytes]] = {}
+        # (msg_type, static-options key) -> ReplyTemplate: the whole
+        # BOOTREPLY payload preassembled, per-client words patched in at
+        # render time (dhcp_codec.ReplyTemplate) — the hot encode path
+        self._reply_template_cache: dict[tuple, dhcp_codec.ReplyTemplate] = {}
 
     # ------------------------------------------------------------------
     def handle_frame(self, raw: bytes) -> bytes | None:
@@ -457,10 +461,12 @@ class DHCPServer:
 
     # ------------------------------------------------------------------
     def _static_reply_options(self, pool: Pool, lt: int,
-                              include_lease: bool) -> tuple[list, bytes]:
+                              include_lease: bool) -> tuple[list, bytes, tuple]:
         """The reply options after MSG_TYPE are a function of (pool, lease
         config) only — build once per key, cache the list AND its encoded
-        TLV suffix (the slow path's hottest allocation)."""
+        TLV suffix (the slow path's hottest allocation). Returns
+        (options, tlv_bytes, cache_key); the key also keys the full
+        reply templates."""
         # keyed on the option-relevant VALUES, so a reconfigured pool (or a
         # future runtime server-IP change — OPT_SERVER_ID is baked into the
         # cached bytes) can never serve a stale cached suffix
@@ -469,7 +475,7 @@ class DHCPServer:
                self.server_ip)
         hit = self._reply_opts_cache.get(key)
         if hit is not None:
-            return hit
+            return hit[0], hit[1], key
         from bng_tpu.utils.net import prefix_to_mask
 
         opts = [(dhcp_codec.OPT_SERVER_ID, struct.pack("!I", self.server_ip))]
@@ -491,19 +497,41 @@ class DHCPServer:
         if len(self._reply_opts_cache) >= 1024:
             self._reply_opts_cache.pop(next(iter(self._reply_opts_cache)))
         self._reply_opts_cache[key] = hit
-        return hit
+        return hit[0], hit[1], key
+
+    def _reply_template(self, msg_type: int, pool: Pool, lt: int,
+                        include_lease: bool) -> dhcp_codec.ReplyTemplate:
+        static_opts, static_raw, key = self._static_reply_options(
+            pool, lt, include_lease)
+        tkey = (msg_type,) + key
+        tmpl = self._reply_template_cache.get(tkey)
+        if tmpl is not None:
+            return tmpl
+        mt_raw = bytes((dhcp_codec.OPT_MSG_TYPE, 1, msg_type))
+        tmpl = dhcp_codec.ReplyTemplate(
+            [(dhcp_codec.OPT_MSG_TYPE, bytes([msg_type]))] + static_opts,
+            siaddr=self.server_ip, options_raw=mt_raw + static_raw)
+        if len(self._reply_template_cache) >= 1024:
+            self._reply_template_cache.pop(
+                next(iter(self._reply_template_cache)))
+        self._reply_template_cache[tkey] = tmpl
+        return tmpl
 
     def _build_reply(self, req: DHCPPacket, msg_type: int, ip: int, pool: Pool,
                      lease_time: int | None = None, include_lease: bool = True) -> DHCPPacket:
         lt = lease_time if lease_time is not None else pool.lease_time
+        ciaddr = req.ciaddr if msg_type == ACK else 0
+        tmpl = self._reply_template(msg_type, pool, lt, include_lease)
         p = DHCPPacket(
-            op=2, xid=req.xid, flags=req.flags, ciaddr=req.ciaddr if msg_type == ACK else 0,
+            op=2, xid=req.xid, flags=req.flags, ciaddr=ciaddr,
             yiaddr=ip, siaddr=self.server_ip, giaddr=req.giaddr, chaddr=req.chaddr,
         )
-        static_opts, static_raw = self._static_reply_options(pool, lt, include_lease)
-        mt = (dhcp_codec.OPT_MSG_TYPE, bytes([msg_type]))
-        p.options = [mt] + static_opts
-        p.set_options_raw(bytes((dhcp_codec.OPT_MSG_TYPE, 1, msg_type)) + static_raw)
+        # fresh list, shared option tuples: the snapshot identity check
+        # keeps the template render valid until a caller mutates options
+        p.options = list(tmpl.options)
+        p.set_encoded(tmpl.render(req.xid, req.chaddr, yiaddr=ip,
+                                  flags=req.flags, ciaddr=ciaddr,
+                                  giaddr=req.giaddr))
         return p
 
     def _build_nak(self, req: DHCPPacket) -> DHCPPacket:

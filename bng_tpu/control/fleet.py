@@ -1,0 +1,893 @@
+"""Slow-path fleet: sharded multi-worker control-plane service.
+
+The reference BNG sustains 50k+ DHCP req/s because its slow path is
+concurrent Go (pkg/dhcp/server.go:302 onward — one goroutine per
+request); ours was a single GIL thread behind the engine's PASS lanes.
+This module re-hosts that concurrency as a shared-nothing worker fleet:
+
+- **Sharding**: frames are steered to workers by FNV-1a32(src MAC) —
+  bit-for-bit the hash the ring classifier uses for DHCP control frames
+  (runtime/ring.py shard_of, bngring.h spec), so one subscriber's whole
+  DORA lands on ONE worker (the SO_REUSEPORT + consistent-hash role).
+  No lock is ever taken on the per-frame path.
+
+- **Workers**: each worker owns a full `SlowPathDemux` + `DHCPServer`
+  stack and allocates from per-worker *lease slices* carved out of the
+  parent `PoolManager` — addresses a worker holds are marked allocated
+  in the parent pool, so two workers can never hand out the same IP.
+  Slice refill (batched, low-watermark-triggered) is the only
+  cross-worker coordination, and it happens between batches, never
+  mid-frame.
+
+- **Single-writer tables**: workers never touch the device tables.
+  Their DHCP servers write to a `TableEventLog` recorder; the parent
+  replays the events into the real `FastPathTables` host mirror, which
+  the engine's existing bounded update drain ships to HBM — the same
+  single-writer discipline every other table producer follows.
+
+- **Admission**: an `AdmissionController` (control/admission.py) sheds
+  DHCP-correctly in front of the inboxes — DISCOVERs first, never a
+  REQUEST whose OFFER we already sent, never a half-allocation.
+
+Execution modes:
+  - ``process`` — one OS process per worker (multiprocessing, spawn by
+    default): real CPU parallelism for the Python slow path. Workers
+    are built IN the child from a picklable `FleetSpec`. Standard
+    spawn rules apply: an embedding *script* must guard its
+    entrypoint with ``if __name__ == '__main__'`` (module entrypoints
+    like ``python -m bng_tpu.cli`` are fine as-is). Parents whose
+    __main__ is not importable at all (stdin, REPL) automatically fall
+    back to fork; BNG_FLEET_START or start_method overrides.
+  - ``inline`` — same sharding/admission/slice machinery, handlers run
+    synchronously in the caller; deterministic (tests, workers=1).
+
+A worker that dies mid-flight (IPC error) loses only its own shard's
+lanes for that batch — clients retransmit — and is counted in
+`worker_failures`; other shards and later batches are unaffected.
+
+The fleet's `handle_batch` is the engine's `slow_path_batch` hook:
+fan-out by shard, fan-in with replies re-merged in lane (ring) order.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Callable
+
+from bng_tpu.control import dhcp_codec
+from bng_tpu.control.admission import (AdmissionConfig, AdmissionController,
+                                       peek_reply)
+from bng_tpu.control.pool import PoolExhaustedError, PoolManager
+from bng_tpu.runtime.ring import classify_dhcp
+from bng_tpu.utils.net import fnv1a32, prefix_to_mask
+
+
+def shard_for_mac(mac: bytes, n_workers: int) -> int:
+    """Worker owning a client MAC — the ring classifier's DHCP-control
+    steering hash (shard_of's fnv1a32(frame[6:12]) fallback), so the
+    host ring, the sharded cluster and the fleet all agree on owners."""
+    if n_workers <= 1:
+        return 0
+    return fnv1a32(mac[:6]) % n_workers
+
+
+def shard_for_frame(frame: bytes, n_workers: int) -> int:
+    """Worker for a slow-path frame: by source MAC (frame[6:12])."""
+    if n_workers <= 1 or len(frame) < 12:
+        return 0
+    return fnv1a32(frame[6:12]) % n_workers
+
+
+# ---------------------------------------------------------------------------
+# picklable worker construction spec
+# ---------------------------------------------------------------------------
+
+@dataclass
+class FleetPoolSpec:
+    """Per-pool config a worker needs to build reply options + validate
+    addresses. Mirrors control.pool.Pool's config surface (no state)."""
+
+    pool_id: int
+    network: int
+    prefix_len: int
+    gateway: int
+    dns_primary: int = 0
+    dns_secondary: int = 0
+    lease_time: int = 3600
+    client_class: int = 0
+
+
+@dataclass
+class FleetSpec:
+    """Everything a child process needs to build its worker stack."""
+
+    server_mac: bytes
+    server_ip: int
+    pools: list = field(default_factory=list)  # [FleetPoolSpec]
+    lease_time_cap: int | None = None
+    slice_size: int = 1024
+    low_watermark: int = 256
+
+    @staticmethod
+    def from_pool_manager(server_mac: bytes, server_ip: int,
+                          pools: PoolManager, **kw) -> "FleetSpec":
+        specs = [FleetPoolSpec(
+            pool_id=p.pool_id, network=p.network, prefix_len=p.prefix_len,
+            gateway=p.gateway, dns_primary=p.dns_primary,
+            dns_secondary=p.dns_secondary, lease_time=p.lease_time,
+            client_class=p.client_class) for p in pools.pools.values()]
+        return FleetSpec(server_mac=server_mac, server_ip=server_ip,
+                         pools=specs, **kw)
+
+
+# ---------------------------------------------------------------------------
+# worker-side pools: lease slices
+# ---------------------------------------------------------------------------
+
+class SlicePool:
+    """Worker-side view of one pool: full config, but allocation is
+    restricted to the address slices the parent granted. Duck-types the
+    Pool surface DHCPServer consumes."""
+
+    def __init__(self, spec: FleetPoolSpec,
+                 on_exhausted: Callable[[int], None] | None = None):
+        # called once when allocate() drains the slice dry: the worker's
+        # synchronous refill hook (mid-batch exhaustion must be able to
+        # pull a new slice, not silently drop the tail of a batch)
+        self.on_exhausted = on_exhausted
+        self.pool_id = spec.pool_id
+        self.prefix_len = spec.prefix_len
+        self.gateway = spec.gateway
+        self.dns_primary = spec.dns_primary
+        self.dns_secondary = spec.dns_secondary
+        self.lease_time = spec.lease_time
+        self.client_class = spec.client_class
+        mask = prefix_to_mask(spec.prefix_len)
+        self.network = spec.network & mask
+        self.first = self.network + 1
+        self.last = (self.network | (~mask & 0xFFFFFFFF)) - 1
+        self._free: deque[int] = deque()
+        self._granted: set[int] = set()
+        self._allocated: dict[int, str] = {}
+        self._declined: set[int] = set()
+
+    def grant(self, ips) -> int:
+        added = 0
+        for ip in ips:
+            if ip not in self._granted:
+                self._granted.add(ip)
+                self._free.append(ip)
+                added += 1
+        return added
+
+    @property
+    def free_count(self) -> int:
+        return (len(self._granted) - len(self._allocated)
+                - len(self._declined & self._granted))
+
+    @property
+    def used(self) -> int:
+        return len(self._allocated)
+
+    def allocate(self, owner: str) -> int:
+        for attempt in (0, 1):
+            while self._free:
+                ip = self._free.popleft()
+                # revoked (no longer granted), re-claimed or declined
+                # addresses may still sit in the free deque — skip them
+                if (ip not in self._granted or ip in self._allocated
+                        or ip in self._declined):
+                    continue
+                self._allocated[ip] = owner
+                return ip
+            if attempt == 0 and self.on_exhausted is not None:
+                self.on_exhausted(self.pool_id)  # may grant a new slice
+        raise PoolExhaustedError(
+            f"worker slice of pool {self.pool_id} exhausted")
+
+    def revoke(self, ip: int) -> bool:
+        """Withdraw an un-leased address from this slice (restore-time
+        ownership transfer). Active allocations are never revoked."""
+        if ip in self._allocated:
+            return False
+        self._granted.discard(ip)
+        return True
+
+    def allocate_specific(self, ip: int, owner: str) -> bool:
+        # the granted set is the correctness boundary: an address another
+        # worker owns is simply not grantable here, so a cross-shard
+        # REQUEST NAKs instead of double-allocating
+        if ip not in self._granted or ip in self._declined:
+            return False
+        cur = self._allocated.get(ip)
+        if cur is not None and cur != owner:
+            return False
+        self._allocated[ip] = owner
+        return True
+
+    def release(self, ip: int) -> bool:
+        if ip in self._allocated:
+            del self._allocated[ip]
+            self._free.append(ip)
+            return True
+        return False
+
+    def decline(self, ip: int) -> None:
+        self._allocated.pop(ip, None)
+        self._declined.add(ip)
+
+    def contains(self, ip: int) -> bool:
+        # FULL pool range, not just granted slices: pool_for_ip must
+        # find the owning pool for renewals/validation; allocate_specific
+        # still enforces the granted boundary
+        return self.first <= ip <= self.last
+
+
+class WorkerPools:
+    """PoolManager-shaped registry over a worker's SlicePools."""
+
+    def __init__(self, specs: list[FleetPoolSpec],
+                 on_exhausted: Callable[[int], None] | None = None):
+        self.pools: dict[int, SlicePool] = {
+            s.pool_id: SlicePool(s, on_exhausted) for s in specs}
+
+    def classify(self, client_class: int = 0):
+        best = None
+        for p in self.pools.values():
+            if p.client_class == client_class:
+                return p
+            if p.client_class == 0 and best is None:
+                best = p
+        return best
+
+    def pool_for_ip(self, ip: int):
+        for p in self.pools.values():
+            if p.contains(ip):
+                return p
+        return None
+
+
+# ---------------------------------------------------------------------------
+# single-writer table relay
+# ---------------------------------------------------------------------------
+
+class TableEventLog:
+    """FastPathTables-shaped recorder: workers call the same methods the
+    DHCP server calls on the real tables; the calls are logged as
+    picklable events the PARENT replays into the host mirror — keeping
+    the device tables single-writer."""
+
+    _METHODS = ("add_subscriber", "remove_subscriber",
+                "add_circuit_id_subscriber", "remove_circuit_id_subscriber",
+                "add_vlan_subscriber", "remove_vlan_subscriber")
+
+    def __init__(self):
+        self.events: list = []
+
+    def __getattr__(self, name):
+        if name not in self._METHODS:
+            raise AttributeError(name)
+
+        def record(*args, **kwargs):
+            self.events.append(("fastpath", name, args, kwargs))
+        return record
+
+    def drain(self) -> list:
+        out, self.events = self.events, []
+        return out
+
+
+def apply_table_events(events: list, table_sink, qos_hook=None,
+                       nat_hook=None, lease_hook=None) -> None:
+    """Replay worker events into the parent-side sinks (the single
+    writer). Unknown event kinds are ignored — forward compatibility
+    across worker versions mid-rolling-restart."""
+    for ev in events:
+        kind = ev[0]
+        if kind == "fastpath":
+            if table_sink is not None:
+                getattr(table_sink, ev[1])(*ev[2], **ev[3])
+        elif kind == "qos":
+            if qos_hook is not None:
+                qos_hook(ev[1], ev[2])
+        elif kind == "nat":
+            if nat_hook is not None:
+                nat_hook(ev[1], ev[2])
+        elif kind == "lease":
+            if lease_hook is not None:
+                lease_hook(ev[1], ev[2], ev[3])
+
+
+# ---------------------------------------------------------------------------
+# the worker (runs in-child for process mode, in-parent for inline)
+# ---------------------------------------------------------------------------
+
+class FleetWorker:
+    """One shard: demux + DHCP server + slice pools, shared-nothing."""
+
+    def __init__(self, spec: FleetSpec, worker_id: int, n_workers: int,
+                 clock: Callable[[], float] | None = None):
+        from bng_tpu.control.dhcp_server import DHCPServer
+        from bng_tpu.control.slowpath import SlowPathDemux
+
+        self.spec = spec
+        self.worker_id = worker_id
+        self.n_workers = n_workers
+        self.clock = clock or time.time
+        self.tables = TableEventLog()
+        # set by the execution context (fleet for inline, _worker_main
+        # for process): called when a slice runs dry MID-batch so the
+        # tail of the batch can still allocate. None = rely on the
+        # between-batch watermark refill only.
+        self.refill_now: Callable[[int], None] | None = None
+        self.pools = WorkerPools(spec.pools, self._on_slice_exhausted)
+        self._events: list = []
+        self.server = DHCPServer(
+            server_mac=spec.server_mac, server_ip=spec.server_ip,
+            pool_manager=self.pools, fastpath_tables=self.tables,
+            qos_hook=lambda ip, pol: self._events.append(("qos", ip, pol)),
+            nat_hook=lambda ip, now: self._events.append(("nat", ip, now)),
+            accounting_hook=self._lease_event,
+            lease_time_cap=spec.lease_time_cap, clock=self.clock)
+        self.demux = SlowPathDemux(dhcp=self.server, clock=self.clock)
+        # mac_u64s whose lease ENDED (release/expiry/replacement) since
+        # the last report — the admission controller's is_known feedback
+        self._released: list[int] = []
+        self.frames = 0
+        self.batches = 0
+        self.errors = 0
+        self.busy_s = 0.0
+
+    def _on_slice_exhausted(self, pool_id: int) -> None:
+        if self.refill_now is not None:
+            self.refill_now(pool_id)
+
+    def _lease_event(self, event: str, lease, sid: str) -> None:
+        if event == "stop":
+            # RELEASE produces no reply frame, so the reply peek can
+            # never observe it — report ended leases explicitly or the
+            # admission controller's known-client set grows forever
+            self._released.append(int.from_bytes(lease.mac[:6], "big"))
+        self._events.append(("lease", event, {
+            "mac": lease.mac.hex(), "ip": lease.ip, "pool_id": lease.pool_id,
+            "expiry": lease.expiry, "username": lease.username,
+            "qos_policy": lease.qos_policy}, sid))
+
+    # -- batch handling ---------------------------------------------------
+
+    def handle_batch(self, items: list, now: float | None = None) -> dict:
+        """[(lane, frame)] -> {"results", "events", "offers", "acks",
+        "releases", "pending", "refill", "stats"}. One poison frame must
+        not kill the worker or shift any other lane's result."""
+        t0 = time.perf_counter()
+        results = []
+        offers, acks, releases = [], [], []
+        for lane, frame in items:
+            reply = None
+            try:
+                reply = self.demux(frame)
+            except Exception:  # noqa: BLE001 — untrusted wire input
+                self.errors += 1
+            if reply is not None:
+                peek = peek_reply(reply)
+                if peek is not None:
+                    if peek[0] == dhcp_codec.OFFER:
+                        offers.append(peek[1])
+                    elif peek[0] == dhcp_codec.ACK:
+                        acks.append(peek[1])
+            results.append((lane, reply))
+        self.frames += len(items)
+        self.batches += 1
+        self.busy_s += time.perf_counter() - t0
+        releases += self._drain_released()
+        return {
+            "results": results,
+            "events": self.tables.drain() + self._drain_events(),
+            "offers": offers, "acks": acks, "releases": releases,
+            "pending": self.demux.drain_pending(),
+            "refill": self._refill_wanted(),
+            "stats": self._stats(),
+        }
+
+    def _drain_events(self) -> list:
+        out, self._events = self._events, []
+        return out
+
+    def _drain_released(self) -> list:
+        out, self._released = self._released, []
+        return out
+
+    def _refill_wanted(self) -> list:
+        """[(pool_id, want)] for slices under the low watermark."""
+        want = []
+        for pid, p in self.pools.pools.items():
+            free = p.free_count
+            if free < self.spec.low_watermark:
+                want.append((pid, self.spec.slice_size - free))
+        return want
+
+    def apply_grant(self, grants: list) -> None:
+        for pid, ips in grants:
+            p = self.pools.pools.get(pid)
+            if p is not None:
+                p.grant(ips)
+
+    def expire(self, now: int) -> dict:
+        n = self.server.cleanup_expired(now)
+        return {"expired": n,
+                "events": self.tables.drain() + self._drain_events(),
+                "releases": self._drain_released(),
+                "stats": self._stats()}
+
+    def _stats(self) -> dict:
+        return {
+            "frames": self.frames, "batches": self.batches,
+            "errors": self.errors, "busy_s": self.busy_s,
+            "leases": len(self.server.leases),
+            "demux": dict(self.demux.stats),
+            "slice_free": {pid: p.free_count
+                           for pid, p in self.pools.pools.items()},
+        }
+
+    # -- checkpoint -------------------------------------------------------
+
+    def export_state(self) -> dict:
+        return self.server.export_leases()
+
+    def restore_state(self, state: dict) -> int:
+        """Hydrate the lease book. `revoke` lists every restored lease
+        address fleet-wide: whichever worker's INITIAL slice happened to
+        cover an address withdraws it first (ownership moves to the
+        lease's hash-owner), then the owner grants + re-claims its own
+        leases — so a fresh DORA can never double-assign a restored
+        subscriber's address."""
+        for ip in state.get("revoke", ()):
+            pool = self.pools.pool_for_ip(int(ip))
+            if pool is not None:
+                pool.revoke(int(ip))
+        ips = [int(d["ip"]) for d in state.get("leases", [])]
+        for ip in ips:
+            pool = self.pools.pool_for_ip(ip)
+            if pool is not None:
+                pool.grant([ip])
+        return self.server.restore_leases(state)
+
+
+def _worker_main(conn, spec: FleetSpec, worker_id: int,
+                 n_workers: int) -> None:
+    """Child-process loop: message-driven, never dies on handler input
+    (per-frame isolation lives in FleetWorker.handle_batch)."""
+    worker = FleetWorker(spec, worker_id, n_workers)
+
+    def refill_now(pool_id: int) -> None:
+        # mid-batch synchronous refill: the parent is blocked in its
+        # gather loop for this worker and answers refill_req inline
+        # (always with a grant message, possibly empty), so this recv
+        # cannot deadlock
+        conn.send(("refill_req", [(pool_id, spec.slice_size)]))
+        tag, payload = conn.recv()
+        if tag == "grant":
+            worker.apply_grant(payload)
+
+    worker.refill_now = refill_now
+    try:
+        while True:
+            try:
+                msg = conn.recv()
+            except EOFError:
+                break
+            kind = msg[0]
+            if kind == "batch":
+                conn.send(("result", worker.handle_batch(msg[1], msg[2])))
+            elif kind == "grant":
+                worker.apply_grant(msg[1])
+            elif kind == "expire":
+                conn.send(("expired", worker.expire(msg[1])))
+            elif kind == "export":
+                conn.send(("state", worker.export_state()))
+            elif kind == "restore":
+                conn.send(("restored", worker.restore_state(msg[1])))
+            elif kind == "stop":
+                break
+    finally:
+        conn.close()
+
+
+# ---------------------------------------------------------------------------
+# the fleet (parent side)
+# ---------------------------------------------------------------------------
+
+class SlowPathFleet:
+    """N shared-nothing slow-path workers behind admission control.
+
+    `handle_batch` is the engine's `slow_path_batch` hook: it fans a
+    slow-lane batch out to the owning workers, fans replies back in
+    **re-merged in lane order**, replays worker table events into the
+    parent's single-writer host mirrors, and services lease-slice
+    refills — the only cross-worker coordination point.
+    """
+
+    def __init__(self, spec: FleetSpec, n_workers: int, pools: PoolManager,
+                 mode: str = "process",
+                 admission: AdmissionConfig | None = None,
+                 table_sink=None, qos_hook=None, nat_hook=None,
+                 lease_hook=None,
+                 fallback: Callable[[bytes], bytes | None] | None = None,
+                 start_method: str | None = None,
+                 clock: Callable[[], float] | None = None,
+                 worker_factory: Callable[[int, int], FleetWorker] | None = None):
+        if mode not in ("process", "inline"):
+            raise ValueError(f"fleet mode {mode!r}: expected "
+                             f"'process' or 'inline'")
+        if n_workers < 1:
+            raise ValueError(f"n_workers must be >= 1, got {n_workers}")
+        self.spec = spec
+        self.n = n_workers
+        self.pools = pools
+        self.mode = mode
+        self.clock = clock or time.time
+        self.admission = AdmissionController(admission, clock=self.clock)
+        self.table_sink = table_sink
+        self.qos_hook = qos_hook
+        self.nat_hook = nat_hook
+        self.lease_hook = lease_hook
+        self.fallback = fallback
+        self.refills = 0
+        self.refill_ips_granted = 0
+        self.fallback_frames = 0
+        self.batches = 0
+        self.worker_failures = 0  # dead-worker batch losses (IPC errors)
+        self.start_method = None  # set for process mode below
+        self._pending: list[bytes] = []
+        self._last_stats: list[dict] = [{} for _ in range(n_workers)]
+        self._procs: list = []
+        self._conns: list = []
+        self._inline: list[FleetWorker] = []
+        if mode == "inline":
+            make = worker_factory or (
+                lambda i, n: FleetWorker(spec, i, n, clock=self.clock))
+            self._inline = [make(i, n_workers) for i in range(n_workers)]
+            for w, worker in enumerate(self._inline):
+                worker.refill_now = (
+                    lambda pid, _w=w: self._refill_sync(_w, pid))
+        else:
+            import multiprocessing as mp
+            import os
+            import sys
+
+            method = start_method or os.environ.get("BNG_FLEET_START")
+            if method is None:
+                # spawn re-imports the parent's __main__ in the child;
+                # when __main__ is not importable (stdin scripts, REPLs:
+                # __file__ == '<stdin>' or missing) every child dies at
+                # startup with FileNotFoundError — fall back to fork,
+                # which needs no re-import
+                main = sys.modules.get("__main__")
+                spec_name = getattr(getattr(main, "__spec__", None),
+                                    "name", None)
+                main_file = getattr(main, "__file__", None)
+                spawn_safe = (spec_name is not None or main_file is None
+                              or os.path.exists(main_file))
+                method = "spawn" if spawn_safe else "fork"
+            ctx = mp.get_context(method)
+            self.start_method = method
+            for i in range(n_workers):
+                parent, child = ctx.Pipe(duplex=True)
+                p = ctx.Process(target=_worker_main,
+                                args=(child, spec, i, n_workers),
+                                daemon=True,
+                                name=f"bng-slowpath-w{i}")
+                p.start()
+                child.close()
+                self._procs.append(p)
+                self._conns.append(parent)
+        self._initial_grant()
+
+    # -- lease-slice coordination (the parent pools stay the authority) --
+
+    def _carve(self, pool_id: int, want: int, worker: int) -> list[int]:
+        """Claim up to `want` addresses from the parent pool for a
+        worker. Claimed addresses are marked allocated in the parent
+        (owner 'fleet:wN'), so cross-worker double allocation is
+        structurally impossible."""
+        pool = self.pools.pools.get(pool_id)
+        if pool is None:
+            return []
+        out = []
+        owner = f"fleet:w{worker}"
+        for _ in range(want):
+            try:
+                out.append(pool.allocate(owner))
+            except PoolExhaustedError:
+                break
+        return out
+
+    def _initial_grant(self) -> None:
+        for pid, pool in self.pools.pools.items():
+            # fair first carve: don't let worker 0 drain a small pool
+            per = max(1, min(self.spec.slice_size,
+                             max(0, pool.size - pool.used) // self.n))
+            for w in range(self.n):
+                ips = self._carve(pid, per, w)
+                if ips:
+                    self._grant(w, [(pid, ips)])
+
+    def _grant(self, worker: int, grants: list) -> None:
+        self.refill_ips_granted += sum(len(ips) for _, ips in grants)
+        if self.mode == "inline":
+            self._inline[worker].apply_grant(grants)
+        else:
+            self._conns[worker].send(("grant", grants))
+
+    def _service_refill(self, worker: int, wanted: list) -> None:
+        grants = self._carve_grants(worker, wanted)
+        if grants:
+            self.refills += 1
+            self._grant(worker, grants)
+
+    def _carve_grants(self, worker: int, wanted: list) -> list:
+        grants = []
+        for pid, want in wanted:
+            ips = self._carve(pid, want, worker)
+            if ips:
+                grants.append((pid, ips))
+        return grants
+
+    def _refill_sync(self, worker: int, pool_id: int) -> None:
+        """Inline-mode mid-batch refill (the worker's slice ran dry)."""
+        grants = self._carve_grants(worker, [(pool_id,
+                                              self.spec.slice_size)])
+        if grants:
+            self.refills += 1
+            self.refill_ips_granted += sum(len(i) for _p, i in grants)
+            self._inline[worker].apply_grant(grants)
+
+    def _gather(self, worker: int, expect: str):
+        """Receive one `expect`-tagged message from a worker process,
+        servicing mid-batch refill_req messages inline. The reply to a
+        refill_req is ALWAYS a grant (possibly empty) — the child blocks
+        on it."""
+        conn = self._conns[worker]
+        while True:
+            tag, payload = conn.recv()
+            if tag == "refill_req":
+                grants = self._carve_grants(worker, payload)
+                if grants:
+                    self.refills += 1
+                    self.refill_ips_granted += sum(
+                        len(i) for _p, i in grants)
+                conn.send(("grant", grants))
+                continue
+            if tag != expect:
+                raise RuntimeError(
+                    f"fleet worker {worker}: unexpected reply {tag!r} "
+                    f"(wanted {expect!r})")
+            return payload
+
+    # -- the hot path -----------------------------------------------------
+
+    def handle_batch(self, items: list, now: float | None = None) -> list:
+        """[(lane, frame)] or [(lane, frame, enq_t)] -> [(lane, reply)]
+        in ascending lane order. Shed frames return (lane, None)."""
+        now = now if now is not None else self.clock()
+        self.batches += 1
+        groups: dict[int, list] = {}
+        depth: dict[int, int] = {}
+        results: list[tuple[int, bytes | None]] = []
+        for item in items:
+            lane, frame = item[0], item[1]
+            enq_t = item[2] if len(item) > 2 else None
+            if self.fallback is not None and not classify_dhcp(frame):
+                # non-DHCPv4 slow traffic (v6 / SLAAC / PPPoE / poison)
+                # stays on the parent's demux — the fleet shards DHCPv4
+                self.fallback_frames += 1
+                try:
+                    results.append((lane, self.fallback(frame)))
+                except Exception:  # noqa: BLE001 — untrusted wire input
+                    results.append((lane, None))
+                continue
+            w = shard_for_frame(frame, self.n)
+            ok, _reason = self.admission.admit(
+                frame, depth.get(w, 0), now, enq_t)
+            if not ok:
+                results.append((lane, None))
+                continue
+            groups.setdefault(w, []).append((lane, frame))
+            depth[w] = depth.get(w, 0) + 1
+        if groups:
+            if self.mode == "inline":
+                for w in sorted(groups):
+                    out = self._inline[w].handle_batch(groups[w], now)
+                    results.extend(self._absorb(w, out))
+            else:
+                # scatter first so every child computes concurrently,
+                # THEN gather. A dead worker (IPC error) loses only ITS
+                # lanes — the client retransmits; other shards and later
+                # batches are unaffected.
+                sent = []
+                for w in sorted(groups):
+                    try:
+                        self._conns[w].send(("batch", groups[w], now))
+                        sent.append(w)
+                    except (OSError, ValueError):
+                        self.worker_failures += 1
+                        results.extend((lane, None)
+                                       for lane, _f in groups[w])
+                for w in sent:
+                    try:
+                        results.extend(self._absorb(
+                            w, self._gather(w, "result")))
+                    except (OSError, EOFError):
+                        self.worker_failures += 1
+                        results.extend((lane, None)
+                                       for lane, _f in groups[w])
+        results.sort(key=lambda t: t[0])
+        return results
+
+    def _absorb(self, worker: int, out: dict) -> list:
+        """Fold one worker's batch result into parent state (events ->
+        single-writer tables, offer/ack feedback -> admission, refill
+        service, pending frames) and return its lane results."""
+        apply_table_events(out["events"], self.table_sink,
+                          self.qos_hook, self.nat_hook, self.lease_hook)
+        # releases BEFORE offers/acks: a lease replaced within the batch
+        # emits stop(old) + ACK(new) for one MAC — the re-lease must win
+        for mac in out["releases"]:
+            self.admission.note_release(mac)
+        for mac in out["offers"]:
+            self.admission.note_offer(mac)
+        for mac in out["acks"]:
+            self.admission.note_ack(mac)
+        self._pending.extend(out["pending"])
+        if out["refill"]:
+            self._service_refill(worker, out["refill"])
+        self._last_stats[worker] = out["stats"]
+        return out["results"]
+
+    def handle_frame(self, frame: bytes) -> bytes | None:
+        """Single-frame facade (the plain `slow_path` signature)."""
+        out = self.handle_batch([(0, frame)])
+        return out[0][1] if out else None
+
+    def drain_pending(self) -> list[bytes]:
+        """Extra frames beyond one-reply-per-input (the demux pending
+        contract), merged in worker-arrival order — deterministic
+        because workers are gathered in index order."""
+        out, self._pending = self._pending, []
+        return out
+
+    # -- maintenance ------------------------------------------------------
+
+    def expire(self, now: int) -> int:
+        """Lease-expiry sweep across every worker (the parent tick's
+        cleanup_expired role)."""
+        total = 0
+        if self.mode == "inline":
+            for w, worker in enumerate(self._inline):
+                out = worker.expire(now)
+                total += self._absorb_expire(w, out)
+        else:
+            for conn in self._conns:
+                conn.send(("expire", now))
+            for w in range(self.n):
+                total += self._absorb_expire(w, self._gather(w, "expired"))
+        return total
+
+    def _absorb_expire(self, worker: int, out: dict) -> int:
+        apply_table_events(out["events"], self.table_sink,
+                          self.qos_hook, self.nat_hook, self.lease_hook)
+        for mac in out.get("releases", ()):
+            self.admission.note_release(mac)
+        self._last_stats[worker] = out["stats"]
+        return out["expired"]
+
+    # -- checkpoint (runtime/checkpoint.py 'fleet' component) -------------
+
+    def export_state(self) -> dict:
+        """Per-worker lease books for the checkpoint payload. Slice
+        free-lists are transient (like the server's _offers) — on
+        restore, workers get fresh slices and each restored lease's IP
+        is re-claimed explicitly."""
+        if self.mode == "inline":
+            workers = [w.export_state() for w in self._inline]
+        else:
+            for conn in self._conns:
+                conn.send(("export",))
+            workers = [self._gather(w, "state") for w in range(self.n)]
+        return {"n_workers": self.n, "workers": workers}
+
+    @staticmethod
+    def parse_state(state: dict) -> int:
+        """Dry-parse (the restore pre-check role): raises on a corrupt
+        fleet blob, touches nothing. Returns the total lease count."""
+        from bng_tpu.control.dhcp_server import DHCPServer
+
+        total = 0
+        for wstate in state["workers"]:
+            _seq, leases = DHCPServer.parse_lease_state(wstate)
+            total += len(leases)
+        return total
+
+    def restore_state(self, state: dict) -> int:
+        """Re-shard the checkpointed lease books onto the CURRENT worker
+        count (the MAC hash decides, so a changed --slowpath-workers
+        still lands every subscriber on its new owner), claim each
+        lease's IP in the parent pool, and hydrate the owners."""
+        per_worker: list[dict] = [
+            {"session_seq": 0, "leases": []} for _ in range(self.n)]
+        all_ips: list[int] = []
+        for wstate in state["workers"]:
+            seq = int(wstate.get("session_seq", 0))
+            for d in wstate.get("leases", []):
+                mac = bytes.fromhex(d["mac"])
+                w = shard_for_mac(mac, self.n)
+                per_worker[w]["leases"].append(d)
+                per_worker[w]["session_seq"] = max(
+                    per_worker[w]["session_seq"], seq)
+                all_ips.append(int(d["ip"]))
+        restored = 0
+        for w, wstate in enumerate(per_worker):
+            for d in wstate["leases"]:
+                # parent-side ownership transfer: the address may sit in
+                # ANOTHER worker's initial free slice — release that
+                # claim, then re-claim for the lease's hash-owner, so it
+                # is out of every other worker's reach before the owner
+                # re-leases it (the workers revoke their side below)
+                ip = int(d["ip"])
+                pool = self.pools.pool_for_ip(ip)
+                if pool is None:
+                    continue
+                owner_tag = f"fleet:w{w}"
+                cur = pool._allocated.get(ip)
+                if cur is not None and cur != owner_tag:
+                    pool.release(ip)
+                pool.allocate_specific(ip, owner_tag)
+            # every worker gets the full revoke list: initial slices are
+            # carved before restore, so any worker may hold any address
+            wstate["revoke"] = all_ips
+            if self.mode == "inline":
+                restored += self._inline[w].restore_state(wstate)
+            else:
+                self._conns[w].send(("restore", wstate))
+        if self.mode == "process":
+            for w in range(self.n):
+                restored += self._gather(w, "restored")
+        return restored
+
+    # -- observability ----------------------------------------------------
+
+    def stats_snapshot(self) -> dict:
+        return {
+            "workers": self.n,
+            "mode": self.mode,
+            "start_method": self.start_method,
+            "worker_failures": self.worker_failures,
+            "batches": self.batches,
+            "refills": self.refills,
+            "refill_ips_granted": self.refill_ips_granted,
+            "fallback_frames": self.fallback_frames,
+            "per_worker": list(self._last_stats),
+            "admission": self.admission.stats_snapshot(),
+        }
+
+    def close(self) -> None:
+        if self.mode == "inline":
+            return
+        for conn in self._conns:
+            try:
+                conn.send(("stop",))
+            except (BrokenPipeError, OSError):
+                pass
+        for p in self._procs:
+            p.join(timeout=5)
+            if p.is_alive():
+                p.terminate()
+        for conn in self._conns:
+            try:
+                conn.close()
+            except OSError:
+                pass
+        self._conns.clear()
+        self._procs.clear()

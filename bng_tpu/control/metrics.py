@@ -308,6 +308,42 @@ class BNGMetrics:
             "bng_sched_dispatch_latency_seconds",
             "Oldest-frame submit->retire latency per dispatched batch",
             lbl_lane)
+        # slow-path fleet (control/fleet.py + control/admission.py). The
+        # reference's concurrency is invisible goroutines; here worker
+        # sharding, admission shedding and lease-slice refill are
+        # explicit mechanisms that earn trust through these families.
+        lbl_worker = ("worker",)
+        self.slowpath_workers = r.gauge(
+            "bng_slowpath_workers", "Slow-path fleet worker count")
+        self.slowpath_worker_frames = r.counter(
+            "bng_slowpath_worker_frames_total",
+            "Frames handled per fleet worker", lbl_worker)
+        self.slowpath_worker_errors = r.counter(
+            "bng_slowpath_worker_errors_total",
+            "Per-frame handler errors isolated per fleet worker",
+            lbl_worker)
+        self.slowpath_worker_busy = r.counter(
+            "bng_slowpath_worker_busy_seconds_total",
+            "Wall seconds each worker spent handling batches", lbl_worker)
+        self.slowpath_worker_leases = r.gauge(
+            "bng_slowpath_worker_leases",
+            "Active leases owned per fleet worker", lbl_worker)
+        self.slowpath_slice_free = r.gauge(
+            "bng_slowpath_lease_slice_free",
+            "Unallocated addresses in a worker's lease slices",
+            lbl_worker)
+        self.slowpath_admitted = r.counter(
+            "bng_slowpath_admitted_total",
+            "Frames admitted to fleet worker inboxes")
+        self.slowpath_shed = r.counter(
+            "bng_slowpath_shed_total",
+            "Frames shed by the admission controller", ("reason",))
+        self.slowpath_refills = r.counter(
+            "bng_slowpath_lease_refills_total",
+            "Lease-slice refill grants served to workers")
+        self.slowpath_fallback = r.counter(
+            "bng_slowpath_fallback_frames_total",
+            "Non-DHCPv4 slow frames routed to the parent demux")
         # checkpoint/warm-restart subsystem (runtime/checkpoint.py +
         # control/statestore.py). The reference needs none of this — its
         # state survives in kernel-pinned maps; here snapshot health IS
@@ -389,6 +425,27 @@ class BNGMetrics:
         self.sched_oversize_dropped.set_total(snap.get("oversize_dropped", 0))
         self.sched_completions_evicted.set_total(
             snap.get("completions_dropped", 0))
+
+    def collect_fleet(self, fleet) -> None:
+        """SlowPathFleet.stats_snapshot() -> bng_slowpath_* families."""
+        snap = fleet.stats_snapshot()
+        self.slowpath_workers.set(snap["workers"])
+        self.slowpath_refills.set_total(snap["refills"])
+        self.slowpath_fallback.set_total(snap["fallback_frames"])
+        for i, w in enumerate(snap["per_worker"]):
+            if not w:
+                continue  # no batch has reached this worker yet
+            wl = str(i)
+            self.slowpath_worker_frames.set_total(w["frames"], worker=wl)
+            self.slowpath_worker_errors.set_total(w["errors"], worker=wl)
+            self.slowpath_worker_busy.set_total(w["busy_s"], worker=wl)
+            self.slowpath_worker_leases.set(w["leases"], worker=wl)
+            self.slowpath_slice_free.set(
+                sum(w["slice_free"].values()), worker=wl)
+        adm = snap["admission"]
+        self.slowpath_admitted.set_total(adm["admitted"])
+        for reason, n in adm["shed"].items():
+            self.slowpath_shed.set_total(n, reason=reason)
 
     def collect_checkpoint(self, checkpointer, now: float | None = None) -> None:
         """PeriodicCheckpointer.stats -> bng_ckpt_* gauges/counters (the

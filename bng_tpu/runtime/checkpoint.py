@@ -61,7 +61,7 @@ _MAX_HEADER = 1 << 26
 # stored as a uint8 array named '<component>/__json__' in the payload
 # (CRC32-covered, unlike the header) and the header keeps only this stub
 _JSON_MARKER = "__payload_json__"
-_PAYLOAD_JSON_COMPONENTS = ("nat", "dhcp", "ha")
+_PAYLOAD_JSON_COMPONENTS = ("nat", "dhcp", "ha", "fleet")
 
 
 class CheckpointError(RuntimeError):
@@ -207,7 +207,7 @@ def _denamespace(prefix: str, arrays: dict) -> dict:
 def build_checkpoint(seq: int, now: float, *, engine=None, scheduler=None,
                      fastpath=None, nat=None, qos=None, antispoof=None,
                      garden=None, pppoe=None, dhcp=None, ha=None,
-                     node_id: str = "") -> Checkpoint:
+                     fleet=None, node_id: str = "") -> Checkpoint:
     """Collect a consistent snapshot of the authoritative state.
 
     With an `engine`, the table managers default from it, and the
@@ -270,6 +270,11 @@ def build_checkpoint(seq: int, now: float, *, engine=None, scheduler=None,
         meta["components"]["dhcp"] = dhcp.export_leases()
     if ha is not None:
         meta["components"]["ha"] = ha.checkpoint_state()
+    if fleet is not None:
+        # per-worker lease books of the slow-path fleet (control/fleet.py);
+        # sharding is recomputed at restore so a changed worker count
+        # still lands every lease on its new owner
+        meta["components"]["fleet"] = fleet.export_state()
     # per-row dict state (NAT allocator bookkeeping, lease book, HA
     # sessions) scales with the subscriber count: it rides the payload
     # as a uint8 JSON blob — CRC32-covered, and the header stays small
@@ -389,8 +394,10 @@ def _verify_components(ckpt: Checkpoint, comps: dict, targets: dict) -> None:
             raise CheckpointError(
                 f"nat: corrupt checkpoint meta: {e!r}") from e
     if "dhcp" in comps:
+        from bng_tpu.control.dhcp_server import DHCPServer
+
         try:
-            targets["dhcp"].parse_lease_state(comps["dhcp"])
+            DHCPServer.parse_lease_state(comps["dhcp"])
         except (KeyError, ValueError, TypeError) as e:
             raise CheckpointError(
                 f"dhcp: corrupt checkpoint lease book: {e!r}") from e
@@ -400,11 +407,20 @@ def _verify_components(ckpt: Checkpoint, comps: dict, targets: dict) -> None:
         except (KeyError, ValueError, TypeError, AttributeError) as e:
             raise CheckpointError(
                 f"ha: corrupt checkpoint session store: {e!r}") from e
+    if "fleet" in comps:
+        from bng_tpu.control.fleet import SlowPathFleet
+
+        try:
+            SlowPathFleet.parse_state(comps["fleet"])
+        except (KeyError, ValueError, TypeError) as e:
+            raise CheckpointError(
+                f"fleet: corrupt checkpoint lease books: {e!r}") from e
 
 
 def restore_checkpoint(ckpt: Checkpoint, *, engine=None, fastpath=None,
                        nat=None, qos=None, antispoof=None, garden=None,
-                       pppoe=None, dhcp=None, ha=None) -> dict[str, int]:
+                       pppoe=None, dhcp=None, ha=None,
+                       fleet=None) -> dict[str, int]:
     """Hydrate the host mirrors from a decoded checkpoint and re-upload.
 
     Reject-on-mismatch: every table component present in the checkpoint
@@ -428,8 +444,19 @@ def restore_checkpoint(ckpt: Checkpoint, *, engine=None, fastpath=None,
             comps[name] = _resolve_component_meta(ckpt, comps, name)
     targets = {"fastpath": fastpath, "nat": nat, "qos": qos,
                "antispoof": antispoof, "garden": garden, "pppoe": pppoe,
-               "dhcp": dhcp, "ha": ha}
-    missing = [name for name in comps if targets.get(name) is None]
+               "dhcp": dhcp, "ha": ha, "fleet": fleet}
+    missing = []
+    for name in comps:
+        tgt = targets.get(name)
+        if tgt is None and name in ("fleet", "dhcp"):
+            # lease books are one format: worker books merge into the
+            # parent server when the fleet is off, and the parent book
+            # re-shards into the fleet when it is on — a changed
+            # --slowpath-workers (including 1 <-> N) must never force a
+            # cold start that discards every other component
+            tgt = targets.get("dhcp" if name == "fleet" else "fleet")
+        if tgt is None:
+            missing.append(name)
     if missing:
         raise CheckpointError(
             f"checkpoint carries {sorted(missing)} but the live process "
@@ -473,8 +500,30 @@ def restore_checkpoint(ckpt: Checkpoint, *, engine=None, fastpath=None,
             got = pppoe.restore_state(comps["pppoe"],
                                       _denamespace("pppoe", ckpt.arrays))
             rows.update({f"pppoe.{k}": v for k, v in got.items()})
-        if "dhcp" in comps:
-            rows["dhcp.leases"] = dhcp.restore_leases(comps["dhcp"])
+        if "dhcp" in comps or "fleet" in comps:
+            worker_books = (list(comps["fleet"]["workers"])
+                            if "fleet" in comps else [])
+            parent_book = comps.get("dhcp")
+            if fleet is not None:
+                # the fleet owns DHCPv4: EVERY lease book (per-worker +
+                # parent) re-shards into the workers. The parent book is
+                # deliberately NOT hydrated too — double ownership would
+                # let the parent's expiry sweep release worker-held
+                # addresses back to the pool (double-allocation risk).
+                books = worker_books + (
+                    [parent_book] if parent_book else [])
+                rows["fleet.leases"] = fleet.restore_state(
+                    {"workers": books})
+            else:
+                # fleet checkpoint, single-worker process now: worker
+                # books merge into the parent server (same format) —
+                # a config change never costs a cold start
+                total = 0
+                if parent_book is not None:
+                    total += dhcp.restore_leases(parent_book)
+                for book in worker_books:
+                    total += dhcp.restore_leases(book)
+                rows["dhcp.leases"] = total
         if "ha" in comps:
             # role decides the direction: a restarted active resumes its
             # seq; a standby bootstraps then catches up via replay_since

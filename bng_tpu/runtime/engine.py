@@ -322,6 +322,12 @@ class Engine:
         self.B = batch_size
         self.L = pkt_slot
         self.slow_path = slow_path
+        # batched slow-path handler (the slow-path fleet's fan-out hook):
+        # [(lane, frame)] -> [(lane, reply|None)] in ascending lane
+        # order. When set it takes precedence over the per-frame
+        # slow_path for every PASS-lane drain (process / process_dhcp /
+        # ring / scheduler retire).
+        self.slow_path_batch = None
         self.violation_sink = violation_sink
         self.clock = clock
         self.stats = EngineStats()
@@ -506,6 +512,35 @@ class Engine:
             length[i] = len(f)
         return pkt, length
 
+    def _handle_slow_lanes(self, items: list, path: str) -> list:
+        """Drain a batch of PASS-lane frames through the slow path:
+        the batched fleet handler when wired (fan-out to workers,
+        replies re-merged in lane order), else the per-frame handler.
+        items: [(lane, frame)] or [(lane, frame, enq_t)] (the scheduler
+        threads per-frame enqueue times through for deadline shedding)
+        -> [(lane, reply|None)] ascending-lane."""
+        if not items:
+            return []
+        if self.slow_path_batch is not None:
+            try:
+                out = self.slow_path_batch(items)
+            except Exception as e:  # noqa: BLE001 — fleet IPC can fail
+                self.stats.slow_errors += 1
+                self._slow_err_log.report(e, path=path, lane=-1)
+                return [(item[0], None) for item in items]
+            return sorted(out, key=lambda t: t[0])
+        results = []
+        for lane, frame in ((item[0], item[1]) for item in items):
+            reply = None
+            try:
+                if self.slow_path is not None:
+                    reply = self.slow_path(frame)
+            except Exception as e:  # noqa: BLE001 — slow path is untrusted input
+                self.stats.slow_errors += 1
+                self._slow_err_log.report(e, path=path, lane=lane)
+            results.append((lane, reply))
+        return results
+
     def process(
         self,
         frames: list[bytes],
@@ -538,6 +573,8 @@ class Engine:
 
         out = {"tx": [], "fwd": [], "dropped": [], "slow": []}
         out_rows = None
+        slow_items = []  # non-punt PASS lanes, drained in one batch below
+        punt_lanes = []
         for i, v in enumerate(verdict):
             if v == VERDICT_TX:
                 if out_rows is None:
@@ -554,18 +591,21 @@ class Engine:
                 self.stats.dropped += 1
             else:
                 self.stats.passed += 1
-                reply = None
-                try:
-                    if punt[i]:
+                if punt[i]:
+                    try:
                         self._punt_new_flow(frames[i], int(now))
-                    elif self.slow_path is not None:
-                        reply = self.slow_path(frames[i])
-                except Exception as e:  # noqa: BLE001 — slow path is untrusted input
-                    self.stats.slow_errors += 1
-                    self._slow_err_log.report(e, path="process", lane=i)
-                out["slow"].append((i, reply))
+                    except Exception as e:  # noqa: BLE001 — untrusted input
+                        self.stats.slow_errors += 1
+                        self._slow_err_log.report(e, path="process", lane=i)
+                    punt_lanes.append(i)
+                else:
+                    slow_items.append((i, frames[i]))
             if viol[i] and self.violation_sink is not None:
                 self.violation_sink(i, frames[i])
+        out["slow"] = sorted(
+            [(i, None) for i in punt_lanes]
+            + self._handle_slow_lanes(slow_items, path="process"),
+            key=lambda t: t[0])
         return out
 
     # fast-lane compile-shape budget: every auto-sized control batch maps
@@ -623,6 +663,7 @@ class Engine:
         out = {"tx": [], "slow": []}
         out_rows = None
         ol = np.asarray(out_len)
+        slow_items = []
         for i, r in enumerate(reply):
             if r:
                 if out_rows is None:
@@ -631,14 +672,8 @@ class Engine:
                 self.stats.tx += 1
             else:
                 self.stats.passed += 1
-                rep = None
-                try:
-                    if self.slow_path is not None:
-                        rep = self.slow_path(frames[i])
-                except Exception as e:  # noqa: BLE001 — slow path is untrusted input
-                    self.stats.slow_errors += 1
-                    self._slow_err_log.report(e, path="process_dhcp", lane=i)
-                out["slow"].append((i, rep))
+                slow_items.append((i, frames[i]))
+        out["slow"] = self._handle_slow_lanes(slow_items, path="process_dhcp")
         return out
 
     def _place_dhcp_chain(self, device) -> None:
@@ -792,21 +827,27 @@ class Engine:
         # abort the drain: a partially drained slow ring would misalign
         # every later batch's lane/punt matching (and wedge PyRing).
         punt = np.asarray(res.nat_punt)[:n]
+        slow_items = []  # (lane, frame); from_access flags kept aside
+        slow_fa = {}
         for lane in np.nonzero(vv == VERDICT_PASS)[0]:
             got = ring.slow_pop()
             if got is None:
                 break  # slow ring overflowed during complete()
             frame, fl = got
-            try:
-                if punt[lane]:
+            if punt[lane]:
+                try:
                     self._punt_new_flow(frame, int(now))
-                elif self.slow_path is not None:
-                    reply = self.slow_path(frame)
-                    if reply is not None:
-                        ring.tx_inject(reply, from_access=(fl & 0x1) != 0)
-            except Exception as e:  # noqa: BLE001 — slow path is untrusted input
-                self.stats.slow_errors += 1
-                self._slow_err_log.report(e, path="ring", lane=int(lane))
+                except Exception as e:  # noqa: BLE001 — untrusted input
+                    self.stats.slow_errors += 1
+                    self._slow_err_log.report(e, path="ring", lane=int(lane))
+            else:
+                slow_items.append((int(lane), frame))
+                slow_fa[int(lane)] = (fl & 0x1) != 0
+        # fan-out/fan-in: replies come back re-merged in lane order, so
+        # TX injection keeps the slow ring's arrival order on the wire
+        for lane, reply in self._handle_slow_lanes(slow_items, path="ring"):
+            if reply is not None:
+                ring.tx_inject(reply, from_access=slow_fa[lane])
 
     def _staging(self, idx: int):
         """Ping-pong staging buffers (allocated once; the in-flight batch

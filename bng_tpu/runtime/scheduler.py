@@ -258,6 +258,14 @@ class TieredScheduler:
         out_rows = None
         eng._fold_stats(res)
         now = self.clock()
+        # batched slow-path fan-out (the fleet hook): collect every
+        # PASS lane, drain once, replies re-merged in lane order — the
+        # per-frame enqueue time rides along for deadline shedding
+        slow_items = [(i, p.frame, p.enq_t)
+                      for i, p in enumerate(entry.pending)
+                      if verdict[i] != VERDICT_TX]
+        replies = dict(eng._handle_slow_lanes(slow_items,
+                                              path="sched_express"))
         for i, p in enumerate(entry.pending):
             if verdict[i] == VERDICT_TX:
                 if out_rows is None:
@@ -267,14 +275,7 @@ class TieredScheduler:
                 self._complete(p, LANE_EXPRESS, "tx", frame, now)
             else:
                 eng.stats.passed += 1
-                reply = None
-                try:
-                    if eng.slow_path is not None:
-                        reply = eng.slow_path(p.frame)
-                except Exception as e:  # noqa: BLE001 — untrusted input
-                    eng.stats.slow_errors += 1
-                    eng._slow_err_log.report(e, path="sched_express", lane=i)
-                self._complete(p, LANE_EXPRESS, "slow", reply, now)
+                self._complete(p, LANE_EXPRESS, "slow", replies.get(i), now)
         self._observe_retire(LANE_EXPRESS, entry, now)
         return n
 
@@ -364,6 +365,21 @@ class TieredScheduler:
         out_rows = None
         eng._fold_stats(res)
         now = self.clock()
+        # NAT punts stay inline (parent-owned manager); everything else
+        # drains through the batched slow path in one fan-out
+        slow_items = []
+        for i, p in enumerate(entry.pending):
+            if int(vv[i]) in (VERDICT_TX, VERDICT_FWD, VERDICT_DROP):
+                continue
+            if punt[i]:
+                try:
+                    eng._punt_new_flow(p.frame, int(entry.dispatch_t))
+                except Exception as e:  # noqa: BLE001 — untrusted input
+                    eng.stats.slow_errors += 1
+                    eng._slow_err_log.report(e, path="sched_bulk", lane=i)
+            else:
+                slow_items.append((i, p.frame, p.enq_t))
+        replies = dict(eng._handle_slow_lanes(slow_items, path="sched_bulk"))
         for i, p in enumerate(entry.pending):
             v = int(vv[i])
             if v == VERDICT_TX or v == VERDICT_FWD:
@@ -381,16 +397,7 @@ class TieredScheduler:
                 self._complete(p, LANE_BULK, "drop", None, now)
             else:
                 eng.stats.passed += 1
-                reply = None
-                try:
-                    if punt[i]:
-                        eng._punt_new_flow(p.frame, int(entry.dispatch_t))
-                    elif eng.slow_path is not None:
-                        reply = eng.slow_path(p.frame)
-                except Exception as e:  # noqa: BLE001 — untrusted input
-                    eng.stats.slow_errors += 1
-                    eng._slow_err_log.report(e, path="sched_bulk", lane=i)
-                self._complete(p, LANE_BULK, "slow", reply, now)
+                self._complete(p, LANE_BULK, "slow", replies.get(i), now)
             if viol[i] and eng.violation_sink is not None:
                 eng.violation_sink(i, p.frame)
         self._observe_retire(LANE_BULK, entry, now)
