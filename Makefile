@@ -10,6 +10,12 @@
 #                       processes, a few thousand exchanges, CPU-only,
 #                       < 60 s — fleet regressions fail fast outside the
 #                       slow tier.
+#   make verify-chaos — fast seeded chaos sweep (< 60 s): the chaos-
+#                       marked tests (kill-at-every-fault-point, auditor
+#                       self-tests, scenario suite) plus a double run of
+#                       `bng chaos run --seed 7` compared byte-for-byte
+#                       (the bit-determinism acceptance gate). The long
+#                       soak lives under @pytest.mark.slow.
 
 SHELL := /bin/bash
 PY ?= python
@@ -17,7 +23,7 @@ TIER1_TIMEOUT ?= 870
 PYTEST_FLAGS = -q --continue-on-collection-errors -p no:cacheprovider \
                -p no:xdist -p no:randomly
 
-.PHONY: verify verify-slow verify-all verify-load
+.PHONY: verify verify-slow verify-all verify-load verify-chaos
 
 verify:
 	set -o pipefail; rm -f /tmp/_t1.log; \
@@ -28,6 +34,21 @@ verify-slow:
 	env JAX_PLATFORMS=cpu $(PY) -m pytest tests/ $(PYTEST_FLAGS) -m slow
 
 verify-all: verify verify-slow
+
+verify-chaos:
+	set -o pipefail; \
+	timeout -k 10 60 env JAX_PLATFORMS=cpu \
+	$(PY) -m pytest tests/test_chaos.py $(PYTEST_FLAGS) -m 'chaos and not slow'
+	set -o pipefail; \
+	timeout -k 10 30 env JAX_PLATFORMS=cpu \
+	$(PY) -m bng_tpu.cli chaos run --seed 7 > /tmp/_chaos_a.json \
+	&& timeout -k 10 30 env JAX_PLATFORMS=cpu \
+	$(PY) -m bng_tpu.cli chaos run --seed 7 > /tmp/_chaos_b.json \
+	&& test -s /tmp/_chaos_a.json \
+	&& cmp /tmp/_chaos_a.json /tmp/_chaos_b.json \
+	&& echo "verify-chaos OK: report bit-deterministic" \
+	|| { echo "verify-chaos FAILED: scenario failure or same-seed \
+	reports differ"; exit 1; }
 
 verify-load:
 	set -o pipefail; \

@@ -1345,6 +1345,58 @@ def _child_dispatch(config: int, verify_lowering: bool = False,
         sys.exit(1 if verify_lowering else 0)
 
 
+def chaos_overhead_bench() -> None:
+    """--chaos-overhead: price the DISARMED fault_point hook on the hot
+    path (PERF_NOTES §7). Two numbers:
+
+    1. ns/call of `fault_point()` with no injector armed (a module
+       global load + None compare) — the absolute cost every
+       instrumented site pays;
+    2. the slow-path fleet's renewal req/s measured over repeated runs,
+       whose run-to-run spread is the noise floor the per-frame hook
+       cost (~1 fault-point call per frame via admission.admit) must
+       sit below.
+
+    Pure host measurement — no device, no child process needed.
+    """
+    import timeit
+
+    from bng_tpu.chaos.faults import SimClock, fault_point
+    from bng_tpu.chaos.scenarios import (_mac, _renew, build_fleet,
+                                         dora_with_retries)
+
+    n = 2_000_000
+    per_call_ns = (timeit.Timer("fp('bench.point')",
+                                globals={"fp": fault_point}).timeit(n)
+                   / n * 1e9)
+
+    clock = SimClock()
+    fleet, _pools, _fastpath = build_fleet(2, clock, slice_size=1024)
+    macs = [_mac(i) for i in range(512)]
+    leased = dora_with_retries(fleet, macs, clock)
+    frames = [(i, _renew(m, leased[m], i)) for i, m in enumerate(macs)]
+    reps = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        for _b in range(4):
+            fleet.handle_batch(frames, now=clock())
+        dt = time.perf_counter() - t0
+        reps.append(4 * len(frames) / dt)
+    mean = sum(reps) / len(reps)
+    spread_pct = (max(reps) - min(reps)) / mean * 100.0
+    per_frame_ns = 1e9 / mean
+    overhead_pct = per_call_ns / per_frame_ns * 100.0
+    print(json.dumps({
+        "metric": "chaos_disarmed_overhead",
+        "fault_point_ns_per_call": round(per_call_ns, 1),
+        "slowpath_req_s_mean": round(mean),
+        "slowpath_req_s_runs": [round(r) for r in reps],
+        "run_to_run_spread_pct": round(spread_pct, 2),
+        "hook_overhead_per_frame_pct": round(overhead_pct, 4),
+        "below_noise": overhead_pct < spread_pct,
+    }))
+
+
 def main_dispatch() -> None:
     """Supervisor: run the benchmark in a killable child process.
 
@@ -1370,7 +1422,16 @@ def main_dispatch() -> None:
                     help="with --scheduler: run the warm-restart snapshot "
                          "cadence during the measured loops (quiesce + "
                          "save every N seconds) to price the barrier")
+    ap.add_argument("--chaos-overhead", action="store_true",
+                    help="measure the disarmed fault_point hook cost vs "
+                         "slow-path run-to-run noise (PERF_NOTES §7); "
+                         "host-only, no device")
     args = ap.parse_args()
+
+    if args.chaos_overhead:
+        # pure-host micro-measurement: nothing to hang on, no child
+        chaos_overhead_bench()
+        return
 
     if os.environ.get("BNG_BENCH_CHILD") == "1":
         _child_dispatch(args.config, verify_lowering=args.verify_lowering,

@@ -1,0 +1,32 @@
+"""Chaos/fault-injection + cross-authority invariant auditing.
+
+The product of an ISP-edge BNG is correctness under partial failure:
+worker death mid-DORA, corrupt snapshots, peer timeouts, clock skew,
+pool exhaustion. This package is the correctness backstop every perf PR
+runs against:
+
+- `faults`     — a seeded, deterministic `FaultPlan` and the
+                 near-zero-overhead `fault_point()` hook API wired into
+                 the fleet pipe protocol, admission controller,
+                 checkpoint writer/reader, engine dispatch/drain, the
+                 HA syncer and the NAT/lease expiry clocks.
+- `invariants` — the cross-authority auditor: proves the five state
+                 authorities (lease books, pool bitmap, fleet slices,
+                 host tables, device mirrors) never disagree, at the
+                 existing quiesce barrier.
+- `scenarios`  — scripted failure scenarios (DORA under worker crash,
+                 corrupt-restore-then-cold-start, fleet reshard under
+                 kill, NAT expiry under skew, HA delta loss).
+- `runner`     — the scenario/soak driver behind `bng chaos run` and
+                 `make verify-chaos`, emitting a bit-deterministic JSON
+                 report.
+
+Only `faults` is imported here: the instrumented runtime/control
+modules import `fault_point` from it, and a package __init__ that
+pulled in scenarios would create import cycles (scenarios import the
+modules that import us).
+"""
+
+from bng_tpu.chaos.faults import (FaultInjector, FaultPlan,  # noqa: F401
+                                  FaultSpec, armed, fault_point,
+                                  mutate_point)

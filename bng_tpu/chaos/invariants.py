@@ -1,0 +1,626 @@
+"""Cross-authority invariant auditor.
+
+Five authorities hold overlapping views of subscriber/session state:
+
+  1. the parent pool bitmaps   (control/pool.py  Pool._allocated)
+  2. the fleet lease slices    (control/fleet.py SlicePool per worker)
+  3. the lease books           (DHCPServer.leases, parent + per worker)
+  4. the host fast-path tables (runtime/tables.py FastPathTables)
+  5. the device mirrors        (Engine.tables — the HBM copies)
+
+plus the NAT manager's allocator/EIM/table triple. Every one of them is
+updated by a different code path (slow path, fleet relay, checkpoint
+restore, expiry sweeps), and a bug in any path shows up as two
+authorities disagreeing — the precondition for double-allocating an
+address or DNATing traffic to the wrong subscriber.
+
+`audit_invariants` proves, at the existing quiesce barrier (the same
+one checkpoints snapshot behind):
+
+  - no IP is owned by two of {parent pool bitmap, fleet worker slices,
+    lease books} — carve-leak, double-grant, double-lease;
+  - every leased IP is marked allocated in its owning authority;
+  - host FastPathTables rows match the device mirrors bit-exact after a
+    drain (krows/stash/vals per table, plus the dense pool/server
+    config), and no fast-path row outlives its lease;
+  - the NAT allocator, EIM map, _ext_ports index, session and reverse
+    tables are mutually consistent (block geometry, port ranges,
+    refcounts, reverse-row pairing);
+  - a checkpoint save -> decode round trip is state-identical
+    (meta + every array + re-encoded bytes).
+
+Violations come back as structured `Finding`s (bounded per kind),
+feed the bng_invariant_* metric families, and `AuditReport.to_dict()`
+is deterministic (sorted) so scenario reports diff clean.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+
+import numpy as np
+
+# per-kind cap: a systematically broken table would otherwise produce
+# one finding per row; the count still lands in violations_by_kind
+MAX_FINDINGS_PER_KIND = 16
+
+
+@dataclass(frozen=True)
+class Finding:
+    kind: str  # stable slug, the bng_invariant_violations_total label
+    subject: str  # the ip/mac/slot/table the violation is about
+    detail: str
+
+    def to_dict(self) -> dict:
+        return {"kind": self.kind, "subject": self.subject,
+                "detail": self.detail}
+
+
+@dataclass
+class AuditReport:
+    findings: list[Finding] = field(default_factory=list)
+    checks: dict[str, int] = field(default_factory=dict)  # coverage counts
+    suppressed: dict[str, int] = field(default_factory=dict)
+
+    @property
+    def ok(self) -> bool:
+        return not self.findings and not self.suppressed
+
+    def violations_by_kind(self) -> dict[str, int]:
+        out: dict[str, int] = {}
+        for f in self.findings:
+            out[f.kind] = out.get(f.kind, 0) + 1
+        for kind, extra in self.suppressed.items():
+            out[kind] = out.get(kind, 0) + extra
+        return dict(sorted(out.items()))
+
+    def add(self, kind: str, subject: str, detail: str) -> None:
+        if sum(1 for f in self.findings if f.kind == kind) \
+                >= MAX_FINDINGS_PER_KIND:
+            self.suppressed[kind] = self.suppressed.get(kind, 0) + 1
+            return
+        self.findings.append(Finding(kind, subject, detail))
+
+    def to_dict(self) -> dict:
+        return {
+            "ok": self.ok,
+            "checks": dict(sorted(self.checks.items())),
+            "violations_by_kind": self.violations_by_kind(),
+            "findings": [f.to_dict() for f in sorted(
+                self.findings, key=lambda f: (f.kind, f.subject))],
+        }
+
+
+# ---------------------------------------------------------------------------
+# lease book collection
+# ---------------------------------------------------------------------------
+
+def _fleet_worker_books(fleet) -> list[tuple[int, dict]] | None:
+    """[(worker_id, {mac_u64: Lease})] — direct object access in inline
+    mode, via the pipe protocol in process mode. None when a dead worker
+    makes the books unknowable (its carved slices stay allocated in the
+    parent, so consistency is preserved; coverage just shrinks)."""
+    if fleet is None:
+        return []
+    if fleet.mode == "inline":
+        return [(w, dict(worker.server.leases))
+                for w, worker in enumerate(fleet._inline)]
+    from bng_tpu.control.dhcp_server import DHCPServer
+
+    try:
+        state = fleet.export_state()
+    except (OSError, EOFError):
+        return None
+    out = []
+    for idx, wstate in enumerate(state["workers"]):
+        from bng_tpu.utils.net import mac_to_u64
+
+        _seq, leases = DHCPServer.parse_lease_state(wstate)
+        # export skips dead workers, so the list index is NOT the worker
+        # id — the entry carries its real id (older snapshots without it
+        # fall back to position)
+        w = int(wstate.get("worker_id", idx))
+        out.append((w, {mac_to_u64(l.mac): l for l in leases}))
+    return out
+
+
+def _audit_ownership(report: AuditReport, pools, dhcp, fleet,
+                     books) -> None:
+    """Authorities 1-3: pool bitmap vs fleet slices vs lease books.
+    `books` is the one fleet-book snapshot shared with the fastpath-row
+    check (one export round-trip, one consistent cut)."""
+    if pools is None:
+        return
+    # slice carve invariants (fleet workers' granted sets vs the parent)
+    granted_by: dict[int, list[int]] = {}  # ip -> [worker]
+    workers = fleet._inline if (fleet is not None
+                                and fleet.mode == "inline") else []
+    n_granted = 0
+    for w, worker in enumerate(workers):
+        owner_tag = f"fleet:w{w}"
+        for pid, sp in worker.pools.pools.items():
+            parent = pools.pools.get(pid)
+            for ip in sp._granted:
+                n_granted += 1
+                granted_by.setdefault(ip, []).append(w)
+                if parent is None:
+                    report.add("carve-leak", _ip(ip),
+                               f"worker {w} slice references unknown "
+                               f"pool {pid}")
+                elif parent._allocated.get(ip) != owner_tag:
+                    report.add(
+                        "carve-leak", _ip(ip),
+                        f"granted to worker {w} but parent pool {pid} "
+                        f"owner is {parent._allocated.get(ip)!r} "
+                        f"(expected {owner_tag!r})")
+            for ip in sp._allocated:
+                if ip not in sp._granted:
+                    report.add("slice-alloc-outside-grant", _ip(ip),
+                               f"worker {w} allocated an address outside "
+                               f"its granted slice of pool {pid}")
+    for ip, ws in granted_by.items():
+        if len(ws) > 1:
+            report.add("double-grant", _ip(ip),
+                       f"address granted to workers {sorted(ws)}")
+    report.checks["slice_granted"] = n_granted
+
+    # lease books: parent server + every fleet worker
+    entries: list[tuple[str, int, object]] = []  # (source, mac_u64, lease)
+    if dhcp is not None:
+        entries += [("parent", mk, l) for mk, l in dhcp.leases.items()]
+    if books is None:
+        report.checks["fleet_books_unreadable"] = 1
+        books = []
+    for w, book in books:
+        entries += [(f"w{w}", mk, l) for mk, l in book.items()]
+    report.checks["leases"] = len(entries)
+
+    by_ip: dict[int, list[tuple[str, int]]] = {}
+    by_mac: dict[int, list[str]] = {}
+    for src, mk, lease in entries:
+        by_ip.setdefault(lease.ip, []).append((src, mk))
+        by_mac.setdefault(mk, []).append(src)
+        # every leased IP must be marked allocated in its owning
+        # authority: the worker's slice for fleet leases (inline mode —
+        # process-mode slices live in the child), the parent pool for
+        # parent leases
+        if src.startswith("w") and fleet is not None \
+                and fleet.mode == "inline":
+            w = int(src[1:])
+            sp = workers[w].pools.pool_for_ip(lease.ip)
+            if sp is None or lease.ip not in sp._allocated:
+                report.add("lease-not-allocated", _ip(lease.ip),
+                           f"worker {w} lease (mac {lease.mac.hex()}) "
+                           f"not allocated in its slice")
+        pool = pools.pool_for_ip(lease.ip)
+        if pool is None:
+            report.add("lease-outside-pools", _ip(lease.ip),
+                       f"{src} lease (mac {lease.mac.hex()}) is outside "
+                       f"every configured pool")
+        elif src == "parent" and lease.ip not in pool._allocated:
+            report.add("lease-not-allocated", _ip(lease.ip),
+                       f"parent lease (mac {lease.mac.hex()}) not "
+                       f"allocated in pool {pool.pool_id}")
+        elif pool is not None and lease.ip == pool.gateway:
+            report.add("gateway-leased", _ip(lease.ip),
+                       f"{src} leased the pool {pool.pool_id} gateway")
+    for ip, owners in by_ip.items():
+        if len(owners) > 1:
+            macs = sorted({f"{s}:{mk:012x}" for s, mk in owners})
+            report.add("double-lease", _ip(ip),
+                       f"leased by {len(owners)} owners: {macs}")
+    for mk, srcs in by_mac.items():
+        if len(srcs) > 1:
+            report.add("mac-double-lease", f"{mk:012x}",
+                       f"one MAC holds leases in {sorted(srcs)}")
+
+
+# ---------------------------------------------------------------------------
+# fast-path tables: rows vs leases, host vs device
+# ---------------------------------------------------------------------------
+
+def _collect_lease_index(dhcp, fleet, books) -> dict[int, int] | None:
+    """mac_u64 -> ip over every lease book (the shared `books` snapshot),
+    or None when books are unknowably partial."""
+    idx: dict[int, int] = {}
+    if dhcp is not None:
+        for mk, lease in dhcp.leases.items():
+            idx[mk] = lease.ip
+    if fleet is not None and fleet.mode == "process" and fleet._dead:
+        # a dead process's book is gone but its subscribers still hold
+        # their leases — rows for them are NOT stale, just unprovable
+        return None
+    if books is None:
+        return None
+    for _w, book in books:
+        for mk, lease in book.items():
+            idx[mk] = lease.ip
+    return idx
+
+
+def _audit_fastpath_rows(report: AuditReport, fastpath, dhcp, fleet,
+                         books) -> None:
+    """Authority 4 vs 3: no subscriber row outlives (or contradicts) its
+    lease. One-directional by design — a lease WITHOUT a row is only a
+    fast-path miss (the slow path re-answers; restores that hydrate
+    books but not tables are legal), but a row without a lease would
+    device-ACK an address nobody holds."""
+    if fastpath is None or (dhcp is None and fleet is None):
+        # without any lease book there is nothing to cross-check rows
+        # against (bench-style bulk installs are legal book-less rows)
+        return
+    idx = _collect_lease_index(dhcp, fleet, books)
+    if idx is None:
+        return
+    sub = fastpath.sub
+    occupied = np.nonzero(sub.used)[0]
+    report.checks["fastpath_rows"] = len(occupied)
+    from bng_tpu.ops.dhcp import AV_IP
+
+    for s in occupied:
+        hi, lo = int(sub.keys[s][0]), int(sub.keys[s][1])
+        mk = (hi << 32) | lo
+        row_ip = int(sub.vals[s][AV_IP])
+        got = idx.get(mk)
+        if got is None:
+            report.add("fastpath-stale-row", f"{mk:012x}",
+                       f"subscriber row (ip {_ip(row_ip)}) has no live "
+                       f"lease in any book")
+        elif got != row_ip:
+            report.add("fastpath-ip-mismatch", f"{mk:012x}",
+                       f"row ip {_ip(row_ip)} != leased ip {_ip(got)}")
+
+
+def _table_mirror_findings(report: AuditReport, host, dev_state,
+                           label: str) -> None:
+    """One HostTable vs its device TableState, bit-exact. Caller must
+    have drained (dirty_count()==0) — pending deltas are legal lag, not
+    divergence."""
+    exp_krows = host._pack_bucket_rows(np.arange(host.nbuckets))
+    exp_stash = host._pack_stash_rows(np.arange(host.stash))
+    got_krows = np.asarray(dev_state.krows)
+    got_stash = np.asarray(dev_state.stash_rows)
+    got_vals = np.asarray(dev_state.vals)
+    report.checks[f"mirror_buckets.{label}"] = host.nbuckets
+    if exp_krows.shape != got_krows.shape:
+        report.add("mirror-mismatch", label,
+                   f"krows shape {got_krows.shape} != host "
+                   f"{exp_krows.shape}")
+        return
+    bad = np.nonzero((exp_krows != got_krows).any(axis=1))[0]
+    for b in bad[:4]:
+        report.add("mirror-mismatch", f"{label}/bucket{int(b)}",
+                   "device probe row differs from host mirror")
+    if len(bad) > 4:
+        report.add("mirror-mismatch", label,
+                   f"{len(bad)} buckets diverge in total")
+    if not np.array_equal(exp_stash, got_stash):
+        report.add("mirror-mismatch", f"{label}/stash",
+                   "device stash rows differ from host mirror")
+    if host.vals.shape != got_vals.shape \
+            or not np.array_equal(host.vals, got_vals):
+        bad_v = (np.nonzero((host.vals != got_vals).any(axis=1))[0]
+                 if host.vals.shape == got_vals.shape else [])
+        for s in bad_v[:4]:
+            report.add("mirror-mismatch", f"{label}/slot{int(s)}",
+                       "device value row differs from host mirror")
+        if len(bad_v) > 4 or host.vals.shape != got_vals.shape:
+            report.add("mirror-mismatch", f"{label}/vals",
+                       "device value array differs from host mirror")
+
+
+def _audit_device_mirror(report: AuditReport, engine,
+                         max_drain_steps: int = 64) -> None:
+    """Authority 5 vs 4: after draining every pending delta, the HBM
+    DHCP tables must equal the host mirrors bit-exact. Only the DHCP
+    fast-path tables are compared — NAT session values and QoS token
+    words are device-WRITTEN (fold_device_authoritative owns those)."""
+    if engine is None:
+        return
+    fastpath = engine.fastpath
+    steps = 0
+    while fastpath.dirty_count() > 0 and steps < max_drain_steps:
+        # an empty batch still runs the bounded update drain (and a
+        # bulk-build resync if one is pending) — the cheapest way to
+        # ship the remaining deltas without inventing a second drain path
+        engine.process([])
+        steps += 1
+    if fastpath.dirty_count() > 0:
+        report.add("mirror-undrained", "fastpath",
+                   f"{fastpath.dirty_count()} dirty slots after "
+                   f"{steps} drain steps")
+        return
+    engine.quiesce()
+    report.checks["mirror_drain_steps"] = steps
+    for t in ("sub", "vlan", "cid"):
+        _table_mirror_findings(report, getattr(fastpath, t),
+                               getattr(engine.tables.dhcp, t),
+                               f"fastpath.{t}")
+    if not np.array_equal(fastpath.pools,
+                          np.asarray(engine.tables.dhcp.pools)):
+        report.add("mirror-mismatch", "fastpath.pools",
+                   "device pool config differs from host")
+    if not np.array_equal(fastpath.server,
+                          np.asarray(engine.tables.dhcp.server)):
+        report.add("mirror-mismatch", "fastpath.server",
+                   "device server config differs from host")
+
+
+# ---------------------------------------------------------------------------
+# NAT: allocator / EIM / tables
+# ---------------------------------------------------------------------------
+
+def _audit_nat(report: AuditReport, nat) -> None:
+    if nat is None:
+        return
+    from bng_tpu.ops.nat44 import (BV_PORT_END, BV_PORT_START, BV_PUBLIC_IP,
+                                   FLAG_EIM, SV_NAT_IP, SV_NAT_PORT,
+                                   SV_ORIG_IP, SV_ORIG_PORT, SV_PROTO)
+    from bng_tpu.ops.parse import PROTO_ICMP
+
+    report.checks["nat_blocks"] = len(nat.blocks)
+    # blocks <-> sub_nat rows
+    for priv_ip, blk in nat.blocks.items():
+        row = nat.sub_nat.lookup([priv_ip])
+        if row is None:
+            report.add("nat-block-row-missing", _ip(priv_ip),
+                       "allocator block has no subscriber_nat row")
+            continue
+        if (int(row[BV_PUBLIC_IP]) != blk["public_ip"]
+                or int(row[BV_PORT_START]) != blk["port_start"]
+                or int(row[BV_PORT_END]) != blk["port_end"]):
+            report.add("nat-block-row-mismatch", _ip(priv_ip),
+                       f"row ({_ip(int(row[BV_PUBLIC_IP]))} "
+                       f"{int(row[BV_PORT_START])}-{int(row[BV_PORT_END])}) "
+                       f"!= block ({_ip(blk['public_ip'])} "
+                       f"{blk['port_start']}-{blk['port_end']})")
+    n_rows = int(np.count_nonzero(nat.sub_nat.used))
+    if n_rows != len(nat.blocks):
+        report.add("nat-subnat-count", "subscriber_nat",
+                   f"{n_rows} rows != {len(nat.blocks)} allocator blocks")
+
+    # block carving: per public IP the allocated+free block starts must
+    # be disjoint, uniform-size and behind the cursor
+    by_pub: dict[int, list[tuple[int, int, str]]] = {}
+    span = nat.ports_per_subscriber
+    for priv_ip, blk in nat.blocks.items():
+        by_pub.setdefault(blk["public_ip"], []).append(
+            (blk["port_start"], blk["port_end"], _ip(priv_ip)))
+        if blk["port_end"] - blk["port_start"] + 1 != span:
+            report.add("nat-block-geometry", _ip(priv_ip),
+                       f"block span {blk['port_end'] - blk['port_start'] + 1}"
+                       f" != ports_per_subscriber {span}")
+    for pub_ip, starts in nat._free_blocks.items():
+        if len(starts) != len(set(starts)):
+            report.add("nat-free-duplicate", _ip(pub_ip),
+                       "free-block list holds duplicate starts")
+        allocated = {s for s, _e, _p in by_pub.get(pub_ip, [])}
+        for s in starts:
+            if s in allocated:
+                report.add("nat-free-allocated-overlap", _ip(pub_ip),
+                           f"block start {s} is both free and allocated")
+            if s + span - 1 >= nat._next_block.get(pub_ip, 0) + span:
+                report.add("nat-free-past-cursor", _ip(pub_ip),
+                           f"free block {s} lies beyond the carve cursor")
+    for pub_ip, ranges in by_pub.items():
+        cursor = nat._next_block.get(pub_ip)
+        prev_end, prev_sub = -1, ""
+        for start, end, sub in sorted(ranges):
+            if start <= prev_end:
+                report.add("nat-block-overlap", _ip(pub_ip),
+                           f"blocks of {prev_sub} and {sub} overlap "
+                           f"at port {start}")
+            prev_end, prev_sub = end, sub
+            if cursor is not None and start >= cursor:
+                report.add("nat-cursor-behind", _ip(pub_ip),
+                           f"block {start}-{end} ({sub}) sits at/past the "
+                           f"carve cursor {cursor} — a future carve would "
+                           f"re-issue it")
+
+    # EIM <-> _ext_ports bijection, mappings inside the owner's block
+    report.checks["nat_eim"] = len(nat.eim)
+    for key, m in nat.eim.items():
+        int_ip, _int_port, proto = key
+        ext = (m[0], m[1], proto)
+        if nat._ext_ports.get(ext) != key:
+            report.add("nat-eim-extports-mismatch", _ip(int_ip),
+                       f"eim {key} -> {ext} not indexed back")
+        if m[2] <= 0:
+            report.add("nat-eim-refcount", _ip(int_ip),
+                       f"eim {key} refcount {m[2]} <= 0 but still mapped")
+        blk = nat.blocks.get(int_ip)
+        if blk is None:
+            report.add("nat-eim-orphan", _ip(int_ip),
+                       f"eim {key} has no allocator block")
+        elif (m[0] != blk["public_ip"]
+              or not blk["port_start"] <= m[1] <= blk["port_end"]):
+            report.add("nat-eim-outside-block", _ip(int_ip),
+                       f"mapping {_ip(m[0])}:{m[1]} outside block "
+                       f"{blk['port_start']}-{blk['port_end']}")
+    for ext, key in nat._ext_ports.items():
+        if key not in nat.eim:
+            report.add("nat-eim-extports-mismatch", _ip(ext[0]),
+                       f"ext port {ext} indexes a vanished eim {key}")
+
+    # sessions <-> reverse pairing + per-endpoint refcounts
+    occupied = np.nonzero(nat.sessions.used)[0]
+    report.checks["nat_sessions"] = len(occupied)
+    ep_counts: dict[tuple[int, int, int], int] = {}
+    for s in occupied:
+        key = nat.sessions.keys[s]
+        v = nat.sessions.vals[s]
+        src_ip, dst_ip = int(key[0]), int(key[1])
+        ports, proto = int(key[2]), int(key[3])
+        src_port, dst_port = ports >> 16, ports & 0xFFFF
+        nat_ip, nat_port = int(v[SV_NAT_IP]), int(v[SV_NAT_PORT])
+        blk = nat.blocks.get(src_ip)
+        if blk is None:
+            report.add("nat-session-orphan", _ip(src_ip),
+                       f"session slot {int(s)} has no allocator block")
+        elif (nat_ip != blk["public_ip"]
+              or not blk["port_start"] <= nat_port <= blk["port_end"]):
+            report.add("nat-session-outside-block", _ip(src_ip),
+                       f"session maps to {_ip(nat_ip)}:{nat_port} outside "
+                       f"block {blk['port_start']}-{blk['port_end']}")
+        r_src = 0 if proto == PROTO_ICMP else dst_port
+        rkey = nat._key(dst_ip, nat_ip, r_src, nat_port, proto)
+        rv = nat.reverse.lookup(rkey)
+        if rv is None or not np.array_equal(
+                np.asarray(rv, dtype=np.uint32),
+                np.asarray(key, dtype=np.uint32)):
+            report.add("nat-missing-reverse", _ip(src_ip),
+                       f"session slot {int(s)} has no matching reverse row")
+        ep = (int(v[SV_ORIG_IP]), int(v[SV_ORIG_PORT]), int(v[SV_PROTO]))
+        ep_counts[ep] = ep_counts.get(ep, 0) + 1
+    n_rev = int(np.count_nonzero(nat.reverse.used))
+    if n_rev != len(occupied):
+        report.add("nat-reverse-count", "nat_reverse",
+                   f"{n_rev} reverse rows != {len(occupied)} sessions "
+                   f"(orphan reverse rows DNAT dead flows)")
+    if nat.flags & FLAG_EIM:
+        for ep, n in ep_counts.items():
+            m = nat.eim.get(ep)
+            if m is not None and m[2] != n:
+                report.add("nat-eim-refcount", _ip(ep[0]),
+                           f"eim {ep} refcount {m[2]} != {n} live sessions")
+
+
+# ---------------------------------------------------------------------------
+# checkpoint round trip
+# ---------------------------------------------------------------------------
+
+def _audit_checkpoint_roundtrip(report: AuditReport, *, fastpath=None,
+                                nat=None, dhcp=None, fleet=None,
+                                ha=None) -> None:
+    """save -> encode -> decode must be state-identical: same meta, same
+    arrays, and a re-encode of the decode is byte-identical. Runs with
+    engine=None — the caller already quiesced; this must not re-enter
+    the barrier."""
+    from bng_tpu.runtime.checkpoint import (build_checkpoint,
+                                            decode_checkpoint,
+                                            encode_checkpoint)
+
+    if fastpath is None and nat is None and dhcp is None and fleet is None:
+        return
+    c1 = build_checkpoint(0, 0.0, fastpath=fastpath, nat=nat, dhcp=dhcp,
+                          fleet=fleet, ha=ha, node_id="audit")
+    e1 = encode_checkpoint(c1)
+    report.checks["ckpt_bytes"] = len(e1)
+    try:
+        d = decode_checkpoint(e1)
+    except Exception as e:  # noqa: BLE001 — a reject IS the finding
+        report.add("ckpt-roundtrip-reject", "checkpoint",
+                   f"fresh snapshot failed to decode: {e}")
+        return
+    if json.dumps(c1.meta, sort_keys=True) != json.dumps(d.meta,
+                                                         sort_keys=True):
+        report.add("ckpt-roundtrip-mismatch", "meta",
+                   "decoded meta differs from the snapshot")
+    if sorted(c1.arrays) != sorted(d.arrays):
+        report.add("ckpt-roundtrip-mismatch", "arrays",
+                   f"array manifest differs: {sorted(c1.arrays)[:4]}... vs "
+                   f"{sorted(d.arrays)[:4]}...")
+        return
+    for name in sorted(c1.arrays):
+        if not np.array_equal(np.asarray(c1.arrays[name]),
+                              d.arrays[name]):
+            report.add("ckpt-roundtrip-mismatch", name,
+                       "decoded array differs from the snapshot")
+    if encode_checkpoint(d) != e1:
+        report.add("ckpt-roundtrip-mismatch", "bytes",
+                   "re-encoding the decode is not byte-identical")
+
+
+# ---------------------------------------------------------------------------
+# entry points
+# ---------------------------------------------------------------------------
+
+def _ip(ip: int) -> str:
+    from bng_tpu.utils.net import u32_to_ip
+
+    try:
+        return u32_to_ip(int(ip))
+    except Exception:  # noqa: BLE001 — a bad value is still a subject
+        return str(ip)
+
+
+def audit_invariants(*, engine=None, scheduler=None, fastpath=None,
+                     pools=None, dhcp=None, fleet=None, nat=None,
+                     ha_pair=None, quiesce=True, check_roundtrip=True,
+                     metrics=None, epoch=None) -> AuditReport:
+    """Run every applicable invariant over the components given.
+
+    With an `engine`, runs at the same drain barrier checkpoints use
+    (scheduler.quiesce() when a scheduler owns the loop, else
+    engine.quiesce()) and includes the host-vs-device mirror proof;
+    fastpath/nat default from the engine. `ha_pair=(active, standby)`
+    adds the replication-divergence check. `metrics` (BNGMetrics) gets
+    the bng_invariant_* families recorded; `epoch` stamps
+    bng_invariant_last_audit_epoch (defaults to the audit counter).
+    """
+    report = AuditReport()
+    if engine is not None:
+        if quiesce:
+            if scheduler is not None:
+                scheduler.quiesce()
+            else:
+                engine.quiesce()
+        fastpath = fastpath if fastpath is not None else engine.fastpath
+        nat = nat if nat is not None else engine.nat
+
+    # ONE fleet-book snapshot (one export IPC round-trip in process
+    # mode) shared by the ownership and fastpath-row checks, so both
+    # reason about the same consistent cut
+    books = _fleet_worker_books(fleet)
+    _audit_ownership(report, pools, dhcp, fleet, books)
+    _audit_fastpath_rows(report, fastpath, dhcp, fleet, books)
+    _audit_device_mirror(report, engine)
+    _audit_nat(report, nat)
+    if check_roundtrip:
+        active = None
+        if ha_pair is not None:
+            active = ha_pair[0]
+        _audit_checkpoint_roundtrip(report, fastpath=fastpath, nat=nat,
+                                    dhcp=dhcp, fleet=fleet, ha=active)
+    if ha_pair is not None:
+        _audit_ha_pair(report, *ha_pair)
+
+    if metrics is not None:
+        metrics.record_audit(report, epoch=epoch)
+    return report
+
+
+def _audit_ha_pair(report: AuditReport, active, standby) -> None:
+    """A CONNECTED standby must mirror the active's session store
+    exactly (a disconnected one is allowed to lag — reconnect heals via
+    replay_since/full_sync)."""
+    if active is None or standby is None or not getattr(
+            standby, "connected", False):
+        return
+    a = {s.session_id: s.to_dict() for s in active.store.all()}
+    b = {s.session_id: s.to_dict() for s in standby.store.all()}
+    report.checks["ha_sessions"] = len(a)
+    for sid in sorted(set(a) | set(b)):
+        if sid not in a:
+            report.add("ha-store-divergence", sid,
+                       "standby holds a session the active deleted")
+        elif sid not in b:
+            report.add("ha-store-divergence", sid,
+                       "connected standby is missing an active session")
+        elif a[sid] != b[sid]:
+            report.add("ha-store-divergence", sid,
+                       "session state differs between active and standby")
+
+
+def audit_app(app, metrics=None, epoch=None) -> AuditReport:
+    """Audit a composed BNGApp (the `bng chaos audit` /
+    `bng checkpoint restore --audit` entry): pulls the live components
+    out of the composition root and runs the full invariant set."""
+    c = app.components
+    return audit_invariants(
+        engine=c.get("engine"), scheduler=c.get("scheduler"),
+        fastpath=c.get("fastpath"), pools=c.get("pools"),
+        dhcp=c.get("dhcp"), fleet=c.get("fleet"), nat=c.get("nat"),
+        metrics=metrics if metrics is not None else c.get("metrics"),
+        epoch=epoch)

@@ -1,0 +1,527 @@
+"""Scripted chaos scenarios — deterministic, device-free, audited.
+
+Every scenario is a pure function `(seed) -> dict`: it builds its own
+small component stack (inline fleet / parent server / NAT manager / HA
+pair) on a `SimClock`, arms a pinned `FaultPlan`, drives real protocol
+traffic through the real code paths, and finishes with a cross-authority
+invariant audit. The contract the suite enforces:
+
+    faults may degrade SERVICE (lost DORAs, shed frames, late replies)
+    but never CONSISTENCY (the closing audit must be clean).
+
+Reports contain no wallclock, no filesystem paths and no object ids —
+two runs with the same seed emit byte-identical JSON (the
+`bng chaos run --seed S` acceptance gate).
+
+Scenario list:
+
+    dora_worker_crash         kill a fleet worker at every scatter hit
+                              (plus a fault-free control sweep)
+    corrupt_restore_cold_start truncation/bit-flip/io-error on the
+                              checkpoint write+read paths: reject, fall
+                              back to the previous good file, cold-start
+                              semantics, then a clean restore
+    fleet_reshard_under_kill  kill a worker mid-traffic, checkpoint the
+                              books, restore onto a smaller fleet
+    nat_expiry_under_skew     forward/backward clock skew over the NAT
+                              expiry sweep; EIM/reverse/block bookkeeping
+                              must survive both directions
+    ha_delta_drop_reconnect   replication stream dies mid-delta + peer
+                              timeout on reconnect; replay_since heals
+"""
+
+from __future__ import annotations
+
+import random
+
+import numpy as np
+
+from bng_tpu.chaos.faults import (BITFLIP, DROP_DELTA, FAIL, IO_ERROR, KILL,
+                                  SKEW, TRUNCATE, FaultPlan, FaultSpec,
+                                  SimClock, armed)
+from bng_tpu.chaos.invariants import audit_invariants
+from bng_tpu.control import dhcp_codec, packets
+from bng_tpu.control.pool import Pool, PoolManager
+from bng_tpu.utils.net import ip_to_u32
+
+SERVER_MAC = bytes.fromhex("02aabbccdd01")
+SERVER_IP = ip_to_u32("10.0.0.1")
+
+
+# ---------------------------------------------------------------------------
+# shared builders (geometry matches tests/test_fleet.py so a test session
+# never compiles anything extra for chaos)
+# ---------------------------------------------------------------------------
+
+def _mac(i: int) -> bytes:
+    return (0x02C5 << 32 | i).to_bytes(6, "big")
+
+
+def _discover(mac: bytes, xid: int) -> bytes:
+    p = dhcp_codec.build_request(mac, dhcp_codec.DISCOVER, xid=xid)
+    return packets.udp_packet(mac, b"\xff" * 6, 0, 0xFFFFFFFF, 68, 67,
+                              p.encode().ljust(300, b"\x00"))
+
+
+def _request(mac: bytes, ip: int, xid: int) -> bytes:
+    p = dhcp_codec.build_request(mac, dhcp_codec.REQUEST, xid=xid,
+                                 requested_ip=ip, server_id=SERVER_IP)
+    return packets.udp_packet(mac, b"\xff" * 6, 0, 0xFFFFFFFF, 68, 67,
+                              p.encode().ljust(300, b"\x00"))
+
+
+def _renew(mac: bytes, ip: int, xid: int) -> bytes:
+    p = dhcp_codec.build_request(mac, dhcp_codec.REQUEST, xid=xid, ciaddr=ip)
+    return packets.udp_packet(mac, b"\xff" * 6, ip, SERVER_IP, 68, 67,
+                              p.encode().ljust(300, b"\x00"))
+
+
+def _release(mac: bytes, ip: int, xid: int) -> bytes:
+    p = dhcp_codec.build_request(mac, dhcp_codec.RELEASE, xid=xid, ciaddr=ip)
+    return packets.udp_packet(mac, b"\xff" * 6, ip, SERVER_IP, 68, 67,
+                              p.encode().ljust(300, b"\x00"))
+
+
+def _reply(frame: bytes) -> dhcp_codec.DHCPPacket:
+    return dhcp_codec.decode(packets.decode(frame).payload)
+
+
+def _make_fastpath():
+    from bng_tpu.runtime.tables import FastPathTables
+
+    fp = FastPathTables(sub_nbuckets=512, vlan_nbuckets=64, cid_nbuckets=64,
+                        max_pools=16)
+    fp.set_server_config(SERVER_MAC, SERVER_IP)
+    return fp
+
+
+def _make_pools(fastpath=None, cidr_net: str = "10.0.0.0",
+                prefix_len: int = 20):
+    pools = PoolManager(fastpath)
+    pools.add_pool(Pool(pool_id=1, network=ip_to_u32(cidr_net),
+                        prefix_len=prefix_len, gateway=SERVER_IP,
+                        dns_primary=ip_to_u32("1.1.1.1"), lease_time=3600))
+    return pools
+
+
+def build_fleet(n_workers: int, clock, slice_size: int = 64):
+    """Inline fleet + parent pools + host fast-path tables — the
+    deterministic stack every fleet scenario runs on."""
+    from bng_tpu.control.fleet import FleetSpec, SlowPathFleet
+
+    fastpath = _make_fastpath()
+    pools = _make_pools(fastpath)
+    spec = FleetSpec.from_pool_manager(SERVER_MAC, SERVER_IP, pools,
+                                       slice_size=slice_size,
+                                       low_watermark=max(1, slice_size // 4))
+    fleet = SlowPathFleet(spec, n_workers, pools, mode="inline",
+                          table_sink=fastpath, clock=clock)
+    return fleet, pools, fastpath
+
+
+def dora_with_retries(fleet, macs, clock, rounds: int = 6) -> dict:
+    """Drive each MAC through DORA, retransmitting lost exchanges once
+    per round (the client-retry behavior every fault scenario leans on).
+    Returns {mac: leased_ip}."""
+    offers: dict[bytes, int] = {}
+    leased: dict[bytes, int] = {}
+    xid = 1
+    for _ in range(rounds):
+        batch, batch_macs = [], []
+        for m in macs:
+            if m in leased:
+                continue
+            if m in offers:
+                batch.append((len(batch), _request(m, offers[m], xid)))
+            else:
+                batch.append((len(batch), _discover(m, xid)))
+            batch_macs.append(m)
+            xid += 1
+        if not batch:
+            break
+        out = fleet.handle_batch(batch, now=clock())
+        for (_lane, rep), m in zip(out, batch_macs):
+            if rep is None:
+                continue
+            p = _reply(rep)
+            if p.msg_type == dhcp_codec.OFFER:
+                offers[m] = p.yiaddr
+            elif p.msg_type == dhcp_codec.ACK:
+                leased[m] = p.yiaddr
+            elif p.msg_type == dhcp_codec.NAK:
+                offers.pop(m, None)
+        clock.advance(1.0)
+    return leased
+
+
+# ---------------------------------------------------------------------------
+# 1. DORA under worker crash, killed at every fault-point hit
+# ---------------------------------------------------------------------------
+
+def dora_worker_crash(seed: int) -> dict:
+    """Sweep the kill fault across scatter hits 0 (control: no fault)
+    through 6. Each killed shard loses service — clients retransmit,
+    survivors complete — but every sweep must audit clean."""
+    n_macs, workers = 12, 3
+    macs = [_mac((seed % 97) * 100 + i) for i in range(n_macs)]
+    sweeps = []
+    for hit in range(0, 7):
+        clock = SimClock()
+        fleet, pools, fastpath = build_fleet(workers, clock)
+        specs = ([] if hit == 0
+                 else [FaultSpec("fleet.scatter", KILL, at_hit=hit)])
+        with armed(FaultPlan(seed=seed, specs=specs), log=False) as inj:
+            leased = dora_with_retries(fleet, macs, clock)
+        audit = audit_invariants(pools=pools, fleet=fleet,
+                                 fastpath=fastpath)
+        sweeps.append({
+            "kill_at_hit": hit,
+            "leased": len(leased),
+            "unique_ips": len(set(leased.values())),
+            "faults": len(inj.injected),
+            "worker_failures": fleet.worker_failures,
+            "audit_ok": audit.ok,
+            "violations": audit.violations_by_kind(),
+        })
+    control = sweeps[0]
+    ok = (all(s["audit_ok"] for s in sweeps)
+          and control["leased"] == n_macs
+          and all(s["unique_ips"] == s["leased"] for s in sweeps)
+          and any(s["faults"] for s in sweeps[1:]))
+    return {"name": "dora_worker_crash", "seed": seed, "ok": ok,
+            "sweeps": sweeps}
+
+
+# ---------------------------------------------------------------------------
+# 2. corrupt restore -> reject -> fall back / cold start -> clean restore
+# ---------------------------------------------------------------------------
+
+def _build_server_stack(clock):
+    """Parent-only stack (no fleet): DHCP server + pools + fast path +
+    NAT, the single-worker authority set."""
+    from bng_tpu.control.dhcp_server import DHCPServer
+    from bng_tpu.control.nat import NATManager
+
+    fastpath = _make_fastpath()
+    pools = _make_pools(fastpath)
+    nat = NATManager(public_ips=[ip_to_u32("203.0.113.1")],
+                     ports_per_subscriber=64,
+                     sessions_nbuckets=256, sub_nat_nbuckets=64)
+    server = DHCPServer(SERVER_MAC, SERVER_IP, pools,
+                        fastpath_tables=fastpath,
+                        nat_hook=lambda ip, now: nat.allocate_nat(ip,
+                                                                  int(now)),
+                        clock=clock)
+    return server, pools, fastpath, nat
+
+
+def _dora_server(server, macs) -> dict:
+    leased = {}
+    for i, m in enumerate(macs):
+        off = server.handle_frame(_discover(m, 1000 + i))
+        ip = _reply(off).yiaddr
+        ack = server.handle_frame(_request(m, ip, 2000 + i))
+        assert _reply(ack).msg_type == dhcp_codec.ACK
+        leased[m] = ip
+    return leased
+
+
+def corrupt_restore_cold_start(seed: int) -> dict:
+    """A corrupt snapshot must never silently serve traffic: write-side
+    truncation lands a bad file that load_latest skips in favor of the
+    previous good one; read-side bit-flips reject at decode; io_error
+    surfaces; and the good checkpoint restores state-identical into a
+    fresh (cold-started) stack that audits clean."""
+    import tempfile
+
+    from bng_tpu.control.statestore import CheckpointStore
+    from bng_tpu.runtime.checkpoint import (CheckpointError,
+                                            build_checkpoint,
+                                            restore_checkpoint)
+
+    clock = SimClock()
+    server, pools, fastpath, nat = _build_server_stack(clock)
+    macs = [_mac((seed % 89) * 100 + i) for i in range(8)]
+    leased = _dora_server(server, macs)
+
+    out = {"name": "corrupt_restore_cold_start", "seed": seed}
+    with tempfile.TemporaryDirectory() as td:
+        store = CheckpointStore(td)
+        good = store.save(build_checkpoint(
+            store.next_seq(), clock(), fastpath=fastpath, nat=nat,
+            dhcp=server, node_id="chaos"))
+
+        # 1. write-side truncation: the NEWER file on disk is corrupt
+        plan = FaultPlan(seed, [
+            FaultSpec("ckpt.write", TRUNCATE, at_hit=1, arg=97.0)])
+        with armed(plan, log=False):
+            bad = store.save(build_checkpoint(
+                store.next_seq(), clock.advance(10.0), fastpath=fastpath,
+                nat=nat, dhcp=server, node_id="chaos"))
+        try:
+            store.load(bad)
+            out["truncated_rejected"] = False
+        except CheckpointError:
+            out["truncated_rejected"] = True
+        ckpt, path = store.load_latest()
+        out["fallback_to_good"] = (str(path) == str(good)
+                                   and ckpt.seq == 1)
+
+        # 2. read-side bit flip: a good file corrupted in transit rejects
+        plan = FaultPlan(seed, [
+            FaultSpec("ckpt.read", BITFLIP, at_hit=1,
+                      arg=float(101 + seed % 997))])
+        with armed(plan, log=False):
+            try:
+                store.load(good)
+                out["bitflip_rejected"] = False
+            except CheckpointError:
+                out["bitflip_rejected"] = True
+
+        # 3. io_error on save surfaces (the PeriodicCheckpointer failure
+        # counter path) instead of landing a half-written file
+        plan = FaultPlan(seed, [FaultSpec("ckpt.write", IO_ERROR)])
+        with armed(plan, log=False):
+            try:
+                store.save(build_checkpoint(
+                    store.next_seq(), clock(), dhcp=server,
+                    node_id="chaos"))
+                out["io_error_surfaced"] = False
+            except OSError:
+                out["io_error_surfaced"] = True
+        out["files_on_disk"] = len(store.list())
+
+        # 4. the good checkpoint restores into a FRESH stack (the warm
+        # path a clean restart takes; a rejected one cold-starts empty)
+        clock2 = SimClock()
+        server2, pools2, fastpath2, nat2 = _build_server_stack(clock2)
+        rows = restore_checkpoint(ckpt, fastpath=fastpath2, nat=nat2,
+                                  dhcp=server2)
+        out["restored_leases"] = rows.get("dhcp.leases", 0)
+        renew_ok = 0
+        for i, m in enumerate(macs):
+            ack = server2.handle_frame(_renew(m, leased[m], 3000 + i))
+            if ack is not None and _reply(ack).msg_type == dhcp_codec.ACK \
+                    and _reply(ack).yiaddr == leased[m]:
+                renew_ok += 1
+        out["renewed_after_restore"] = renew_ok
+        audit = audit_invariants(pools=pools2, dhcp=server2,
+                                 fastpath=fastpath2, nat=nat2)
+        out["audit_ok"] = audit.ok
+        out["violations"] = audit.violations_by_kind()
+
+    out["ok"] = (out["truncated_rejected"] and out["fallback_to_good"]
+                 and out["bitflip_rejected"] and out["io_error_surfaced"]
+                 and out["restored_leases"] == len(macs)
+                 and out["renewed_after_restore"] == len(macs)
+                 and out["audit_ok"])
+    return out
+
+
+# ---------------------------------------------------------------------------
+# 3. fleet reshard under kill
+# ---------------------------------------------------------------------------
+
+def fleet_reshard_under_kill(seed: int) -> dict:
+    """Kill a worker mid-traffic, checkpoint every lease book (the dead
+    worker's included), restore onto a SMALLER fleet: the MAC hash
+    re-shards every subscriber onto its new owner and renewals ACK the
+    original addresses."""
+    clock = SimClock()
+    fleet, pools, fastpath = build_fleet(4, clock)
+    macs = [_mac((seed % 83) * 100 + i) for i in range(24)]
+    leased = dora_with_retries(fleet, macs, clock)
+    out = {"name": "fleet_reshard_under_kill", "seed": seed,
+           "leased_before": len(leased)}
+
+    plan = FaultPlan(seed, [FaultSpec("fleet.scatter", KILL, at_hit=1)])
+    with armed(plan, log=False) as inj:
+        # renewal round under the kill: the dead shard's lanes are lost
+        batch = [(i, _renew(m, leased[m], 5000 + i))
+                 for i, m in enumerate(macs)]
+        replies = fleet.handle_batch(batch, now=clock.advance(30.0))
+    out["renew_lost_to_kill"] = sum(1 for _l, r in replies if r is None)
+    out["faults"] = len(inj.injected)
+    audit1 = audit_invariants(pools=pools, fleet=fleet, fastpath=fastpath)
+    out["audit_after_kill_ok"] = audit1.ok
+
+    state = fleet.export_state()  # inline books: dead worker's included
+    clock2 = SimClock(clock())
+    fleet2, pools2, fastpath2 = build_fleet(3, clock2)
+    restored = fleet2.restore_state(state)
+    out["restored"] = restored
+
+    renew_ok = 0
+    out2 = fleet2.handle_batch(
+        [(i, _renew(m, leased[m], 6000 + i)) for i, m in enumerate(macs)],
+        now=clock2.advance(30.0))
+    for (_lane, rep), m in zip(out2, macs):
+        if rep is not None and _reply(rep).msg_type == dhcp_codec.ACK \
+                and _reply(rep).yiaddr == leased[m]:
+            renew_ok += 1
+    out["renewed_after_reshard"] = renew_ok
+    audit2 = audit_invariants(pools=pools2, fleet=fleet2,
+                              fastpath=fastpath2)
+    out["audit_ok"] = audit2.ok
+    out["violations"] = audit2.violations_by_kind()
+    out["ok"] = (out["leased_before"] == len(macs)
+                 and out["faults"] >= 1
+                 and out["renew_lost_to_kill"] >= 1
+                 and out["audit_after_kill_ok"]
+                 and restored == len(macs)
+                 and renew_ok == len(macs)
+                 and audit2.ok)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# 4. NAT expiry under clock skew
+# ---------------------------------------------------------------------------
+
+def nat_expiry_under_skew(seed: int) -> dict:
+    """Forward skew mass-expires sessions; backward skew must expire
+    nothing; both directions must leave the allocator/EIM/session/
+    reverse bookkeeping mutually consistent and the port blocks
+    reusable."""
+    from bng_tpu.control.nat import NATManager
+    from bng_tpu.ops.parse import PROTO_UDP
+
+    clock = SimClock()
+    nat = NATManager(public_ips=[ip_to_u32("203.0.113.1"),
+                                 ip_to_u32("203.0.113.2")],
+                     ports_per_subscriber=64,
+                     sessions_nbuckets=256, sub_nat_nbuckets=64)
+    rng = random.Random(seed)
+    subs = [ip_to_u32("10.1.0.10") + i for i in range(8)]
+    for s in subs:
+        nat.allocate_nat(s, int(clock()))
+
+    def make_flows(tag: int) -> int:
+        n = 0
+        for s in subs:
+            base_port = 5000 + (tag * 16) + rng.randrange(0, 4)
+            dsts = [ip_to_u32("93.184.216.34"), ip_to_u32("1.1.1.1")]
+            # two flows share one internal endpoint (EIM refcount 2),
+            # a third uses its own port
+            for dst, dport in ((dsts[0], 80), (dsts[1], 443)):
+                if nat.handle_new_flow(s, dst, base_port, dport,
+                                       PROTO_UDP, 128, int(clock())):
+                    n += 1
+            if nat.handle_new_flow(s, dsts[0], base_port + 1000 + tag, 80,
+                                   PROTO_UDP, 128, int(clock())):
+                n += 1
+        return n
+
+    out = {"name": "nat_expiry_under_skew", "seed": seed}
+    out["flows_created"] = make_flows(0)
+    out["audit_fresh_ok"] = audit_invariants(nat=nat,
+                                             check_roundtrip=False).ok
+
+    # forward skew: every UDP session is idle far past its timeout
+    with armed(FaultPlan(seed, [
+            FaultSpec("nat.expire", SKEW, at_hit=1, arg=7200.0)]),
+            log=False):
+        out["expired_forward"] = nat.expire_sessions(int(clock()))
+    audit_f = audit_invariants(nat=nat, check_roundtrip=False)
+    out["audit_forward_ok"] = audit_f.ok
+    out["sessions_after_forward"] = int(np.count_nonzero(nat.sessions.used))
+
+    # recreate on the freed ports — the blocks must be reusable
+    out["flows_recreated"] = make_flows(1)
+    # backward skew: (now - last_seen) goes negative, nothing may expire
+    with armed(FaultPlan(seed, [
+            FaultSpec("nat.expire", SKEW, at_hit=1, arg=-7200.0)]),
+            log=False):
+        out["expired_backward"] = nat.expire_sessions(
+            int(clock.advance(30.0)))
+    audit_b = audit_invariants(nat=nat, check_roundtrip=False)
+    out["audit_ok"] = audit_b.ok
+    out["violations"] = audit_b.violations_by_kind()
+
+    out["ok"] = (out["flows_created"] == 24
+                 and out["audit_fresh_ok"]
+                 and out["expired_forward"] == 24
+                 and out["sessions_after_forward"] == 0
+                 and out["audit_forward_ok"]
+                 and out["flows_recreated"] == 24
+                 and out["expired_backward"] == 0
+                 and out["audit_ok"])
+    return out
+
+
+# ---------------------------------------------------------------------------
+# 5. HA replication: stream death mid-delta + peer timeout on reconnect
+# ---------------------------------------------------------------------------
+
+def ha_delta_drop_reconnect(seed: int) -> dict:
+    """The replication stream dies mid-delta (drop_delta kills every
+    subscriber callback, exactly like an SSE connection breaking), then
+    the first reconnect attempt times out (ha.connect fail -> backoff).
+    The second reconnect heals via replay_since with zero full syncs —
+    and the stores must end identical."""
+    from bng_tpu.control.ha import (ActiveSyncer, InMemorySessionStore,
+                                    SessionState, StandbySyncer)
+
+    clock = SimClock()
+    active = ActiveSyncer(InMemorySessionStore(), replay_buffer=64)
+    standby = StandbySyncer(InMemorySessionStore(),
+                            transport=lambda: active,
+                            backoff_initial_s=1.0)
+    standby.tick(clock())
+    out = {"name": "ha_delta_drop_reconnect", "seed": seed,
+           "connected_initially": standby.connected}
+
+    def push(i: int) -> None:
+        active.push_change(SessionState(
+            session_id=f"s-{i:04d}", mac=_mac(i).hex(),
+            ip=ip_to_u32("10.2.0.1") + i, lease_expiry=clock() + 3600,
+            updated_at=clock()))
+
+    for i in range(6):
+        push(i)
+    out["delivered_before_fault"] = standby.last_seq
+
+    plan = FaultPlan(seed, [
+        # hits count from arming: the 2nd armed push (session seq 8)
+        # dies mid-delivery; seq 7 lands, 8-12 reach only the replay log
+        FaultSpec("ha.push", DROP_DELTA, at_hit=2),
+        # the standby's FIRST reconnect attempt times out
+        FaultSpec("ha.connect", FAIL, at_hit=1)])
+    with armed(plan, log=False) as inj:
+        for i in range(6, 12):
+            push(i)
+        out["standby_seq_after_drop"] = standby.last_seq
+        # the broken stream is observed (no subscriber left on the
+        # active — the on_stream_end role) and the standby reconnects
+        out["stream_died"] = not active._subscribers
+        if out["stream_died"]:
+            standby.disconnect()
+        standby.tick(clock.advance(1.0))  # injected peer timeout
+        out["first_reconnect_failed"] = not standby.connected
+        standby.tick(clock.advance(5.0))  # backoff elapsed: heals
+    out["faults"] = len(inj.injected)
+    out["healed"] = (standby.connected
+                     and standby.last_seq == active._seq)
+    out["full_syncs_during_heal"] = standby.stats["full_syncs"] - 1
+    audit = audit_invariants(ha_pair=(active, standby),
+                             check_roundtrip=False)
+    out["audit_ok"] = audit.ok
+    out["violations"] = audit.violations_by_kind()
+    out["ok"] = (out["connected_initially"]
+                 and out["delivered_before_fault"] == 6
+                 and out["stream_died"]
+                 and out["standby_seq_after_drop"] == 7
+                 and out["first_reconnect_failed"]
+                 and out["healed"]
+                 and out["full_syncs_during_heal"] == 0
+                 and out["audit_ok"])
+    return out
+
+
+SCENARIOS = {
+    "dora_worker_crash": dora_worker_crash,
+    "corrupt_restore_cold_start": corrupt_restore_cold_start,
+    "fleet_reshard_under_kill": fleet_reshard_under_kill,
+    "nat_expiry_under_skew": nat_expiry_under_skew,
+    "ha_delta_drop_reconnect": ha_delta_drop_reconnect,
+}

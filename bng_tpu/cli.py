@@ -1771,10 +1771,64 @@ def run_checkpoint(args) -> int:
             print(f"checkpoint restore: no checkpoint in "
                   f"{cfg.checkpoint_dir}", file=sys.stderr)
             return 1
-        print(json.dumps({"restored_rows": rows}, indent=2))
+        out = {"restored_rows": rows}
+        if getattr(args, "audit", False):
+            # --audit: prove the hydrated authorities agree BEFORE the
+            # snapshot is trusted to serve traffic. rc=2 on ANY
+            # violation — a bad checkpoint must never silently serve.
+            from bng_tpu.chaos.invariants import audit_app
+
+            report = audit_app(app)
+            out["audit"] = report.to_dict()
+            print(json.dumps(out, indent=2))
+            if not report.ok:
+                print("checkpoint restore --audit: invariant "
+                      f"violations {report.violations_by_kind()} — "
+                      "refusing this snapshot", file=sys.stderr)
+                return 2
+            return 0
+        print(json.dumps(out, indent=2))
         return 0
     finally:
         app.close()
+
+
+def run_chaos(args) -> int:
+    """`bng chaos run|audit` — the fault-injection harness
+    (bng_tpu/chaos): `run` executes the scripted scenario suite (plus an
+    optional fault soak) and prints a bit-deterministic JSON report —
+    two runs with one --seed emit identical bytes; `audit` builds the
+    app from the normal run flags and proves the cross-authority
+    invariants hold (rc=2 on any violation)."""
+    if args.chaos_cmd == "audit":
+        from bng_tpu.chaos.invariants import audit_app
+
+        app = BNGApp(_config_from_args(args))
+        try:
+            report = audit_app(app)
+            print(json.dumps(report.to_dict(), indent=2, sort_keys=True))
+            return 0 if report.ok else 2
+        finally:
+            app.close()
+
+    from bng_tpu.chaos.runner import canonical_json, run_report
+
+    # metrics=None: the one-shot CLI run has no scrape endpoint to serve
+    # the bng_chaos_* families from — the report IS the output. A live
+    # `bng run` process soaking via the runner passes its own BNGMetrics.
+    names = [args.scenario] if args.scenario else None
+    try:
+        report = run_report(args.seed, names=names,
+                            soak_epochs=args.soak_epochs)
+    except ValueError as e:
+        print(f"chaos run: {e}", file=sys.stderr)
+        return 2
+    text = canonical_json(report)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(text + "\n")
+    print(text)
+    return 0 if report["ok"] else 1
 
 
 # ---------------------------------------------------------------------------
@@ -1872,6 +1926,34 @@ def main(argv: list[str] | None = None) -> int:
                                "(header-only; flags corrupt files)")):
         vp = ckpt_sub.add_parser(verb, help=hlp)
         _add_run_flags(vp)
+        if verb == "restore":
+            vp.add_argument("--audit", action="store_true",
+                            help="run the cross-authority invariant "
+                                 "auditor after hydration; exit rc=2 on "
+                                 "any violation (a bad snapshot must "
+                                 "never silently serve traffic)")
+
+    # chaos harness + invariant auditor (bng_tpu/chaos)
+    chaosp = sub.add_parser("chaos", help="fault-injection scenarios and "
+                                          "cross-authority invariant audits")
+    chaos_sub = chaosp.add_subparsers(dest="chaos_cmd", required=True)
+    crun = chaos_sub.add_parser(
+        "run", help="run the scripted chaos scenarios (+ optional fault "
+                    "soak); deterministic JSON report, rc=1 on failure")
+    crun.add_argument("--seed", type=int, default=1,
+                      help="fault-schedule seed; same seed -> identical "
+                           "schedules and byte-identical report")
+    crun.add_argument("--scenario", default="",
+                      help="run one scenario by name (default: all)")
+    crun.add_argument("--soak-epochs", type=int, default=0,
+                      help="also run the seeded fault soak for N epochs "
+                           "(traffic + generated faults + audit/epoch)")
+    crun.add_argument("--out", default="",
+                      help="also write the report JSON to this file")
+    caud = chaos_sub.add_parser(
+        "audit", help="build the app from run flags and audit the state "
+                      "authorities; rc=2 on any violation")
+    _add_run_flags(caud)
 
     sub.add_parser("version", help="print version")
 
@@ -1887,6 +1969,8 @@ def main(argv: list[str] | None = None) -> int:
         return run_loadtest(args)
     if args.command == "checkpoint":
         return run_checkpoint(args)
+    if args.command == "chaos":
+        return run_chaos(args)
     if args.command in ("run", "stats"):
         app = BNGApp(_config_from_args(args))
         try:

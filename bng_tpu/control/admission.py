@@ -32,6 +32,7 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Callable
 
+from bng_tpu.chaos.faults import fault_point
 from bng_tpu.control import dhcp_codec
 
 # shed reasons (the bng_slowpath_shed_total label values)
@@ -209,6 +210,12 @@ class AdmissionController:
         """(admitted, shed_reason). `inbox_depth` is the target worker's
         current backlog; `enq_t` (when the caller tracked it — the
         scheduler's lanes do) enables deadline shedding."""
+        fp = fault_point("admission.admit")
+        if fp is not None and fp.kind == "force_shed":
+            # chaos: shed a frame the policy would admit. Service-only
+            # degradation by construction — a shed frame never reached a
+            # worker, so no allocation can be half-done.
+            return self._shed("chaos")
         # fast path: no inbox pressure, no deadline breach — admit
         # without peeking. The peek exists to decide WHAT to shed; when
         # nothing sheds it is pure per-frame overhead on the parent,

@@ -26,6 +26,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Callable
 
+from bng_tpu.chaos.faults import fault_point
 from bng_tpu.control import dhcp_codec, packets
 from bng_tpu.control.dhcp_codec import (
     ACK,
@@ -436,6 +437,11 @@ class DHCPServer:
     def cleanup_expired(self, now: int | None = None) -> int:
         """Lease expiry sweep (parity: server.go:1100-1163)."""
         now = now if now is not None else self._now()
+        fp = fault_point("dhcp.expire")
+        if fp is not None and fp.kind == "skew":
+            # chaos: skewed expiry clock — early expiry costs a re-DORA
+            # (service), never a double allocation (consistency)
+            now = int(now + fp.arg)
         dead = [mk for mk, l in self.leases.items() if l.expiry < now]
         for mk in dead:
             lease = self.leases.pop(mk)

@@ -56,6 +56,7 @@ from collections import deque
 from dataclasses import dataclass, field
 from typing import Callable
 
+from bng_tpu.chaos.faults import fault_point
 from bng_tpu.control import dhcp_codec
 from bng_tpu.control.admission import (AdmissionConfig, AdmissionController,
                                        peek_reply)
@@ -539,6 +540,11 @@ class SlowPathFleet:
         self.fallback_frames = 0
         self.batches = 0
         self.worker_failures = 0  # dead-worker batch losses (IPC errors)
+        # workers killed by the chaos harness (fleet.scatter `kill`):
+        # process mode terminates the child AND marks it here so the
+        # maintenance fan-outs stop talking to a dead pipe; inline mode
+        # uses the mark alone (deterministic scenarios)
+        self._dead: set[int] = set()
         self.start_method = None  # set for process mode below
         self._pending: list[bytes] = []
         self._last_stats: list[dict] = [{} for _ in range(n_workers)]
@@ -666,6 +672,55 @@ class SlowPathFleet:
                     f"(wanted {expect!r})")
             return payload
 
+    # -- chaos harness hooks (bng_tpu/chaos/faults.py) --------------------
+
+    def _scatter_fault(self, w: int, groups: dict,
+                       now: float | None = None) -> bool:
+        """fault_point('fleet.scatter') on the per-worker batch dispatch
+        — the pipe protocol's failure surface. Returns True when this
+        worker's batch is LOST (kill / drop_batch / already dead);
+        dup_batch and reorder mutate the delivery and the batch still
+        runs. Disarmed cost: one no-op call per worker-group."""
+        fp = fault_point("fleet.scatter")
+        if fp is not None:
+            if fp.kind == "kill":
+                self._kill_worker(w)
+            elif fp.kind == "drop_batch":
+                self.worker_failures += 1
+                return True
+            elif fp.kind == "reorder":
+                # pipe reorder: lanes arrive at the worker out of order;
+                # the parent's lane-sorted re-merge must absorb it
+                groups[w] = list(reversed(groups[w]))
+            elif fp.kind == "dup_batch" and self.mode == "inline" \
+                    and w not in self._dead:
+                # at-least-once delivery: the worker handles the batch
+                # twice. The duplicate's table events / admission
+                # feedback absorb normally (idempotent upserts); its
+                # replies are superseded by the second pass.
+                self._absorb(w, self._inline[w].handle_batch(
+                    list(groups[w]),
+                    now if now is not None else self.clock()))
+        if w in self._dead:
+            self.worker_failures += 1
+            return True
+        return False
+
+    def _kill_worker(self, w: int) -> None:
+        """The chaos `kill` fault: a real terminate in process mode (the
+        pipe dies mid-protocol — the existing IPC-failure handling owns
+        the fallout), a permanent dead-mark in inline mode. Either way
+        the worker's shard loses service until a restart; its carved
+        slices stay allocated in the parent pool, so no other worker can
+        ever double-assign its addresses."""
+        self._dead.add(w)
+        if self.mode == "process":
+            try:
+                self._procs[w].terminate()
+                self._procs[w].join(timeout=2)
+            except (OSError, ValueError):
+                pass
+
     # -- the hot path -----------------------------------------------------
 
     def handle_batch(self, items: list, now: float | None = None) -> list:
@@ -699,6 +754,10 @@ class SlowPathFleet:
         if groups:
             if self.mode == "inline":
                 for w in sorted(groups):
+                    if self._scatter_fault(w, groups, now):
+                        results.extend((lane, None)
+                                       for lane, _f in groups[w])
+                        continue
                     out = self._inline[w].handle_batch(groups[w], now)
                     results.extend(self._absorb(w, out))
             else:
@@ -708,6 +767,10 @@ class SlowPathFleet:
                 # batches are unaffected.
                 sent = []
                 for w in sorted(groups):
+                    if self._scatter_fault(w, groups, now):
+                        results.extend((lane, None)
+                                       for lane, _f in groups[w])
+                        continue
                     try:
                         self._conns[w].send(("batch", groups[w], now))
                         sent.append(w)
@@ -766,13 +829,26 @@ class SlowPathFleet:
         total = 0
         if self.mode == "inline":
             for w, worker in enumerate(self._inline):
+                if w in self._dead:
+                    continue
                 out = worker.expire(now)
                 total += self._absorb_expire(w, out)
         else:
-            for conn in self._conns:
-                conn.send(("expire", now))
-            for w in range(self.n):
-                total += self._absorb_expire(w, self._gather(w, "expired"))
+            sent = []
+            for w, conn in enumerate(self._conns):
+                if w in self._dead:
+                    continue
+                try:
+                    conn.send(("expire", now))
+                    sent.append(w)
+                except (OSError, ValueError):
+                    self.worker_failures += 1
+            for w in sent:
+                try:
+                    total += self._absorb_expire(w,
+                                                 self._gather(w, "expired"))
+                except (OSError, EOFError):
+                    self.worker_failures += 1
         return total
 
     def _absorb_expire(self, worker: int, out: dict) -> int:
@@ -791,11 +867,24 @@ class SlowPathFleet:
         restore, workers get fresh slices and each restored lease's IP
         is re-claimed explicitly."""
         if self.mode == "inline":
-            workers = [w.export_state() for w in self._inline]
+            # dead (chaos-killed) inline workers keep their books in
+            # memory — a checkpoint still captures their leases
+            workers = [dict(w.export_state(), worker_id=i)
+                       for i, w in enumerate(self._inline)]
         else:
-            for conn in self._conns:
+            # a KNOWN-dead process's book is gone: snapshot the
+            # survivors rather than failing the whole checkpoint. A
+            # LIVE worker's IPC failure still raises — a silently
+            # partial snapshot saved as good would un-claim a whole
+            # shard's addresses on restore (double-allocation), which is
+            # strictly worse than keeping the previous good checkpoint.
+            workers = []
+            for w, conn in enumerate(self._conns):
+                if w in self._dead:
+                    continue
                 conn.send(("export",))
-            workers = [self._gather(w, "state") for w in range(self.n)]
+                workers.append(dict(self._gather(w, "state"),
+                                    worker_id=w))
         return {"n_workers": self.n, "workers": workers}
 
     @staticmethod
@@ -849,11 +938,16 @@ class SlowPathFleet:
             wstate["revoke"] = all_ips
             if self.mode == "inline":
                 restored += self._inline[w].restore_state(wstate)
-            else:
+            elif w not in self._dead:
+                # a chaos-killed process can't hydrate its shard; the
+                # parent-side claims above still protect every restored
+                # address from double-allocation (service degraded,
+                # consistency intact)
                 self._conns[w].send(("restore", wstate))
         if self.mode == "process":
             for w in range(self.n):
-                restored += self._gather(w, "restored")
+                if w not in self._dead:
+                    restored += self._gather(w, "restored")
         return restored
 
     # -- observability ----------------------------------------------------
@@ -864,6 +958,7 @@ class SlowPathFleet:
             "mode": self.mode,
             "start_method": self.start_method,
             "worker_failures": self.worker_failures,
+            "dead_workers": sorted(self._dead),
             "batches": self.batches,
             "refills": self.refills,
             "refill_ips_granted": self.refill_ips_granted,

@@ -18,6 +18,8 @@ import enum
 from dataclasses import dataclass, field, asdict
 from typing import Callable
 
+from bng_tpu.chaos.faults import fault_point
+
 
 @dataclass
 class SessionState:
@@ -130,6 +132,14 @@ class ActiveSyncer:
         if len(self._replay) > self._replay_cap:
             self._replay.pop(0)
         self.stats["changes"] += 1
+        fp = fault_point("ha.push")
+        if fp is not None and fp.kind == "drop_delta":
+            # chaos: every replica stream dies mid-event (an SSE
+            # connection breaking). The change IS recorded — store +
+            # replay buffer — so a reconnecting standby heals via
+            # replay_since; only the live delivery is lost.
+            self._subscribers.clear()
+            return
         for cb in list(self._subscribers):
             # a broken replica sink must never take down the active's
             # session-write path; the subscriber is dropped and will
@@ -246,6 +256,10 @@ class StandbySyncer:
         self.stats["deltas"] += 1
 
     def _connect(self) -> None:
+        fp = fault_point("ha.connect")
+        if fp is not None and fp.kind == "fail":
+            # chaos: peer timeout — tick()'s backoff path owns recovery
+            raise ConnectionError("chaos: injected peer timeout")
         active = self.transport()  # raises ConnectionError when active is down
         replay = active.replay_since(self.last_seq) if self.last_seq else None
         if replay is None:

@@ -368,6 +368,28 @@ class BNGMetrics:
         self.ckpt_restores = r.counter(
             "bng_ckpt_restores_total",
             "Startup restore outcomes", ("outcome",))
+        # chaos harness + invariant auditor (bng_tpu/chaos). The
+        # reference has no analog — its correctness-under-failure story
+        # is kernel-pinned maps; here recovery is code, and code that is
+        # only trusted because these families prove it keeps passing.
+        self.chaos_faults = r.counter(
+            "bng_chaos_faults_injected_total",
+            "Faults injected by the chaos harness", ("point", "kind"))
+        self.chaos_scenarios = r.counter(
+            "bng_chaos_scenarios_total",
+            "Chaos scenarios run", ("result",))
+        self.invariant_audits = r.counter(
+            "bng_invariant_audits_total",
+            "Cross-authority invariant audits run")
+        self.invariant_violations = r.counter(
+            "bng_invariant_violations_total",
+            "Invariant violations found, by kind", ("kind",))
+        self.invariant_last_epoch = r.gauge(
+            "bng_invariant_last_audit_epoch",
+            "Epoch (soak epoch or audit counter) of the last audit")
+        self.invariant_last_violations = r.gauge(
+            "bng_invariant_last_audit_violations",
+            "Violations found by the most recent audit")
 
     # -- collection (metrics.go:555-623) -------------------------------
 
@@ -464,6 +486,18 @@ class BNGMetrics:
         if s["last_success_t"]:
             self.ckpt_bytes.set(s["last_bytes"])
             self.ckpt_seq.set(s["last_seq"])
+
+    def record_audit(self, report, epoch=None) -> None:
+        """Invariant AuditReport -> bng_invariant_* families. `epoch`
+        defaults to the running audit count (a monotonic stamp either
+        way, so alerting can detect a stalled auditor)."""
+        self.invariant_audits.inc()
+        by_kind = report.violations_by_kind()
+        for kind, n in by_kind.items():
+            self.invariant_violations.inc(n, kind=kind)
+        self.invariant_last_violations.set(sum(by_kind.values()))
+        self.invariant_last_epoch.set(
+            epoch if epoch is not None else self.invariant_audits.value())
 
     def record_restore(self, rows: dict, outcome: str = "ok") -> None:
         """Startup-restore result -> bng_ckpt_restore_rows / restores."""

@@ -25,6 +25,7 @@ from typing import Callable
 import numpy as np
 import jax.numpy as jnp
 
+from bng_tpu.chaos.faults import fault_point
 from bng_tpu.ops.nat44 import (
     BV_FLAGS,
     BV_IN_USE,
@@ -532,6 +533,11 @@ class NATManager:
         Python loop below runs only over the already-expired indices — at
         the 1M-session target a full sweep with a per-slot Python body
         was the cost of the sweep, not the deletions."""
+        fp = fault_point("nat.expire")
+        if fp is not None and fp.kind == "skew":
+            # chaos: the expiry clock jumps (NTP step / host suspend);
+            # the sweep must stay consistent in BOTH directions
+            now = int(now + fp.arg)
         vals = device_vals if device_vals is not None else self.sessions.vals
         used = self.sessions.used
         expired = 0

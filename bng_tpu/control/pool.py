@@ -9,6 +9,7 @@ from __future__ import annotations
 
 from dataclasses import dataclass, field
 
+from bng_tpu.chaos.faults import fault_point
 from bng_tpu.utils.net import ip_to_u32, prefix_to_mask, u32_to_ip
 
 
@@ -54,6 +55,12 @@ class Pool:
 
     def allocate(self, owner: str) -> int:
         """Sequential-then-freelist allocation (parity: pool.go:64-118)."""
+        fp = fault_point("pool.allocate")
+        if fp is not None and fp.kind == "exhaust":
+            # chaos: simulated pool exhaustion — every caller already
+            # owns this path (silent DISCOVER, empty carve grant)
+            raise PoolExhaustedError(
+                f"pool {self.pool_id}: chaos-injected exhaustion")
         while self._next <= self.last:
             ip = self._next
             self._next += 1

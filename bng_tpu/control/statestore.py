@@ -32,6 +32,7 @@ import time
 from pathlib import Path
 from typing import Callable, NamedTuple
 
+from bng_tpu.chaos.faults import mutate_point
 from bng_tpu.runtime.checkpoint import (Checkpoint, CheckpointError,
                                         decode_checkpoint, encode_checkpoint,
                                         verify_checkpoint_bytes)
@@ -99,7 +100,10 @@ class CheckpointStore:
 
     def save(self, ckpt: Checkpoint) -> Path:
         """Encode + write atomically; returns the final path."""
-        data = encode_checkpoint(ckpt)
+        # chaos hook: truncation/bit-flip corrupts the bytes that land
+        # on disk (the decoder must reject them later); io_error raises
+        # before any file exists (the failure-counter path)
+        data = mutate_point("ckpt.write", encode_checkpoint(ckpt))
         final = self._path_for(ckpt.seq)
         tmp = self.root / f".tmp-{final.name}.{os.getpid()}"
         try:
@@ -126,7 +130,9 @@ class CheckpointStore:
     def load(self, path: str | os.PathLike) -> Checkpoint:
         """Decode one specific file (CheckpointError on any corruption)."""
         try:
-            data = Path(path).read_bytes()
+            # chaos hook: read-side corruption (bad disk / torn page) —
+            # the decoder's CRC gates must reject, never half-hydrate
+            data = mutate_point("ckpt.read", Path(path).read_bytes())
         except OSError as e:
             raise CheckpointError(f"cannot read checkpoint {path}: {e}") from e
         return decode_checkpoint(data)

@@ -31,6 +31,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from bng_tpu.chaos.faults import FaultInjectedError, fault_point
 from bng_tpu.control.nat import NATManager, apply_nat_updates
 from bng_tpu.ops.antispoof import ANTISPOOF_NSTATS, AntispoofGeom
 from bng_tpu.ops.dhcp import NSTATS as DHCP_NSTATS
@@ -521,6 +522,12 @@ class Engine:
         -> [(lane, reply|None)] ascending-lane."""
         if not items:
             return []
+        fp = fault_point("engine.slow_drain")
+        if fp is not None and fp.kind == "fail":
+            # chaos: the whole slow batch is lost BEFORE any handler
+            # runs — no half-allocation is possible, clients retransmit
+            self.stats.slow_errors += 1
+            return [(item[0], None) for item in items]
         if self.slow_path_batch is not None:
             try:
                 out = self.slow_path_batch(items)
@@ -697,6 +704,7 @@ class Engine:
         PASS otherwise; no NAT punts or spoof violations exist on this
         program). `device` pins the dispatch (tables + inputs) to a
         specific device — the scheduler's express lane."""
+        self._dispatch_fault()
         B = pkt.shape[0]
         upd = self._drain_with_resync(self.fastpath.make_updates)
         pkt_d, len_d = jnp.asarray(pkt), jnp.asarray(length)
@@ -733,6 +741,7 @@ class Engine:
         """Enqueue one jitted step (async — outputs are futures). The table
         state threads immediately; callers force outputs when they need
         them (sync path: right away; pipelined path: one batch later)."""
+        self._dispatch_fault()
         # drain FIRST: a bulk-build resync rebinds self.tables, and Python
         # evaluates arguments left-to-right — reading self.tables before
         # the drain would pass (and donate) the stale pre-resync reference
@@ -744,6 +753,20 @@ class Engine:
         self.tables = res.tables
         self.stats.batches += 1
         return res
+
+    @staticmethod
+    def _dispatch_fault() -> None:
+        """Chaos hook on every device dispatch: `delay` simulates a slow
+        device (bounded sleep), `fail` a failing one — raised BEFORE the
+        update drain is consumed, so no table delta is lost with the
+        batch. Disarmed: one no-op call per batch."""
+        fp = fault_point("engine.dispatch")
+        if fp is not None:
+            if fp.kind == "fail":
+                raise FaultInjectedError(
+                    "chaos: injected device dispatch failure")
+            if fp.kind == "delay":
+                time.sleep(min(max(fp.arg, 0.0), 0.05))
 
     def _fold_stats(self, res: PipelineResult) -> None:
         self.stats.dhcp += np.asarray(res.dhcp_stats, dtype=np.uint64)

@@ -1,0 +1,506 @@
+"""Chaos harness + cross-authority invariant auditor (bng_tpu/chaos).
+
+Covers the PR acceptance gates:
+
+- fault_point API: disarmed no-op, deterministic seeded schedules,
+  byte-mutation kinds, armed/disarm scoping;
+- the kill-at-every-fault-point sweep for the fleet DORA path (plus
+  drop/dup/reorder pipe faults) — service may degrade, the audit stays
+  clean;
+- auditor self-tests: a clean stack passes, and PLANTED violations
+  (double-allocation, host/device mirror mismatch, stale fast-path row,
+  orphaned NAT reverse row) are all detected;
+- every scripted scenario ends with a clean invariant audit, and
+  `bng chaos run --seed S` is bit-deterministic (identical JSON twice);
+- `bng checkpoint restore --audit` accepts a good snapshot (rc=0) and
+  refuses one that hydrates into inconsistent state (rc=2);
+- the seeded soak (fast tier-1 run here; the long soak is @slow).
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from bng_tpu.chaos import faults as F
+from bng_tpu.chaos import runner
+from bng_tpu.chaos.faults import (FaultInjector, FaultPlan, FaultSpec,
+                                  SimClock, armed, fault_point,
+                                  mutate_point)
+from bng_tpu.chaos.invariants import audit_invariants
+from bng_tpu.chaos.scenarios import (SCENARIOS, SERVER_IP, SERVER_MAC,
+                                     _discover, _mac, _reply, _request,
+                                     build_fleet, dora_with_retries)
+from bng_tpu.control import dhcp_codec
+
+pytestmark = pytest.mark.chaos
+
+
+# ---------------------------------------------------------------------------
+# fault_point API
+# ---------------------------------------------------------------------------
+
+class TestFaultPointAPI:
+    def test_disarmed_is_noop(self):
+        assert fault_point("fleet.scatter") is None
+        assert mutate_point("ckpt.write", b"abc") == b"abc"
+
+    def test_armed_fires_at_hit_then_disarms(self):
+        plan = FaultPlan(1, [FaultSpec("p", F.KILL, at_hit=2, count=2)])
+        with armed(plan, log=False) as inj:
+            assert fault_point("p") is None          # hit 1
+            assert fault_point("p").kind == F.KILL   # hit 2
+            assert fault_point("p").kind == F.KILL   # hit 3 (count=2)
+            assert fault_point("p") is None          # hit 4
+            assert fault_point("other") is None
+            assert inj.injected == [("p", F.KILL, 2), ("p", F.KILL, 3)]
+        assert fault_point("p") is None  # context exit disarmed
+
+    def test_armed_context_disarms_on_exception(self):
+        with pytest.raises(RuntimeError):
+            with armed(FaultPlan(1, [FaultSpec("p", F.KILL)]), log=False):
+                raise RuntimeError("scenario died")
+        assert fault_point("p") is None
+
+    def test_generate_is_seed_deterministic(self):
+        a = FaultPlan.generate(42, n_faults=12)
+        b = FaultPlan.generate(42, n_faults=12)
+        assert a.specs == b.specs
+        assert FaultPlan.generate(43, n_faults=12).specs != a.specs
+        for s in a.specs:
+            assert s.kind in F.POINT_KINDS[s.point]
+
+    def test_mutate_kinds(self):
+        data = bytes(range(64))
+        with armed(FaultPlan(1, [
+                FaultSpec("m", F.TRUNCATE, at_hit=1, arg=16),
+                FaultSpec("m", F.BITFLIP, at_hit=2, arg=10),
+                FaultSpec("m", F.IO_ERROR, at_hit=3)]), log=False):
+            assert mutate_point("m", data) == data[:-16]
+            flipped = mutate_point("m", data)
+            assert len(flipped) == len(data)
+            assert flipped[10] == data[10] ^ (1 << 2)  # bit = arg % 8
+            with pytest.raises(OSError):
+                mutate_point("m", data)
+            assert mutate_point("m", data) == data  # past the plan
+
+    def test_injector_stats_snapshot(self):
+        inj = FaultInjector(FaultPlan(1, [FaultSpec("p", F.SKEW)]),
+                            log=False)
+        inj.check("p")
+        inj.check("p")
+        snap = inj.stats_snapshot()
+        assert snap["hits"] == {"p": 2}
+        assert snap["by_kind"] == {F.SKEW: 1}
+
+
+# ---------------------------------------------------------------------------
+# fleet DORA under pipe-protocol faults: the kill-at-every-hit sweep
+# ---------------------------------------------------------------------------
+
+MACS = [_mac(i) for i in range(12)]
+
+
+class TestFleetFaultSweep:
+    @pytest.mark.parametrize("kill_hit", [1, 2, 3, 4, 5, 6])
+    def test_kill_at_every_fault_point(self, kill_hit):
+        """Today's ad-hoc fleet test killed one worker between batches;
+        this sweep kills at EVERY scatter hit of the DORA path. Each
+        kill costs at most one shard's service; consistency (the audit)
+        must survive every one of them."""
+        clock = SimClock()
+        fleet, pools, fastpath = build_fleet(3, clock)
+        plan = FaultPlan(kill_hit, [
+            FaultSpec("fleet.scatter", F.KILL, at_hit=kill_hit)])
+        with armed(plan, log=False) as inj:
+            leased = dora_with_retries(fleet, MACS, clock)
+        assert len(inj.injected) == 1, "the kill must actually fire"
+        assert fleet._dead and fleet.worker_failures >= 1
+        # survivors' shards fully lease; no IP is handed out twice
+        assert len(set(leased.values())) == len(leased)
+        dead = next(iter(fleet._dead))
+        from bng_tpu.control.fleet import shard_for_mac
+        for m, _ip in leased.items():
+            assert shard_for_mac(m, 3) != dead or kill_hit > 3, (
+                "a lease on the dead shard can only predate the kill")
+        report = audit_invariants(pools=pools, fleet=fleet,
+                                  fastpath=fastpath)
+        assert report.ok, report.to_dict()
+
+    @pytest.mark.parametrize("kind", [F.DROP_BATCH, F.DUP_BATCH, F.REORDER])
+    def test_nonfatal_pipe_faults_cost_nothing_durable(self, kind):
+        """Dropped, duplicated or reordered batch delivery: retransmits
+        recover full service and the audit stays clean."""
+        clock = SimClock()
+        fleet, pools, fastpath = build_fleet(3, clock)
+        plan = FaultPlan(3, [FaultSpec("fleet.scatter", kind, at_hit=2)])
+        with armed(plan, log=False) as inj:
+            leased = dora_with_retries(fleet, MACS, clock)
+        assert len(inj.injected) == 1
+        assert len(leased) == len(MACS)
+        assert len(set(leased.values())) == len(MACS)
+        report = audit_invariants(pools=pools, fleet=fleet,
+                                  fastpath=fastpath)
+        assert report.ok, report.to_dict()
+
+    def test_admission_chaos_shed_is_service_only(self):
+        clock = SimClock()
+        fleet, pools, fastpath = build_fleet(2, clock)
+        with armed(FaultPlan(1, [
+                FaultSpec("admission.admit", F.FORCE_SHED)]), log=False):
+            out = fleet.handle_batch([(0, _discover(_mac(0), 1))],
+                                     now=clock())
+        assert out == [(0, None)]
+        assert fleet.admission.stats.shed["chaos"] == 1
+        assert audit_invariants(pools=pools, fleet=fleet,
+                                fastpath=fastpath).ok
+
+    def test_dhcp_expiry_skew_releases_cleanly(self):
+        """Forward clock skew early-expires leases — a re-DORA (service
+        cost), never a leaked allocation or stale fast-path row."""
+        clock = SimClock()
+        fleet, pools, fastpath = build_fleet(2, clock)
+        leased = dora_with_retries(fleet, MACS, clock)
+        assert len(leased) == len(MACS)
+        with armed(FaultPlan(1, [
+                FaultSpec("dhcp.expire", F.SKEW, at_hit=1, count=2,
+                          arg=7200.0)]), log=False):
+            expired = fleet.expire(int(clock()))
+        assert expired == len(MACS)
+        assert int(np.count_nonzero(fastpath.sub.used)) == 0
+        report = audit_invariants(pools=pools, fleet=fleet,
+                                  fastpath=fastpath)
+        assert report.ok, report.to_dict()
+        # the freed addresses are re-leasable
+        again = dora_with_retries(fleet, MACS, clock)
+        assert len(again) == len(MACS)
+
+
+# ---------------------------------------------------------------------------
+# auditor self-tests: clean pass + planted violations
+# ---------------------------------------------------------------------------
+
+class TestAuditorSelfTest:
+    def _leased_fleet(self, n=3):
+        clock = SimClock()
+        fleet, pools, fastpath = build_fleet(n, clock)
+        leased = dora_with_retries(fleet, MACS, clock)
+        assert len(leased) == len(MACS)
+        return fleet, pools, fastpath, leased
+
+    def test_clean_stack_audits_clean(self):
+        fleet, pools, fastpath, _ = self._leased_fleet()
+        report = audit_invariants(pools=pools, fleet=fleet,
+                                  fastpath=fastpath)
+        assert report.ok
+        assert report.checks["leases"] == len(MACS)
+        assert report.checks["slice_granted"] > 0
+        assert report.checks["fastpath_rows"] == len(MACS)
+        assert report.checks["ckpt_bytes"] > 0
+
+    def test_planted_double_grant_detected(self):
+        """The deliberate double-allocation: one address granted to two
+        workers' lease slices — the fleet's core correctness boundary."""
+        fleet, pools, fastpath, _ = self._leased_fleet()
+        w1_slice = fleet._inline[1].pools.pools[1]
+        stolen = next(iter(w1_slice._granted))
+        fleet._inline[0].pools.pools[1].grant([stolen])
+        report = audit_invariants(pools=pools, fleet=fleet,
+                                  fastpath=fastpath)
+        assert not report.ok
+        kinds = report.violations_by_kind()
+        assert "double-grant" in kinds, kinds
+        assert "carve-leak" in kinds, kinds  # parent owner can match one
+
+    def test_planted_double_lease_detected(self):
+        fleet, pools, fastpath, leased = self._leased_fleet()
+        victim_ip = next(iter(leased.values()))
+        intruder = _mac(999)
+        w = fleet._inline[0]
+        w.restore_state({"session_seq": 0, "leases": [{
+            "mac": intruder.hex(), "ip": victim_ip, "pool_id": 1,
+            "expiry": 2_000_000_000, "circuit_id": "", "remote_id": "",
+            "s_tag": 0, "c_tag": 0, "session_id": "forged",
+            "client_class": 0, "username": "", "qos_policy": ""}]})
+        report = audit_invariants(pools=pools, fleet=fleet,
+                                  fastpath=fastpath)
+        assert not report.ok
+        assert "double-lease" in report.violations_by_kind()
+
+    def test_planted_stale_fastpath_row_detected(self):
+        fleet, pools, fastpath, _ = self._leased_fleet()
+        fastpath.add_subscriber(_mac(500), pool_id=1,
+                                ip=SERVER_IP + 4000,
+                                lease_expiry=2_000_000_000)
+        report = audit_invariants(pools=pools, fleet=fleet,
+                                  fastpath=fastpath)
+        assert not report.ok
+        assert "fastpath-stale-row" in report.violations_by_kind()
+
+    def test_planted_nat_orphan_reverse_detected(self):
+        from bng_tpu.control.nat import NATManager
+        from bng_tpu.ops.parse import PROTO_UDP
+        from bng_tpu.utils.net import ip_to_u32
+
+        nat = NATManager(public_ips=[ip_to_u32("203.0.113.9")],
+                         ports_per_subscriber=64,
+                         sessions_nbuckets=256, sub_nat_nbuckets=64)
+        sub = ip_to_u32("10.9.0.5")
+        nat.allocate_nat(sub, 100)
+        got = nat.handle_new_flow(sub, ip_to_u32("1.1.1.1"), 5000, 53,
+                                  PROTO_UDP, 100, 100)
+        assert got is not None
+        assert audit_invariants(nat=nat, check_roundtrip=False).ok
+        # sabotage: delete the reverse row out from under the session
+        nat_ip, nat_port = got
+        nat.reverse.delete(nat._key(ip_to_u32("1.1.1.1"), nat_ip, 53,
+                                    nat_port, PROTO_UDP))
+        report = audit_invariants(nat=nat, check_roundtrip=False)
+        assert not report.ok
+        kinds = report.violations_by_kind()
+        assert "nat-missing-reverse" in kinds and "nat-reverse-count" in kinds
+
+
+# ---------------------------------------------------------------------------
+# engine-backed: host/device mirror proof + dispatch faults
+# ---------------------------------------------------------------------------
+
+def _engine_stack():
+    """Engine + parent DHCP slow path. Geometry matches
+    tests/test_fleet.build_engine so the jitted programs are shared via
+    the lru cache (no extra tier-1 compiles)."""
+    from bng_tpu.control.dhcp_server import DHCPServer
+    from bng_tpu.control.nat import NATManager
+    from bng_tpu.control.pool import Pool, PoolManager
+    from bng_tpu.runtime.engine import Engine
+    from bng_tpu.runtime.tables import FastPathTables
+    from bng_tpu.utils.net import ip_to_u32
+
+    fastpath = FastPathTables(sub_nbuckets=512, vlan_nbuckets=64,
+                              cid_nbuckets=64, max_pools=16)
+    fastpath.set_server_config(SERVER_MAC, SERVER_IP)
+    pools = PoolManager(fastpath)
+    pools.add_pool(Pool(pool_id=1, network=ip_to_u32("10.0.0.0"),
+                        prefix_len=16, gateway=SERVER_IP,
+                        dns_primary=ip_to_u32("1.1.1.1"), lease_time=86400))
+    nat = NATManager(public_ips=[ip_to_u32("203.0.113.1")],
+                     sessions_nbuckets=256, sub_nat_nbuckets=64)
+    server = DHCPServer(SERVER_MAC, SERVER_IP, pools,
+                        fastpath_tables=fastpath)
+    engine = Engine(fastpath, nat, batch_size=32,
+                    slow_path=server.handle_frame)
+    return engine, pools, fastpath, server
+
+
+class TestEngineMirrorAudit:
+    def test_mirror_clean_then_planted_mismatch(self):
+        engine, pools, fastpath, server = _engine_stack()
+        macs = [_mac(100 + i) for i in range(8)]
+        res = engine.process([_discover(m, i) for i, m in enumerate(macs)])
+        offers = {m: _reply(r).yiaddr
+                  for (l, r), m in zip(res["slow"], macs)}
+        res2 = engine.process([_request(m, offers[m], 50 + i)
+                               for i, m in enumerate(macs)])
+        assert all(_reply(r).msg_type == dhcp_codec.ACK
+                   for _l, r in res2["slow"])
+        report = audit_invariants(engine=engine, pools=pools,
+                                  dhcp=server)
+        assert report.ok, report.to_dict()
+        assert report.checks["mirror_buckets.fastpath.sub"] == 512
+        # plant the mirror mismatch: a host row mutated behind the dirty
+        # tracking — the device now serves different bytes than the host
+        # authority believes
+        from bng_tpu.ops.dhcp import AV_IP
+        slot = int(np.nonzero(fastpath.sub.used)[0][0])
+        fastpath.sub.vals[slot, AV_IP] ^= 1
+        report2 = audit_invariants(engine=engine, pools=pools,
+                                   dhcp=server)
+        assert not report2.ok
+        kinds = report2.violations_by_kind()
+        assert "mirror-mismatch" in kinds, kinds
+        # un-plant and prove the auditor settles clean again
+        fastpath.sub.vals[slot, AV_IP] ^= 1
+        assert audit_invariants(engine=engine, pools=pools,
+                                dhcp=server).ok
+
+    def test_dispatch_and_slow_drain_faults(self):
+        engine, _pools, _fastpath, _server = _engine_stack()
+        from bng_tpu.chaos.faults import FaultInjectedError
+
+        with armed(FaultPlan(1, [
+                FaultSpec("engine.dispatch", F.FAIL, at_hit=1)]),
+                log=False):
+            with pytest.raises(FaultInjectedError):
+                engine.process([_discover(_mac(1), 1)])
+        # the failed dispatch consumed nothing durable: the next batch
+        # serves normally
+        out = engine.process([_discover(_mac(1), 2)])
+        assert out["slow"][0][1] is not None
+        errs = engine.stats.slow_errors
+        with armed(FaultPlan(1, [
+                FaultSpec("engine.slow_drain", F.FAIL, at_hit=1)]),
+                log=False):
+            out = engine.process([_discover(_mac(2), 3)])
+        assert out["slow"] == [(0, None)]
+        assert engine.stats.slow_errors == errs + 1
+
+
+# ---------------------------------------------------------------------------
+# scenarios + runner determinism
+# ---------------------------------------------------------------------------
+
+class TestScenarios:
+    @pytest.mark.parametrize("name", sorted(SCENARIOS))
+    def test_scenario_ends_with_clean_audit(self, name):
+        result = SCENARIOS[name](seed=123)
+        assert result["ok"], json.dumps(result, indent=1)
+
+    def test_run_scenarios_deterministic(self):
+        a = runner.canonical_json(runner.run_scenarios(seed=9))
+        b = runner.canonical_json(runner.run_scenarios(seed=9))
+        assert a == b
+
+    def test_soak_fast(self):
+        r = runner.soak(seed=5, epochs=3)
+        assert r["ok"], json.dumps(r, indent=1)
+        assert all(e["audit_ok"] for e in r["epochs"])
+
+    def test_soak_deterministic(self):
+        a = runner.canonical_json(runner.soak(seed=6, epochs=2))
+        b = runner.canonical_json(runner.soak(seed=6, epochs=2))
+        assert a == b
+
+    def test_unknown_scenario_rejected(self):
+        with pytest.raises(ValueError):
+            runner.run_scenarios(seed=1, names=["nope"])
+
+    def test_metrics_families_recorded(self):
+        from bng_tpu.control.metrics import BNGMetrics
+
+        m = BNGMetrics()
+        r = runner.soak(seed=5, epochs=2, metrics=m)
+        assert r["ok"]
+        assert m.invariant_audits.value() == 2
+        assert m.invariant_last_violations.value() == 0
+        text = m.expose()
+        assert "bng_chaos_faults_injected_total" in text
+        assert "bng_invariant_audits_total" in text
+        # the audit epoch gauge carries the LAST epoch index
+        assert m.invariant_last_epoch.value() == 1
+
+    @pytest.mark.slow
+    def test_long_soak(self):
+        r = runner.soak(seed=17, epochs=12, n_macs=48, workers=4,
+                        n_faults=16)
+        assert r["ok"], json.dumps(r, indent=1)
+        assert len(r["injected"]["injected"]) >= 4
+
+
+# ---------------------------------------------------------------------------
+# CLI: bng chaos run / checkpoint restore --audit
+# ---------------------------------------------------------------------------
+
+class TestCLI:
+    def test_chaos_run_bit_deterministic(self, capsys):
+        from bng_tpu.cli import main
+
+        assert main(["chaos", "run", "--seed", "5"]) == 0
+        first = capsys.readouterr().out
+        assert main(["chaos", "run", "--seed", "5"]) == 0
+        second = capsys.readouterr().out
+        assert first == second
+        assert json.loads(first)["ok"] is True
+
+    def test_chaos_run_single_scenario(self, capsys):
+        from bng_tpu.cli import main
+
+        rc = main(["chaos", "run", "--seed", "5",
+                   "--scenario", "nat_expiry_under_skew"])
+        out = json.loads(capsys.readouterr().out)
+        assert rc == 0
+        assert list(out["scenarios"]) == ["nat_expiry_under_skew"]
+
+    def _app_cfg(self, tmp_path):
+        from bng_tpu.cli import BNGConfig
+
+        return BNGConfig(
+            slowpath_workers=2, slowpath_worker_mode="inline",
+            checkpoint_dir=str(tmp_path), metrics_enabled=False,
+            dhcpv6_enabled=False, slaac_enabled=False,
+            walled_garden_enabled=False)
+
+    _CLI_FLAGS = ["--slowpath-workers", "2",
+                  "--slowpath-worker-mode", "inline",
+                  "--no-metrics-enabled", "--no-dhcpv6-enabled",
+                  "--no-slaac-enabled", "--no-walled-garden-enabled"]
+
+    def test_checkpoint_restore_audit_accepts_good_snapshot(
+            self, tmp_path, capsys):
+        from bng_tpu.cli import BNGApp, main
+
+        app = BNGApp(self._app_cfg(tmp_path))
+        try:
+            leased = dora_with_retries(app.components["fleet"], MACS,
+                                       SimClock())
+            assert len(leased) == len(MACS)
+            app.components["checkpointer"].save_now(reason="test")
+        finally:
+            app.close()
+        rc = main(["checkpoint", "restore", "--checkpoint-dir",
+                   str(tmp_path), "--audit"] + self._CLI_FLAGS)
+        out = json.loads(capsys.readouterr().out)
+        assert rc == 0
+        assert out["audit"]["ok"] is True
+        assert out["restored_rows"]["fleet.leases"] == len(MACS)
+
+    def test_checkpoint_restore_audit_refuses_bad_snapshot(
+            self, tmp_path, capsys):
+        """A snapshot that hydrates into a double-leased address must
+        exit rc=2 — it can never silently serve traffic."""
+        from bng_tpu.cli import BNGApp, main
+
+        app = BNGApp(self._app_cfg(tmp_path))
+        try:
+            fleet = app.components["fleet"]
+            leased = dora_with_retries(fleet, MACS, SimClock())
+            victim_ip = next(iter(leased.values()))
+            fleet._inline[0].restore_state({
+                "session_seq": 0, "revoke": [], "leases": [{
+                    "mac": _mac(999).hex(), "ip": victim_ip,
+                    "pool_id": 1, "expiry": 2_000_000_000,
+                    "circuit_id": "", "remote_id": "", "s_tag": 0,
+                    "c_tag": 0, "session_id": "forged",
+                    "client_class": 0, "username": "",
+                    "qos_policy": ""}]})
+            app.components["checkpointer"].save_now(reason="test")
+        finally:
+            app.close()
+        rc = main(["checkpoint", "restore", "--checkpoint-dir",
+                   str(tmp_path), "--audit"] + self._CLI_FLAGS)
+        out = json.loads(capsys.readouterr().out)
+        assert rc == 2
+        assert "double-lease" in out["audit"]["violations_by_kind"]
+
+    def test_corrupt_newest_falls_back_then_audits(self, tmp_path,
+                                                   capsys):
+        """End to end: corrupt the NEWEST file on disk; restore --audit
+        falls back to the older good snapshot and still passes."""
+        from bng_tpu.cli import BNGApp, main
+
+        app = BNGApp(self._app_cfg(tmp_path))
+        try:
+            dora_with_retries(app.components["fleet"], MACS, SimClock())
+            app.components["checkpointer"].save_now(reason="test")
+            app.components["checkpointer"].save_now(reason="test")
+        finally:
+            app.close()
+        files = sorted(tmp_path.glob("ckpt-*.bngckpt"))
+        assert len(files) == 2
+        newest = files[-1]
+        newest.write_bytes(newest.read_bytes()[:-200])  # torn write
+        rc = main(["checkpoint", "restore", "--checkpoint-dir",
+                   str(tmp_path), "--audit"] + self._CLI_FLAGS)
+        out = json.loads(capsys.readouterr().out)
+        assert rc == 0
+        assert out["audit"]["ok"] is True
+        assert out["restored_rows"]["fleet.leases"] == len(MACS)
