@@ -16,6 +16,13 @@
 #                       `bng chaos run --seed 7` compared byte-for-byte
 #                       (the bit-determinism acceptance gate). The long
 #                       soak lives under @pytest.mark.slow.
+#   make verify-telemetry — telemetry tests with tracing ARMED via
+#                       BNG_TELEMETRY=1 (< 30 s): disarmed-overhead
+#                       bound, histogram merge laws, flight-recorder
+#                       wrap + every anomaly trigger, Chrome-trace
+#                       schema. The engine-compiling DORA e2e lives in
+#                       the same file under @pytest.mark.slow (tier-1
+#                       runs it; this target stays fast).
 
 SHELL := /bin/bash
 PY ?= python
@@ -23,7 +30,8 @@ TIER1_TIMEOUT ?= 870
 PYTEST_FLAGS = -q --continue-on-collection-errors -p no:cacheprovider \
                -p no:xdist -p no:randomly
 
-.PHONY: verify verify-slow verify-all verify-load verify-chaos
+.PHONY: verify verify-slow verify-all verify-load verify-chaos \
+        verify-telemetry
 
 verify:
 	set -o pipefail; rm -f /tmp/_t1.log; \
@@ -49,6 +57,13 @@ verify-chaos:
 	&& echo "verify-chaos OK: report bit-deterministic" \
 	|| { echo "verify-chaos FAILED: scenario failure or same-seed \
 	reports differ"; exit 1; }
+
+verify-telemetry:
+	set -o pipefail; \
+	timeout -k 10 30 env JAX_PLATFORMS=cpu BNG_TELEMETRY=1 \
+	$(PY) -m pytest tests/test_telemetry.py $(PYTEST_FLAGS) \
+	  -m 'telemetry and not slow' \
+	&& echo "verify-telemetry OK"
 
 verify-load:
 	set -o pipefail; \

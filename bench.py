@@ -229,13 +229,23 @@ def main(on_tpu: bool) -> None:
     hit_rate = (n_tx + n_fwd) / B
 
     # ---- timed sustained loop (per-step latency measured too) ----
+    # telemetry spans decompose each step into dispatch (host enqueue)
+    # vs device_wait (blocked sync) — the stage_breakdown quantities
+    from bng_tpu.telemetry import spans as tele
+
     lat = []
     t0 = time.time()
     for k in range(STEPS):
         t1 = time.perf_counter()
+        tok = tele.begin_batch(tele.LANE_BENCH, B)
+        td = tele.t()
         tables, verdict, ds, ns = step(tables, pkt_d, len_d, fa_d,
                                        jnp.uint32(now + 1 + k), jnp.uint32(k * 100))
+        tele.lap(tele.DISPATCH, td, tok)
+        td = tele.t()
         verdict.block_until_ready()
+        tele.lap(tele.DEVICE_WAIT, td, tok)
+        tele.end_batch(tok)
         lat.append(time.perf_counter() - t1)
     elapsed = time.time() - t0
 
@@ -315,14 +325,49 @@ def main(on_tpu: bool) -> None:
     llat = []
     for k in range(LAT_STEPS):
         t1 = time.perf_counter()
+        tok = tele.begin_batch(tele.LANE_BENCH, B_LAT)
+        td = tele.t()
         lreply, lout, lolen = dhcp_step(dtables, lpkt_d, llen_d,
                                         jnp.uint32(now + k))
+        tele.lap(tele.DISPATCH, td, tok)
+        td = tele.t()
         lreply.block_until_ready()
+        tele.lap(tele.DEVICE_WAIT, td, tok)
+        tele.end_batch(tok)
         llat.append(time.perf_counter() - t1)
     llat_us = np.array(llat) * 1e6
     offer_p50 = float(np.percentile(llat_us, 50))
     offer_p99 = float(np.percentile(llat_us, 99))
     offer_hits = int(np.asarray(lreply).sum())
+
+    # ---- device-ONLY OFFER latency (profiler-fenced; VERDICT r5) ----
+    # The <50us p99 target constrains DEVICE time. Blocked wall time
+    # above includes host dispatch + sync artifacts (the axon tunnel's
+    # ~63ms completion-poll bucket, PERF_NOTES §1); the XLA profiler's
+    # per-execution events isolate the program itself, fenced by
+    # jax.block_until_ready inside profile_step_durations. Published as
+    # its own key so a tunnel artifact can never masquerade as device
+    # cost again — and on XLA:CPU the closest isolate (per-execution
+    # TfrtCpuExecutable time) is labeled "cpu-exec", never "device".
+    offer_dev_p50 = offer_dev_p99 = 0.0
+    device_source = "none"
+    try:
+        from bng_tpu.utils.profiling import profile_step_durations
+
+        sd = profile_step_durations(
+            lambda: dhcp_step(dtables, lpkt_d, llen_d, jnp.uint32(now)),
+            iters=max(20, min(LAT_STEPS, 200)))
+        if sd.us:
+            offer_dev_p50 = sd.percentile(50)
+            offer_dev_p99 = sd.percentile(99)
+            device_source = sd.source
+            tr = tele.tracer()
+            if tr is not None:  # the `device` stage in stage_breakdown
+                tr.observe_many(tele.DEVICE, sd.us)
+        else:
+            _DIAG["device_profile_error"] = "no per-execution events in trace"
+    except Exception as e:  # profiling must never sink the benchmark
+        _DIAG["device_profile_error"] = f"{type(e).__name__}: {e}"
 
     offer_profile_top = None
     if want_profile == "1":
@@ -399,10 +444,20 @@ def main(on_tpu: bool) -> None:
         "batch_latency_p99_us": round(p99, 1),
         "offer_p50_us": round(offer_p50, 1),
         "offer_p99_us": round(offer_p99, 1),
+        # the quantity the 50us target actually constrains (fenced
+        # device/executable time, never host wall) — see device_time_source
+        "offer_device_only_p50_us": round(offer_dev_p50, 1),
+        "offer_device_only_p99_us": round(offer_dev_p99, 1),
+        "device_time_source": device_source,
         "offer_latency_batch": B_LAT,
         "offer_program": "dhcp_fastpath",  # reference parity: own XDP prog
         "offer_hits": offer_hits,
         "latency_curve": curve,
+        # per-stage p50/p99 from the telemetry tracer (dispatch /
+        # device_wait are host decomposition; `device` is the fenced
+        # profiler distribution above)
+        "stage_breakdown": (tele.tracer().breakdown()
+                            if tele.tracer() is not None else {}),
         **({"profile_top_ops": profile_top} if profile_top else {}),
         **({"offer_profile_top_ops": offer_profile_top} if offer_profile_top else {}),
         "device": str(dev),
@@ -410,6 +465,9 @@ def main(on_tpu: bool) -> None:
         "setup_s": round(setup_s, 1),
         **extra,
     }
+    _finalize_diag()
+    line = _order_line({**line, **{k: v for k, v in _DIAG.items()
+                                   if k not in line}})
     print(json.dumps(line))
     _persist(line)
 
@@ -468,6 +526,35 @@ def _timed_loop(step, args, steps, batch, carry: bool = False):
 # merged into every emitted JSON line: backend-fallback diagnostics etc.
 _DIAG: dict = {}
 
+# keys that must lead the emitted JSON object (VERDICT "What's weak" §1:
+# a CPU-fallback run was read as a TPU headline because the flag sat
+# buried mid-object — a reader skimming the first line must hit it first)
+_LEAD_KEYS = ("backend_fallback", "backend_error", "flight_record",
+              "tunnel_precheck")
+
+
+def _order_line(line: dict) -> dict:
+    """Reorder so backend-fallback diagnostics lead the object (dicts
+    are insertion-ordered; json.dumps preserves it)."""
+    lead = {k: line[k] for k in _LEAD_KEYS if k in line}
+    if not lead:
+        return line
+    return {**lead, **{k: v for k, v in line.items() if k not in lead}}
+
+
+def _finalize_diag() -> None:
+    """Pre-print hook: a CPU-fallback run must dump the flight recorder
+    (telemetry armed by _child_dispatch) and carry the dump path in its
+    JSON — the gray-failure class where three rounds published CPU
+    numbers while every metric looked healthy."""
+    if "backend_fallback" in _DIAG and "flight_record" not in _DIAG:
+        from bng_tpu.telemetry import spans as tele
+
+        path = tele.trigger("backend_fallback",
+                            _DIAG.get("backend_error", ""))
+        if path:
+            _DIAG["flight_record"] = path
+
 
 def _probe_window() -> float:
     """Capture-on-return probe window (s), shared by child and supervisor.
@@ -497,8 +584,11 @@ def _persist(line: dict) -> None:
 
 
 def _emit(metric, value, unit, baseline, **extra):
-    line = {"metric": metric, "value": round(value, 3), "unit": unit,
-            "vs_baseline": round(value / baseline, 4), **extra, **_DIAG}
+    _finalize_diag()
+    line = _order_line({"metric": metric, "value": round(value, 3),
+                        "unit": unit,
+                        "vs_baseline": round(value / baseline, 4),
+                        **extra, **_DIAG})
     print(json.dumps(line))
     _persist(line)
 
@@ -1149,6 +1239,10 @@ def scheduler_bench(on_tpu: bool, checkpoint_interval_s: float = 0.0) -> None:
             offer_device_p50 = sd.percentile(50)
             offer_device_p99 = sd.percentile(99)
             device_source = sd.source
+            from bng_tpu.telemetry import spans as _tele
+
+            if _tele.tracer() is not None:  # `device` stage, fenced
+                _tele.tracer().observe_many(_tele.DEVICE, sd.us)
         else:
             _DIAG["sched_profile_error"] = "no per-execution events in trace"
     except Exception as e:  # profiling must never sink the benchmark
@@ -1200,6 +1294,10 @@ def scheduler_bench(on_tpu: bool, checkpoint_interval_s: float = 0.0) -> None:
         "offer_p99_us": round(offer_p99, 1),
         "offer_device_p50_us": round(offer_device_p50, 1),
         "offer_device_p99_us": round(offer_device_p99, 1),
+        # default-path key parity (the 50us target's quantity under one
+        # name whichever mode produced the artifact)
+        "offer_device_only_p50_us": round(offer_device_p50, 1),
+        "offer_device_only_p99_us": round(offer_device_p99, 1),
         "device_time_source": device_source,
         "offer_hits_warm": offer_hits,
         "express_under_load_p50_us": round(under_load_p50, 1),
@@ -1222,6 +1320,15 @@ def scheduler_bench(on_tpu: bool, checkpoint_interval_s: float = 0.0) -> None:
         "setup_s": round(setup_s, 1),
         **_DIAG,
     }
+    from bng_tpu.telemetry import spans as _tele2
+
+    if _tele2.tracer() is not None:
+        # scheduler paths are span-instrumented end to end — the full
+        # lifecycle breakdown (lane_wait/dispatch/device_wait/slow/reply)
+        line["stage_breakdown"] = _tele2.tracer().breakdown()
+    _finalize_diag()
+    line = _order_line({**line, **{k: v for k, v in _DIAG.items()
+                                   if k not in line}})
     print(json.dumps(line))
     _persist(line)
 
@@ -1239,9 +1346,10 @@ _CONFIG_METRICS = {
 
 def _error_line(config: int, err: str) -> str:
     metric, unit = _CONFIG_METRICS.get(config, _CONFIG_METRICS[0])
-    return json.dumps({"metric": metric, "value": 0.0, "unit": unit,
-                       "vs_baseline": 0.0, "config": config,
-                       "error": err, **_DIAG})
+    return json.dumps(_order_line({"metric": metric, "value": 0.0,
+                                   "unit": unit, "vs_baseline": 0.0,
+                                   "config": config, "error": err,
+                                   **_DIAG}))
 
 
 def _run_lowering_gate(strict: bool) -> None:
@@ -1270,7 +1378,8 @@ def _run_lowering_gate(strict: bool) -> None:
 
 def _child_dispatch(config: int, verify_lowering: bool = False,
                     scheduler: bool = False,
-                    checkpoint_interval_s: float = 0.0) -> None:
+                    checkpoint_interval_s: float = 0.0,
+                    require_tpu: bool = False) -> None:
     """Run one benchmark config in this process (the supervised child)."""
     try:
         if config == 1 and not verify_lowering and not scheduler:
@@ -1281,9 +1390,19 @@ def _child_dispatch(config: int, verify_lowering: bool = False,
         # subprocess with a timeout; on failure, fall back to a hermetic CPU
         # backend and record the diagnostic in the JSON line. Round 1 shipped
         # both failure modes as artifacts (BENCH_r01 rc=1, MULTICHIP rc=124).
-        from bng_tpu.utils.jaxenv import guarded_backend
+        from bng_tpu.utils.jaxenv import guarded_backend, tunnel_precheck
 
         window = _probe_window()
+        if window > 0:
+            # cheap relay/tunnel health check BEFORE committing the 900s
+            # window: a fast "up" skips straight to the real probe; a
+            # fast "down" is recorded and the window runs with BACKOFF
+            # cadence (poll often early — tunnels usually flap back in
+            # under a minute — without burning the window on a dead one)
+            up, diag = tunnel_precheck(
+                float(os.environ.get("BNG_BENCH_PRECHECK_TIMEOUT", 20)))
+            _DIAG["tunnel_precheck"] = "up" if up else f"down: {diag}"
+            _mark(f"tunnel precheck: {_DIAG['tunnel_precheck']}")
         _mark("probing accelerator availability"
               + (f" (capture-on-return window {window:.0f}s)..." if window
                  else "..."))
@@ -1295,14 +1414,35 @@ def _child_dispatch(config: int, verify_lowering: bool = False,
             tries=int(os.environ.get("BNG_BENCH_PROBE_TRIES",
                                      999 if window > 0 else 2)),
             probe_timeout_s=float(os.environ.get("BNG_BENCH_PROBE_TIMEOUT", 150)),
-            retry_sleep_s=float(os.environ.get("BNG_BENCH_PROBE_SLEEP", 45)),
+            retry_sleep_s=float(os.environ.get(
+                "BNG_BENCH_PROBE_SLEEP", 15 if window > 0 else 45)),
             window_s=window,
+            backoff=float(os.environ.get(
+                "BNG_BENCH_PROBE_BACKOFF", 1.6 if window > 0 else 1.0)),
         )
         on_tpu = platform not in ("cpu",)
         _mark(f"backend: {platform}" + (f" (fallback: {err})" if err else ""))
         if err:
             _DIAG["backend_fallback"] = "cpu"
             _DIAG["backend_error"] = err
+        if require_tpu and not on_tpu:
+            # CI gate: refuse to publish CPU numbers as headlines — emit
+            # the flagged error line and exit nonzero (rc=3)
+            _DIAG.setdefault("backend_fallback", "cpu")
+            _DIAG.setdefault("backend_error", err or "no accelerator")
+            print(_error_line(config,
+                              "--require-tpu: accelerator unavailable, "
+                              "refusing to run on CPU"))
+            sys.exit(3)
+        # arm the telemetry tracer for the run: stage_breakdown in the
+        # emitted JSON, and the flight recorder that must dump on a
+        # backend fallback (_finalize_diag)
+        from bng_tpu.telemetry import (FlightRecorder, RecorderConfig,
+                                       spans as tele)
+
+        recorder = FlightRecorder(RecorderConfig())
+        recorder.set_backend(platform)
+        tele.arm(tele.Tracer(recorder=recorder))
         # persistent XLA compile cache: repeat bench runs skip the
         # minutes-long compile phase (verdict weakness 5; BNG_JAX_CACHE_DIR=0 off)
         from bng_tpu.utils.jaxenv import enable_compilation_cache
@@ -1397,6 +1537,69 @@ def chaos_overhead_bench() -> None:
     }))
 
 
+def telemetry_overhead_bench() -> None:
+    """--telemetry-overhead: price the DISARMED telemetry span hooks on
+    the hot path (PERF_NOTES §8) with the §7 methodology. Three numbers:
+
+    1. ns/call of `spans.t()` disarmed (one module-global load + is-None
+       compare — the origin half of every instrumented region);
+    2. ns/call of `spans.lap()` with a None origin (the close half);
+    3. the slow-path fleet's renewal req/s over repeated runs, whose
+       run-to-run spread is the noise floor the per-batch hook cost
+       must sit below (instrumented sites pay ~10 hook calls per BATCH,
+       amortized over >= dozens of frames).
+
+    Pure host measurement — no device, no child process needed.
+    """
+    import timeit
+
+    from bng_tpu.chaos.scenarios import (_mac, _renew, build_fleet,
+                                         dora_with_retries)
+    from bng_tpu.chaos.faults import SimClock
+    from bng_tpu.telemetry import spans
+
+    assert not spans.enabled()
+    n = 2_000_000
+    t_ns = (timeit.Timer("f()", globals={"f": spans.t}).timeit(n)
+            / n * 1e9)
+    lap_ns = (timeit.Timer("f(3, None)",
+                           globals={"f": spans.lap}).timeit(n) / n * 1e9)
+    stamp_ns = (timeit.Timer("f(3)",
+                             globals={"f": spans.stamp}).timeit(n) / n * 1e9)
+
+    clock = SimClock()
+    fleet, _pools, _fastpath = build_fleet(2, clock, slice_size=1024)
+    macs = [_mac(i) for i in range(512)]
+    leased = dora_with_retries(fleet, macs, clock)
+    frames = [(i, _renew(m, leased[m], i)) for i, m in enumerate(macs)]
+    reps = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        for _b in range(4):
+            fleet.handle_batch(frames, now=clock())
+        dt = time.perf_counter() - t0
+        reps.append(4 * len(frames) / dt)
+    mean = sum(reps) / len(reps)
+    spread_pct = (max(reps) - min(reps)) / mean * 100.0
+    per_frame_ns = 1e9 / mean
+    # the fleet slow path pays 4 hook calls/batch (admit span + shed
+    # count + fleet span) + the engine's ~8/batch; per FRAME the cost is
+    # hooks/batch / frames-per-batch — bound it with the worst case of
+    # one t()+lap() pair per frame
+    overhead_pct = (t_ns + lap_ns) / per_frame_ns * 100.0
+    print(json.dumps({
+        "metric": "telemetry_disarmed_overhead",
+        "span_t_ns_per_call": round(t_ns, 1),
+        "span_lap_ns_per_call": round(lap_ns, 1),
+        "span_stamp_ns_per_call": round(stamp_ns, 1),
+        "slowpath_req_s_mean": round(mean),
+        "slowpath_req_s_runs": [round(r) for r in reps],
+        "run_to_run_spread_pct": round(spread_pct, 2),
+        "hook_pair_per_frame_pct": round(overhead_pct, 4),
+        "below_noise": overhead_pct < spread_pct,
+    }))
+
+
 def main_dispatch() -> None:
     """Supervisor: run the benchmark in a killable child process.
 
@@ -1426,17 +1629,28 @@ def main_dispatch() -> None:
                     help="measure the disarmed fault_point hook cost vs "
                          "slow-path run-to-run noise (PERF_NOTES §7); "
                          "host-only, no device")
+    ap.add_argument("--telemetry-overhead", action="store_true",
+                    help="measure the disarmed telemetry span hook cost "
+                         "vs slow-path run-to-run noise (PERF_NOTES §8); "
+                         "host-only, no device")
+    ap.add_argument("--require-tpu", action="store_true",
+                    help="exit nonzero (rc=3) instead of publishing "
+                         "CPU-fallback numbers — the CI headline gate")
     args = ap.parse_args()
 
     if args.chaos_overhead:
         # pure-host micro-measurement: nothing to hang on, no child
         chaos_overhead_bench()
         return
+    if args.telemetry_overhead:
+        telemetry_overhead_bench()
+        return
 
     if os.environ.get("BNG_BENCH_CHILD") == "1":
         _child_dispatch(args.config, verify_lowering=args.verify_lowering,
                         scheduler=args.scheduler,
-                        checkpoint_interval_s=args.checkpoint_interval_s)
+                        checkpoint_interval_s=args.checkpoint_interval_s,
+                        require_tpu=args.require_tpu)
         return
 
     # BNG_BENCH_TIMEOUT bounds the benchmark itself; the probe window is
@@ -1458,18 +1672,19 @@ def main_dispatch() -> None:
         else:
             print(_error_line(args.config,
                               f"child rc={res.returncode}, no JSON emitted"))
-        if args.verify_lowering or args.scheduler:
-            # CI pre-step / scheduler mode: propagate the child verdict
-            # (scheduler exits 2 when lowering verification refused it)
+        if args.verify_lowering or args.scheduler or args.require_tpu:
+            # CI pre-step / scheduler mode / headline gate: propagate the
+            # child verdict (scheduler exits 2 when lowering verification
+            # refused it; --require-tpu exits 3 on CPU fallback)
             sys.exit(res.returncode)
     except subprocess.TimeoutExpired:
         print(_error_line(args.config,
                           f"benchmark child timed out after {timeout_s:.0f}s"))
-        if args.verify_lowering or args.scheduler:
+        if args.verify_lowering or args.scheduler or args.require_tpu:
             sys.exit(1)  # a gate that never ran is a failed gate
     except Exception as e:  # pragma: no cover - spawn failure
         print(_error_line(args.config, f"supervisor error: {type(e).__name__}: {e}"))
-        if args.verify_lowering or args.scheduler:
+        if args.verify_lowering or args.scheduler or args.require_tpu:
             sys.exit(1)
 
 

@@ -588,6 +588,15 @@ def audit_invariants(*, engine=None, scheduler=None, fastpath=None,
 
     if metrics is not None:
         metrics.record_audit(report, epoch=epoch)
+    if not report.ok:
+        # flight-recorder anomaly hook (telemetry/recorder.py): an
+        # invariant violation must leave the last-N batch evidence on
+        # disk the moment it is proven, not at run end. Disarmed: one
+        # global load + None compare.
+        from bng_tpu.telemetry import spans as _tele
+
+        _tele.trigger("invariant_violation",
+                      str(report.violations_by_kind()))
     return report
 
 

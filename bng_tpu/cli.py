@@ -154,6 +154,13 @@ class BNGConfig:
     checkpoint_dir: str = ""
     checkpoint_interval_s: float = 0.0
     checkpoint_keep: int = 3
+    # telemetry (bng_tpu/telemetry): span tracing + per-batch flight
+    # recorder. Off by default (disarmed hooks cost one global load per
+    # call site); BNG_TELEMETRY=1 arms it too (the env is how fleet
+    # worker processes inherit the setting).
+    telemetry_enabled: bool = False
+    trace_dir: str = ""  # "" -> $BNG_TRACE_DIR or <tmp>/bng-flightrec
+    trace_budget_us: float = 0.0  # latency-excursion dump trigger; 0=off
     # metrics
     metrics_port: int = 9090
     metrics_enabled: bool = True
@@ -245,6 +252,26 @@ class BNGApp:
 
         structlog.setup(self.config.log_level, self.config.log_format)
         self.log = structlog.get_logger("app", node_id=self.config.node_id)
+
+        # 0. telemetry — armed FIRST so every later construction step
+        # (fleet spawn exports BNG_TELEMETRY to workers; engine/scheduler
+        # spans) sees the armed tracer. Metrics attach at step 13.
+        import os as _os
+
+        if self.config.telemetry_enabled or _os.environ.get(
+                "BNG_TELEMETRY") == "1":
+            from bng_tpu.telemetry import (FlightRecorder, RecorderConfig,
+                                           spans as tele_spans)
+
+            recorder = FlightRecorder(RecorderConfig(
+                latency_budget_us=self.config.trace_budget_us,
+                out_dir=self.config.trace_dir))
+            tracer = self.components["telemetry"] = tele_spans.arm(
+                tele_spans.Tracer(recorder=recorder))
+            self._on_close(tele_spans.disarm)
+            self.log.info("telemetry armed",
+                          trace_dir=recorder.cfg.out_dir or "(default)",
+                          budget_us=self.config.trace_budget_us)
 
         from bng_tpu.control import walledgarden as wg
         from bng_tpu.control.dhcp_server import DHCPServer
@@ -595,6 +622,13 @@ class BNGApp:
             clock=self.clock)
         self.log.info("engine built", batch_size=cfg.batch_size,
                       nat=cfg.nat_enabled, qos=cfg.qos_enabled)
+        if "telemetry" in c:
+            import jax as _jax
+
+            # flight records must name the backend that actually served
+            # them — the gray-failure flag (a CPU fallback must never
+            # read as a TPU run)
+            c["telemetry"].recorder.set_backend(_jax.default_backend())
 
         # 9a. latency-tiered scheduler over the engine's two programs
         # (express DHCP / depth-pipelined bulk) — opt-in; drive_once then
@@ -1203,6 +1237,13 @@ class BNGApp:
                 fleet_c = c["fleet"]
                 collector.add_source(
                     lambda: metrics.collect_fleet(fleet_c))
+            if "telemetry" in c:
+                tele_tr = c["telemetry"]
+                # bng_stage_latency_us renders live from the tracer's
+                # histograms at scrape; the counters ride the 5s loop
+                metrics.attach_telemetry(tele_tr)
+                collector.add_source(
+                    lambda: metrics.collect_telemetry(tele_tr))
             if cfg.dns_enabled:
                 collector.add_source(lambda: metrics.collect_dns(
                     dns_srv.stats, resolver.stats()))
@@ -1352,8 +1393,10 @@ class BNGApp:
         """
         from bng_tpu.runtime.ring import FLAG_DHCP_CTRL, FLAG_FROM_ACCESS
         from bng_tpu.runtime.scheduler import LANE_BULK, LANE_EXPRESS
+        from bng_tpu.telemetry import spans as tele
 
         moved = 0
+        t0 = tele.t()
         budget = sched.bulk.cfg.batch * sched.bulk.cfg.depth
         for _ in range(budget):
             got = ring.rx_pop()
@@ -1370,6 +1413,8 @@ class BNGApp:
             # closes — otherwise the run loop's moved==0 idle sleep (1ms)
             # would stretch a sub-ms express deadline close
             moved += 1
+        if moved:
+            tele.lap(tele.RING, t0)
         moved += sched.poll()
         if moved == 0 and (len(sched.express) or len(sched.bulk)):
             # frames are waiting on a deadline close: keep the run loop
@@ -1667,6 +1712,16 @@ def run_loadtest(args) -> int:
     server = DHCPServer(server_mac, server_ip, pools, fastpath_tables=fastpath)
     engine = Engine(fastpath, nat, batch_size=args.batch_size,
                     slow_path=server.handle_frame)
+    tracer = None
+    if getattr(args, "trace", False):
+        # --trace: arm the telemetry tracer BEFORE the fleet spawns — a
+        # process-mode fleet exports BNG_TELEMETRY to its children at
+        # construction, which is how worker processes know to build the
+        # per-frame histograms the `worker` stage merges. The report
+        # gains the per-stage latency breakdown.
+        from bng_tpu.telemetry import spans as tele_spans
+
+        tracer = tele_spans.arm(tele_spans.Tracer())
     fleet = None
     workers = getattr(args, "workers", 1) or 1
     if workers > 1:
@@ -1703,6 +1758,10 @@ def run_loadtest(args) -> int:
     try:
         res = bench.run()
     finally:
+        if tracer is not None:
+            from bng_tpu.telemetry import spans as tele_spans
+
+            tele_spans.disarm()
         if fleet is not None:
             fleet_snap = fleet.stats_snapshot()
             fleet.close()
@@ -1711,6 +1770,8 @@ def run_loadtest(args) -> int:
         out = res.to_dict()
         if fleet is not None:
             out["fleet"] = fleet_snap
+        if tracer is not None:
+            out["stage_breakdown"] = tracer.breakdown()
         print(json.dumps(out, indent=2))
     else:
         print(res.summary())
@@ -1719,11 +1780,184 @@ def run_loadtest(args) -> int:
             print(f"Fleet:             {fleet_snap['workers']} workers, "
                   f"{adm['admitted']} admitted, "
                   f"{sum(adm['shed'].values())} shed")
+        if tracer is not None:
+            print("Stage breakdown (us):")
+            for stage, s in tracer.breakdown().items():
+                print(f"  {stage:<12} p50 {s['p50_us']:>9.1f}   "
+                      f"p99 {s['p99_us']:>9.1f}   n {s['count']}")
     if args.validate:
         failures = res.meets_targets(cfg)
         for f in failures:
             print(f"TARGET FAILED: {f}", file=sys.stderr)
         return 1 if failures else 0
+    return 0
+
+
+def _trace_dora(args):
+    """Build a self-contained engine (+scheduler/+inline fleet) stack,
+    arm a span-event-keeping tracer, and drive a full DORA exchange for
+    `--macs` subscribers plus a renewal round that hits the device fast
+    path — the canonical traced workload `bng trace dump/export` ships.
+    Returns (tracer, recorder) with the tracer DISARMED again."""
+    import ipaddress
+
+    from bng_tpu.control import dhcp_codec, packets
+    from bng_tpu.control.dhcp_server import DHCPServer
+    from bng_tpu.control.nat import NATManager
+    from bng_tpu.control.pool import Pool, PoolManager
+    from bng_tpu.runtime.engine import Engine
+    from bng_tpu.runtime.tables import FastPathTables
+    from bng_tpu.telemetry import FlightRecorder, RecorderConfig
+    from bng_tpu.telemetry import spans as tele
+    from bng_tpu.utils.net import ip_to_u32, parse_mac
+
+    net = ipaddress.ip_network(args.pool_cidr)
+    server_ip = int(net.network_address + 1)
+    server_mac = parse_mac("02:aa:bb:cc:dd:01")
+    fastpath = FastPathTables(sub_nbuckets=1 << 10, vlan_nbuckets=64,
+                              cid_nbuckets=64, max_pools=4,
+                              update_slots=max(256, 2 * args.batch_size))
+    fastpath.set_server_config(server_mac, server_ip)
+    pools = PoolManager(fastpath)
+    pools.add_pool(Pool(pool_id=1, network=int(net.network_address),
+                        prefix_len=net.prefixlen, gateway=server_ip,
+                        dns_primary=ip_to_u32("1.1.1.1"), lease_time=3600))
+    nat = NATManager(public_ips=[ip_to_u32("203.0.113.1")],
+                     sessions_nbuckets=256, sub_nat_nbuckets=64)
+    server = DHCPServer(server_mac, server_ip, pools,
+                        fastpath_tables=fastpath)
+    engine = Engine(fastpath, nat, batch_size=args.batch_size,
+                    slow_path=server.handle_frame)
+    fleet = None
+    if args.workers > 1:
+        from bng_tpu.control.admission import AdmissionConfig
+        from bng_tpu.control.fleet import FleetSpec, SlowPathFleet
+
+        # inline workers: deterministic, and the worker-stage histogram
+        # still exercises the cross-worker merge path. A generous
+        # deadline keeps compile-cold first batches from being shed.
+        fleet = SlowPathFleet(
+            FleetSpec.from_pool_manager(server_mac, server_ip, pools),
+            n_workers=args.workers, pools=pools, mode="inline",
+            admission=AdmissionConfig(
+                inbox_capacity=max(512, 2 * args.batch_size),
+                deadline_ms=60_000.0),
+            table_sink=fastpath)
+        engine.slow_path_batch = fleet.handle_batch
+    target = engine
+    if args.scheduler:
+        from bng_tpu.runtime.scheduler import (SchedulerConfig,
+                                               TieredScheduler)
+
+        target = TieredScheduler(engine, SchedulerConfig(
+            bulk_batch=args.batch_size))
+
+    recorder = FlightRecorder(RecorderConfig(out_dir=args.trace_dir))
+    import jax
+
+    recorder.set_backend(jax.default_backend())
+    tracer = tele.Tracer(recorder=recorder, keep_events=1 << 14)
+
+    def discover(mac, xid):
+        p = dhcp_codec.build_request(mac, dhcp_codec.DISCOVER, xid=xid)
+        return packets.udp_packet(mac, b"\xff" * 6, 0, 0xFFFFFFFF, 68, 67,
+                                  p.encode().ljust(320, b"\x00"))
+
+    def request(mac, offer_frame, xid):
+        od = packets.decode(offer_frame)
+        off = dhcp_codec.decode(od.payload)
+        p = dhcp_codec.build_request(mac, dhcp_codec.REQUEST, xid=xid,
+                                     requested_ip=off.yiaddr,
+                                     server_id=od.src_ip)
+        return packets.udp_packet(mac, b"\xff" * 6, 0, 0xFFFFFFFF, 68, 67,
+                                  p.encode().ljust(320, b"\x00"))
+
+    macs = [(0x02C0 << 32 | i).to_bytes(6, "big") for i in range(args.macs)]
+    with tele.armed(tracer):
+        for base in range(0, len(macs), args.batch_size):
+            chunk = macs[base : base + args.batch_size]
+            res = target.process([discover(m, 0x1000 + base + k)
+                                  for k, m in enumerate(chunk)])
+            offers = {i: f for i, f in res["slow"] if f is not None}
+            offers.update({i: f for i, f in res.get("tx", [])})
+            reqs = [request(m, offers[k], 0x2000 + base + k)
+                    for k, m in enumerate(chunk) if k in offers]
+            if reqs:
+                target.process(reqs)
+        # renewal round: cached DISCOVERs answered on device (the
+        # trace shows the fast path next to the slow one)
+        target.process([discover(m, 0x3000 + k)
+                        for k, m in enumerate(macs[: args.batch_size])])
+        if hasattr(target, "flush"):
+            target.flush()
+    if fleet is not None:
+        fleet.close()
+    return tracer, recorder
+
+
+def run_trace(args) -> int:
+    """`bng trace status|dump|export` — operator verbs over the
+    telemetry subsystem. `status` lists flight dumps in the trace dir;
+    `dump` runs a traced DORA exchange and writes a flight-recorder
+    dump; `export --format chrome` emits Chrome-trace/Perfetto JSON of
+    the exchange's spans."""
+    import os
+
+    from bng_tpu.telemetry import chrome_trace, default_trace_dir
+
+    if args.trace_cmd == "status":
+        out_dir = args.trace_dir or default_trace_dir()
+        dumps = []
+        if os.path.isdir(out_dir):
+            for name in sorted(os.listdir(out_dir)):
+                if not name.startswith("flight-") or not name.endswith(".json"):
+                    continue
+                path = os.path.join(out_dir, name)
+                entry = {"file": name, "bytes": os.path.getsize(path)}
+                try:
+                    with open(path) as f:
+                        d = json.load(f)
+                    entry.update(reason=d.get("reason"),
+                                 backend=d.get("meta", {}).get("backend"),
+                                 records=len(d.get("records", ())))
+                except (OSError, ValueError):
+                    entry["error"] = "unreadable"
+                dumps.append(entry)
+        print(json.dumps({
+            "trace_dir": out_dir,
+            "armed_env": os.environ.get("BNG_TELEMETRY") == "1",
+            "dumps": dumps,
+        }, indent=2))
+        return 0
+
+    tracer, recorder = _trace_dora(args)
+    if args.trace_cmd == "dump":
+        path = recorder.dump("cli", "bng trace dump DORA exchange",
+                             path=args.out or None)
+        if path is None:
+            print("trace dump: write failed", file=sys.stderr)
+            return 1
+        print(json.dumps({"dump": path,
+                          "records": int(tracer.seq),
+                          "stage_breakdown": tracer.breakdown()}, indent=2))
+        return 0
+    # export
+    if args.format != "chrome":
+        print(f"trace export: unknown format {args.format!r} "
+              f"(supported: chrome)", file=sys.stderr)
+        return 2
+    trace = chrome_trace(tracer, label="bng-tpu DORA")
+    out_path = args.out or os.path.join(
+        args.trace_dir or default_trace_dir(), "dora-trace.json")
+    if os.path.dirname(out_path):
+        os.makedirs(os.path.dirname(out_path), exist_ok=True)
+    with open(out_path, "w") as f:
+        json.dump(trace, f)
+    n_x = sum(1 for e in trace["traceEvents"] if e.get("ph") == "X")
+    print(json.dumps({"trace": out_path, "events": n_x,
+                      "stages": sorted({e["name"] for e in
+                                        trace["traceEvents"]
+                                        if e.get("ph") == "X"})}, indent=2))
     return 0
 
 
@@ -1910,6 +2144,41 @@ def main(argv: list[str] | None = None) -> int:
                        choices=("process", "inline"),
                        help="fleet execution mode (inline = deterministic, "
                             "no child processes)")
+    loadp.add_argument("--trace", action="store_true",
+                       help="arm the telemetry tracer for the run and "
+                            "report the per-stage latency breakdown")
+
+    # telemetry subsystem (bng_tpu/telemetry)
+    tracep = sub.add_parser("trace", help="telemetry: flight-recorder "
+                            "status/dumps and Chrome-trace export of a "
+                            "traced DORA exchange")
+    trace_sub = tracep.add_subparsers(dest="trace_cmd", required=True)
+    for verb, hlp in (("status", "list flight-recorder dumps in the "
+                                 "trace dir"),
+                      ("dump", "run a traced DORA exchange and write a "
+                               "flight-recorder dump"),
+                      ("export", "run a traced DORA exchange and export "
+                                 "its spans (--format chrome loads in "
+                                 "Perfetto / chrome://tracing)")):
+        vp = trace_sub.add_parser(verb, help=hlp)
+        vp.add_argument("--trace-dir", default="",
+                        help="flight-dump dir (default $BNG_TRACE_DIR "
+                             "or <tmp>/bng-flightrec)")
+        if verb == "status":
+            continue
+        vp.add_argument("--out", default="", help="output file path")
+        vp.add_argument("--format", default="chrome",
+                        help="export format (chrome)")
+        vp.add_argument("--macs", type=int, default=32,
+                        help="subscribers to DORA through the trace")
+        vp.add_argument("--batch-size", type=int, default=64)
+        vp.add_argument("--pool-cidr", default="10.0.0.0/16")
+        vp.add_argument("--scheduler", action="store_true",
+                        help="drive the tiered scheduler (express/bulk "
+                             "lanes appear as trace threads)")
+        vp.add_argument("--workers", type=int, default=1,
+                        help="inline fleet workers (>1 adds the worker "
+                             "stage + scatter/gather spans)")
 
     # warm-restart snapshots (runtime/checkpoint.py + statestore.py)
     ckptp = sub.add_parser("checkpoint",
@@ -1971,6 +2240,8 @@ def main(argv: list[str] | None = None) -> int:
         return run_checkpoint(args)
     if args.command == "chaos":
         return run_chaos(args)
+    if args.command == "trace":
+        return run_trace(args)
     if args.command in ("run", "stats"):
         app = BNGApp(_config_from_args(args))
         try:

@@ -51,6 +51,7 @@ fan-out by shard, fan-in with replies re-merged in lane (ring) order.
 
 from __future__ import annotations
 
+import os
 import time
 from collections import deque
 from dataclasses import dataclass, field
@@ -58,6 +59,8 @@ from typing import Callable
 
 from bng_tpu.chaos.faults import fault_point
 from bng_tpu.control import dhcp_codec
+from bng_tpu.telemetry import spans as tele
+from bng_tpu.telemetry.hist import LatencyHist
 from bng_tpu.control.admission import (AdmissionConfig, AdmissionController,
                                        peek_reply)
 from bng_tpu.control.pool import PoolExhaustedError, PoolManager
@@ -340,6 +343,17 @@ class FleetWorker:
         self.batches = 0
         self.errors = 0
         self.busy_s = 0.0
+        # per-frame handler latency histogram, shipped in the stats
+        # payload and merged into the parent tracer's `worker` stage
+        # (telemetry/hist.py — merge is counter addition, so worker
+        # order never matters). Built only when telemetry is armed in
+        # the parent: process-mode children inherit BNG_TELEMETRY=1
+        # (exported by SlowPathFleet before spawning), inline workers
+        # see the parent's armed tracer directly.
+        self._lat_hist = (LatencyHist()
+                          if (tele.enabled()
+                              or os.environ.get("BNG_TELEMETRY") == "1")
+                          else None)
 
     def _on_slice_exhausted(self, pool_id: int) -> None:
         if self.refill_now is not None:
@@ -365,12 +379,20 @@ class FleetWorker:
         t0 = time.perf_counter()
         results = []
         offers, acks, releases = [], [], []
+        hist = self._lat_hist
+        if hist is None and tele.enabled():
+            # armed after construction (inline workers share the parent
+            # interpreter): start recording from this batch on
+            hist = self._lat_hist = LatencyHist()
         for lane, frame in items:
             reply = None
+            tf = time.perf_counter() if hist is not None else 0.0
             try:
                 reply = self.demux(frame)
             except Exception:  # noqa: BLE001 — untrusted wire input
                 self.errors += 1
+            if hist is not None:
+                hist.record((time.perf_counter() - tf) * 1e6)
             if reply is not None:
                 peek = peek_reply(reply)
                 if peek is not None:
@@ -423,7 +445,7 @@ class FleetWorker:
                 "stats": self._stats()}
 
     def _stats(self) -> dict:
-        return {
+        out = {
             "frames": self.frames, "batches": self.batches,
             "errors": self.errors, "busy_s": self.busy_s,
             "leases": len(self.server.leases),
@@ -431,6 +453,12 @@ class FleetWorker:
             "slice_free": {pid: p.free_count
                            for pid, p in self.pools.pools.items()},
         }
+        if self._lat_hist is not None and self._lat_hist.n:
+            # ship-and-reset: the parent folds each shipped delta into
+            # its tracer (merge = addition, so deltas compose exactly)
+            out["lat_hist"] = self._lat_hist.to_dict()
+            self._lat_hist = LatencyHist()
+        return out
 
     # -- checkpoint -------------------------------------------------------
 
@@ -560,9 +588,18 @@ class SlowPathFleet:
                     lambda pid, _w=w: self._refill_sync(_w, pid))
         else:
             import multiprocessing as mp
-            import os
             import sys
 
+            # children build their own per-frame latency histograms only
+            # when the parent traces — env is the only channel that
+            # survives both spawn and fork. Set ONLY around the worker
+            # starts and restored after: a leaked BNG_TELEMETRY=1 would
+            # force-arm every later BNGApp in this process and make every
+            # later fleet's workers pay armed per-frame costs forever.
+            env_was = os.environ.get("BNG_TELEMETRY")
+            env_set = tele.enabled()
+            if env_set:
+                os.environ["BNG_TELEMETRY"] = "1"
             method = start_method or os.environ.get("BNG_FLEET_START")
             if method is None:
                 # spawn re-imports the parent's __main__ in the child;
@@ -579,16 +616,26 @@ class SlowPathFleet:
                 method = "spawn" if spawn_safe else "fork"
             ctx = mp.get_context(method)
             self.start_method = method
-            for i in range(n_workers):
-                parent, child = ctx.Pipe(duplex=True)
-                p = ctx.Process(target=_worker_main,
-                                args=(child, spec, i, n_workers),
-                                daemon=True,
-                                name=f"bng-slowpath-w{i}")
-                p.start()
-                child.close()
-                self._procs.append(p)
-                self._conns.append(parent)
+            try:
+                for i in range(n_workers):
+                    parent, child = ctx.Pipe(duplex=True)
+                    p = ctx.Process(target=_worker_main,
+                                    args=(child, spec, i, n_workers),
+                                    daemon=True,
+                                    name=f"bng-slowpath-w{i}")
+                    p.start()
+                    child.close()
+                    self._procs.append(p)
+                    self._conns.append(parent)
+            finally:
+                # every child inherited its env at start(); restore ours
+                # even when a spawn fails mid-loop (a leaked armed flag
+                # outlives this fleet, per the warning above)
+                if env_set:
+                    if env_was is None:
+                        os.environ.pop("BNG_TELEMETRY", None)
+                    else:
+                        os.environ["BNG_TELEMETRY"] = env_was
         self._initial_grant()
 
     # -- lease-slice coordination (the parent pools stay the authority) --
@@ -686,7 +733,7 @@ class SlowPathFleet:
             if fp.kind == "kill":
                 self._kill_worker(w)
             elif fp.kind == "drop_batch":
-                self.worker_failures += 1
+                self._note_worker_failure(w)
                 return True
             elif fp.kind == "reorder":
                 # pipe reorder: lanes arrive at the worker out of order;
@@ -702,7 +749,7 @@ class SlowPathFleet:
                     list(groups[w]),
                     now if now is not None else self.clock()))
         if w in self._dead:
-            self.worker_failures += 1
+            self._note_worker_failure(w)
             return True
         return False
 
@@ -731,6 +778,8 @@ class SlowPathFleet:
         groups: dict[int, list] = {}
         depth: dict[int, int] = {}
         results: list[tuple[int, bytes | None]] = []
+        shed_n = 0
+        t0 = tele.t()
         for item in items:
             lane, frame = item[0], item[1]
             enq_t = item[2] if len(item) > 2 else None
@@ -747,10 +796,14 @@ class SlowPathFleet:
             ok, _reason = self.admission.admit(
                 frame, depth.get(w, 0), now, enq_t)
             if not ok:
+                shed_n += 1
                 results.append((lane, None))
                 continue
             groups.setdefault(w, []).append((lane, frame))
             depth[w] = depth.get(w, 0) + 1
+        tele.lap(tele.ADMIT, t0)
+        tele.add(shed=shed_n)
+        t0 = tele.t()
         if groups:
             if self.mode == "inline":
                 for w in sorted(groups):
@@ -775,7 +828,7 @@ class SlowPathFleet:
                         self._conns[w].send(("batch", groups[w], now))
                         sent.append(w)
                     except (OSError, ValueError):
-                        self.worker_failures += 1
+                        self._note_worker_failure(w)
                         results.extend((lane, None)
                                        for lane, _f in groups[w])
                 for w in sent:
@@ -783,11 +836,19 @@ class SlowPathFleet:
                         results.extend(self._absorb(
                             w, self._gather(w, "result")))
                     except (OSError, EOFError):
-                        self.worker_failures += 1
+                        self._note_worker_failure(w)
                         results.extend((lane, None)
                                        for lane, _f in groups[w])
+        tele.lap(tele.FLEET, t0)
         results.sort(key=lambda t: t[0])
         return results
+
+    def _note_worker_failure(self, w: int) -> None:
+        """One dead/failed worker batch: counted AND surfaced to the
+        flight recorder (gray failures hide in counters; a worker death
+        must leave the last-N batch evidence on disk)."""
+        self.worker_failures += 1
+        tele.trigger("worker_death", f"worker {w} lost a batch")
 
     def _absorb(self, worker: int, out: dict) -> list:
         """Fold one worker's batch result into parent state (events ->
@@ -807,6 +868,13 @@ class SlowPathFleet:
         if out["refill"]:
             self._service_refill(worker, out["refill"])
         self._last_stats[worker] = out["stats"]
+        tr = tele.tracer()
+        if tr is not None and "lat_hist" in out["stats"]:
+            # cross-process histogram merge: the worker's per-frame
+            # handler-latency delta folds into the parent's `worker`
+            # stage (merge = counter addition — worker order never
+            # changes the distribution)
+            tr.merge_stage(tele.WORKER, out["stats"]["lat_hist"])
         return out["results"]
 
     def handle_frame(self, frame: bytes) -> bytes | None:
@@ -842,13 +910,13 @@ class SlowPathFleet:
                     conn.send(("expire", now))
                     sent.append(w)
                 except (OSError, ValueError):
-                    self.worker_failures += 1
+                    self._note_worker_failure(w)
             for w in sent:
                 try:
                     total += self._absorb_expire(w,
                                                  self._gather(w, "expired"))
                 except (OSError, EOFError):
-                    self.worker_failures += 1
+                    self._note_worker_failure(w)
         return total
 
     def _absorb_expire(self, worker: int, out: dict) -> int:
@@ -857,6 +925,11 @@ class SlowPathFleet:
         for mac in out.get("releases", ()):
             self.admission.note_release(mac)
         self._last_stats[worker] = out["stats"]
+        tr = tele.tracer()
+        if tr is not None and "lat_hist" in out["stats"]:
+            # the worker ships-and-resets its histogram with EVERY stats
+            # payload — an expire-path delta dropped here would be lost
+            tr.merge_stage(tele.WORKER, out["stats"]["lat_hist"])
         return out["expired"]
 
     # -- checkpoint (runtime/checkpoint.py 'fleet' component) -------------

@@ -390,6 +390,43 @@ class BNGMetrics:
         self.invariant_last_violations = r.gauge(
             "bng_invariant_last_audit_violations",
             "Violations found by the most recent audit")
+        # telemetry subsystem (bng_tpu/telemetry): flight-recorder and
+        # tracer health. The per-stage latency distributions themselves
+        # export as bng_stage_latency_us via attach_telemetry (a live
+        # view over the tracer's mergeable log-bucketed histograms — a
+        # 5s scrape cannot reconstruct a p999).
+        self.flight_dumps = r.counter(
+            "bng_flight_dumps_total",
+            "Flight-recorder dumps written, by anomaly trigger",
+            ("reason",))
+        self.telemetry_records = r.counter(
+            "bng_telemetry_batch_records_total",
+            "Per-batch flight records finalized by the tracer")
+        self.telemetry_dropped = r.counter(
+            "bng_telemetry_records_dropped_total",
+            "Batch records dropped because the open-slot pool was full")
+        self._stage_latency_export = None  # attach_telemetry wires it
+
+    # -- telemetry (bng_tpu/telemetry) ----------------------------------
+
+    def attach_telemetry(self, tracer) -> None:
+        """Register the bng_stage_latency_us family as a live view over
+        the tracer's per-stage histograms and remember the tracer for
+        collect_telemetry. Idempotent (re-attach swaps the tracer)."""
+        if self._stage_latency_export is None:
+            self._stage_latency_export = _StageLatencyExport(tracer)
+            self.registry.register(self._stage_latency_export)
+        else:
+            self._stage_latency_export.tracer = tracer
+
+    def collect_telemetry(self, tracer) -> None:
+        """Tracer/recorder health -> counters (a 5s-scrape source)."""
+        self.telemetry_records.set_total(tracer.seq)
+        self.telemetry_dropped.set_total(tracer.records_dropped)
+        rec = tracer.recorder
+        if rec is not None:
+            for reason, n in rec.triggers.items():
+                self.flight_dumps.set_total(n, reason=reason)
 
     # -- collection (metrics.go:555-623) -------------------------------
 
@@ -521,6 +558,43 @@ class BNGMetrics:
 
     def expose(self) -> str:
         return self.registry.expose()
+
+
+class _StageLatencyExport:
+    """bng_stage_latency_us: Prometheus-histogram rendering of the
+    telemetry tracer's per-stage log-bucketed histograms (telemetry/
+    hist.py), materialized at expose time. The native buckets (8 per
+    octave, <=12.5% relative error) are re-binned onto a fixed 1-2-5
+    microsecond ladder so the exposition stays a bounded ~20 lines per
+    stage while percentile math still happens on the full-resolution
+    histograms (bench stage_breakdown, trace CLI)."""
+
+    name = "bng_stage_latency_us"
+    BOUNDS = (1, 2, 5, 10, 20, 50, 100, 200, 500,
+              1_000, 2_000, 5_000, 10_000, 50_000, 100_000, 1_000_000)
+
+    def __init__(self, tracer):
+        self.tracer = tracer
+
+    def collect(self) -> list[str]:
+        out = [f"# HELP {self.name} Per-stage packet-lifecycle latency "
+               f"(telemetry tracer)",
+               f"# TYPE {self.name} histogram"]
+        from bng_tpu.telemetry.spans import STAGE_NAMES
+
+        for i, h in enumerate(self.tracer.hists):
+            if not h.n:
+                continue
+            stage = STAGE_NAMES[i]
+            for ub in self.BOUNDS:
+                out.append(f'{self.name}_bucket{{stage="{stage}",'
+                           f'le="{ub}"}} {h.cumulative_le(float(ub))}')
+            out.append(f'{self.name}_bucket{{stage="{stage}",'
+                       f'le="+Inf"}} {h.n}')
+            out.append(f'{self.name}_sum{{stage="{stage}"}} '
+                       f'{round(h.sum_us, 3)}')
+            out.append(f'{self.name}_count{{stage="{stage}"}} {h.n}')
+        return out
 
 
 class MetricsCollector:

@@ -32,6 +32,7 @@ import jax
 import jax.numpy as jnp
 
 from bng_tpu.chaos.faults import FaultInjectedError, fault_point
+from bng_tpu.telemetry import spans as tele
 from bng_tpu.control.nat import NATManager, apply_nat_updates
 from bng_tpu.ops.antispoof import ANTISPOOF_NSTATS, AntispoofGeom
 from bng_tpu.ops.dhcp import NSTATS as DHCP_NSTATS
@@ -522,6 +523,15 @@ class Engine:
         -> [(lane, reply|None)] ascending-lane."""
         if not items:
             return []
+        t0 = tele.t()
+        if t0 is None:
+            return self._handle_slow_lanes_inner(items, path)
+        tele.stamp(tele.SLOW)
+        out = self._handle_slow_lanes_inner(items, path)
+        tele.lap(tele.SLOW, t0)
+        return out
+
+    def _handle_slow_lanes_inner(self, items: list, path: str) -> list:
         fp = fault_point("engine.slow_drain")
         if fp is not None and fp.kind == "fail":
             # chaos: the whole slow batch is lost BEFORE any handler
@@ -570,10 +580,19 @@ class Engine:
             fa = np.zeros((self.B,), dtype=bool)
             fa[: len(from_access)] = from_access
 
-        res = self._run_step(pkt, length, fa, now_s, now_us)
+        tok = tele.begin_batch(tele.LANE_ENGINE, len(frames))
+        t0 = tele.t()
+        try:
+            res = self._run_step(pkt, length, fa, now_s, now_us)
+        except BaseException:
+            tele.cancel_batch(tok)  # a failed dispatch must not leak a slot
+            raise
+        tele.lap(tele.DISPATCH, t0, tok)
 
+        t0 = tele.t()
         verdict = np.asarray(res.verdict)[: len(frames)]
         out_len = np.asarray(res.out_len)
+        tele.lap(tele.DEVICE_WAIT, t0, tok)
         out_pkt = res.out_pkt  # fetch rows lazily
         punt = np.asarray(res.nat_punt)[: len(frames)]
         viol = np.asarray(res.spoof_violation)[: len(frames)]
@@ -582,6 +601,7 @@ class Engine:
         out_rows = None
         slow_items = []  # non-punt PASS lanes, drained in one batch below
         punt_lanes = []
+        t0 = tele.t()
         for i, v in enumerate(verdict):
             if v == VERDICT_TX:
                 if out_rows is None:
@@ -609,10 +629,12 @@ class Engine:
                     slow_items.append((i, frames[i]))
             if viol[i] and self.violation_sink is not None:
                 self.violation_sink(i, frames[i])
+        tele.lap(tele.REPLY, t0, tok)
         out["slow"] = sorted(
             [(i, None) for i in punt_lanes]
             + self._handle_slow_lanes(slow_items, path="process"),
             key=lambda t: t[0])
+        tele.end_batch(tok, punt=len(punt_lanes))
         return out
 
     # fast-lane compile-shape budget: every auto-sized control batch maps
@@ -664,13 +686,24 @@ class Engine:
             B = self.dhcp_batch_bucket(len(frames))
         now = now if now is not None else self.clock()
         pkt, length = self._pack_frames(frames, B)
-        res = self._run_dhcp_batch_sync(pkt, length, now)
+        tok = tele.begin_batch(tele.LANE_ENGINE, len(frames))
+        t0 = tele.t()
+        try:
+            res = self._run_dhcp_batch(pkt, length, now)
+        except BaseException:
+            tele.cancel_batch(tok)  # a failed dispatch must not leak a slot
+            raise
+        tele.lap(tele.DISPATCH, t0, tok)
+        t0 = tele.t()
         reply = np.asarray(res.verdict)[: len(frames)] == VERDICT_TX
+        tele.lap(tele.DEVICE_WAIT, t0, tok)
+        self._fold_stats(res)
         out_pkt, out_len = res.out_pkt, res.out_len
         out = {"tx": [], "slow": []}
         out_rows = None
         ol = np.asarray(out_len)
         slow_items = []
+        t0 = tele.t()
         for i, r in enumerate(reply):
             if r:
                 if out_rows is None:
@@ -680,7 +713,9 @@ class Engine:
             else:
                 self.stats.passed += 1
                 slow_items.append((i, frames[i]))
+        tele.lap(tele.REPLY, t0, tok)
         out["slow"] = self._handle_slow_lanes(slow_items, path="process_dhcp")
+        tele.end_batch(tok)
         return out
 
     def _place_dhcp_chain(self, device) -> None:
@@ -804,9 +839,12 @@ class Engine:
         pkt = np.zeros((self.B, self.L), dtype=np.uint8)
         length = np.zeros((self.B,), dtype=np.uint32)
         flags = np.zeros((self.B,), dtype=np.uint32)
+        t0 = tele.t()
         n = ring.assemble(pkt, length, flags)
         if n == 0:
             return 0
+        tok = tele.begin_batch(tele.LANE_RING_L, n)
+        tele.lap(tele.RING, t0, tok)
         now = now if now is not None else self.clock()
         now_s = np.uint32(int(now))
         now_us = np.uint32(int(now * 1e6) & 0xFFFFFFFF)
@@ -816,19 +854,29 @@ class Engine:
         # DHCP-only fast lane — reference hook-order parity, and a
         # several-fold smaller program for the latency-sensitive traffic.
         # Mixed batches run the fused step: one dispatch beats two.
-        if bool(((flags[:n] & FLAG_DHCP_CTRL) != 0).all()):
-            res = self._run_dhcp_batch_sync(pkt, length, now)
-        else:
-            res = self._run_step(pkt, length, fa, now_s, now_us)
+        t0 = tele.t()
+        try:
+            if bool(((flags[:n] & FLAG_DHCP_CTRL) != 0).all()):
+                res = self._run_dhcp_batch_sync(pkt, length, now)
+            else:
+                res = self._run_step(pkt, length, fa, now_s, now_us)
+        except BaseException:
+            tele.cancel_batch(tok)  # a failed dispatch must not leak a slot
+            raise
+        tele.lap(tele.DISPATCH, t0, tok)
         self._apply_ring_verdicts(ring, res, pkt, length, n, now)
+        tele.end_batch(tok)
         return n
 
     def _apply_ring_verdicts(self, ring, res: PipelineResult, pkt, length,
                              n: int, now: float) -> None:
         """Force the step's outputs and demux verdicts back to the ring."""
+        t0 = tele.t()
         vv = np.asarray(res.verdict)[:n]
         out_pkt = np.asarray(res.out_pkt)
         out_len = np.asarray(res.out_len).astype(np.uint32)
+        tele.lap(tele.DEVICE_WAIT, t0)
+        t0 = tele.t()
         ring.complete(vv.astype(np.uint8), out_pkt, out_len, n)
 
         self.stats.tx += int((vv == VERDICT_TX).sum())
@@ -852,12 +900,14 @@ class Engine:
         punt = np.asarray(res.nat_punt)[:n]
         slow_items = []  # (lane, frame); from_access flags kept aside
         slow_fa = {}
+        punts = 0
         for lane in np.nonzero(vv == VERDICT_PASS)[0]:
             got = ring.slow_pop()
             if got is None:
                 break  # slow ring overflowed during complete()
             frame, fl = got
             if punt[lane]:
+                punts += 1
                 try:
                     self._punt_new_flow(frame, int(now))
                 except Exception as e:  # noqa: BLE001 — untrusted input
@@ -866,6 +916,8 @@ class Engine:
             else:
                 slow_items.append((int(lane), frame))
                 slow_fa[int(lane)] = (fl & 0x1) != 0
+        tele.lap(tele.REPLY, t0)
+        tele.add(punt=punts)
         # fan-out/fan-in: replies come back re-merged in lane order, so
         # TX injection keeps the slow ring's arrival order on the wire
         for lane, reply in self._handle_slow_lanes(slow_items, path="ring"):
@@ -905,10 +957,14 @@ class Engine:
             # NOT using, so its frames stay intact until retirement
             idx = 1 - self._stage_idx
             pkt, length, flags = self._staging(idx)
+            t0 = tele.t()
             n = ring.assemble(pkt, length, flags)
             if n:
+                tok = tele.begin_batch(tele.LANE_RING_L, n)
+                tele.lap(tele.RING, t0, tok)
                 now_s = np.uint32(int(now))
                 now_us = np.uint32(int(now * 1e6) & 0xFFFFFFFF)
+                t0 = tele.t()
                 try:
                     # all-control batches ride the DHCP-only fast lane here
                     # too — its outputs are equally async, so the overlap
@@ -924,12 +980,14 @@ class Engine:
                     # must not wedge. complete() retires FIFO, so the
                     # previous batch's (older) window must retire FIRST —
                     # dropping into it would mis-complete prev's frames.
+                    tele.cancel_batch(tok)
                     self._retire(prev)
                     prev = None
                     ring.complete(np.full((n,), VERDICT_DROP, dtype=np.uint8),
                                   pkt, length, n)
                     raise
-                self._inflight = (ring, res, pkt, length, n, now)
+                tele.lap(tele.DISPATCH, t0, tok)
+                self._inflight = (ring, res, pkt, length, n, now, tok)
                 self._stage_idx = idx
         finally:
             # 2. retire the previous batch (even if dispatch raised) while
@@ -941,9 +999,11 @@ class Engine:
         """Apply a pipelined batch's verdicts to the ring it came from."""
         if entry is None:
             return 0
-        ring, res, pkt, length, n, now = entry
+        ring, res, pkt, length, n, now, tok = entry
+        tele.focus(tok)
         self._apply_ring_verdicts(ring, res, pkt, length, n, now)
         self._fold_stats(res)
+        tele.end_batch(tok)
         return n
 
     def flush_pipeline(self, ring=None) -> int:

@@ -130,6 +130,7 @@ class InflightEntry:
     pending: list[PendingFrame]
     dispatch_t: float
     close_reason: str
+    trace: object = None  # telemetry batch-record token (None = disarmed)
 
 
 class CompletionRing:

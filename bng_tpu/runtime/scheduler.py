@@ -51,6 +51,7 @@ import jax
 import jax.numpy as jnp
 
 from bng_tpu.ops.pipeline import VERDICT_DROP, VERDICT_FWD, VERDICT_TX
+from bng_tpu.telemetry import spans as tele
 from bng_tpu.runtime.lanes import (CLOSE_FLUSH, CompletionRing, InflightEntry,
                                    Lane, LaneConfig, LANE_BULK, LANE_EXPRESS)
 from bng_tpu.runtime.ring import classify_dhcp
@@ -231,12 +232,25 @@ class TieredScheduler:
         if not pend:
             return 0
         eng = self.engine
+        tok = tele.begin_batch(tele.LANE_EXPRESS_L, len(pend))
+        if tok is not None:
+            # lane wait of the batch's OLDEST frame — the worst case the
+            # deadline close bounds (computed from enqueue stamps, so the
+            # per-frame submit path pays no telemetry cost at all)
+            tele.observe(tele.LANE_WAIT, (now - pend[0].enq_t) * 1e6, tok)
         pkt, length = eng._pack_frames([p.frame for p in pend],
                                        self.express.cfg.batch)
-        res = eng._run_dhcp_batch(pkt, length, now, device=self._express_dev)
+        t0 = tele.t()
+        try:
+            res = eng._run_dhcp_batch(pkt, length, now,
+                                      device=self._express_dev)
+        except BaseException:
+            tele.cancel_batch(tok)  # a failed dispatch must not leak a slot
+            raise
+        tele.lap(tele.DISPATCH, t0, tok)
         self._observe_dispatch(LANE_EXPRESS, len(pend), reason)
         over = self._express_ring.push(
-            InflightEntry(res, pend, now, reason))
+            InflightEntry(res, pend, now, reason, trace=tok))
         return self._retire_express(over) if over is not None else 0
 
     def _retire_express_all(self) -> int:
@@ -253,8 +267,11 @@ class TieredScheduler:
         eng = self.engine
         res = entry.res
         n = len(entry.pending)
+        tele.focus(entry.trace)
+        t0 = tele.t()
         verdict = np.asarray(res.verdict)[:n]
         out_len = np.asarray(res.out_len)
+        tele.lap(tele.DEVICE_WAIT, t0, entry.trace)
         out_rows = None
         eng._fold_stats(res)
         now = self.clock()
@@ -266,6 +283,7 @@ class TieredScheduler:
                       if verdict[i] != VERDICT_TX]
         replies = dict(eng._handle_slow_lanes(slow_items,
                                               path="sched_express"))
+        t0 = tele.t()
         for i, p in enumerate(entry.pending):
             if verdict[i] == VERDICT_TX:
                 if out_rows is None:
@@ -276,6 +294,8 @@ class TieredScheduler:
             else:
                 eng.stats.passed += 1
                 self._complete(p, LANE_EXPRESS, "slow", replies.get(i), now)
+        tele.lap(tele.REPLY, t0, entry.trace)
+        tele.end_batch(entry.trace)
         self._observe_retire(LANE_EXPRESS, entry, now)
         return n
 
@@ -331,16 +351,25 @@ class TieredScheduler:
         if not pend:
             return None
         eng = self.engine
+        tok = tele.begin_batch(tele.LANE_BULK_L, len(pend))
+        if tok is not None:
+            tele.observe(tele.LANE_WAIT, (now - pend[0].enq_t) * 1e6, tok)
         B = self.bulk.cfg.batch
         pkt, length = eng._pack_frames([p.frame for p in pend], B)
         fa = np.zeros((B,), dtype=bool)
         fa[: len(pend)] = [p.from_access for p in pend]
-        self._ensure_bulk_replica()
-        drain = (self.cfg.drain_every <= 1
-                 or self._bulk_seq % self.cfg.drain_every == 0)
-        before = eng.resync_count
-        res, self._bulk_dhcp = eng.dispatch_scheduled_bulk(
-            pkt, length, fa, now, self._bulk_dhcp, drain=drain)
+        t0 = tele.t()
+        try:
+            self._ensure_bulk_replica()
+            drain = (self.cfg.drain_every <= 1
+                     or self._bulk_seq % self.cfg.drain_every == 0)
+            before = eng.resync_count
+            res, self._bulk_dhcp = eng.dispatch_scheduled_bulk(
+                pkt, length, fa, now, self._bulk_dhcp, drain=drain)
+        except BaseException:
+            tele.cancel_batch(tok)  # a failed dispatch must not leak a slot
+            raise
+        tele.lap(tele.DISPATCH, t0, tok)
         if eng.resync_count != before:
             # a bulk-build resync fired inside the drain: the replica we
             # just threaded derives from pre-resync leaves; rebuild next
@@ -350,7 +379,8 @@ class TieredScheduler:
         if drain:
             self._drains_applied += 1
         self._observe_dispatch(LANE_BULK, len(pend), reason)
-        return self._bulk_ring.push(InflightEntry(res, pend, now, reason))
+        return self._bulk_ring.push(
+            InflightEntry(res, pend, now, reason, trace=tok))
 
     def _retire_bulk(self, entry: InflightEntry) -> int:
         """Force + demux one bulk batch's verdicts (the completion-ring
@@ -358,20 +388,25 @@ class TieredScheduler:
         eng = self.engine
         res = entry.res
         n = len(entry.pending)
+        tele.focus(entry.trace)
+        t0 = tele.t()
         vv = np.asarray(res.verdict)[:n]
         out_len = np.asarray(res.out_len)
         punt = np.asarray(res.nat_punt)[:n]
         viol = np.asarray(res.spoof_violation)[:n]
+        tele.lap(tele.DEVICE_WAIT, t0, entry.trace)
         out_rows = None
         eng._fold_stats(res)
         now = self.clock()
         # NAT punts stay inline (parent-owned manager); everything else
         # drains through the batched slow path in one fan-out
         slow_items = []
+        punts = 0
         for i, p in enumerate(entry.pending):
             if int(vv[i]) in (VERDICT_TX, VERDICT_FWD, VERDICT_DROP):
                 continue
             if punt[i]:
+                punts += 1
                 try:
                     eng._punt_new_flow(p.frame, int(entry.dispatch_t))
                 except Exception as e:  # noqa: BLE001 — untrusted input
@@ -380,6 +415,7 @@ class TieredScheduler:
             else:
                 slow_items.append((i, p.frame, p.enq_t))
         replies = dict(eng._handle_slow_lanes(slow_items, path="sched_bulk"))
+        t0 = tele.t()
         for i, p in enumerate(entry.pending):
             v = int(vv[i])
             if v == VERDICT_TX or v == VERDICT_FWD:
@@ -400,6 +436,8 @@ class TieredScheduler:
                 self._complete(p, LANE_BULK, "slow", replies.get(i), now)
             if viol[i] and eng.violation_sink is not None:
                 eng.violation_sink(i, p.frame)
+        tele.lap(tele.REPLY, t0, entry.trace)
+        tele.end_batch(entry.trace, punt=punts)
         self._observe_retire(LANE_BULK, entry, now)
         return n
 

@@ -156,6 +156,19 @@ def probe_accelerator(timeout_s: float = 120.0) -> tuple[str, str]:
     return (out[0] if out else "unknown"), ""
 
 
+def tunnel_precheck(timeout_s: float = 20.0) -> tuple[bool, str]:
+    """Cheap relay/tunnel health check BEFORE committing to a long probe
+    window (VERDICT "What's weak" §1: three rounds burned their window
+    against a tunnel that was down from the first second). One short
+    subprocess probe: (True, platform) when an accelerator answers fast,
+    (False, diagnostic) when it doesn't — the caller then decides
+    whether the full backoff window is worth spending."""
+    platform, err = probe_accelerator(timeout_s)
+    if platform and platform != "cpu":
+        return True, platform
+    return False, err or f"probe returned platform={platform!r}"
+
+
 def guarded_backend(
     prefer_accelerator: bool = True,
     tries: int = 2,
@@ -163,6 +176,8 @@ def guarded_backend(
     retry_sleep_s: float = 10.0,
     cpu_devices: int = 8,
     window_s: float = 0.0,
+    backoff: float = 1.0,
+    max_sleep_s: float = 120.0,
 ) -> tuple[str, str]:
     """Initialize a usable JAX backend without ever hanging or crashing.
 
@@ -176,6 +191,11 @@ def guarded_backend(
     the axon tunnel drops for stretches, and a single 150 s probe turned a
     whole round's deliverable into a CPU artifact.  Probes are subprocesses,
     so a dead tunnel costs one child per attempt, never a wedged parent.
+
+    ``backoff > 1`` grows the inter-probe sleep geometrically (capped at
+    ``max_sleep_s``): a down tunnel gets polled often early (it usually
+    flaps back within a minute) without burning the whole window on
+    fixed-cadence probes when it stays down.
     """
     if not prefer_accelerator or os.environ.get("JAX_PLATFORMS") == "cpu":
         force_cpu(cpu_devices)
@@ -183,16 +203,18 @@ def guarded_backend(
     err = ""
     deadline = time.monotonic() + window_s if window_s > 0 else None
     attempt = 0
+    sleep_s = retry_sleep_s
     while True:
         if attempt >= tries:
             break
         if deadline is not None and attempt:
             # a retry costs up to sleep+probe: only start one that can
             # finish inside the window, so probing never eats run budget
-            if time.monotonic() + retry_sleep_s + probe_timeout_s >= deadline:
+            if time.monotonic() + sleep_s + probe_timeout_s >= deadline:
                 break
         if attempt:
-            time.sleep(retry_sleep_s)
+            time.sleep(sleep_s)
+            sleep_s = min(sleep_s * max(backoff, 1.0), max_sleep_s)
         attempt += 1
         platform, err = probe_accelerator(probe_timeout_s)
         if platform:

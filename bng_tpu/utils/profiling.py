@@ -122,10 +122,31 @@ class StepDurations:
     us: list[float]
     source: str
 
-    def percentile(self, q: float) -> float:
-        import numpy as _np
+    def __post_init__(self):
+        self._sorted = None  # lazy sort cache, built once per instance
 
-        return float(_np.percentile(_np.asarray(self.us), q)) if self.us else 0.0
+    def percentile(self, q: float) -> float:
+        """Linearly interpolated percentile over a ONCE-sorted copy.
+
+        Callers ask for several quantiles per run (p50/p99 per bench
+        section); re-sorting per call was O(n log n) each time. Linear
+        interpolation matches numpy.percentile's default method
+        (pinned by tests/test_telemetry.py against numpy directly)."""
+        if not self.us:
+            return 0.0
+        if self._sorted is None:
+            import numpy as _np
+
+            self._sorted = _np.sort(_np.asarray(self.us, dtype=_np.float64))
+        s = self._sorted
+        if not 0.0 <= q <= 100.0:
+            raise ValueError(f"percentile q={q} outside [0, 100]")
+        pos = (len(s) - 1) * (q / 100.0)
+        lo = int(pos)
+        frac = pos - lo
+        if frac == 0.0 or lo + 1 >= len(s):
+            return float(s[lo])
+        return float(s[lo] + (s[lo + 1] - s[lo]) * frac)
 
 
 def profile_step_durations(fn: Callable[[], object], iters: int = 50,

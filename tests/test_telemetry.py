@@ -1,0 +1,626 @@
+"""Telemetry subsystem tests (bng_tpu/telemetry): disarmed-overhead
+bound, histogram merge laws, flight-recorder wrap + anomaly triggers
+(incl. forced backend fallback), Chrome-trace export schema, and DORA
+through tracing — host-only through the fleet in the fast tier, full
+engine + scheduler + fleet under @pytest.mark.slow.
+
+`make verify-telemetry` runs the 'telemetry and not slow' set with
+BNG_TELEMETRY=1 in the environment (< 30 s — no XLA compiles there).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import timeit
+
+import numpy as np
+import pytest
+
+from bng_tpu.chaos.faults import FaultPlan, FaultSpec, SimClock, armed
+from bng_tpu.chaos.invariants import audit_invariants
+from bng_tpu.chaos.scenarios import _mac, build_fleet, dora_with_retries
+from bng_tpu.telemetry import (FlightRecorder, LatencyHist, RecorderConfig,
+                               Tracer, chrome_trace)
+from bng_tpu.telemetry import spans
+
+pytestmark = pytest.mark.telemetry
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _always_disarm():
+    yield
+    spans.disarm()
+
+
+# ---------------------------------------------------------------------------
+# disarmed overhead: the production state must stay near-free
+# ---------------------------------------------------------------------------
+
+class TestDisarmedOverhead:
+    def test_hooks_disarmed_ns_per_call_bounded(self):
+        """Each disarmed hook is one module-global load + is-None
+        compare. Measured 77-84 ns/call on the dev container (PERF_NOTES
+        §8); the bound here is deliberately loose for noisy CI — what it
+        pins is the ORDER (ns, not us): an accidental dict lookup or
+        allocation on the disarmed path would blow through it."""
+        assert not spans.enabled()
+        n = 200_000
+        for fn, args in ((spans.t, ()), (spans.stamp, (spans.DISPATCH,)),
+                         (spans.lap, (spans.DISPATCH, None))):
+            ns = (timeit.Timer(lambda: fn(*args)).timeit(n) / n) * 1e9
+            assert ns < 2_000, f"{fn.__name__}: {ns:.0f} ns/call"
+
+    def test_disarmed_hooks_are_noops(self):
+        assert spans.t() is None
+        assert spans.begin_batch(spans.LANE_ENGINE, 8) is None
+        spans.lap(spans.DISPATCH, None)
+        spans.end_batch(None)
+        spans.add(shed=5)
+        assert spans.trigger("worker_death") is None
+        with spans.span(spans.SLOW):
+            pass  # the no-op singleton
+
+
+# ---------------------------------------------------------------------------
+# histograms: accuracy, merge laws, wire round-trip
+# ---------------------------------------------------------------------------
+
+class TestLatencyHist:
+    def test_percentiles_track_numpy_within_bucket_error(self):
+        rng = np.random.default_rng(7)
+        vals = rng.lognormal(3.0, 1.5, 50_000)  # us, heavy tail
+        h = LatencyHist()
+        h.record_many(vals)
+        for q in (50, 90, 99, 99.9):
+            exact = float(np.percentile(vals, q))
+            got = h.percentile(q)
+            assert abs(got - exact) / exact < 0.126, (q, got, exact)
+
+    def test_scalar_and_vector_record_agree(self):
+        rng = np.random.default_rng(8)
+        vals = rng.lognormal(2.0, 2.0, 2_000)
+        a, b = LatencyHist(), LatencyHist()
+        for v in vals:
+            a.record(float(v))
+        b.record_many(vals)
+        assert (a.counts == b.counts).all()
+        assert a.n == b.n
+
+    def test_merge_is_associative_and_commutative(self):
+        """The property that makes per-worker/per-shard histograms
+        mergeable in ANY gather order: counts are plain addition."""
+        rng = np.random.default_rng(9)
+        parts = [rng.lognormal(3, 1, 5_000) for _ in range(3)]
+        a, b, c = (LatencyHist() for _ in range(3))
+        for h, p in zip((a, b, c), parts):
+            h.record_many(p)
+        ab_c = a.copy().merge(b.copy()).merge(c.copy())
+        a_bc = a.copy().merge(b.copy().merge(c.copy()))
+        cba = c.copy().merge(b.copy()).merge(a.copy())
+        for m in (a_bc, cba):
+            assert (ab_c.counts == m.counts).all()
+            assert ab_c.n == m.n
+            assert ab_c.sum_us == pytest.approx(m.sum_us)
+        whole = LatencyHist()
+        whole.record_many(np.concatenate(parts))
+        assert (whole.counts == ab_c.counts).all()
+
+    def test_wire_roundtrip(self):
+        h = LatencyHist()
+        h.record_many(np.random.default_rng(1).lognormal(4, 1, 1_000))
+        rt = LatencyHist.from_dict(json.loads(json.dumps(h.to_dict())))
+        assert (rt.counts == h.counts).all()
+        assert rt.n == h.n and rt.max_us == h.max_us
+        assert rt.percentile(99) == h.percentile(99)
+
+    def test_empty_hist(self):
+        h = LatencyHist()
+        assert h.percentile(99) == 0.0
+        assert h.summary()["count"] == 0
+
+
+# ---------------------------------------------------------------------------
+# flight recorder: wrap + anomaly triggers
+# ---------------------------------------------------------------------------
+
+def _traced_batches(tracer, n, total_sleep_us=0.0, shed=0):
+    for _ in range(n):
+        tok = tracer.begin(spans.LANE_ENGINE, 16)
+        t0 = tracer.clock()
+        tracer.lap(spans.DISPATCH, t0, tok)
+        if shed:
+            tracer.add(tok, shed=shed)
+        tracer.end(tok)
+
+
+class TestFlightRecorder:
+    def test_ring_wraps_keeping_last_n(self, tmp_path):
+        rec = FlightRecorder(RecorderConfig(capacity=16,
+                                            out_dir=str(tmp_path)))
+        tr = Tracer(recorder=rec)
+        _traced_batches(tr, 50)
+        meta = rec.snapshot_meta()
+        assert meta["valid_records"] == 16
+        records = rec.records()
+        assert len(records) == 16
+        # oldest-first, exactly the LAST 16 of the 50
+        assert [r["seq"] for r in records] == list(range(34, 50))
+        assert all(r["stages_us"].get("total", 0) >= 0 for r in records)
+
+    def test_latency_excursion_trigger_dumps(self, tmp_path):
+        rec = FlightRecorder(RecorderConfig(
+            capacity=8, latency_budget_us=0.000001,
+            out_dir=str(tmp_path)))
+        tr = Tracer(recorder=rec)
+        _traced_batches(tr, 1)
+        assert rec.triggers.get("latency_excursion") == 1
+        assert len(rec.dump_paths) == 1
+        d = json.load(open(rec.dump_paths[0]))
+        assert d["reason"] == "latency_excursion"
+        assert d["meta"]["backend"] == "unknown"
+
+    def test_shed_burst_trigger_dumps(self, tmp_path):
+        rec = FlightRecorder(RecorderConfig(capacity=8, shed_burst=4,
+                                            out_dir=str(tmp_path)))
+        tr = Tracer(recorder=rec)
+        _traced_batches(tr, 1, shed=10)
+        assert rec.triggers.get("shed_burst") == 1
+        # and the token-less path (fleet outside a traced batch)
+        rec.note_shed(10)
+        assert rec.triggers["shed_burst"] == 2
+
+    def test_worker_death_trigger_via_module_hook(self, tmp_path):
+        rec = FlightRecorder(RecorderConfig(capacity=8,
+                                            out_dir=str(tmp_path)))
+        with spans.armed(Tracer(recorder=rec)):
+            path = spans.trigger("worker_death", "worker 2 lost a batch")
+        assert path is not None
+        d = json.load(open(path))
+        assert d["reason"] == "worker_death"
+        assert d["detail"] == "worker 2 lost a batch"
+
+    def test_dump_rate_limit_and_cap(self, tmp_path):
+        rec = FlightRecorder(RecorderConfig(
+            capacity=4, min_dump_interval_s=3600.0,
+            out_dir=str(tmp_path)))
+        with spans.armed(Tracer(recorder=rec)):
+            assert spans.trigger("worker_death") is not None
+            assert spans.trigger("worker_death") is None  # rate-limited
+        assert rec.triggers["worker_death"] == 2  # counted regardless
+
+    def test_backend_fallback_dump_and_json_flag(self, tmp_path):
+        """The acceptance path: a CPU-fallback bench run must dump the
+        flight recorder and flag it at the TOP of the JSON. Drives
+        bench._finalize_diag / _order_line directly (the code the child
+        dispatch runs before every print)."""
+        sys.path.insert(0, _ROOT)
+        try:
+            import bench
+        finally:
+            sys.path.remove(_ROOT)
+        rec = FlightRecorder(RecorderConfig(capacity=8,
+                                            out_dir=str(tmp_path)))
+        rec.set_backend("cpu")
+        old = dict(bench._DIAG)
+        bench._DIAG.clear()
+        try:
+            with spans.armed(Tracer(recorder=rec)) as tr:
+                _traced_batches(tr, 3)
+                bench._DIAG["backend_fallback"] = "cpu"
+                bench._DIAG["backend_error"] = "probe timed out"
+                bench._finalize_diag()
+                line = bench._order_line({"metric": "m", "value": 1.0,
+                                          **bench._DIAG})
+            assert bench._DIAG["flight_record"].startswith(str(tmp_path))
+            d = json.load(open(bench._DIAG["flight_record"]))
+            assert d["reason"] == "backend_fallback"
+            assert d["meta"]["backend"] == "cpu"
+            assert len(d["records"]) == 3
+            # fallback keys lead the object
+            assert list(line)[:3] == ["backend_fallback", "backend_error",
+                                      "flight_record"]
+        finally:
+            bench._DIAG.clear()
+            bench._DIAG.update(old)
+
+    def test_invariant_violation_triggers_dump(self, tmp_path):
+        """A planted double-lease must land a flight dump the moment the
+        auditor proves it (the chaos <-> telemetry wiring)."""
+        clock = SimClock()
+        fleet, pools, fastpath = build_fleet(2, clock)
+        macs = [_mac(i) for i in range(8)]
+        leased = dora_with_retries(fleet, macs, clock)
+        victim_ip = next(iter(leased.values()))
+        fleet._inline[0].restore_state({"session_seq": 0, "leases": [{
+            "mac": _mac(999).hex(), "ip": victim_ip, "pool_id": 1,
+            "expiry": 2_000_000_000, "circuit_id": "", "remote_id": "",
+            "s_tag": 0, "c_tag": 0, "session_id": "forged",
+            "client_class": 0, "username": "", "qos_policy": ""}]})
+        rec = FlightRecorder(RecorderConfig(capacity=8,
+                                            out_dir=str(tmp_path)))
+        with spans.armed(Tracer(recorder=rec)):
+            report = audit_invariants(pools=pools, fleet=fleet,
+                                      fastpath=fastpath)
+        assert not report.ok
+        assert rec.triggers.get("invariant_violation") == 1
+        d = json.load(open(rec.dump_paths[0]))
+        assert "double-lease" in d["detail"]
+        fleet.close()
+
+
+# ---------------------------------------------------------------------------
+# Chrome-trace export schema
+# ---------------------------------------------------------------------------
+
+class TestChromeTrace:
+    def test_export_schema(self):
+        tr = Tracer(keep_events=100)
+        with spans.armed(tr):
+            for _ in range(4):
+                tok = spans.begin_batch(spans.LANE_EXPRESS_L, 8)
+                t0 = spans.t()
+                spans.lap(spans.DISPATCH, t0, tok)
+                spans.end_batch(tok)
+        trace = json.loads(json.dumps(chrome_trace(tr)))
+        assert set(trace) >= {"traceEvents", "displayTimeUnit"}
+        xs = [e for e in trace["traceEvents"] if e["ph"] == "X"]
+        ms = [e for e in trace["traceEvents"] if e["ph"] == "M"]
+        assert xs and ms
+        for e in xs:
+            assert {"name", "cat", "pid", "tid", "ts", "dur"} <= set(e)
+            assert e["dur"] > 0 and e["ts"] >= 0
+            assert e["name"] in spans.STAGE_NAMES
+        assert {"total", "dispatch"} <= {e["name"] for e in xs}
+        # lane thread metadata names the express lane
+        assert any(e["name"] == "thread_name"
+                   and "express" in e["args"]["name"] for e in ms)
+
+    def test_export_without_events_refuses(self):
+        with pytest.raises(ValueError):
+            chrome_trace(Tracer())
+
+
+# ---------------------------------------------------------------------------
+# DORA through tracing — host-only fleet tier (no XLA compile)
+# ---------------------------------------------------------------------------
+
+class TestFleetTracing:
+    def test_dora_through_fleet_records_stages(self, tmp_path):
+        """Full DORA through 2 inline workers with the tracer armed:
+        admit/fleet stages populate from the parent, and the workers'
+        per-frame histograms merge into the `worker` stage — the
+        cross-worker histogram merge, live."""
+        rec = FlightRecorder(RecorderConfig(capacity=32,
+                                            out_dir=str(tmp_path)))
+        with spans.armed(Tracer(recorder=rec)) as tr:
+            clock = SimClock()
+            fleet, pools, fastpath = build_fleet(2, clock)
+            macs = [_mac(i) for i in range(16)]
+            leased = dora_with_retries(fleet, macs, clock)
+            assert len(leased) == len(macs)
+            bd = tr.breakdown()
+        assert {"admit", "fleet", "worker"} <= set(bd)
+        assert bd["worker"]["count"] >= 2 * len(macs)  # DISCOVER+REQUEST
+        assert bd["worker"]["p99_us"] > 0
+        fleet.close()
+
+    def test_worker_hists_merge_across_both_workers(self):
+        """Both shards' workers must contribute to the merged worker
+        stage — the per-worker deltas fold through _absorb."""
+        with spans.armed(Tracer()) as tr:
+            clock = SimClock()
+            fleet, _pools, _fastpath = build_fleet(2, clock)
+            macs = [_mac(i) for i in range(32)]
+            dora_with_retries(fleet, macs, clock)
+            from bng_tpu.control.fleet import shard_for_mac
+            shards = {shard_for_mac(m, 2) for m in macs}
+            assert shards == {0, 1}  # both workers saw traffic
+            assert tr.hists[spans.WORKER].n >= 2 * len(macs)
+        fleet.close()
+
+    def test_chaos_worker_kill_dumps_flight_record(self, tmp_path):
+        """A chaos-killed worker (fleet.scatter kill) must both count a
+        worker failure AND leave a flight dump."""
+        rec = FlightRecorder(RecorderConfig(capacity=16,
+                                            out_dir=str(tmp_path)))
+        with spans.armed(Tracer(recorder=rec)):
+            clock = SimClock()
+            fleet, pools, fastpath = build_fleet(2, clock)
+            plan = FaultPlan(1, [FaultSpec("fleet.scatter", "kill",
+                                           at_hit=1)])
+            with armed(plan, log=False):
+                dora_with_retries(fleet, [_mac(i) for i in range(8)],
+                                  clock)
+        assert fleet.worker_failures >= 1
+        assert rec.triggers.get("worker_death", 0) >= 1
+        assert rec.dump_paths
+        fleet.close()
+
+
+# ---------------------------------------------------------------------------
+# metrics export
+# ---------------------------------------------------------------------------
+
+class TestDispatchFailureSlotSafety:
+    def test_chaos_dispatch_failure_releases_record_slot(self):
+        """A chaos-injected dispatch failure (engine.dispatch `fail`,
+        raised BEFORE the jit call) must cancel the open batch record —
+        a leaked slot per failure would exhaust the pool exactly during
+        the failure storms the flight recorder exists to capture."""
+        from bng_tpu.control.nat import NATManager
+        from bng_tpu.runtime.engine import Engine, FaultInjectedError
+        from bng_tpu.runtime.tables import FastPathTables
+        from bng_tpu.utils.net import ip_to_u32
+
+        fp = FastPathTables(sub_nbuckets=256, vlan_nbuckets=64,
+                            cid_nbuckets=64, max_pools=4)
+        fp.set_server_config(b"\x02" * 6, ip_to_u32("10.0.0.1"))
+        nat = NATManager(public_ips=[ip_to_u32("203.0.113.1")],
+                         sessions_nbuckets=256, sub_nat_nbuckets=64)
+        engine = Engine(fp, nat, batch_size=8)
+        tr = Tracer()
+        with spans.armed(tr):
+            n_fails = tr.OPEN_SLOTS + 4  # more failures than slots
+            plan = FaultPlan(1, [FaultSpec("engine.dispatch", "fail",
+                                           at_hit=1, count=n_fails)])
+            with armed(plan, log=False):
+                for _ in range(n_fails):
+                    with pytest.raises(FaultInjectedError):
+                        engine.process([b"\x00" * 64])
+            assert len(tr._free) == tr.OPEN_SLOTS
+            assert tr.records_dropped == 0
+
+
+class TestMetricsExport:
+    def test_stage_latency_family_and_counters(self, tmp_path):
+        from bng_tpu.control.metrics import BNGMetrics
+
+        rec = FlightRecorder(RecorderConfig(capacity=8,
+                                            out_dir=str(tmp_path)))
+        tr = Tracer(recorder=rec)
+        _traced_batches(tr, 5)
+        with spans.armed(tr):
+            spans.trigger("worker_death", "x")
+        m = BNGMetrics()
+        m.attach_telemetry(tr)
+        m.attach_telemetry(tr)  # idempotent
+        m.collect_telemetry(tr)
+        text = m.expose()
+        assert 'bng_stage_latency_us_bucket{stage="total",le="+Inf"} 5' \
+            in text
+        assert 'bng_stage_latency_us_count{stage="dispatch"} 5' in text
+        assert 'bng_flight_dumps_total{reason="worker_death"} 1' in text
+        assert "bng_telemetry_batch_records_total 5" in text
+
+
+# ---------------------------------------------------------------------------
+# profiling percentile (satellite fix)
+# ---------------------------------------------------------------------------
+
+class TestStepDurationsPercentile:
+    def test_matches_numpy_percentile_property(self):
+        """Property test pinning the sort-once interpolating percentile
+        to numpy.percentile's default (linear) method."""
+        from bng_tpu.utils.profiling import StepDurations
+
+        rng = np.random.default_rng(11)
+        for size in (1, 2, 3, 7, 50, 501):
+            vals = rng.lognormal(2, 1.3, size).tolist()
+            sd = StepDurations(us=vals, source="device")
+            for q in (0.0, 10.0, 25.0, 50.0, 90.0, 99.0, 99.9, 100.0):
+                assert sd.percentile(q) == pytest.approx(
+                    float(np.percentile(np.asarray(vals), q)),
+                    rel=1e-12, abs=1e-12), (size, q)
+
+    def test_sort_cache_and_empty(self):
+        from bng_tpu.utils.profiling import StepDurations
+
+        sd = StepDurations(us=[], source="none")
+        assert sd.percentile(99) == 0.0
+        sd2 = StepDurations(us=[3.0, 1.0, 2.0], source="device")
+        assert sd2.percentile(50) == 2.0
+        assert sd2.percentile(50) == 2.0  # cached-sort path
+        with pytest.raises(ValueError):
+            sd2.percentile(101.0)
+
+
+# ---------------------------------------------------------------------------
+# full engine + scheduler + fleet e2e (XLA compiles: slow tier)
+# ---------------------------------------------------------------------------
+
+def _build_engine_stack(workers: int = 2, scheduler: bool = True):
+    from bng_tpu.control.admission import AdmissionConfig
+    from bng_tpu.control.dhcp_server import DHCPServer
+    from bng_tpu.control.fleet import FleetSpec, SlowPathFleet
+    from bng_tpu.control.nat import NATManager
+    from bng_tpu.control.pool import Pool, PoolManager
+    from bng_tpu.runtime.engine import Engine
+    from bng_tpu.runtime.scheduler import SchedulerConfig, TieredScheduler
+    from bng_tpu.runtime.tables import FastPathTables
+    from bng_tpu.utils.net import ip_to_u32, parse_mac
+
+    smac = parse_mac("02:aa:bb:cc:dd:01")
+    sip = ip_to_u32("10.0.0.1")
+    fp = FastPathTables(sub_nbuckets=1 << 10, vlan_nbuckets=64,
+                        cid_nbuckets=64, max_pools=4, update_slots=256)
+    fp.set_server_config(smac, sip)
+    pools = PoolManager(fp)
+    pools.add_pool(Pool(pool_id=1, network=ip_to_u32("10.0.0.0"),
+                        prefix_len=16, gateway=sip,
+                        dns_primary=ip_to_u32("1.1.1.1"), lease_time=3600))
+    nat = NATManager(public_ips=[ip_to_u32("203.0.113.1")],
+                     sessions_nbuckets=256, sub_nat_nbuckets=64)
+    server = DHCPServer(smac, sip, pools, fastpath_tables=fp)
+    engine = Engine(fp, nat, batch_size=64, slow_path=server.handle_frame)
+    fleet = SlowPathFleet(
+        FleetSpec.from_pool_manager(smac, sip, pools),
+        n_workers=workers, pools=pools, mode="inline",
+        # compile-cold first batches must not be deadline-shed
+        admission=AdmissionConfig(inbox_capacity=512, deadline_ms=60_000.0),
+        table_sink=fp)
+    engine.slow_path_batch = fleet.handle_batch
+    target = engine
+    if scheduler:
+        target = TieredScheduler(engine, SchedulerConfig(
+            express_batch=16, bulk_batch=64))
+    return target, fleet
+
+
+def _dora_frames():
+    from bng_tpu.control import dhcp_codec, packets
+
+    def discover(mac, xid):
+        p = dhcp_codec.build_request(mac, dhcp_codec.DISCOVER, xid=xid)
+        return packets.udp_packet(mac, b"\xff" * 6, 0, 0xFFFFFFFF, 68, 67,
+                                  p.encode().ljust(320, b"\x00"))
+
+    def request(mac, offer_frame, xid):
+        od = packets.decode(offer_frame)
+        off = dhcp_codec.decode(od.payload)
+        p = dhcp_codec.build_request(mac, dhcp_codec.REQUEST, xid=xid,
+                                     requested_ip=off.yiaddr,
+                                     server_id=od.src_ip)
+        return packets.udp_packet(mac, b"\xff" * 6, 0, 0xFFFFFFFF, 68, 67,
+                                  p.encode().ljust(320, b"\x00"))
+
+    return discover, request
+
+
+@pytest.mark.slow
+class TestDoraTracingE2E:
+    def test_dora_through_scheduler_and_fleet(self, tmp_path):
+        """The tentpole e2e: DORA for 32 subscribers through the tiered
+        scheduler (express lane), the slow-path fleet (2 inline workers)
+        and back — with the tracer armed the whole way. Every lifecycle
+        stage the scheduler path exercises must land samples, the flight
+        recorder must hold per-batch records, and the span log must
+        export a valid Chrome trace."""
+        rec = FlightRecorder(RecorderConfig(capacity=64,
+                                            out_dir=str(tmp_path)))
+        tr = Tracer(recorder=rec, keep_events=1 << 12)
+        sched, fleet = _build_engine_stack(workers=2, scheduler=True)
+        discover, request = _dora_frames()
+        macs = [(0x02D0 << 32 | i).to_bytes(6, "big") for i in range(32)]
+        with spans.armed(tr):
+            res = sched.process([discover(m, 0x100 + i)
+                                 for i, m in enumerate(macs)])
+            offers = {i: f for i, f in res["slow"] if f is not None}
+            assert len(offers) == len(macs)
+            res2 = sched.process([request(m, offers[i], 0x200 + i)
+                                  for i, m in enumerate(macs)])
+            assert sum(1 for _i, f in res2["slow"] if f is not None) \
+                == len(macs)
+            # renewal DISCOVERs answered on device (express lane TX)
+            res3 = sched.process([discover(m, 0x300 + i)
+                                  for i, m in enumerate(macs)])
+            assert len(res3["tx"]) == len(macs)
+            bd = tr.breakdown()
+        for stage in ("lane_wait", "dispatch", "device_wait", "fleet",
+                      "worker", "slow_path", "reply", "total"):
+            assert stage in bd, (stage, sorted(bd))
+            assert bd[stage]["count"] > 0
+        assert tr.seq >= 3  # at least one record per exchange batch
+        assert rec.snapshot_meta()["valid_records"] == min(tr.seq, 64)
+        trace = chrome_trace(tr)
+        xs = [e for e in trace["traceEvents"] if e["ph"] == "X"]
+        assert len(xs) >= tr.seq  # every batch contributes spans
+        assert {"dispatch", "device_wait", "total"} <= {e["name"]
+                                                        for e in xs}
+        fleet.close()
+
+    def test_engine_pipelined_ring_tracing(self):
+        """The ring stage: the pipelined engine loop over a PyRing must
+        attribute ring assemble time and keep records balanced (every
+        begun batch ends — the open-slot pool never leaks)."""
+        from bng_tpu.runtime.ring import PyRing
+
+        engine, fleet = _build_engine_stack(workers=1, scheduler=False)
+        discover, _request = _dora_frames()
+        ring = PyRing(nframes=256, frame_size=2048)
+        with spans.armed(Tracer()) as tr:
+            for i in range(32):
+                ring.rx_push(discover(
+                    (0x02D1 << 32 | i).to_bytes(6, "big"), 0x400 + i),
+                    from_access=True)
+            engine.process_ring_pipelined(ring)
+            engine.process_ring_pipelined(ring)
+            engine.flush_pipeline()
+            bd = tr.breakdown()
+            assert "ring" in bd and bd["ring"]["count"] >= 1
+            assert "reply" in bd
+            # the open-slot pool drained back: all begun records ended
+            assert len(tr._free) == tr.OPEN_SLOTS
+        fleet.close()
+
+    def test_loadtest_harness_reports_histogram_percentiles(self):
+        from bng_tpu.loadtest import BenchmarkConfig, DHCPBenchmark
+
+        engine, fleet = _build_engine_stack(workers=1, scheduler=False)
+        cfg = BenchmarkConfig(batch_size=32, duration_s=0.5, warmup_s=0.5,
+                              unique_macs=64)
+        res = DHCPBenchmark(engine, cfg).run()
+        assert res.requests > 0
+        assert res.request_p50_us > 0
+        assert res.request_p999_us >= res.request_p99_us \
+            >= res.request_p50_us
+        assert res.latency_p999_us >= res.latency_p99_us
+        d = res.to_dict()
+        assert "request_p999_us" in d and "latency_p999_us" in d
+        fleet.close()
+
+    def test_process_fleet_restores_telemetry_env(self):
+        """Spawning a process fleet under an armed tracer must not leak
+        BNG_TELEMETRY=1 into the parent environment — a leaked flag
+        would force-arm every later BNGApp in this process and make
+        every later fleet's workers pay armed per-frame costs."""
+        from bng_tpu.control.fleet import FleetSpec, SlowPathFleet
+        from bng_tpu.control.pool import Pool, PoolManager
+        from bng_tpu.utils.net import ip_to_u32
+
+        before = os.environ.get("BNG_TELEMETRY")
+        sip = ip_to_u32("10.9.0.1")
+        pools = PoolManager(None)
+        pools.add_pool(Pool(pool_id=1, network=ip_to_u32("10.9.0.0"),
+                            prefix_len=24, gateway=sip,
+                            dns_primary=ip_to_u32("1.1.1.1"),
+                            lease_time=3600))
+        with spans.armed(Tracer()) as tr:
+            fleet = SlowPathFleet(
+                FleetSpec.from_pool_manager(b"\x02" * 6, sip, pools),
+                n_workers=1, pools=pools, mode="process")
+            try:
+                assert os.environ.get("BNG_TELEMETRY") == before
+                # and the child DID inherit it: its per-frame histogram
+                # arrives in the stats payload and merges
+                from bng_tpu.control import dhcp_codec, packets
+
+                mac = (0x02E0 << 32).to_bytes(6, "big")
+                p = dhcp_codec.build_request(mac, dhcp_codec.DISCOVER,
+                                             xid=1)
+                frame = packets.udp_packet(
+                    mac, b"\xff" * 6, 0, 0xFFFFFFFF, 68, 67,
+                    p.encode().ljust(320, b"\x00"))
+                out = fleet.handle_batch([(0, frame)])
+                assert out[0][1] is not None
+                assert tr.hists[spans.WORKER].n >= 1
+            finally:
+                fleet.close()
+
+    def test_trace_cli_export_chrome(self, tmp_path):
+        from bng_tpu import cli
+
+        out = tmp_path / "dora.json"
+        rc = cli.main(["trace", "export", "--format", "chrome",
+                       "--out", str(out), "--macs", "16",
+                       "--trace-dir", str(tmp_path)])
+        assert rc == 0
+        d = json.load(open(out))
+        xs = [e for e in d["traceEvents"] if e["ph"] == "X"]
+        assert xs and all(e["dur"] > 0 for e in xs)
+        # and `trace status` sees the dir
+        rc = cli.main(["trace", "status", "--trace-dir", str(tmp_path)])
+        assert rc == 0
