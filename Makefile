@@ -23,6 +23,19 @@
 #                       schema. The engine-compiling DORA e2e lives in
 #                       the same file under @pytest.mark.slow (tier-1
 #                       runs it; this target stays fast).
+#   make verify-static — bngcheck static analyzer (< 30 s, no jax):
+#                       `bng check` must exit 0 against the checked-in
+#                       baseline (bng_tpu/analysis/baseline.json), then
+#                       the analyzer's own planted-violation +
+#                       clean-corpus tests run. Part of `verify`: a PR
+#                       that violates a dataplane invariant fails here
+#                       before the test suite even starts.
+#   make verify-sanitize — hotpath-marked engine/scheduler tests under
+#                       BNG_SANITIZE=1 (transfer_guard + debug_nans):
+#                       the dynamic cross-check of the static transfer
+#                       lint. Best-effort on XLA:CPU (d2h guard inert
+#                       there — analysis/sanitize.py documents the
+#                       asymmetry); compile-bound, so not in tier-1.
 
 SHELL := /bin/bash
 PY ?= python
@@ -31,9 +44,9 @@ PYTEST_FLAGS = -q --continue-on-collection-errors -p no:cacheprovider \
                -p no:xdist -p no:randomly
 
 .PHONY: verify verify-slow verify-all verify-load verify-chaos \
-        verify-telemetry
+        verify-telemetry verify-static verify-sanitize
 
-verify:
+verify: verify-static
 	set -o pipefail; rm -f /tmp/_t1.log; \
 	timeout -k 10 $(TIER1_TIMEOUT) env JAX_PLATFORMS=cpu \
 	$(PY) -m pytest tests/ $(PYTEST_FLAGS) -m 'not slow' 2>&1 | tee /tmp/_t1.log
@@ -64,6 +77,22 @@ verify-telemetry:
 	$(PY) -m pytest tests/test_telemetry.py $(PYTEST_FLAGS) \
 	  -m 'telemetry and not slow' \
 	&& echo "verify-telemetry OK"
+
+verify-static:
+	set -o pipefail; \
+	timeout -k 10 30 $(PY) -m bng_tpu.analysis \
+	&& timeout -k 10 30 env JAX_PLATFORMS=cpu \
+	$(PY) -m pytest tests/test_analysis.py $(PYTEST_FLAGS) \
+	  -m 'analysis and not slow' \
+	&& echo "verify-static OK"
+
+verify-sanitize:
+	set -o pipefail; \
+	timeout -k 10 300 env JAX_PLATFORMS=cpu BNG_SANITIZE=1 \
+	$(PY) -m pytest tests/test_sanitize.py tests/test_scheduler.py \
+	  tests/test_dhcp_fastpath.py $(PYTEST_FLAGS) \
+	  -m 'hotpath or analysis' \
+	&& echo "verify-sanitize OK"
 
 verify-load:
 	set -o pipefail; \

@@ -1,0 +1,34 @@
+"""bngcheck: dataplane-invariant static analysis + runtime sanitizers.
+
+Static half (stdlib `ast`, no jax import): `bng check` /
+`python -m bng_tpu.analysis` runs six passes over the scan set and
+compares findings against the checked-in baseline —
+
+    hotpath         BNG001-003  dispatch scope never forces; disarmed
+                                hooks guard-first, allocation-free
+    jit-discipline  BNG010-012  cached jit factories, donated table
+                                steps, fixed-width traced scalars
+    handler-audit   BNG020-021  no swallowed broad excepts (Yuan '14)
+    registry        BNG030-035  span/fault/metric/checkpoint/trigger
+                                vocabularies consistent
+    single-writer   BNG040-041  table mutators only from allowlisted
+                                writer modules
+    fencing         BNG050      no wall-clock over async dispatch
+                                without a force
+
+Runtime half (`BNG_SANITIZE=1`, analysis/sanitize.py): arms
+jax.transfer_guard + debug_nans around hot-path tests so the transfer
+lint's claims are cross-checked dynamically (best-effort on XLA:CPU —
+see the module docstring for which guards fire where).
+"""
+
+from bng_tpu.analysis.core import (Finding, Project, Report,  # noqa: F401
+                                   run_passes)
+from bng_tpu.analysis.passes import ALL_PASSES, all_codes, build  # noqa: F401
+
+
+def run_analysis(root, paths=None, select=None) -> "Report":
+    """Programmatic entry: scan `root` and return the Report (no
+    baseline applied — callers split against a baseline themselves)."""
+    project = Project.load(root, paths)
+    return run_passes(project, build(set(select) if select else None))

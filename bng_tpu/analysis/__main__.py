@@ -1,0 +1,7 @@
+"""`python -m bng_tpu.analysis` — alias for `bng check`."""
+
+import sys
+
+from bng_tpu.analysis.cli import main
+
+sys.exit(main())

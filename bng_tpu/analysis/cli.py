@@ -1,0 +1,142 @@
+"""`bng check` / `python -m bng_tpu.analysis` — the analyzer driver.
+
+Exit codes:
+    0  clean (every finding baselined, or none)
+    1  at least one non-baselined finding
+    2  analyzer-internal error (unreadable baseline, bad arguments)
+
+Importing this module never imports jax — the analyzer is pure stdlib
+`ast`, so `bng check` runs in milliseconds on any box (the <30s
+acceptance bound is dominated by Python startup, not the scan).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+from bng_tpu.analysis import baseline as baseline_mod
+from bng_tpu.analysis.core import CODE_CONFIG, Project, run_passes
+from bng_tpu.analysis.passes import all_codes, build
+
+
+def default_root() -> Path:
+    """The repo root: the directory holding the bng_tpu package."""
+    return Path(__file__).resolve().parents[2]
+
+
+def add_check_args(p: argparse.ArgumentParser) -> None:
+    p.add_argument("paths", nargs="*",
+                   help="files/dirs to scan (default: the repo scan set)")
+    p.add_argument("--root", default=None,
+                   help="repo root (default: the bng_tpu install root)")
+    p.add_argument("--baseline", default=None,
+                   help="baseline file (default: "
+                        "bng_tpu/analysis/baseline.json)")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="ignore the baseline (report every finding)")
+    p.add_argument("--update-baseline", action="store_true",
+                   help="rewrite the baseline from this run (new entries "
+                        "tagged 'TODO: justify')")
+    p.add_argument("--select", default=None,
+                   help="comma-separated pass names or finding codes "
+                        "(e.g. hotpath,BNG020)")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="machine-readable report on stdout")
+    p.add_argument("--codes", action="store_true",
+                   help="print the finding-code catalog and exit")
+
+
+def run_check(args: argparse.Namespace) -> int:
+    if args.codes:
+        for code, desc in all_codes().items():
+            print(f"{code}  {desc}")
+        return 0
+
+    if args.no_baseline and args.update_baseline:
+        # --no-baseline discards the justifications --update-baseline
+        # must carry over; combining them would rewrite the file with
+        # every entry reset to the TODO tag.
+        print("bng check: --no-baseline and --update-baseline are "
+              "mutually exclusive", file=sys.stderr)
+        return 2
+
+    root = Path(args.root) if args.root else default_root()
+    select = (set(s.strip() for s in args.select.split(","))
+              if args.select else None)
+    passes = build(select)
+    if not passes:
+        print(f"bng check: no pass matches --select {args.select}",
+              file=sys.stderr)
+        return 2
+
+    project = Project.load(root, [Path(p) for p in args.paths] or None)
+    report = run_passes(project, passes)
+
+    if args.no_baseline:
+        bl: dict = {}
+        bl_path = None
+    else:
+        bl_path = Path(args.baseline) if args.baseline else (
+            baseline_mod.DEFAULT_BASELINE)
+        try:
+            bl = baseline_mod.load(bl_path)
+        except (json.JSONDecodeError, KeyError, OSError) as e:
+            print(f"bng check: unreadable baseline {bl_path}: {e}",
+                  file=sys.stderr)
+            return 2
+    new, accepted, stale = baseline_mod.split(report.findings, bl)
+    report.findings, report.baselined = new, accepted
+
+    if args.update_baseline:
+        # A selective run (--select, or explicit paths narrowing the
+        # scan) can only vouch for the codes its passes emit against the
+        # files it scanned — baseline entries outside that scope must
+        # survive the rewrite, or `--select hotpath --update-baseline`
+        # silently wipes every other pass's justified entries.
+        emittable = {c for p in passes for c in p.codes} | {CODE_CONFIG}
+        scanned = {f.path for f in project.files} | {"<analyzer>"}
+        keep = {k: v for k, v in bl.items()
+                if k[0] not in emittable or k[1] not in scanned}
+        stale = [k for k in stale if k not in keep]
+        out = baseline_mod.write(new + accepted, bl_path, old=bl,
+                                 keep=keep)
+        print(f"bng check: baseline rewritten: {out} "
+              f"({len(new)} new, {len(accepted)} kept, "
+              f"{len(keep)} out-of-scope preserved, "
+              f"{len(stale)} stale dropped)")
+        return 0
+
+    if args.as_json:
+        doc = report.to_dict()
+        doc["stale_baseline_entries"] = [list(k) for k in stale]
+        print(json.dumps(doc, indent=2))
+    else:
+        for f in new:
+            print(f"{f.location()}: {f.code} [{f.scope or '<module>'}] "
+                  f"{f.message}")
+        if stale:
+            print(f"bng check: {len(stale)} stale baseline entr"
+                  f"{'y' if len(stale) == 1 else 'ies'} (code no longer "
+                  f"produces them) — run --update-baseline",
+                  file=sys.stderr)
+        print(f"bng check: {len(new)} finding(s), {len(accepted)} "
+              f"baselined, {report.files_scanned} files, "
+              f"{report.elapsed_s:.2f}s "
+              f"[{', '.join(report.passes_run)}]",
+              file=sys.stderr)
+    return 1 if new else 0
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="bng check",
+        description="bngcheck: dataplane-invariant static analyzer")
+    add_check_args(parser)
+    return run_check(parser.parse_args(argv))
+
+
+if __name__ == "__main__":  # pragma: no cover
+    sys.exit(main())

@@ -1,0 +1,163 @@
+"""Fact extraction: the repo's registries, read back out of its AST.
+
+The registry-consistency passes check call sites against the *declared*
+vocabularies — the span stage constants in telemetry/spans.py, the
+fault-point registry in chaos/faults.py, the metric families in
+control/metrics.py, the flight-recorder trigger reasons in
+telemetry/recorder.py, and the checkpoint component keys in
+runtime/checkpoint.py. All of these are parsed from source (never
+imported), so the analyzer stays in lockstep with the code it checks:
+renaming a stage constant updates the vocabulary and the check in the
+same commit, and a fixture tree carrying miniature fact files gets a
+consistent miniature vocabulary.
+
+Every extractor returns None when its source file or declaration shape
+is missing — the dependent pass turns that into a loud BNG990 config
+finding instead of silently checking nothing.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from bng_tpu.analysis.core import Project, str_const
+
+SPANS_FILE = "bng_tpu/telemetry/spans.py"
+FAULTS_FILE = "bng_tpu/chaos/faults.py"
+RECORDER_FILE = "bng_tpu/telemetry/recorder.py"
+CHECKPOINT_FILE = "bng_tpu/runtime/checkpoint.py"
+
+
+def stage_vocabulary(project: Project) -> tuple[set[str], set[str]] | None:
+    """(stage constant names, lane constant names) from spans.py — the
+    tuple-unpacking assignments `(RING, ...) = range(N)` whose names are
+    kept in lockstep with STAGE_NAMES/LANE_NAMES."""
+    sf = project.find_file(SPANS_FILE)
+    if sf is None:
+        return None
+    stages: set[str] = set()
+    lanes: set[str] = set()
+    for node in sf.tree.body:
+        if not (isinstance(node, ast.Assign) and len(node.targets) == 1):
+            continue
+        tgt = node.targets[0]
+        if not isinstance(tgt, ast.Tuple):
+            continue
+        names = [e.id for e in tgt.elts if isinstance(e, ast.Name)]
+        if not names:
+            continue
+        if all(n.startswith("LANE_") for n in names):
+            lanes.update(names)
+        elif any(n in ("RING", "DISPATCH", "TOTAL") for n in names):
+            stages.update(names)
+    if not stages:
+        return None
+    return stages, lanes
+
+
+def fault_registry(project: Project) -> set[str] | None:
+    """Keys of POINT_KINDS in chaos/faults.py — the fault-point IDs the
+    soak generator may draw and the call sites may reference."""
+    sf = project.find_file(FAULTS_FILE)
+    if sf is None:
+        return None
+    for node in sf.tree.body:
+        targets = []
+        value = None
+        if isinstance(node, ast.Assign):
+            targets, value = node.targets, node.value
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            targets, value = [node.target], node.value
+        for tgt in targets:
+            if (isinstance(tgt, ast.Name) and tgt.id == "POINT_KINDS"
+                    and isinstance(value, ast.Dict)):
+                keys = {str_const(k) for k in value.keys}
+                keys.discard(None)
+                return keys  # type: ignore[return-value]
+    return None
+
+
+def trigger_reasons(project: Project) -> set[str] | None:
+    """Flight-recorder anomaly reasons: the TRIG_* string constants in
+    telemetry/recorder.py."""
+    sf = project.find_file(RECORDER_FILE)
+    if sf is None:
+        return None
+    reasons: set[str] = set()
+    for node in sf.tree.body:
+        if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and node.targets[0].id.startswith("TRIG_")):
+            v = str_const(node.value)
+            if v:
+                reasons.add(v)
+    return reasons or None
+
+
+def checkpoint_components(project: Project) -> dict | None:
+    """Checkpoint component-key symmetry facts from runtime/checkpoint.py:
+
+      save     — keys assigned via  meta["components"]["X"] = ...
+      restore  — keys of the `targets = {...}` dict literal in the
+                 restore path, plus keys tested with  "X" in comps
+      payload  — the _PAYLOAD_JSON_COMPONENTS tuple
+
+    Returns {"save": set, "restore": set, "payload": set, "line": int}.
+    """
+    sf = project.find_file(CHECKPOINT_FILE)
+    if sf is None:
+        return None
+    save: set[str] = set()
+    restore: set[str] = set()
+    payload: set[str] = set()
+    line = 1
+    for node in ast.walk(sf.tree):
+        # meta["components"]["X"] = ...
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            tgt = node.targets[0]
+            if (isinstance(tgt, ast.Subscript)
+                    and isinstance(tgt.value, ast.Subscript)
+                    and str_const(tgt.value.slice) == "components"):
+                key = str_const(tgt.slice)
+                if key:
+                    save.add(key)
+            # targets = {...}
+            if (isinstance(tgt, ast.Name) and tgt.id == "targets"
+                    and isinstance(node.value, ast.Dict)):
+                for k in node.value.keys:
+                    key = str_const(k)
+                    if key:
+                        restore.add(key)
+                line = node.lineno
+            # _PAYLOAD_JSON_COMPONENTS = (...)
+            if (isinstance(tgt, ast.Name)
+                    and tgt.id == "_PAYLOAD_JSON_COMPONENTS"
+                    and isinstance(node.value, (ast.Tuple, ast.List))):
+                for e in node.value.elts:
+                    key = str_const(e)
+                    if key:
+                        payload.add(key)
+        # "X" in comps  (restore-side consumption)
+        if isinstance(node, ast.Compare) and len(node.ops) == 1:
+            if (isinstance(node.ops[0], ast.In)
+                    and isinstance(node.comparators[0], ast.Name)
+                    and node.comparators[0].id == "comps"):
+                key = str_const(node.left)
+                if key:
+                    restore.add(key)
+    if not save and not restore:
+        return None
+    # the statestore also declares payload components; fold them in
+    ss = project.find_file("bng_tpu/control/statestore.py")
+    if ss is not None:
+        for node in ast.walk(ss.tree):
+            if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)
+                    and node.targets[0].id == "_PAYLOAD_JSON_COMPONENTS"
+                    and isinstance(node.value, (ast.Tuple, ast.List))):
+                for e in node.value.elts:
+                    key = str_const(e)
+                    if key:
+                        payload.add(key)
+    return {"save": save, "restore": restore, "payload": payload,
+            "line": line}

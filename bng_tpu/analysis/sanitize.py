@@ -1,0 +1,77 @@
+"""Runtime sanitizer mode (BNG_SANITIZE=1): the dynamic cross-check of
+the static transfer lint.
+
+`sanitized()` arms, for the enclosed block:
+
+* ``jax.transfer_guard_device_to_host("disallow")`` — an implicit
+  device->host transfer (the BNG001 class: lazily consuming a device
+  value where host code expected numpy) raises instead of silently
+  blocking. Explicit forces (`jax.device_get`, `np.asarray`,
+  `block_until_ready` — the blessed retire-path idioms) stay legal.
+* ``jax.transfer_guard_host_to_device(h2d)`` — default "allow"; the
+  planted-violation test passes "disallow" to prove the guard has
+  teeth: feeding a raw numpy array (or a bare Python/np scalar) to a
+  jitted step is an *implicit* h2d transfer and trips, while the
+  engine's explicit `jnp.asarray`/`device_put` staging would not.
+* ``jax.debug_nans`` — jitted programs re-checked for NaN production
+  (forces outputs per call: correct, slow, opt-in).
+
+**XLA:CPU caveat (measured on jaxlib 0.4.37, see tests):** the
+device-to-host guard never fires on the CPU backend — `__array__`,
+`.item()` and `float()` on a CPU jax array are serviced without a
+guarded transfer. Host-to-device guards DO fire on CPU (scalar and
+ndarray args to jitted calls trip "disallow"). So under
+`BNG_SANITIZE=1` on the tier-1 CPU suite the effective checks are
+debug_nans + h2d hygiene of the planted tests; on a real TPU the d2h
+guard gains teeth with no change here. That asymmetry is why the
+sanitizer is the *cross-check* and the static lint is the gate.
+
+Wiring: tests/conftest.py applies `sanitized()` around every test
+marked ``hotpath`` when BNG_SANITIZE=1 (`make verify-sanitize`);
+anything may also use it directly as a context manager.
+"""
+
+from __future__ import annotations
+
+import os
+from contextlib import contextmanager
+
+SANITIZE_ENV = "BNG_SANITIZE"
+
+
+def enabled() -> bool:
+    """Is sanitizer mode requested? ("1", "true", "strict" accept)."""
+    return os.environ.get(SANITIZE_ENV, "").lower() in ("1", "true",
+                                                        "strict")
+
+
+def strict() -> bool:
+    """BNG_SANITIZE=strict also disallows implicit host->device
+    transfers — only viable for code whose inputs are staged with
+    explicit jnp.asarray/device_put end to end."""
+    return os.environ.get(SANITIZE_ENV, "").lower() == "strict"
+
+
+@contextmanager
+def sanitized(h2d: str = "allow", d2h: str = "disallow",
+              nans: bool = True):
+    """Arm the transfer guards + debug_nans for the block.
+
+    Imports jax lazily so `bng check` (static half) never pays for it.
+    """
+    import jax
+
+    ctxs = [jax.transfer_guard_device_to_host(d2h),
+            jax.transfer_guard_host_to_device(h2d)]
+    if nans:
+        ctxs.append(jax.debug_nans(True))
+    # contextlib.ExitStack without the import ceremony
+    entered = []
+    try:
+        for c in ctxs:
+            c.__enter__()
+            entered.append(c)
+        yield
+    finally:
+        for c in reversed(entered):
+            c.__exit__(None, None, None)

@@ -2224,6 +2224,12 @@ def main(argv: list[str] | None = None) -> int:
                       "authorities; rc=2 on any violation")
     _add_run_flags(caud)
 
+    checkp = sub.add_parser(
+        "check", help="bngcheck: dataplane-invariant static analyzer "
+                      "(rc=1 on any non-baselined finding)")
+    from bng_tpu.analysis.cli import add_check_args, run_check
+    add_check_args(checkp)
+
     sub.add_parser("version", help="print version")
 
     args = parser.parse_args(argv)
@@ -2231,6 +2237,8 @@ def main(argv: list[str] | None = None) -> int:
     if args.command == "version":
         print(f"bng-tpu {__version__}")
         return 0
+    if args.command == "check":
+        return run_check(args)
     if args.command == "demo":
         run_demo(args.subscribers)
         return 0

@@ -15,6 +15,7 @@ from dataclasses import dataclass, field
 from typing import Protocol
 
 from bng_tpu.control.allocator.bitmap import IPAllocator
+from bng_tpu.utils.structlog import ErrorLog
 
 
 class Allocator(Protocol):
@@ -72,6 +73,10 @@ class HybridAllocator:
         self._failures = 0
         self.partition_active = False
         self.fallback_allocations: list[FallbackAllocation] = []
+        self.release_errors = 0
+        self._release_err_log = ErrorLog(
+            "allocator", "primary release failed (local release still "
+            "applies)")
 
     def is_partition_active(self) -> bool:
         return self.partition_active
@@ -107,8 +112,11 @@ class HybridAllocator:
         ok = False
         try:
             ok = self.primary.release(subscriber_id)
-        except Exception:
-            pass
+        except Exception as e:
+            # a leaked primary allocation is exactly what reconcile()
+            # heals — but it must be visible, not silent (BNG020)
+            self.release_errors += 1
+            self._release_err_log.report(e, subscriber_id=subscriber_id)
         return self.local.release(subscriber_id) or ok
 
     def reconcile(self) -> tuple[int, list[tuple[FallbackAllocation, str]]]:

@@ -49,6 +49,7 @@ from urllib.parse import parse_qs, urlparse
 
 from bng_tpu.control.ha import ActiveSyncer, HAChange, SessionState
 from bng_tpu.control.ztp_tls import CertificateValidationError
+from bng_tpu.utils.structlog import ErrorLog
 from bng_tpu.control.peerpool import PeerPool, PeerPoolError
 
 __all__ = [
@@ -446,6 +447,8 @@ class HTTPActiveProxy:
         self.url = url.rstrip("/")
         self.on_stream_end = on_stream_end
         self._opener = make_cluster_opener(tls) if tls is not None else None
+        self._stream_err_log = ErrorLog(
+            "cluster", "SSE stream died; standby will reconnect")
         self._seen_seq = 0  # high-water mark from full_sync/replay_since
         # fail fast like an in-process transport: unreachable = raise now
         status, _ = self._req("GET", f"{self.url}/health")
@@ -491,8 +494,10 @@ class HTTPActiveProxy:
                         line = raw.decode().strip()
                         if line.startswith("data: "):
                             cb(_change_from(json.loads(line[6:])))
-            except Exception:
-                pass
+            except Exception as e:  # noqa: BLE001 — any stream failure
+                # means reconnect; the reason still matters (TLS reject
+                # vs timeout vs bad payload diagnose very differently)
+                self._stream_err_log.report(e, since=since)
             finally:
                 if not stop.is_set() and self.on_stream_end is not None:
                     self.on_stream_end()

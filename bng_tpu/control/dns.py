@@ -21,6 +21,8 @@ from collections import OrderedDict
 from dataclasses import dataclass, field
 from enum import Enum
 
+from bng_tpu.utils.structlog import ErrorLog
+
 # DNS constants (types.go:173-221)
 TYPE_A = 1
 TYPE_NS = 2
@@ -223,7 +225,9 @@ class Resolver:
         self._stats = {"queries": 0, "cache_hits": 0, "intercepted": 0,
                        "walled_garden_redirects": 0, "forwarded": 0,
                        "rate_limited": 0, "dns64_synthesized": 0,
-                       "errors": 0}
+                       "errors": 0, "dns64_errors": 0}
+        self._dns64_err_log = ErrorLog(
+            "dns", "DNS64 synthesis failed; empty AAAA passed through")
 
     # -- config surface -------------------------------------------------
 
@@ -308,8 +312,12 @@ class Resolver:
                 and not resp.answers and resp.rcode == RCODE_SUCCESS):
             try:
                 synth = self._apply_dns64(query)
-            except Exception:
+            except Exception as e:
+                # a broken upstream A answer must not kill the resolve,
+                # but silent DNS64 breakage hides v6-only outage (BNG021)
                 synth = None
+                self._stats["dns64_errors"] += 1
+                self._dns64_err_log.report(e, qname=query.name)
             if synth is not None:
                 resp = synth
 

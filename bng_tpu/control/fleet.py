@@ -66,6 +66,7 @@ from bng_tpu.control.admission import (AdmissionConfig, AdmissionController,
 from bng_tpu.control.pool import PoolExhaustedError, PoolManager
 from bng_tpu.runtime.ring import classify_dhcp
 from bng_tpu.utils.net import fnv1a32, prefix_to_mask
+from bng_tpu.utils.structlog import SlowPathErrorLog
 
 
 def shard_for_mac(mac: bytes, n_workers: int) -> int:
@@ -566,6 +567,8 @@ class SlowPathFleet:
         self.refills = 0
         self.refill_ips_granted = 0
         self.fallback_frames = 0
+        self.fallback_errors = 0
+        self._fallback_err_log = SlowPathErrorLog("fleet-fallback")
         self.batches = 0
         self.worker_failures = 0  # dead-worker batch losses (IPC errors)
         # workers killed by the chaos harness (fleet.scatter `kill`):
@@ -789,7 +792,9 @@ class SlowPathFleet:
                 self.fallback_frames += 1
                 try:
                     results.append((lane, self.fallback(frame)))
-                except Exception:  # noqa: BLE001 — untrusted wire input
+                except Exception as e:  # noqa: BLE001 — untrusted wire input
+                    self.fallback_errors += 1
+                    self._fallback_err_log.report(e, lane=lane)
                     results.append((lane, None))
                 continue
             w = shard_for_frame(frame, self.n)
@@ -1036,6 +1041,7 @@ class SlowPathFleet:
             "refills": self.refills,
             "refill_ips_granted": self.refill_ips_granted,
             "fallback_frames": self.fallback_frames,
+            "fallback_errors": self.fallback_errors,
             "per_worker": list(self._last_stats),
             "admission": self.admission.stats_snapshot(),
         }

@@ -19,6 +19,7 @@ from dataclasses import dataclass, field, asdict
 from typing import Callable
 
 from bng_tpu.chaos.faults import fault_point
+from bng_tpu.utils.structlog import ErrorLog
 
 
 @dataclass
@@ -106,7 +107,10 @@ class ActiveSyncer:
         self._replay: list[HAChange] = []
         self._replay_cap = replay_buffer
         self._subscribers: list[Callable[[HAChange], None]] = []
-        self.stats = {"changes": 0, "full_syncs": 0}
+        self.stats = {"changes": 0, "full_syncs": 0, "sink_errors": 0}
+        self._sink_err_log = ErrorLog(
+            "ha", "replica sink failed; subscriber dropped pending "
+            "reconnect full-resync")
         # push_change runs on the main loop; full_sync/replay_since on
         # the cluster listener's HTTP threads. Without this lock a push
         # landing between the snapshot read and the seq read hands a
@@ -146,7 +150,9 @@ class ActiveSyncer:
             # full-resync on reconnect
             try:
                 cb(ch)
-            except Exception:
+            except Exception as e:
+                self.stats["sink_errors"] += 1
+                self._sink_err_log.report(e, seq=ch.seq)
                 if cb in self._subscribers:
                     self._subscribers.remove(cb)
 
@@ -326,6 +332,7 @@ class HealthMonitor:
         self._fails = 0
         self._oks = 0
         self._last_check = 0.0
+        self._probe_err_log = ErrorLog("ha", "health probe raised")
 
     def tick(self, now: float) -> HealthState:
         if now - self._last_check < self.interval_s:
@@ -334,8 +341,10 @@ class HealthMonitor:
         ok = False
         try:
             ok = bool(self.probe())
-        except Exception:
-            ok = False
+        except Exception as e:
+            # a RAISING probe is a distinct gray-failure signal from a
+            # clean False — log it (rate-limited) before folding to fail
+            self._probe_err_log.report(e)
         if ok:
             self._oks += 1
             self._fails = 0

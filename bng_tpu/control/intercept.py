@@ -562,8 +562,8 @@ class TLSDeliverySink:
                 else:  # a send() beat us to it
                     try:
                         tls.close()
-                    except Exception:
-                        pass
+                    except OSError:
+                        pass  # race loser; the winning socket is kept
 
     # -- the sink callable the exporters take --
     def __call__(self, pdu: bytes) -> None:
@@ -626,8 +626,8 @@ class TLSDeliverySink:
                 # drop the socket, back off before redialing
                 try:
                     sock.close()
-                except Exception:
-                    pass
+                except OSError:
+                    pass  # socket already dead; nothing to release
                 self._sock = None
                 self._next_dial = self.clock() + self.backoff_s
                 return
@@ -647,8 +647,8 @@ class TLSDeliverySink:
             if self._sock is not None:
                 try:
                     self._sock.close()
-                except Exception:
-                    pass
+                except OSError:
+                    pass  # shutdown path; the socket is gone either way
                 self._sock = None
 
 

@@ -16,6 +16,8 @@ import threading
 import time
 from collections import OrderedDict
 
+from bng_tpu.utils.structlog import ErrorLog
+
 
 def _fmt_labels(labels: dict) -> str:
     if not labels:
@@ -607,6 +609,9 @@ class MetricsCollector:
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         self._httpd = None
+        self.source_errors = 0
+        self._source_err_log = ErrorLog(
+            "metrics", "metrics source failed; its families go stale")
 
     def add_source(self, fn) -> None:
         self._sources.append(fn)
@@ -615,8 +620,12 @@ class MetricsCollector:
         for fn in self._sources:
             try:
                 fn()
-            except Exception:
-                pass
+            except Exception as e:  # noqa: BLE001 — one bad source must
+                # not stop the scrape, but a source that fails every 5s
+                # forever is exactly how dashboards go quietly stale
+                self.source_errors += 1
+                self._source_err_log.report(
+                    e, source=getattr(fn, "__qualname__", repr(fn)))
 
     def start(self) -> None:
         self._stop.clear()

@@ -18,6 +18,8 @@ import enum
 from dataclasses import dataclass, field
 from typing import Callable
 
+from bng_tpu.utils.structlog import ErrorLog
+
 
 class PartitionState(str, enum.Enum):
     NORMAL = "normal"
@@ -283,6 +285,8 @@ class ResilienceManager:
         self.radius_down = False
         self._last_check = 0.0
         self._last_conflicts: list[Conflict] = []
+        self._probe_err_log = ErrorLog(
+            "resilience", "health probe raised (folded to unhealthy)")
 
     @property
     def partitioned(self) -> bool:
@@ -314,16 +318,18 @@ class ResilienceManager:
         ok = False
         try:
             ok = bool(self.nexus_healthy())
-        except Exception:
-            ok = False
+        except Exception as e:
+            # a raising probe is a different signal than a clean False —
+            # visible (rate-limited), then folded to unhealthy (BNG021)
+            self._probe_err_log.report(e, probe="nexus")
 
         # RADIUS-only outage: degraded auth without a Nexus partition
         if self.radius_healthy is not None:
             r_ok = False
             try:
                 r_ok = bool(self.radius_healthy())
-            except Exception:
-                r_ok = False
+            except Exception as e:
+                self._probe_err_log.report(e, probe="radius")
             if r_ok:
                 self._radius_fails = 0
                 if self.radius_down:

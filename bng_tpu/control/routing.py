@@ -1040,7 +1040,7 @@ class SubscriberRouteManager:
         self._by_ip: dict[str, str] = {}
         self._retry: list[tuple[str, SubscriberRoute, int]] = []  # (op, rt, n)
         self.stats = {"injected": 0, "withdrawn": 0, "failed": 0,
-                      "retried": 0}
+                      "retried": 0, "retry_errors": 0}
 
     def _community_for(self, subscriber_class: str) -> str:
         return self.config.communities_by_class.get(
@@ -1144,6 +1144,10 @@ class SubscriberRouteManager:
                 done += 1
                 self.stats["retried"] += 1
             except Exception:
+                # still failing: requeue with the attempt count bumped,
+                # and count it — an install that never converges must
+                # show up in stats, not just sit in the retry deque
+                self.stats["retry_errors"] += 1
                 with self._lock:
                     self._retry.append((op, route, attempts + 1))
         return done

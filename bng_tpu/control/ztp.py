@@ -17,6 +17,7 @@ import time
 from dataclasses import dataclass, field
 
 from bng_tpu.control.deviceauth import DeviceIdentity, read_device_identity
+from bng_tpu.utils.structlog import ErrorLog
 
 OPTION_NEXUS_URL = 224  # private-use simple string
 OPTION_VENDOR = 43  # vendor TLV; sub-type 1 = Nexus URL
@@ -172,6 +173,8 @@ class BootstrapClient:
         self._sleep = sleep
         self.identity = identity or read_device_identity(sys_root)
         self.attempts = 0
+        self._bootstrap_err_log = ErrorLog(
+            "ztp", "bootstrap attempt failed; backing off")
 
     def detect_system_info(self) -> BootstrapRequest:
         """bootstrap.go:181-217."""
@@ -214,6 +217,10 @@ class BootstrapClient:
                 backoff = self.config.initial_backoff  # reset after contact
             except TimeoutError:
                 raise
-            except Exception:
+            except Exception as e:
+                # transient bootstrap failure: visible per retry (ZTP
+                # hangs are diagnosed from exactly these lines), then
+                # backed off and retried
+                self._bootstrap_err_log.report(e, backoff_s=backoff)
                 self._sleep(backoff)
                 backoff = min(backoff * 2, self.config.max_backoff)

@@ -144,7 +144,44 @@ class RateLimiter:
         return False, self._suppressed
 
 
-class SlowPathErrorLog:
+class ErrorLog:
+    """Rate-limited exception reporter for background/maintenance paths.
+
+    The generic sibling of SlowPathErrorLog, for the Yuan-style handler
+    fixes (bngcheck BNG020/BNG021): a broad `except` that used to be
+    `pass` reports here instead — one line per `rate`/s with a
+    suppressed-count, traceback included, never raising into the path it
+    guards.
+    """
+
+    def __init__(self, name: str, message: str, rate: float = 1.0,
+                 burst: int = 5, clock=time.monotonic, level: str = "warning",
+                 **bound):
+        self._log = get_logger(name, **bound)
+        self._message = message
+        self._level = level
+        self._limit = RateLimiter(rate=rate, burst=burst, clock=clock)
+
+    def report(self, exc: BaseException, **fields) -> bool:
+        """Log `exc` (with traceback) unless rate-limited; returns
+        whether the line was emitted. Never raises — a logging failure
+        must not take down the path it guards."""
+        try:
+            ok, suppressed = self._limit.allow()
+            if not ok:
+                return False
+            getattr(self._log, self._level)(
+                self._message,
+                error=f"{type(exc).__name__}: {exc}",
+                suppressed=suppressed,
+                exc_info=(type(exc), exc, exc.__traceback__),
+                **fields)
+            return True
+        except Exception:  # pragma: no cover - defensive
+            return False
+
+
+class SlowPathErrorLog(ErrorLog):
     """Rate-limited exception reporter for the engine slow-path drains.
 
     The engines count `slow_errors` for metrics; this adds the traceback
@@ -157,23 +194,6 @@ class SlowPathErrorLog:
 
     def __init__(self, component: str, rate: float = 1.0, burst: int = 5,
                  clock=time.monotonic):
-        self._log = get_logger("slowpath", component=component)
-        self._limit = RateLimiter(rate=rate, burst=burst, clock=clock)
-
-    def report(self, exc: BaseException, **fields) -> bool:
-        """Log `exc` (with traceback) unless rate-limited; returns whether
-        the line was emitted. Never raises — a logging failure must not
-        take down the drain loop it guards."""
-        try:
-            ok, suppressed = self._limit.allow()
-            if not ok:
-                return False
-            self._log.error(
-                "slow-path handler failed",
-                error=f"{type(exc).__name__}: {exc}",
-                suppressed=suppressed,
-                exc_info=(type(exc), exc, exc.__traceback__),
-                **fields)
-            return True
-        except Exception:  # pragma: no cover - defensive
-            return False
+        super().__init__("slowpath", "slow-path handler failed",
+                         rate=rate, burst=burst, clock=clock,
+                         level="error", component=component)

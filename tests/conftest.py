@@ -23,3 +23,32 @@ force_cpu(8)
 # unless BNG_JAX_CACHE_CPU=1; accelerator runs get the cache. The CPU
 # time win comes from the @pytest.mark.slow tier instead.
 enable_compilation_cache()
+
+# ---------------------------------------------------------------------------
+# BNG_SANITIZE=1 — runtime sanitizer around hot-path tests
+# ---------------------------------------------------------------------------
+# The dynamic cross-check of bngcheck's static transfer lint
+# (bng_tpu/analysis): tests marked `hotpath` run under
+# jax.transfer_guard_device_to_host("disallow") + jax.debug_nans, so an
+# implicit device->host transfer the lint missed fails the test instead
+# of silently blocking the dispatch path. Best-effort on XLA:CPU — the
+# d2h guard is inert there (measured, see analysis/sanitize.py); the
+# debug_nans half and the planted h2d tests keep teeth everywhere.
+# BNG_SANITIZE=strict additionally disallows implicit host->device
+# transfers (only hotpath tests whose inputs are explicitly staged
+# survive that).
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _bng_sanitize(request):
+    from bng_tpu.analysis import sanitize
+
+    if (not sanitize.enabled()
+            or request.node.get_closest_marker("hotpath") is None):
+        yield
+        return
+    with sanitize.sanitized(
+            h2d="disallow" if sanitize.strict() else "allow"):
+        yield

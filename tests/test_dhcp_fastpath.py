@@ -305,6 +305,7 @@ class TestDNSVariants:
         assert d.opt(dhcp_codec.OPT_REBIND_TIME) == (6300).to_bytes(4, "big")
 
 
+@pytest.mark.hotpath
 class TestBatch:
     def test_mixed_batch(self):
         t = make_tables()

@@ -1,0 +1,102 @@
+"""Runtime sanitizer tests (BNG_SANITIZE, bng_tpu/analysis/sanitize.py).
+
+The sanitizer is the dynamic cross-check of bngcheck's static transfer
+lint: transfer guards + debug_nans armed around hot-path code. The
+planted-violation test proves the guard has real teeth on THIS backend
+(an implicit transfer into a jitted call raises); the caveat test pins
+the measured XLA:CPU asymmetry the docs promise (d2h guards inert,
+h2d guards live), so a jaxlib upgrade that changes guard behavior
+fails loudly here instead of silently changing what `make
+verify-sanitize` covers.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from bng_tpu.analysis import sanitize
+
+pytestmark = pytest.mark.analysis
+
+
+@pytest.fixture(scope="module")
+def add_one():
+    f = jax.jit(lambda a: a + 1)
+    f(jnp.zeros(4, jnp.float32))  # compiled outside any guard
+    return f
+
+
+class TestPlantedViolations:
+    def test_implicit_h2d_transfer_caught(self, add_one):
+        """THE planted implicit transfer: a raw numpy array fed to a
+        jitted step is an implicit host->device transfer and must trip
+        the strict guard."""
+        with sanitize.sanitized(h2d="disallow"):
+            with pytest.raises(Exception, match="[Dd]isallowed"):
+                add_one(np.zeros(4, np.float32))
+
+    def test_explicit_staging_passes(self, add_one):
+        """The engine's idiom — explicit jnp.asarray staging — is legal
+        under the same strict guard."""
+        staged = jnp.asarray(np.ones(4, np.float32))
+        with sanitize.sanitized(h2d="disallow"):
+            out = add_one(staged)
+        assert jax.device_get(out).tolist() == [2.0] * 4
+
+    def test_debug_nans_catches_planted_nan(self):
+        with sanitize.sanitized():
+            with pytest.raises(FloatingPointError):
+                jax.block_until_ready(jnp.log(-jnp.ones(2)))
+
+    def test_guards_disarmed_outside_context(self, add_one):
+        # after the context exits, implicit transfers work again
+        with sanitize.sanitized(h2d="disallow"):
+            pass
+        out = add_one(np.zeros(4, np.float32))
+        assert jax.device_get(out).tolist() == [1.0] * 4
+
+
+class TestCpuCaveat:
+    """Pin the measured jaxlib-0.4.37 XLA:CPU behavior the sanitizer
+    docs document: d2h guards never fire on CPU (so the retire path's
+    np.asarray/device_get forces are safe under BNG_SANITIZE=1), while
+    explicit forces stay legal everywhere."""
+
+    @pytest.mark.skipif(jax.default_backend() != "cpu",
+                        reason="pins the CPU-backend caveat")
+    def test_d2h_forces_pass_on_cpu(self, add_one):
+        x = add_one(jnp.zeros(4, jnp.float32))
+        with sanitize.sanitized():
+            assert np.asarray(x).shape == (4,)      # explicit (device_get)
+            assert jax.device_get(x).shape == (4,)
+            assert float(x.sum()) == 4.0            # inert on CPU
+
+    def test_enabled_flag_parsing(self, monkeypatch):
+        for val, want in (("1", True), ("true", True), ("strict", True),
+                          ("0", False), ("", False)):
+            monkeypatch.setenv(sanitize.SANITIZE_ENV, val)
+            assert sanitize.enabled() is want
+        monkeypatch.setenv(sanitize.SANITIZE_ENV, "strict")
+        assert sanitize.strict()
+        monkeypatch.setenv(sanitize.SANITIZE_ENV, "1")
+        assert not sanitize.strict()
+
+
+class TestFixtureWiring:
+    """Prove the conftest autouse fixture actually arms around
+    hotpath-marked tests when BNG_SANITIZE=1 (debug_nans is the
+    observable: jax.config.jax_debug_nans flips inside the test)."""
+
+    @pytest.mark.hotpath
+    def test_hotpath_marked_test_is_armed_when_enabled(self):
+        if sanitize.enabled():
+            assert jax.config.jax_debug_nans is True
+        else:
+            assert jax.config.jax_debug_nans is False
+
+    def test_unmarked_test_is_never_armed(self):
+        assert jax.config.jax_debug_nans is False

@@ -191,6 +191,7 @@ class TestDeadlineClose:
         assert replies, "slow path should have produced OFFERs"
 
 
+@pytest.mark.hotpath
 class TestExpressNeverBehindBulk:
     def test_express_completes_while_bulk_in_flight(self):
         engine, _, clock = build_stack(batch_size=8)
@@ -258,6 +259,7 @@ class TestExpressNeverBehindBulk:
         assert lanes_in_order.index(LANE_EXPRESS) < lanes_in_order.index(LANE_BULK)
 
 
+@pytest.mark.hotpath
 class TestPipelineDepth:
     def test_no_more_than_depth_in_flight(self):
         engine, _, clock = build_stack(batch_size=8)
@@ -285,6 +287,7 @@ class TestPipelineDepth:
         assert retired == 40
 
 
+@pytest.mark.hotpath
 class TestUpdateDrainCadence:
     def test_bulk_drains_every_n_dispatches(self):
         engine, _, clock = build_stack(batch_size=8)
@@ -349,6 +352,7 @@ class TestUpdateDrainCadence:
         assert len(out["tx"]) == 1  # on-device OFFER: the update landed
 
 
+@pytest.mark.hotpath
 class TestSchedulerDHCPCorrectness:
     def test_dora_then_fastpath_hit(self):
         engine, server, clock = build_stack()
