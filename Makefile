@@ -10,12 +10,20 @@
 #                       processes, a few thousand exchanges, CPU-only,
 #                       < 60 s — fleet regressions fail fast outside the
 #                       slow tier.
-#   make verify-chaos — fast seeded chaos sweep (< 60 s): the chaos-
-#                       marked tests (kill-at-every-fault-point, auditor
-#                       self-tests, scenario suite) plus a double run of
+#   make verify-chaos — seeded chaos sweep: the chaos-marked tests
+#                       (kill-at-every-fault-point, auditor self-tests,
+#                       scenario suite) plus a double run of
 #                       `bng chaos run --seed 7` compared byte-for-byte
-#                       (the bit-determinism acceptance gate). The long
+#                       (the bit-determinism acceptance gate, now
+#                       covering the three zero-downtime transition
+#                       scenarios — the engine-swap scenario compiles
+#                       the fused pipeline, ~30 s/run on CPU). The long
 #                       soak lives under @pytest.mark.slow.
+#   make verify-ops   — zero-downtime transition tests (< 60 s): live
+#                       fleet resize / rolling restart / blue-green
+#                       engine swap + rollback, the checkpoint N->M
+#                       worker matrix, the `bng ctl` wire and the
+#                       autoscaler (tests/test_ops.py, `ops` marker).
 #   make verify-telemetry — telemetry tests with tracing ARMED via
 #                       BNG_TELEMETRY=1 (< 30 s): disarmed-overhead
 #                       bound, histogram merge laws, flight-recorder
@@ -44,7 +52,7 @@ PYTEST_FLAGS = -q --continue-on-collection-errors -p no:cacheprovider \
                -p no:xdist -p no:randomly
 
 .PHONY: verify verify-slow verify-all verify-load verify-chaos \
-        verify-telemetry verify-static verify-sanitize
+        verify-telemetry verify-static verify-sanitize verify-ops
 
 verify: verify-static
 	set -o pipefail; rm -f /tmp/_t1.log; \
@@ -61,15 +69,22 @@ verify-chaos:
 	timeout -k 10 60 env JAX_PLATFORMS=cpu \
 	$(PY) -m pytest tests/test_chaos.py $(PYTEST_FLAGS) -m 'chaos and not slow'
 	set -o pipefail; \
-	timeout -k 10 30 env JAX_PLATFORMS=cpu \
+	timeout -k 10 150 env JAX_PLATFORMS=cpu \
 	$(PY) -m bng_tpu.cli chaos run --seed 7 > /tmp/_chaos_a.json \
-	&& timeout -k 10 30 env JAX_PLATFORMS=cpu \
+	&& timeout -k 10 150 env JAX_PLATFORMS=cpu \
 	$(PY) -m bng_tpu.cli chaos run --seed 7 > /tmp/_chaos_b.json \
 	&& test -s /tmp/_chaos_a.json \
 	&& cmp /tmp/_chaos_a.json /tmp/_chaos_b.json \
-	&& echo "verify-chaos OK: report bit-deterministic" \
+	&& echo "verify-chaos OK: report bit-deterministic (incl. the 3 \
+	transition scenarios)" \
 	|| { echo "verify-chaos FAILED: scenario failure or same-seed \
 	reports differ"; exit 1; }
+
+verify-ops:
+	set -o pipefail; \
+	timeout -k 10 90 env JAX_PLATFORMS=cpu \
+	$(PY) -m pytest tests/test_ops.py $(PYTEST_FLAGS) -m 'ops and not slow' \
+	&& echo "verify-ops OK"
 
 verify-telemetry:
 	set -o pipefail; \

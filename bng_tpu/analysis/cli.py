@@ -1,8 +1,10 @@
 """`bng check` / `python -m bng_tpu.analysis` — the analyzer driver.
 
 Exit codes:
-    0  clean (every finding baselined, or none)
-    1  at least one non-baselined finding
+    0  clean (every finding baselined WITH a justification, or none)
+    1  at least one non-baselined finding, or a baseline entry still
+       tagged "TODO: justify" (the justification is the review
+       artifact — an unjustified acceptance is not an acceptance)
     2  analyzer-internal error (unreadable baseline, bad arguments)
 
 Importing this module never imports jax — the analyzer is pure stdlib
@@ -89,15 +91,28 @@ def run_check(args: argparse.Namespace) -> int:
             return 2
     new, accepted, stale = baseline_mod.split(report.findings, bl)
     report.findings, report.baselined = new, accepted
+    # A selective run (--select, or explicit paths narrowing the scan)
+    # can only vouch for the codes its passes emit against the files it
+    # scanned — both the TODO rejection and the baseline rewrite below
+    # must stay inside that scope.
+    emittable = {c for p in passes for c in p.codes} | {CODE_CONFIG}
+    scanned = {f.path for f in project.files} | {"<analyzer>"}
+    # baseline.py's contract: entries stamped "TODO: justify" by
+    # --update-baseline are review debt, and CI must reject them — an
+    # entry nobody wrote a reason for is a silenced finding, not an
+    # accepted one. (--update-baseline itself is exempt below: it is the
+    # verb that CREATES the tag for the reviewer to replace.) Scoped:
+    # an out-of-scope TODO entry is one this invocation can neither
+    # re-verify nor re-stamp, so failing on it would leave a narrow
+    # `--select`/path run permanently red.
+    todo = sorted(k for k, just in bl.items()
+                  if just.strip() == baseline_mod.TODO_TAG
+                  and k[0] in emittable and k[1] in scanned)
 
     if args.update_baseline:
-        # A selective run (--select, or explicit paths narrowing the
-        # scan) can only vouch for the codes its passes emit against the
-        # files it scanned — baseline entries outside that scope must
-        # survive the rewrite, or `--select hotpath --update-baseline`
-        # silently wipes every other pass's justified entries.
-        emittable = {c for p in passes for c in p.codes} | {CODE_CONFIG}
-        scanned = {f.path for f in project.files} | {"<analyzer>"}
+        # Baseline entries outside the run's scope must survive the
+        # rewrite, or `--select hotpath --update-baseline` silently
+        # wipes every other pass's justified entries.
         keep = {k: v for k, v in bl.items()
                 if k[0] not in emittable or k[1] not in scanned}
         stale = [k for k in stale if k not in keep]
@@ -112,22 +127,28 @@ def run_check(args: argparse.Namespace) -> int:
     if args.as_json:
         doc = report.to_dict()
         doc["stale_baseline_entries"] = [list(k) for k in stale]
+        doc["todo_baseline_entries"] = [list(k) for k in todo]
         print(json.dumps(doc, indent=2))
     else:
         for f in new:
             print(f"{f.location()}: {f.code} [{f.scope or '<module>'}] "
                   f"{f.message}")
+        for k in todo:
+            print(f"{k[1]}: {k[0]} [{k[2] or '<module>'}] baseline entry "
+                  f"still tagged {baseline_mod.TODO_TAG!r} — write the "
+                  f"justification in {bl_path}")
         if stale:
             print(f"bng check: {len(stale)} stale baseline entr"
                   f"{'y' if len(stale) == 1 else 'ies'} (code no longer "
                   f"produces them) — run --update-baseline",
                   file=sys.stderr)
         print(f"bng check: {len(new)} finding(s), {len(accepted)} "
-              f"baselined, {report.files_scanned} files, "
+              f"baselined ({len(todo)} unjustified), "
+              f"{report.files_scanned} files, "
               f"{report.elapsed_s:.2f}s "
               f"[{', '.join(report.passes_run)}]",
               file=sys.stderr)
-    return 1 if new else 0
+    return 1 if new or todo else 0
 
 
 def main(argv: list[str] | None = None) -> int:

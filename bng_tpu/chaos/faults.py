@@ -36,6 +36,21 @@ Instrumented points and the kinds each site honors:
     nat.expire        skew              (NATManager.expire_sessions now)
     dhcp.expire       skew              (DHCPServer.cleanup_expired now)
     pool.allocate     exhaust           (control/pool.py Pool.allocate)
+    fleet.resize      kill | fail       (SlowPathFleet.resize transfer
+                                        loop: kill a worker mid-resize,
+                                        or abort the transition before
+                                        any state has moved)
+    fleet.restart     kill | fail       (SlowPathFleet.rolling_restart:
+                                        kill the shard being replaced,
+                                        or abort the remaining rotation)
+    ops.swap          fail              (blue/green engine swap, fired
+                                        at the flip barrier — standby
+                                        discarded, active keeps serving;
+                                        runtime/ops.py)
+    ops.snapshot      io_error          (in-memory checkpoint encode the
+                                        swap hydrates from;
+                                        runtime/checkpoint.py
+                                        roundtrip_checkpoint)
 
 Chaos events log through the existing rate-limited structlog path
 (utils.structlog.RateLimiter) — a fault storm must be visible without
@@ -79,6 +94,10 @@ POINT_KINDS: dict[str, tuple[str, ...]] = {
     "nat.expire": (SKEW,),
     "dhcp.expire": (SKEW,),
     "pool.allocate": (EXHAUST,),
+    "fleet.resize": (KILL, FAIL),
+    "fleet.restart": (KILL, FAIL),
+    "ops.swap": (FAIL,),
+    "ops.snapshot": (IO_ERROR,),
 }
 
 

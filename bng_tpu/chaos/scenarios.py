@@ -28,6 +28,18 @@ Scenario list:
                               must survive both directions
     ha_delta_drop_reconnect   replication stream dies mid-delta + peer
                               timeout on reconnect; replay_since heals
+    fleet_resize_under_kill   LIVE resize (shrink + grow) with a worker
+                              killed at every transfer hit; in-flight
+                              DORAs (un-ACKed OFFERs) must complete on
+                              the new owners, zero drops
+    rolling_restart_under_kill rolling worker replacement with a kill at
+                              every rotation hit; books+offers+slices
+                              move verbatim, the dead shard heals
+    engine_swap_crash_rollback blue/green engine swap: clean flip serves
+                              renewals on-device from the hydrated
+                              standby; crash-mid-swap and snapshot
+                              io_error roll back with the active
+                              untouched
 """
 
 from __future__ import annotations
@@ -518,10 +530,242 @@ def ha_delta_drop_reconnect(seed: int) -> dict:
     return out
 
 
+# ---------------------------------------------------------------------------
+# 6. LIVE fleet resize under kill — the zero-downtime elasticity proof
+# ---------------------------------------------------------------------------
+
+def _start_inflight(fleet, clock, macs) -> dict:
+    """Open an in-flight DORA per MAC (DISCOVER only) -> {mac: offered
+    ip}. These are the exchanges a transition must NOT drop."""
+    out = fleet.handle_batch(
+        [(i, _discover(m, 0x5000 + i)) for i, m in enumerate(macs)],
+        now=clock())
+    offers = {}
+    for (_lane, rep), m in zip(out, macs):
+        if rep is not None and _reply(rep).msg_type == dhcp_codec.OFFER:
+            offers[m] = _reply(rep).yiaddr
+    return offers
+
+
+def _complete_inflight(fleet, clock, offers) -> int:
+    """REQUEST each outstanding OFFER; count ACKs of the OFFERED ip."""
+    macs = sorted(offers)
+    out = fleet.handle_batch(
+        [(i, _request(m, offers[m], 0x6000 + i))
+         for i, m in enumerate(macs)], now=clock())
+    done = 0
+    for (_lane, rep), m in zip(out, macs):
+        if rep is not None and _reply(rep).msg_type == dhcp_codec.ACK \
+                and _reply(rep).yiaddr == offers[m]:
+            done += 1
+    return done
+
+
+def _renew_all(fleet, clock, leased) -> int:
+    macs = sorted(leased)
+    out = fleet.handle_batch(
+        [(i, _renew(m, leased[m], 0x7000 + i))
+         for i, m in enumerate(macs)], now=clock.advance(30.0))
+    return sum(1 for (_l, rep), m in zip(out, macs)
+               if rep is not None and _reply(rep).msg_type == dhcp_codec.ACK
+               and _reply(rep).yiaddr == leased[m])
+
+
+def fleet_resize_under_kill(seed: int) -> dict:
+    """Sweep the kill fault across fleet.resize transfer hits 0 (control)
+    through 4 on a 4->2 shrink, then grow 2->5 clean. The acceptance
+    bar: ZERO dropped in-flight DORAs (every un-ACKed OFFER completes on
+    its new owner), every lease renews its original address, and every
+    audit is clean — kill included, because an inline worker's book
+    survives its death and the transfer HEALS the shard."""
+    n_macs, workers = 16, 4
+    sweeps = []
+    for hit in range(0, 5):
+        clock = SimClock()
+        fleet, pools, fastpath = build_fleet(workers, clock)
+        macs = [_mac((seed % 79) * 100 + i) for i in range(n_macs)]
+        leased = dora_with_retries(fleet, macs, clock)
+        inflight = [_mac((seed % 79) * 100 + 500 + i) for i in range(4)]
+        offers = _start_inflight(fleet, clock, inflight)
+        specs = ([] if hit == 0
+                 else [FaultSpec("fleet.resize", KILL, at_hit=hit)])
+        with armed(FaultPlan(seed=seed, specs=specs), log=False) as inj:
+            rep = fleet.resize(2)
+        sweep = {
+            "kill_at_hit": hit,
+            "resize_outcome": rep["outcome"],
+            "leases_moved": rep.get("leases_moved", 0),
+            "offers_moved": rep.get("offers_moved", 0),
+            "faults": len(inj.injected),
+            "inflight_completed": _complete_inflight(fleet, clock, offers),
+            "renewed": _renew_all(fleet, clock, leased),
+        }
+        # grow back past the original count — elasticity both ways
+        rep2 = fleet.resize(5)
+        sweep["grow_outcome"] = rep2["outcome"]
+        sweep["renewed_after_grow"] = _renew_all(fleet, clock, leased)
+        audit = audit_invariants(pools=pools, fleet=fleet,
+                                 fastpath=fastpath)
+        sweep["audit_ok"] = audit.ok
+        sweep["violations"] = audit.violations_by_kind()
+        sweeps.append(sweep)
+    ok = (all(s["audit_ok"] for s in sweeps)
+          and all(s["resize_outcome"] == "ok"
+                  and s["grow_outcome"] == "ok" for s in sweeps)
+          and all(s["renewed"] == n_macs for s in sweeps)
+          and all(s["renewed_after_grow"] == n_macs for s in sweeps)
+          and all(s["inflight_completed"] == 4 for s in sweeps)
+          and all(s["offers_moved"] == 4 for s in sweeps)
+          and any(s["faults"] for s in sweeps[1:]))
+    return {"name": "fleet_resize_under_kill", "seed": seed, "ok": ok,
+            "sweeps": sweeps}
+
+
+# ---------------------------------------------------------------------------
+# 7. rolling worker restart under kill
+# ---------------------------------------------------------------------------
+
+def rolling_restart_under_kill(seed: int) -> dict:
+    """Replace every worker one shard at a time with a kill injected at
+    each rotation hit in turn. Books, un-ACKed OFFERs and granted slices
+    move verbatim into the replacement (no re-shard: same slot, same
+    MAC owner), so renewals and in-flight DORAs survive every sweep —
+    and a killed shard comes back HEALED (its book was still knowable
+    inline), which the report pins via the `healed` list."""
+    n_macs, workers = 18, 3
+    sweeps = []
+    for hit in range(0, 4):
+        clock = SimClock()
+        fleet, pools, fastpath = build_fleet(workers, clock)
+        macs = [_mac((seed % 71) * 100 + i) for i in range(n_macs)]
+        leased = dora_with_retries(fleet, macs, clock)
+        inflight = [_mac((seed % 71) * 100 + 600 + i) for i in range(3)]
+        offers = _start_inflight(fleet, clock, inflight)
+        specs = ([] if hit == 0
+                 else [FaultSpec("fleet.restart", KILL, at_hit=hit)])
+        with armed(FaultPlan(seed=seed, specs=specs), log=False) as inj:
+            rep = fleet.rolling_restart()
+        audit = audit_invariants(pools=pools, fleet=fleet,
+                                 fastpath=fastpath)
+        sweeps.append({
+            "kill_at_hit": hit,
+            "outcome": rep["outcome"],
+            "replaced": len(rep.get("replaced", ())),
+            "healed": len(rep.get("healed", ())),
+            "lost": len(rep.get("lost", ())),
+            "faults": len(inj.injected),
+            "inflight_completed": _complete_inflight(fleet, clock, offers),
+            "renewed": _renew_all(fleet, clock, leased),
+            "audit_ok": audit.ok,
+            "violations": audit.violations_by_kind(),
+        })
+    ok = (all(s["audit_ok"] for s in sweeps)
+          and all(s["outcome"] == "ok" for s in sweeps)
+          and all(s["renewed"] == n_macs for s in sweeps)
+          and all(s["inflight_completed"] == 3 for s in sweeps)
+          and all(s["lost"] == 0 for s in sweeps)
+          and all(s["healed"] == 1 for s in sweeps[1:])
+          and any(s["faults"] for s in sweeps[1:]))
+    return {"name": "rolling_restart_under_kill", "seed": seed, "ok": ok,
+            "sweeps": sweeps}
+
+
+# ---------------------------------------------------------------------------
+# 8. blue/green engine swap: clean flip + crash rollback + snapshot fault
+# ---------------------------------------------------------------------------
+
+def engine_swap_crash_rollback(seed: int) -> dict:
+    """Three swaps on one live engine stack: (a) clean — the standby
+    hydrates from the in-memory snapshot, audits clean, flips, and
+    serves renewals ON DEVICE from the hydrated chain; (b) crash at the
+    flip barrier (ops.swap fail) — rolled back, active untouched; (c)
+    snapshot encode io_error — failed before a standby ever existed.
+    After every failure the ACTIVE engine must still serve and audit
+    clean (the rollback re-sync heals any consumed delta)."""
+    from bng_tpu.runtime.engine import Engine
+    from bng_tpu.runtime.ops import blue_green_swap
+
+    clock = SimClock()
+    server, pools, fastpath, nat = _build_server_stack(clock)
+    eng = Engine(fastpath, nat, batch_size=32,
+                 slow_path=server.handle_frame, clock=clock)
+    macs = [_mac((seed % 61) * 100 + i) for i in range(6)]
+    leased = {}
+    for i, m in enumerate(macs):
+        out = eng.process([_discover(m, 0x800 + i)])
+        off = (out["slow"] or out["tx"])[0][1]
+        ip = _reply(off).yiaddr
+        out = eng.process([_request(m, ip, 0x900 + i)])
+        leased[m] = ip
+    components = {"engine": eng, "pools": pools, "dhcp": server}
+    out_rep: dict = {"name": "engine_swap_crash_rollback", "seed": seed,
+                     "leased": len(leased)}
+
+    def _renew_one(i: int) -> tuple[bool, str]:
+        m = macs[i % len(macs)]
+        res = components["engine"].process(
+            [_renew(m, leased[m], 0xA00 + i)],
+            now=clock.advance(30.0))
+        path = "tx" if res["tx"] else "slow"
+        rep = (res["tx"] or res["slow"])[0][1]
+        ok = (rep is not None
+              and _reply(rep).msg_type == dhcp_codec.ACK
+              and _reply(rep).yiaddr == leased[m])
+        return ok, path
+
+    # (a) clean swap
+    rep = blue_green_swap(components)
+    out_rep["swap_outcome"] = rep["outcome"]
+    out_rep["swap_audit_ok"] = rep.get("audit_ok", False)
+    out_rep["swapped_engine"] = components["engine"] is not eng
+    ok_renew, path = _renew_one(0)
+    out_rep["renew_after_swap"] = ok_renew
+    # the standby's device chain came from the snapshot: a renewal must
+    # hit the device fast path, proving the hydration actually carried
+    # the subscriber rows (a slow-path ACK would mask an empty chain)
+    out_rep["renew_path_after_swap"] = path
+
+    # (b) crash mid-swap -> rollback
+    active = components["engine"]
+    plan = FaultPlan(seed, [FaultSpec("ops.swap", FAIL, at_hit=1)])
+    with armed(plan, log=False):
+        rep_b = blue_green_swap(components)
+    out_rep["crash_outcome"] = rep_b["outcome"]
+    out_rep["crash_kept_active"] = components["engine"] is active
+    out_rep["renew_after_crash"] = _renew_one(1)[0]
+
+    # (c) io_error on the in-memory snapshot encode
+    plan = FaultPlan(seed, [FaultSpec("ops.snapshot", IO_ERROR, at_hit=1)])
+    with armed(plan, log=False):
+        rep_c = blue_green_swap(components)
+    out_rep["snapshot_fault_outcome"] = rep_c["outcome"]
+    out_rep["renew_after_snapshot_fault"] = _renew_one(2)[0]
+
+    audit = audit_invariants(engine=components["engine"], pools=pools,
+                             dhcp=server, nat=nat)
+    out_rep["audit_ok"] = audit.ok
+    out_rep["violations"] = audit.violations_by_kind()
+    out_rep["ok"] = (out_rep["swap_outcome"] == "ok"
+                     and out_rep["swap_audit_ok"]
+                     and out_rep["swapped_engine"]
+                     and out_rep["renew_after_swap"]
+                     and out_rep["renew_path_after_swap"] == "tx"
+                     and out_rep["crash_outcome"] == "rolled_back"
+                     and out_rep["crash_kept_active"]
+                     and out_rep["renew_after_crash"]
+                     and out_rep["snapshot_fault_outcome"] == "failed"
+                     and out_rep["renew_after_snapshot_fault"]
+                     and out_rep["audit_ok"])
+    return out_rep
+
+
 SCENARIOS = {
     "dora_worker_crash": dora_worker_crash,
     "corrupt_restore_cold_start": corrupt_restore_cold_start,
     "fleet_reshard_under_kill": fleet_reshard_under_kill,
     "nat_expiry_under_skew": nat_expiry_under_skew,
     "ha_delta_drop_reconnect": ha_delta_drop_reconnect,
+    "fleet_resize_under_kill": fleet_resize_under_kill,
+    "rolling_restart_under_kill": rolling_restart_under_kill,
+    "engine_swap_crash_rollback": engine_swap_crash_rollback,
 }

@@ -54,6 +54,18 @@ class BNGConfig:
     slowpath_inbox: int = 512  # per-worker admission inbox bound
     slowpath_deadline_ms: float = 50.0  # stale-DISCOVER shed deadline
     slowpath_slice: int = 1024  # per-worker lease-slice target size
+    # watermark-driven live fleet elasticity (control/opsctl.py
+    # FleetAutoscaler -> SlowPathFleet.resize at the tick boundary)
+    slowpath_autoscale: bool = False
+    slowpath_min_workers: int = 1
+    slowpath_max_workers: int = 8
+    # runtime ops control listener (`bng ctl` wire, control/opsctl.py):
+    # fleet resize / rolling restart / engine swap on the LIVE process.
+    # OPT-IN ("" = disabled, the default): the endpoint is unauthenticated
+    # and mutates subscriber-serving state, so even loopback exposure —
+    # any local process could resize/swap a production dataplane — is a
+    # deployment decision. Enable with --ctl-listen 127.0.0.1:9092.
+    ctl_listen: str = ""
     # pools (single primary pool via flags; more via YAML `pools:`)
     pool_cidr: str = "10.0.0.0/16"
     pool_gateway: str = ""
@@ -850,12 +862,19 @@ class BNGApp:
         # auth, HA replication, Nexus allocation, CoA lease lookups)
         # are not yet fleet-aware: with any of them configured the
         # fleet is skipped so no integration silently degrades.
+        self.fleet_blockers: list[str] = []
         if cfg.slowpath_workers > 1:
             blockers = [name for flag, name in (
                 (cfg.radius_server, "radius"), (cfg.nexus_url, "nexus"),
                 (cfg.ha_role, "ha"), (cfg.pppoe_enabled, "pppoe"),
                 (cfg.peer_pool_cidr, "peer-pool")) if flag]
             if blockers:
+                # more than a log line: the degradation is exported as
+                # bng_slowpath_fleet_blocked (step 13), surfaced in the
+                # `bng run` startup status and stats() — a capacity
+                # config that silently collapsed to 1 worker is how
+                # overload pages happen (blockers documented in README)
+                self.fleet_blockers = blockers
                 self.log.warning(
                     "slowpath fleet disabled: per-lease integrations "
                     "not yet fleet-aware", blockers=blockers,
@@ -968,7 +987,7 @@ class BNGApp:
                 if "slowpath" in c:
                     # PADT/LCP teardown frames ride the demux pending
                     # queue; drive_once injects them on the TX ring
-                    c["slowpath"]._pending.extend(frames)
+                    c["slowpath"].requeue(frames)
                 return True
 
             class _CoASession:  # adapt (kind, obj) to processor's .ip read
@@ -1220,12 +1239,17 @@ class BNGApp:
         if cfg.metrics_enabled:
             metrics = c["metrics"] = BNGMetrics()
             collector = c["collector"] = MetricsCollector(metrics)
-            engine = c["engine"]
-            collector.add_source(lambda: metrics.collect_engine(engine.stats))
+            # engine sources read c["engine"] at scrape time, never a
+            # captured reference: a blue/green swap replaces the engine
+            # object mid-run and the dashboard must follow the flip
+            collector.add_source(
+                lambda: metrics.collect_engine(c["engine"].stats))
             collector.add_source(lambda: metrics.collect_dhcp_server(dhcp.stats))
+            if self.fleet_blockers:
+                metrics.record_fleet_blocked(self.fleet_blockers)
             if cfg.walled_garden_enabled:
                 collector.add_source(
-                    lambda: metrics.collect_garden(engine.stats))
+                    lambda: metrics.collect_garden(c["engine"].stats))
             if "scheduler" in c:
                 sched = c["scheduler"]
                 # histograms are fed live at dispatch/retire; the gauges
@@ -1287,11 +1311,14 @@ class BNGApp:
                     if "metrics" in c:
                         c["metrics"].record_restore({}, outcome="rejected")
 
-            def _snapshot(seq, now, _eng=engine, _dhcp=dhcp, _ha=ha_sync):
+            def _snapshot(seq, now, _dhcp=dhcp, _ha=ha_sync):
+                # c["engine"] read at snapshot time: after a blue/green
+                # swap the checkpoint must fold device words from the
+                # SERVING engine's chain, not the retired one's
                 return ckpt_mod.build_checkpoint(
-                    seq, now, engine=_eng, scheduler=c.get("scheduler"),
-                    dhcp=_dhcp, ha=_ha, fleet=c.get("fleet"),
-                    node_id=cfg.node_id)
+                    seq, now, engine=c["engine"],
+                    scheduler=c.get("scheduler"), dhcp=_dhcp, ha=_ha,
+                    fleet=c.get("fleet"), node_id=cfg.node_id)
 
             ckptr = c["checkpointer"] = PeriodicCheckpointer(
                 store, _snapshot, interval_s=cfg.checkpoint_interval_s,
@@ -1300,6 +1327,109 @@ class BNGApp:
             if "collector" in c:
                 c["collector"].add_source(
                     lambda: c["metrics"].collect_checkpoint(ckptr))
+
+        # 15. zero-downtime ops (control/opsctl.py): the transition
+        # queue the run loop drains at batch boundaries (`bng ctl`
+        # submits into it over the --ctl-listen wire, started by the
+        # serve loop like the metrics endpoint) and, when asked, the
+        # watermark autoscaler driving live fleet elasticity from tick.
+        from bng_tpu.control.opsctl import (AutoscaleConfig, FleetAutoscaler,
+                                            OpsController)
+
+        c["ops"] = OpsController(self)
+        if cfg.slowpath_autoscale and "fleet" in c:
+            c["autoscaler"] = FleetAutoscaler(
+                c["fleet"],
+                AutoscaleConfig(min_workers=max(1, cfg.slowpath_min_workers),
+                                max_workers=max(1, cfg.slowpath_max_workers)),
+                clock=self.clock)
+            self.log.info("fleet autoscaler armed",
+                          min=cfg.slowpath_min_workers,
+                          max=cfg.slowpath_max_workers)
+
+    # -- zero-downtime transitions (ops verbs; serialized on _ctl) -------
+
+    def fleet_resize(self, n: int) -> dict:
+        """Live fleet elasticity: grow/shrink the slow-path fleet to `n`
+        workers at a batch boundary — no restart, no dropped in-flight
+        DORAs (control/fleet.py resize)."""
+        with self._ctl:
+            return self._fleet_resize_locked(int(n))
+
+    def _fleet_resize_locked(self, n: int) -> dict:
+        fleet = self.components.get("fleet")
+        if fleet is None:
+            why = (f"blocked by {self.fleet_blockers}"
+                   if self.fleet_blockers else
+                   "not configured (--slowpath-workers <= 1)")
+            return {"op": "fleet_resize", "outcome": "rejected",
+                    "error": f"no slow-path fleet: {why}"}
+        report = fleet.resize(n)
+        if "metrics" in self.components:
+            self.components["metrics"].record_transition(report)
+            self.components["metrics"].slowpath_workers.set(fleet.n)
+        self.log.info("fleet resize", **{k: report.get(k) for k in
+                                         ("from", "to", "outcome",
+                                          "leases_moved", "offers_moved")})
+        return report
+
+    def fleet_rolling_restart(self) -> dict:
+        """Replace fleet workers one shard at a time (drain-then-transfer
+        per shard; heals chaos-killed inline workers) — the live-deploy
+        verb (control/fleet.py rolling_restart)."""
+        with self._ctl:
+            fleet = self.components.get("fleet")
+            if fleet is None:
+                return {"op": "fleet_rolling_restart",
+                        "outcome": "rejected",
+                        "error": "no slow-path fleet configured"}
+            report = fleet.rolling_restart()
+            if "metrics" in self.components:
+                self.components["metrics"].record_transition(report)
+            self.log.info("fleet rolling restart",
+                          outcome=report.get("outcome"),
+                          replaced=report.get("replaced"),
+                          lost=report.get("lost"))
+            return report
+
+    def engine_swap(self) -> dict:
+        """Blue/green engine swap: hydrate a standby from an in-memory
+        snapshot, replay the delta, audit, flip atomically — rollback on
+        any failure with the active untouched (runtime/ops.py)."""
+        from bng_tpu.runtime.ops import blue_green_swap
+
+        with self._ctl:
+            report = blue_green_swap(
+                self.components, metrics=self.components.get("metrics"),
+                node_id=self.config.node_id)
+            self.log.info("engine swap", outcome=report.get("outcome"),
+                          delta_rows=report.get("delta_rows"),
+                          error=report.get("error"))
+            return report
+
+    def ops_status(self) -> dict:
+        """GET /ops/status payload: what a transition would act on.
+        Runs on the HTTP handler thread — takes _ctl so it never reads
+        fleet state mid-mutation (stats_snapshot iterates sets/lists the
+        loop thread's transitions rebind)."""
+        with self._ctl:
+            c = self.components
+            out: dict = {"node_id": self.config.node_id,
+                         "fleet_blocked": self.fleet_blockers,
+                         "ops": c["ops"].stats_snapshot()
+                         if "ops" in c else None}
+            fleet = c.get("fleet")
+            if fleet is not None:
+                fs = fleet.stats_snapshot()
+                out["fleet"] = {k: fs[k] for k in (
+                    "workers", "mode", "resizes", "rolling_restarts",
+                    "dead_workers")}
+            auto = c.get("autoscaler")
+            if auto is not None:
+                out["autoscaler"] = {"decisions": auto.decisions,
+                                     "min": auto.cfg.min_workers,
+                                     "max": auto.cfg.max_workers}
+            return out
 
     def _cluster_client_tls(self):
         """Client-side TLSConfig for https cluster peers, or None when no
@@ -1361,22 +1491,26 @@ class BNGApp:
                                  "using pipelined engine loop")
             with self._ctl:
                 moved = self.components["engine"].process_ring_pipelined(ring)
-        demux = self.components.get("slowpath")
-        if demux is not None:
-            # PPPoE negotiation extras beyond the one-inline-reply slow
-            # contract (CHAP-Success + IPCP Conf-Req in one beat). A full
-            # TX ring re-queues the frame for the next beat (the FSM
-            # retransmit would recover anyway, but without the drop).
-            # Under _ctl: a CoA disconnect may extend the queue
-            # concurrently, and drain's swap must not lose its frames.
+        # PPPoE negotiation extras beyond the one-inline-reply slow
+        # contract (CHAP-Success + IPCP Conf-Req in one beat), plus the
+        # fleet workers' pending frames relayed by the parent. A full
+        # TX ring re-queues the remainder for the next beat (the FSM
+        # retransmit would recover anyway, but without the drop).
+        # Under _ctl: a CoA disconnect may extend the queue
+        # concurrently, and drain's swap must not lose its frames.
+        for src in (self.components.get("slowpath"),
+                    self.components.get("fleet")):
+            if src is None:
+                continue
             with self._ctl:
-                pending = demux.drain_pending()
+                pending = src.drain_pending()
                 for i, frame in enumerate(pending):
                     if ring.tx_inject(frame, from_access=True):
                         moved += 1
                     else:
-                        # re-queue the WHOLE un-injected remainder
-                        demux._pending[:0] = pending[i:]
+                        # re-queue the WHOLE un-injected remainder,
+                        # order-preserving, via the public API
+                        src.requeue(pending[i:], front=True)
                         break
         if att is not None and att.xsk is not None:
             pumped += att.xsk.pump()  # verdicts -> kernel after the step
@@ -1536,6 +1670,26 @@ class BNGApp:
         if ckptr is not None:
             ckptr.tick(now)
 
+        # watermark-driven fleet elasticity: the autoscaler recommends,
+        # the SAME resize verb the operator uses executes (already under
+        # _ctl here — tick() took it)
+        auto = c.get("autoscaler")
+        if auto is not None and "fleet" in c:
+            target = auto.target(now)
+            if target is not None and target != c["fleet"].n:
+                if "metrics" in c:
+                    c["metrics"].ops_autoscaler_target.set(target)
+                try:
+                    self._fleet_resize_locked(target)
+                except Exception as e:  # noqa: BLE001
+                    # an autoscaler-triggered resize failure must not
+                    # take the dataplane loop (and the whole process)
+                    # down — that is the outage this layer exists to
+                    # prevent; cooldown paces the retry
+                    self.log.error("autoscaler resize failed",
+                                   target=target,
+                                   error=f"{type(e).__name__}: {e}")
+
         acct = c.get("accounting")
         if acct is not None:
             # bridge device-authoritative NAT octet counters into the
@@ -1592,6 +1746,10 @@ class BNGApp:
         fleet = self.components.get("fleet")
         if fleet is not None:
             out["slowpath_fleet"] = fleet.stats_snapshot()
+        if self.fleet_blockers:
+            # the configured-but-degraded state must be visible wherever
+            # an operator looks first (stats, metrics, startup banner)
+            out["slowpath_fleet_blocked"] = list(self.fleet_blockers)
         res = self.components.get("resilience")
         if res is not None:
             out["resilience"] = {"state": res.state.value,
@@ -1961,6 +2119,34 @@ def run_trace(args) -> int:
     return 0
 
 
+def run_ctl(args) -> int:
+    """`bng ctl` — runtime control of a LIVE `bng run` process over its
+    --ctl-listen wire (control/opsctl.py): `fleet resize N`,
+    `fleet rolling-restart`, `engine swap`, `status`. Prints the
+    transition report; rc=0 on ok/noop, 1 on a rejected/failed/rolled-
+    back transition, 2 when the process is unreachable."""
+    from bng_tpu.control.opsctl import ctl_request
+
+    if args.ctl_cmd == "status":
+        op, body = "status", None
+    elif args.ctl_cmd == "fleet":
+        if args.fleet_cmd == "resize":
+            op, body = "fleet/resize", {"n": args.n}
+        else:
+            op, body = "fleet/rolling-restart", {}
+    else:  # engine swap
+        op, body = "engine/swap", {}
+    try:
+        _code, doc = ctl_request(args.ctl_addr, op, body)
+    except OSError as e:  # URLError subclasses OSError
+        print(f"ctl: cannot reach {args.ctl_addr}: {e}", file=sys.stderr)
+        return 2
+    print(json.dumps(doc, indent=2, sort_keys=True))
+    if op == "status":
+        return 0
+    return 0 if doc.get("outcome") in ("ok", "noop") else 1
+
+
 def run_checkpoint(args) -> int:
     """`bng checkpoint save|restore|info` — operator verbs over the
     warm-restart store. save/restore build the full app from the same
@@ -2224,6 +2410,31 @@ def main(argv: list[str] | None = None) -> int:
                       "authorities; rc=2 on any violation")
     _add_run_flags(caud)
 
+    # runtime ops control (control/opsctl.py wire)
+    ctlp = sub.add_parser(
+        "ctl", help="zero-downtime ops on a LIVE `bng run` process "
+                    "(fleet resize / rolling restart / engine swap)")
+    ctlp.add_argument("--ctl-addr", default="127.0.0.1:9092",
+                      help="the live process's --ctl-listen address")
+    ctl_sub = ctlp.add_subparsers(dest="ctl_cmd", required=True)
+    ctl_sub.add_parser("status", help="what a transition would act on")
+    cfp = ctl_sub.add_parser("fleet", help="slow-path fleet transitions")
+    cf_sub = cfp.add_subparsers(dest="fleet_cmd", required=True)
+    rzp = cf_sub.add_parser(
+        "resize", help="grow/shrink the fleet live — re-carves lease "
+                       "slices and re-shards books without dropping "
+                       "in-flight DORAs")
+    rzp.add_argument("n", type=int, help="target worker count")
+    cf_sub.add_parser(
+        "rolling-restart", help="replace workers one shard at a time "
+                                "(drain-then-transfer per shard)")
+    cep = ctl_sub.add_parser("engine", help="engine transitions")
+    ce_sub = cep.add_subparsers(dest="engine_cmd", required=True)
+    ce_sub.add_parser(
+        "swap", help="blue/green engine swap: snapshot-hydrated standby "
+                     "+ delta replay + audited atomic flip (rollback on "
+                     "failure)")
+
     checkp = sub.add_parser(
         "check", help="bngcheck: dataplane-invariant static analyzer "
                       "(rc=1 on any non-baselined finding)")
@@ -2248,6 +2459,8 @@ def main(argv: list[str] | None = None) -> int:
         return run_checkpoint(args)
     if args.command == "chaos":
         return run_chaos(args)
+    if args.command == "ctl":
+        return run_ctl(args)
     if args.command == "trace":
         return run_trace(args)
     if args.command in ("run", "stats"):
@@ -2267,6 +2480,29 @@ def main(argv: list[str] | None = None) -> int:
             srv = app.components.get("cluster_server")
             if srv is not None:
                 print(f"cluster on {srv.url}", file=sys.stderr)
+            if app.fleet_blockers:
+                # startup status must say it, not just a log line: the
+                # configured worker count is NOT what is running
+                print(f"slowpath fleet BLOCKED (single-worker): "
+                      f"{','.join(app.fleet_blockers)} not yet "
+                      f"fleet-aware — see README 'Slow-path fleet'",
+                      file=sys.stderr)
+            ops = app.components.get("ops")
+            if ops is not None and app.config.ctl_listen:
+                from bng_tpu.control.opsctl import OpsServer
+
+                chost, _, cport = app.config.ctl_listen.rpartition(":")
+                try:
+                    osrv = app.components["ops_server"] = OpsServer(
+                        ops, chost or "127.0.0.1", int(cport or 0)).start()
+                    app._on_close(osrv.close)
+                    print(f"ctl on {osrv.addr[0]}:{osrv.addr[1]} "
+                          f"(bng ctl --ctl-addr "
+                          f"{osrv.addr[0]}:{osrv.addr[1]} ...)",
+                          file=sys.stderr)
+                except OSError as e:
+                    print(f"ctl listener unavailable ({e}); "
+                          f"runtime ops disabled", file=sys.stderr)
             # SIGTERM -> final checkpoint then clean exit. The handler
             # only sets a flag: the save runs on the loop thread below,
             # never from signal context (the drive loop may hold _ctl —
@@ -2288,6 +2524,11 @@ def main(argv: list[str] | None = None) -> int:
                         ckptr.save_now(reason="sigterm")
                     return 0
                 moved = app.drive_once()
+                if ops is not None:
+                    # operator transitions run HERE — at the batch
+                    # boundary, on the loop thread — never on the HTTP
+                    # handler thread that requested them
+                    moved += ops.run_pending()
                 now_t = time.time()
                 if now_t - last_tick >= 1.0:
                     last_tick = now_t

@@ -255,6 +255,12 @@ class AdmissionController:
         self.stats.shed[reason] = self.stats.shed.get(reason, 0) + 1
         return False, reason
 
+    def shed_total(self) -> int:
+        """Cumulative shed count across every reason — the watermark the
+        fleet autoscaler scales up on (shedding means the worker set is
+        underwater NOW; queue depth alone lags a burst)."""
+        return sum(self.stats.shed.values())
+
     def stats_snapshot(self) -> dict:
         return {
             "admitted": self.stats.admitted,

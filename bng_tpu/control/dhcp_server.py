@@ -397,6 +397,35 @@ class DHCPServer:
             } for l in self.leases.values()],
         }
 
+    def export_offers(self) -> list[dict]:
+        """The in-flight DORA state: un-ACKed OFFERs, JSON-safe. A
+        checkpoint restart deliberately drops these (export_leases — the
+        client re-DISCOVERs), but a LIVE transition (fleet resize,
+        rolling restart) transfers them so a client whose OFFER is
+        outstanding completes its DORA against the new owner."""
+        return [{"mac": f"{mk:012x}", "ip": int(ip), "pool_id": int(pid)}
+                for mk, (ip, pid) in self._offers.items()]
+
+    def restore_offers(self, entries: list[dict]) -> int:
+        """Re-arm transferred OFFERs: re-claim each offered address in
+        its pool under the client's owner tag (exactly what _discover's
+        allocate did on the old worker) and re-index _offers so the
+        client's REQUEST lands on the offered-path, not a NAK. An
+        address this server's pools cannot claim (not granted here —
+        e.g. a raced re-allocation) drops the offer: the client retries
+        its DORA, which is the checkpoint-restart behavior."""
+        restored = 0
+        for o in entries:
+            mk = int(o["mac"], 16)
+            ip, pid = int(o["ip"]), int(o["pool_id"])
+            pool = self.pools.pools.get(pid)
+            if pool is None or not pool.allocate_specific(
+                    ip, o["mac"].lower()):
+                continue
+            self._offers[mk] = (ip, pid)
+            restored += 1
+        return restored
+
     @staticmethod
     def parse_lease_state(state: dict) -> tuple[int, list["Lease"]]:
         """export_leases() output -> (session_seq, Lease list), touching

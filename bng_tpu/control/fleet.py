@@ -66,7 +66,7 @@ from bng_tpu.control.admission import (AdmissionConfig, AdmissionController,
 from bng_tpu.control.pool import PoolExhaustedError, PoolManager
 from bng_tpu.runtime.ring import classify_dhcp
 from bng_tpu.utils.net import fnv1a32, prefix_to_mask
-from bng_tpu.utils.structlog import SlowPathErrorLog
+from bng_tpu.utils.structlog import SlowPathErrorLog, get_logger
 
 
 def shard_for_mac(mac: bytes, n_workers: int) -> int:
@@ -466,23 +466,39 @@ class FleetWorker:
     def export_state(self) -> dict:
         return self.server.export_leases()
 
+    def export_transfer(self) -> dict:
+        """Live-transfer state (fleet resize / rolling restart): the
+        checkpoint lease book PLUS the in-flight DORA state (un-ACKed
+        OFFERs — a checkpoint drops them because a restart client just
+        re-DISCOVERs, but a live transition must not strand a client
+        whose OFFER is outstanding) and the granted slice map (so the
+        parent can release un-held addresses / re-grant verbatim)."""
+        st = self.server.export_leases()
+        st["offers"] = self.server.export_offers()
+        st["granted"] = {int(pid): sorted(int(i) for i in p._granted)
+                         for pid, p in self.pools.pools.items()}
+        return st
+
     def restore_state(self, state: dict) -> int:
-        """Hydrate the lease book. `revoke` lists every restored lease
-        address fleet-wide: whichever worker's INITIAL slice happened to
-        cover an address withdraws it first (ownership moves to the
-        lease's hash-owner), then the owner grants + re-claims its own
-        leases — so a fresh DORA can never double-assign a restored
-        subscriber's address."""
+        """Hydrate the lease book (and, for live transfers, the in-flight
+        OFFER state). `revoke` lists every restored address fleet-wide:
+        whichever worker's INITIAL slice happened to cover an address
+        withdraws it first (ownership moves to the lease's hash-owner),
+        then the owner grants + re-claims its own leases — so a fresh
+        DORA can never double-assign a restored subscriber's address."""
         for ip in state.get("revoke", ()):
             pool = self.pools.pool_for_ip(int(ip))
             if pool is not None:
                 pool.revoke(int(ip))
         ips = [int(d["ip"]) for d in state.get("leases", [])]
+        ips += [int(o["ip"]) for o in state.get("offers", [])]
         for ip in ips:
             pool = self.pools.pool_for_ip(ip)
             if pool is not None:
                 pool.grant([ip])
-        return self.server.restore_leases(state)
+        restored = self.server.restore_leases(state)
+        restored += self.server.restore_offers(state.get("offers", []))
+        return restored
 
 
 def _worker_main(conn, spec: FleetSpec, worker_id: int,
@@ -517,6 +533,8 @@ def _worker_main(conn, spec: FleetSpec, worker_id: int,
                 conn.send(("expired", worker.expire(msg[1])))
             elif kind == "export":
                 conn.send(("state", worker.export_state()))
+            elif kind == "export_transfer":
+                conn.send(("state", worker.export_transfer()))
             elif kind == "restore":
                 conn.send(("restored", worker.restore_state(msg[1])))
             elif kind == "stop":
@@ -582,27 +600,15 @@ class SlowPathFleet:
         self._procs: list = []
         self._conns: list = []
         self._inline: list[FleetWorker] = []
-        if mode == "inline":
-            make = worker_factory or (
-                lambda i, n: FleetWorker(spec, i, n, clock=self.clock))
-            self._inline = [make(i, n_workers) for i in range(n_workers)]
-            for w, worker in enumerate(self._inline):
-                worker.refill_now = (
-                    lambda pid, _w=w: self._refill_sync(_w, pid))
-        else:
+        self._worker_factory = worker_factory
+        self._mp_ctx = None
+        # zero-downtime transition counters (bng_ops_* families)
+        self.resizes = 0
+        self.rolling_restarts = 0
+        if mode == "process":
             import multiprocessing as mp
             import sys
 
-            # children build their own per-frame latency histograms only
-            # when the parent traces — env is the only channel that
-            # survives both spawn and fork. Set ONLY around the worker
-            # starts and restored after: a leaked BNG_TELEMETRY=1 would
-            # force-arm every later BNGApp in this process and make every
-            # later fleet's workers pay armed per-frame costs forever.
-            env_was = os.environ.get("BNG_TELEMETRY")
-            env_set = tele.enabled()
-            if env_set:
-                os.environ["BNG_TELEMETRY"] = "1"
             method = start_method or os.environ.get("BNG_FLEET_START")
             if method is None:
                 # spawn re-imports the parent's __main__ in the child;
@@ -617,29 +623,92 @@ class SlowPathFleet:
                 spawn_safe = (spec_name is not None or main_file is None
                               or os.path.exists(main_file))
                 method = "spawn" if spawn_safe else "fork"
-            ctx = mp.get_context(method)
+            self._mp_ctx = mp.get_context(method)
             self.start_method = method
-            try:
-                for i in range(n_workers):
-                    parent, child = ctx.Pipe(duplex=True)
-                    p = ctx.Process(target=_worker_main,
-                                    args=(child, spec, i, n_workers),
-                                    daemon=True,
-                                    name=f"bng-slowpath-w{i}")
-                    p.start()
-                    child.close()
-                    self._procs.append(p)
-                    self._conns.append(parent)
-            finally:
-                # every child inherited its env at start(); restore ours
-                # even when a spawn fails mid-loop (a leaked armed flag
-                # outlives this fleet, per the warning above)
-                if env_set:
-                    if env_was is None:
-                        os.environ.pop("BNG_TELEMETRY", None)
-                    else:
-                        os.environ["BNG_TELEMETRY"] = env_was
+        self._spawn_workers()
         self._initial_grant()
+
+    # -- worker lifecycle (shared by __init__, resize, rolling restart) --
+
+    def _make_inline(self, i: int) -> FleetWorker:
+        make = self._worker_factory or (
+            lambda w, n: FleetWorker(self.spec, w, n, clock=self.clock))
+        worker = make(i, self.n)
+        worker.refill_now = (lambda pid, _w=i: self._refill_sync(_w, pid))
+        return worker
+
+    def _spawn_one(self, i: int) -> tuple:
+        """(process, conn) for worker slot i — caller owns the telemetry
+        env window (see _spawn_workers)."""
+        parent, child = self._mp_ctx.Pipe(duplex=True)
+        p = self._mp_ctx.Process(target=_worker_main,
+                                 args=(child, self.spec, i, self.n),
+                                 daemon=True,
+                                 name=f"bng-slowpath-w{i}")
+        p.start()
+        child.close()
+        return p, parent
+
+    class _telemetry_env:
+        """Children build their own per-frame latency histograms only
+        when the parent traces — env is the only channel that survives
+        both spawn and fork. Set ONLY around the worker starts and
+        restored after: a leaked BNG_TELEMETRY=1 would force-arm every
+        later BNGApp in this process and make every later fleet's
+        workers pay armed per-frame costs forever."""
+
+        def __enter__(self):
+            self.was = os.environ.get("BNG_TELEMETRY")
+            self.set = tele.enabled()
+            if self.set:
+                os.environ["BNG_TELEMETRY"] = "1"
+            return self
+
+        def __exit__(self, *exc):
+            # every child inherited its env at start(); restore ours even
+            # when a spawn fails mid-loop (a leaked armed flag outlives
+            # this fleet, per the warning above)
+            if self.set:
+                if self.was is None:
+                    os.environ.pop("BNG_TELEMETRY", None)
+                else:
+                    os.environ["BNG_TELEMETRY"] = self.was
+
+    def _spawn_workers(self) -> None:
+        """Build a fresh worker set for the CURRENT self.n."""
+        if self.mode == "inline":
+            self._inline = [self._make_inline(i) for i in range(self.n)]
+            return
+        with self._telemetry_env():
+            for i in range(self.n):
+                p, conn = self._spawn_one(i)
+                self._procs.append(p)
+                self._conns.append(conn)
+
+    def _stop_worker(self, w: int) -> None:
+        """Tear down one worker slot (process mode: stop + join; inline:
+        the object is simply replaced)."""
+        if self.mode == "inline":
+            return
+        conn, p = self._conns[w], self._procs[w]
+        try:
+            conn.send(("stop",))
+        except (BrokenPipeError, OSError):
+            pass
+        p.join(timeout=5)
+        if p.is_alive():
+            p.terminate()
+        try:
+            conn.close()
+        except OSError:
+            pass
+
+    def _stop_workers(self) -> None:
+        for w in range(len(self._procs)):
+            self._stop_worker(w)
+        self._procs.clear()
+        self._conns.clear()
+        self._inline.clear()
 
     # -- lease-slice coordination (the parent pools stay the authority) --
 
@@ -669,6 +738,16 @@ class SlowPathFleet:
                 ips = self._carve(pid, per, w)
                 if ips:
                     self._grant(w, [(pid, ips)])
+
+    def _initial_grant_for(self, w: int) -> None:
+        """Fresh initial slices for ONE worker slot (rolling restart of a
+        worker whose book was lost with its process)."""
+        for pid, pool in self.pools.pools.items():
+            per = max(1, min(self.spec.slice_size,
+                             max(0, pool.size - pool.used) // self.n))
+            ips = self._carve(pid, per, w)
+            if ips:
+                self._grant(w, [(pid, ips)])
 
     def _grant(self, worker: int, grants: list) -> None:
         self.refill_ips_granted += sum(len(ips) for _, ips in grants)
@@ -894,6 +973,16 @@ class SlowPathFleet:
         out, self._pending = self._pending, []
         return out
 
+    def requeue(self, frames: list[bytes], front: bool = False) -> None:
+        """Public re-queue onto the pending queue (the drain_pending
+        counterpart): the composition root puts back frames it could not
+        TX-inject this beat (`front=True` preserves wire order) instead
+        of reaching into the private list."""
+        if front:
+            self._pending[:0] = frames
+        else:
+            self._pending.extend(frames)
+
     # -- maintenance ------------------------------------------------------
 
     def expire(self, now: int) -> int:
@@ -982,10 +1071,19 @@ class SlowPathFleet:
         count (the MAC hash decides, so a changed --slowpath-workers
         still lands every subscriber on its new owner), claim each
         lease's IP in the parent pool, and hydrate the owners."""
+        return self._hydrate_books(state["workers"])
+
+    def _hydrate_books(self, books: list[dict]) -> int:
+        """The shared re-shard + hydrate core: checkpoint restore and
+        live resize both route every lease (and, for live transfers,
+        every in-flight OFFER) to its MAC-hash owner at the CURRENT
+        worker count — bit-for-bit the ring classifier's steering hash,
+        so restore-time and resize-time ownership can never diverge."""
         per_worker: list[dict] = [
-            {"session_seq": 0, "leases": []} for _ in range(self.n)]
+            {"session_seq": 0, "leases": [], "offers": []}
+            for _ in range(self.n)]
         all_ips: list[int] = []
-        for wstate in state["workers"]:
+        for wstate in books:
             seq = int(wstate.get("session_seq", 0))
             for d in wstate.get("leases", []):
                 mac = bytes.fromhex(d["mac"])
@@ -994,15 +1092,19 @@ class SlowPathFleet:
                 per_worker[w]["session_seq"] = max(
                     per_worker[w]["session_seq"], seq)
                 all_ips.append(int(d["ip"]))
+            for o in wstate.get("offers", []):
+                w = shard_for_mac(bytes.fromhex(o["mac"]), self.n)
+                per_worker[w]["offers"].append(o)
+                all_ips.append(int(o["ip"]))
         restored = 0
         for w, wstate in enumerate(per_worker):
-            for d in wstate["leases"]:
+            for ip in ([int(d["ip"]) for d in wstate["leases"]]
+                       + [int(o["ip"]) for o in wstate["offers"]]):
                 # parent-side ownership transfer: the address may sit in
                 # ANOTHER worker's initial free slice — release that
                 # claim, then re-claim for the lease's hash-owner, so it
                 # is out of every other worker's reach before the owner
                 # re-leases it (the workers revoke their side below)
-                ip = int(d["ip"])
                 pool = self.pools.pool_for_ip(ip)
                 if pool is None:
                     continue
@@ -1028,7 +1130,220 @@ class SlowPathFleet:
                     restored += self._gather(w, "restored")
         return restored
 
+    # -- zero-downtime operations (ROADMAP [ops-refactor]) ----------------
+
+    def _export_transfer(self, w: int) -> dict | None:
+        """One worker's live-transfer state, or None when the book is
+        unknowable (dead process — its carved addresses stay allocated
+        in the parent pool, so consistency survives the loss). Inline
+        dead-marked workers keep their books in memory, so a transition
+        HEALS them: the state moves, the subscriber never notices."""
+        if self.mode == "inline":
+            return dict(self._inline[w].export_transfer(), worker_id=w)
+        if w in self._dead:
+            return None
+        try:
+            self._conns[w].send(("export_transfer",))
+            return dict(self._gather(w, "state"), worker_id=w)
+        except (OSError, EOFError, BrokenPipeError):
+            self._note_worker_failure(w)
+            return None
+
+    def resize(self, n_new: int) -> dict:
+        """Live fleet elasticity: grow/shrink to `n_new` workers at a
+        batch boundary (caller serializes against handle_batch), without
+        dropping in-flight DORAs.
+
+        Drain-then-transfer, transactional: phase 1 reads every knowable
+        worker book + offer set (abortable — a chaos `fail` here leaves
+        the old fleet serving untouched); phase 2 stops the old workers
+        and releases their un-held slice addresses back to the parent
+        pool; phase 3 builds the new worker set with fresh initial
+        slices; phase 4 re-shards every lease AND every un-ACKed OFFER
+        onto its new MAC-hash owner (the checkpoint-restore discipline),
+        transferring parent-pool ownership address by address. The
+        admission controller is parent-side state and rides through
+        unchanged, so REQUEST-after-OFFER protection holds ACROSS the
+        transition. Returns the transition report (bng_ops_* feed)."""
+        if n_new < 1:
+            raise ValueError(f"n_workers must be >= 1, got {n_new}")
+        t_all = time.perf_counter()
+        report: dict = {"op": "fleet_resize", "from": self.n, "to": n_new}
+        if n_new == self.n:
+            report.update(outcome="noop", duration_s=0.0)
+            return report
+        # phase 1 — drain-then-transfer (read-only, abortable)
+        t0 = tele.t()
+        states: list[dict] = []
+        lost: list[int] = []
+        for w in range(self.n):
+            fp = fault_point("fleet.resize")
+            if fp is not None:
+                if fp.kind == "kill":
+                    self._kill_worker(w)
+                elif fp.kind == "fail":
+                    report.update(
+                        outcome="aborted",
+                        error="chaos: injected resize failure",
+                        duration_s=time.perf_counter() - t_all)
+                    return report
+            st = self._export_transfer(w)
+            if st is None:
+                lost.append(w)
+            else:
+                states.append(st)
+        tele.lap(tele.OPS, t0)
+        # phase 2 — commit: stop the old fleet; un-held slice addresses
+        # go back to the parent pool (a lost book's grants are unknowable
+        # and stay allocated: consistency over reclamation)
+        t0 = tele.t()
+        self._stop_workers()
+        held = {int(d["ip"]) for st in states for d in st["leases"]}
+        held |= {int(o["ip"]) for st in states
+                 for o in st.get("offers", [])}
+        freed = 0
+        for st in states:
+            for pid, ips in st.get("granted", {}).items():
+                pool = self.pools.pools.get(int(pid))
+                if pool is None:
+                    continue
+                for ip in ips:
+                    if int(ip) not in held and pool.release(int(ip)):
+                        freed += 1
+        # phase 3 — the new worker set + initial slices at the new count
+        try:
+            self.n = n_new
+            self._dead.clear()
+            self._last_stats = [{} for _ in range(n_new)]
+            self._spawn_workers()
+            self._initial_grant()
+            tele.lap(tele.OPS, t0)
+            # phase 4 — re-shard + hydrate (checkpoint-restore hash)
+            t0 = tele.t()
+            restored = self._hydrate_books(states)
+            tele.lap(tele.OPS, t0)
+        except Exception as e:  # noqa: BLE001
+            # past the commit point the old fleet is GONE and `states`
+            # is the only copy of every lease and in-flight OFFER —
+            # "transactional" must not end at phase 2. Salvage: rebuild
+            # the smallest viable worker set and hydrate the exported
+            # books into it (fd/process pressure that failed an N-worker
+            # spawn usually still admits one; shard count changing again
+            # is fine — _hydrate_books re-routes by the same hash).
+            report.update(outcome="failed",
+                          error=f"{type(e).__name__}: {e}"[:300])
+            log = get_logger("fleet.resize")
+            log.error("resize failed past commit point, salvaging",
+                      to=n_new, error=report["error"],
+                      books=len(states))
+            for fallback in dict.fromkeys((n_new, 1)):
+                try:
+                    self._stop_workers()
+                    self.n = fallback
+                    self._dead.clear()
+                    self._last_stats = [{} for _ in range(fallback)]
+                    self._spawn_workers()
+                    self._initial_grant()
+                    restored = self._hydrate_books(states)
+                except Exception as e2:  # noqa: BLE001 — next size down
+                    log.error("salvage attempt failed", workers=fallback,
+                              error=f"{type(e2).__name__}: {e2}")
+                    continue
+                self.resizes += 1
+                report.update(
+                    outcome="salvaged", to=fallback, restored=restored,
+                    leases_moved=sum(len(s["leases"]) for s in states),
+                    offers_moved=sum(len(s.get("offers", ()))
+                                     for s in states),
+                    slices_freed=freed, lost_workers=sorted(lost))
+                break
+            report["duration_s"] = time.perf_counter() - t_all
+            return report
+        self.resizes += 1
+        report.update(
+            outcome="ok", restored=restored,
+            leases_moved=sum(len(s["leases"]) for s in states),
+            offers_moved=sum(len(s.get("offers", ())) for s in states),
+            slices_freed=freed, lost_workers=sorted(lost),
+            duration_s=time.perf_counter() - t_all)
+        return report
+
+    def rolling_restart(self) -> dict:
+        """Replace every worker one shard at a time under the same
+        drain-then-transfer discipline as resize — the live-deploy /
+        leak-recovery verb. Same worker count, same shard map: each
+        worker's book, offer set and granted slices move verbatim into
+        a fresh worker in the same slot (parent-pool owner tags never
+        change), so no re-shard and no cross-shard transfer happens. A
+        dead-marked process worker's book is gone — its replacement
+        starts empty on fresh slices (subscribers re-DORA; the lost
+        slices stay allocated: consistency over reclamation) — while a
+        dead-marked INLINE worker's book is still in memory, so the
+        rotation heals it with zero subscriber impact."""
+        t_all = time.perf_counter()
+        report: dict = {"op": "fleet_rolling_restart", "workers": self.n}
+        replaced: list[int] = []
+        healed: list[int] = []
+        lost: list[int] = []
+        moved = 0
+        for w in range(self.n):
+            fp = fault_point("fleet.restart")
+            if fp is not None:
+                if fp.kind == "kill":
+                    self._kill_worker(w)
+                elif fp.kind == "fail":
+                    report.update(
+                        outcome="aborted",
+                        error="chaos: injected restart failure",
+                        replaced=replaced, healed=healed, lost=lost,
+                        leases_moved=moved,
+                        duration_s=time.perf_counter() - t_all)
+                    return report
+            t0 = tele.t()
+            was_dead = w in self._dead
+            st = self._export_transfer(w)
+            self._stop_worker(w)
+            if self.mode == "inline":
+                self._inline[w] = self._make_inline(w)
+            else:
+                with self._telemetry_env():
+                    p, conn = self._spawn_one(w)
+                self._procs[w], self._conns[w] = p, conn
+            self._dead.discard(w)
+            self._last_stats[w] = {}
+            if st is None:
+                # fresh slices so the shard serves again
+                self._initial_grant_for(w)
+                lost.append(w)
+                tele.lap(tele.OPS, t0)
+                continue
+            grants = [(int(pid), [int(i) for i in ips])
+                      for pid, ips in st.pop("granted", {}).items()]
+            if grants:
+                self._grant(w, grants)
+            st["revoke"] = []
+            if self.mode == "inline":
+                moved += self._inline[w].restore_state(st)
+            else:
+                self._conns[w].send(("restore", st))
+                moved += self._gather(w, "restored")
+            (healed if was_dead else replaced).append(w)
+            tele.lap(tele.OPS, t0)
+        self.rolling_restarts += 1
+        report.update(outcome="ok", replaced=replaced, healed=healed,
+                      lost=lost, leases_moved=moved,
+                      duration_s=time.perf_counter() - t_all)
+        return report
+
     # -- observability ----------------------------------------------------
+
+    def busy_seconds_total(self) -> float:
+        """Cumulative handler-busy seconds across the worker set (from
+        the latest per-worker stats payloads) — the autoscaler's load
+        signal: sampled on a cadence, the delta over wall time is the
+        fleet's mean busy fraction."""
+        return sum(float(w.get("busy_s", 0.0))
+                   for w in self._last_stats if w)
 
     def stats_snapshot(self) -> dict:
         return {
@@ -1038,6 +1353,8 @@ class SlowPathFleet:
             "worker_failures": self.worker_failures,
             "dead_workers": sorted(self._dead),
             "batches": self.batches,
+            "resizes": self.resizes,
+            "rolling_restarts": self.rolling_restarts,
             "refills": self.refills,
             "refill_ips_granted": self.refill_ips_granted,
             "fallback_frames": self.fallback_frames,

@@ -346,6 +346,14 @@ class BNGMetrics:
         self.slowpath_fallback = r.counter(
             "bng_slowpath_fallback_frames_total",
             "Non-DHCPv4 slow frames routed to the parent demux")
+        # a configured fleet that silently degraded to one worker is an
+        # invisible capacity cliff: the gauge names WHY (per blocker), so
+        # the dashboard shows it before the first overload does
+        self.slowpath_fleet_blocked = r.gauge(
+            "bng_slowpath_fleet_blocked",
+            "1 per integration blocking the configured slow-path fleet "
+            "(process runs single-worker until these are fleet-aware)",
+            ("blocker",))
         # checkpoint/warm-restart subsystem (runtime/checkpoint.py +
         # control/statestore.py). The reference needs none of this — its
         # state survives in kernel-pinned maps; here snapshot health IS
@@ -392,6 +400,40 @@ class BNGMetrics:
         self.invariant_last_violations = r.gauge(
             "bng_invariant_last_audit_violations",
             "Violations found by the most recent audit")
+        # zero-downtime operations (control/fleet.py resize/rolling
+        # restart, runtime/ops.py blue/green swap, control/opsctl.py).
+        # The reference restarts for every capacity/config change; here
+        # each transition is code with a rollback path, and these
+        # families are how an operator proves a transition cost what the
+        # runbook promised (PERF_NOTES §9).
+        lbl_op = ("op",)
+        self.ops_transitions = r.counter(
+            "bng_ops_transitions_total",
+            "Zero-downtime transitions by op and outcome",
+            ("op", "outcome"))
+        self.ops_transition_duration = r.histogram(
+            "bng_ops_transition_duration_seconds",
+            "End-to-end duration per transition", lbl_op)
+        self.ops_quiesce_duration = r.histogram(
+            "bng_ops_quiesce_duration_seconds",
+            "Quiesce-barrier cost paid by a transition", lbl_op)
+        self.ops_frames_deferred = r.counter(
+            "bng_ops_frames_deferred_total",
+            "In-flight frames retired early by a transition's quiesce",
+            lbl_op)
+        self.ops_leases_moved = r.counter(
+            "bng_ops_leases_transferred_total",
+            "Leases transferred between workers by a transition", lbl_op)
+        self.ops_offers_moved = r.counter(
+            "bng_ops_offers_transferred_total",
+            "In-flight (un-ACKed) OFFERs carried across a transition",
+            lbl_op)
+        self.ops_delta_rows = r.counter(
+            "bng_ops_delta_rows_replayed_total",
+            "Host-mirror rows delta-replayed into the standby engine")
+        self.ops_autoscaler_target = r.gauge(
+            "bng_ops_autoscaler_target_workers",
+            "Most recent worker count the autoscaler steered to")
         # telemetry subsystem (bng_tpu/telemetry): flight-recorder and
         # tracer health. The per-stage latency distributions themselves
         # export as bng_stage_latency_us via attach_telemetry (a live
@@ -537,6 +579,35 @@ class BNGMetrics:
         self.invariant_last_violations.set(sum(by_kind.values()))
         self.invariant_last_epoch.set(
             epoch if epoch is not None else self.invariant_audits.value())
+
+    def record_transition(self, report: dict) -> None:
+        """One zero-downtime transition report (fleet resize / rolling
+        restart / engine swap) -> bng_ops_* families. Fed at transition
+        time, not by the 5s scrape — transitions are rare events whose
+        distribution a poll could miss entirely."""
+        op = str(report.get("op", "unknown"))
+        self.ops_transitions.inc(op=op,
+                                outcome=str(report.get("outcome", "unknown")))
+        if "duration_s" in report:
+            self.ops_transition_duration.observe(float(report["duration_s"]),
+                                                 op=op)
+        if "quiesce_s" in report:
+            self.ops_quiesce_duration.observe(float(report["quiesce_s"]),
+                                              op=op)
+        if report.get("frames_deferred"):
+            self.ops_frames_deferred.inc(report["frames_deferred"], op=op)
+        if report.get("leases_moved"):
+            self.ops_leases_moved.inc(report["leases_moved"], op=op)
+        if report.get("offers_moved"):
+            self.ops_offers_moved.inc(report["offers_moved"], op=op)
+        if report.get("delta_rows"):
+            self.ops_delta_rows.inc(report["delta_rows"])
+
+    def record_fleet_blocked(self, blockers: list[str]) -> None:
+        """The configured-but-degraded fleet gauge: one labeled 1 per
+        blocking integration (empty list = nothing blocked)."""
+        for b in blockers:
+            self.slowpath_fleet_blocked.set(1, blocker=str(b))
 
     def record_restore(self, rows: dict, outcome: str = "ok") -> None:
         """Startup-restore result -> bng_ckpt_restore_rows / restores."""

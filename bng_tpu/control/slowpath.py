@@ -85,6 +85,17 @@ class SlowPathDemux:
         out, self._pending = self._pending, []
         return out
 
+    def requeue(self, frames: list[bytes], front: bool = False) -> None:
+        """Public re-queue onto the pending queue (drain_pending's
+        counterpart): CoA teardown frames enter here for the next beat's
+        TX injection, and the composition root puts back the un-injected
+        remainder when the TX ring fills (`front=True` preserves wire
+        order). Callers never touch the private list."""
+        if front:
+            self._pending[:0] = frames
+        else:
+            self._pending.extend(frames)
+
     def _try_dhcpv6(self, frame: bytes) -> bytes | None:
         """Eth/IPv6/UDP:547 -> DHCPv6Server.handle_message -> framed reply."""
         if self.dhcpv6 is None or len(frame) < 14 + 40 + 8:

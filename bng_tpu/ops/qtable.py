@@ -373,6 +373,15 @@ class HostQTable:
     def dirty_count(self) -> int:
         return self.S if self._dirty_all else len(self._dirty)
 
+    def mark_dirty(self, slots) -> int:
+        """Queue way rows for the next bounded drain without touching the
+        host rows — the delta-replay primitive (see HostTable.mark_dirty).
+        Returns the number of NEWLY queued slots (already-dirty ones add
+        no drain traffic)."""
+        before = len(self._dirty)
+        self._dirty.update(int(s) for s in slots)
+        return len(self._dirty) - before
+
     def make_update(self, max_slots: int) -> QTableUpdate:
         """Drain up to max_slots dirty way rows (bounded host->HBM traffic)."""
         if self._dirty_all:

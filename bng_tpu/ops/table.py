@@ -494,6 +494,17 @@ class HostTable:
     def dirty_count(self) -> int:
         return self.S if self._dirty_all else len(self._dirty)
 
+    def mark_dirty(self, slots) -> int:
+        """Queue slots for the next bounded update drain without touching
+        their host rows — the delta-replay primitive (blue/green standby
+        hydration diffs host arrays against a snapshot and re-ships only
+        the changed slots). Returns the number of NEWLY queued slots
+        (already-dirty slots don't add drain traffic and must not inflate
+        the delta_rows report)."""
+        before = len(self._dirty)
+        self._dirty.update(int(s) for s in slots)
+        return len(self._dirty) - before
+
     def make_update(self, max_slots: int) -> TableUpdate:
         """Drain up to max_slots dirty slots into a fixed-size TableUpdate.
 

@@ -170,6 +170,24 @@ def verify_checkpoint_bytes(data: bytes) -> tuple[dict, int]:
     return header, payload_off
 
 
+def roundtrip_checkpoint(ckpt: Checkpoint) -> Checkpoint:
+    """In-memory encode -> verify -> decode: the blue/green standby
+    hydration source (runtime/ops.py). Exercises the exact rejection
+    surface the disk path has (magic/CRC/manifest/truncation) with no
+    file round-trip, so a snapshot that could never restore fails the
+    swap BEFORE a standby is built from it. The `ops.snapshot` chaos
+    point injects encode-side I/O errors (the disk-full / OOM class) —
+    surfaced as OSError, which the swap orchestrator turns into a clean
+    abort with the active engine untouched."""
+    from bng_tpu.chaos.faults import fault_point
+
+    data = encode_checkpoint(ckpt)
+    fp = fault_point("ops.snapshot")
+    if fp is not None and fp.kind == "io_error":
+        raise OSError("chaos: injected I/O error at ops.snapshot")
+    return decode_checkpoint(data)
+
+
 def decode_checkpoint(data: bytes) -> Checkpoint:
     """File bytes -> Checkpoint, rejecting truncation and corruption.
     Peak memory = the input buffer + one owned copy per array (the

@@ -307,6 +307,7 @@ class Engine:
         slow_path: Callable[[bytes], bytes | None] | None = None,
         violation_sink: Callable[[int, bytes], None] | None = None,
         clock: Callable[[], float] = time.time,
+        device_tables: "PipelineTables | None" = None,
     ):
         self.fastpath = fastpath
         self.nat = nat
@@ -349,7 +350,14 @@ class Engine:
             garden=self.garden.geom if self.garden else None,
             pppoe=self.pppoe.geom if self.pppoe else None,
         )
-        self.tables: PipelineTables = self._device_tables()
+        # `device_tables` adopts a prebuilt geometry-identical device
+        # pytree (the blue/green standby's snapshot-hydrated chain,
+        # runtime/ops.py) in place of the init upload — without it the
+        # standby would pay a full H2D upload of the live mirrors only
+        # to discard it, doubling the swap's quiesce-held hydrate cost
+        self.tables: PipelineTables = (
+            device_tables if device_tables is not None
+            else self._device_tables())
         # jit cache is keyed on geometry so Engine instances with identical
         # table shapes share one compile (tests build many engines)
         self._step = _pipeline_jit(self.geom)
@@ -1065,6 +1073,48 @@ class Engine:
         n = self.flush_pipeline()
         jax.block_until_ready(jax.tree_util.tree_leaves(self.tables))
         return n
+
+    # -- blue/green engine swap support (runtime/ops.py) ------------------
+
+    def adopt_device_tables(self, tables: PipelineTables) -> None:
+        """Standby hydration: adopt a device pytree built from a
+        checkpoint snapshot (via geometry-identical clone mirrors) in
+        place of the init-time upload. Must be shape-identical to
+        self.geom — callers hydrate through restore_checkpoint, whose
+        verify gate already enforced that. This is the ONE sanctioned
+        rebind of .tables outside the step/resync paths; the delta
+        accumulated since the snapshot is replayed afterwards through
+        the normal bounded update drain (ops.replay_delta_since)."""
+        self.tables = tables
+
+    def host_mirror_tables(self) -> dict:
+        """{name: HostTable|HostQTable} of every sparse host mirror this
+        engine drains — the delta-replay walk surface (runtime/ops.py).
+        Dense config arrays (pools/server, spoof ranges, garden allowed,
+        NAT hairpin/alg) are re-read wholesale on every drain and need
+        no diffing."""
+        out = {
+            "fastpath/sub": self.fastpath.sub,
+            "fastpath/vlan": self.fastpath.vlan,
+            "fastpath/cid": self.fastpath.cid,
+            "nat/sessions": self.nat.sessions,
+            "nat/reverse": self.nat.reverse,
+            "nat/sub_nat": self.nat.sub_nat,
+            "qos/up": self.qos.up,
+            "qos/down": self.qos.down,
+            "antispoof/bindings": self.antispoof.bindings,
+        }
+        if self.garden is not None:
+            out["garden/subscribers"] = self.garden.subscribers
+        if self.pppoe is not None:
+            out["pppoe/by_sid"] = self.pppoe.by_sid
+            out["pppoe/by_ip"] = self.pppoe.by_ip
+        return out
+
+    def pending_dirty(self) -> int:
+        """Dirty slots across every drained host mirror — 0 means the
+        device chain is current (the delta-replay completion test)."""
+        return sum(t.dirty_count() for t in self.host_mirror_tables().values())
 
     @staticmethod
     def _uploaded_mask(table, live: np.ndarray) -> np.ndarray:

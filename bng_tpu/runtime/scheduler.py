@@ -214,6 +214,19 @@ class TieredScheduler:
         jax.block_until_ready(jax.tree_util.tree_leaves(self.engine.tables))
         return retired
 
+    def adopt_engine(self, engine) -> int:
+        """Blue/green flip (runtime/ops.py): retire everything in flight
+        against the OLD engine's programs, then atomically re-point both
+        lanes at the standby. The bulk dhcp replica is invalidated — it
+        derives from the old authoritative chain — and rebuilds from the
+        new engine's leaves on the next bulk dispatch. Returns frames
+        retired by the drain (the batches-deferred cost of the flip)."""
+        retired = self.flush()
+        self.engine = engine
+        self._bulk_dhcp = None
+        self._replica_resync = -1
+        return retired
+
     # -- express lane ----------------------------------------------------
 
     def _pump_express(self, now: float) -> int:

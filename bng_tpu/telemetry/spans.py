@@ -55,12 +55,16 @@ import numpy as np
 
 from bng_tpu.telemetry.hist import LatencyHist
 
-# stage ids — array indexes; keep STAGE_NAMES in lockstep
+# stage ids — array indexes; keep STAGE_NAMES in lockstep. `ops` is the
+# zero-downtime-transition stage (fleet resize / rolling restart /
+# blue/green engine swap phases — runtime/ops.py, control/fleet.py):
+# each transition phase records one lap, so the histogram answers "how
+# long do operational state moves stall the dataplane".
 (RING, ADMIT, LANE_WAIT, DISPATCH, DEVICE, DEVICE_WAIT, FLEET, WORKER,
- SLOW, REPLY, TOTAL) = range(11)
+ SLOW, REPLY, OPS, TOTAL) = range(12)
 STAGE_NAMES = ("ring", "admit", "lane_wait", "dispatch", "device",
                "device_wait", "fleet", "worker", "slow_path", "reply",
-               "total")
+               "ops", "total")
 NSTAGES = len(STAGE_NAMES)
 
 # lane ids for batch records

@@ -730,3 +730,79 @@ def f(x):
             cwd=REPO, capture_output=True, text=True)
         assert out.returncode == 2
         assert "mutually exclusive" in out.stderr
+
+    # baseline.py's documented contract: "CI should reject a TODO tag"
+    # — enforced by the driver, not just promised by the docstring
+    _TODO_TREE = {"bng_tpu/control/foo.py": """\
+def f(x):
+    try:
+        x()
+    except Exception:
+        pass
+"""}
+
+    def _check(self, tmp_path, bl, *extra):
+        return subprocess.run(
+            [sys.executable, "-m", "bng_tpu.analysis", "--root",
+             str(tmp_path), str(tmp_path), "--baseline", str(bl),
+             "--select", "handler-audit", *extra],
+            cwd=REPO, capture_output=True, text=True)
+
+    def test_todo_tagged_baseline_fails_rc1(self, tmp_path):
+        """The --update-baseline -> review -> justify flow: a freshly
+        stamped entry fails `bng check` (rc=1, named) until a human
+        replaces the TODO tag with a reason; then it passes."""
+        write_tree(tmp_path, self._TODO_TREE)
+        bl = tmp_path / "bl.json"
+        out = self._check(tmp_path, bl, "--update-baseline")
+        assert out.returncode == 0, out.stdout + out.stderr
+        # the new entry is TODO-tagged -> the very next check fails
+        out = self._check(tmp_path, bl)
+        assert out.returncode == 1
+        assert baseline_mod.TODO_TAG in out.stdout
+        # a written justification makes the same baseline pass
+        d = json.loads(bl.read_text())
+        d["findings"][0]["justification"] = "reviewed: fixture swallow"
+        bl.write_text(json.dumps(d))
+        out = self._check(tmp_path, bl)
+        assert out.returncode == 0, out.stdout + out.stderr
+
+    def test_todo_entries_in_json_report(self, tmp_path):
+        write_tree(tmp_path, self._TODO_TREE)
+        bl = tmp_path / "bl.json"
+        assert self._check(tmp_path, bl, "--update-baseline").returncode == 0
+        out = self._check(tmp_path, bl, "--json")
+        assert out.returncode == 1
+        doc = json.loads(out.stdout)
+        assert len(doc["todo_baseline_entries"]) == 1
+        assert doc["todo_baseline_entries"][0][0] == "BNG020"
+
+    def test_todo_entry_out_of_scope_spares_selective_runs(self, tmp_path):
+        """A TODO-tagged entry only fails runs that could re-verify it:
+        a --select whose passes can't emit the entry's code, or a path
+        scope that doesn't include the entry's file, must stay green —
+        the same scope rule --update-baseline uses to preserve
+        out-of-scope entries (which a narrow run can't re-stamp either,
+        so failing on them would be permanently red)."""
+        tree = dict(self._TODO_TREE)
+        tree["bng_tpu/control/bar.py"] = "X = 1\n"
+        write_tree(tmp_path, tree)
+        bl = tmp_path / "bl.json"
+        assert self._check(tmp_path, bl, "--update-baseline").returncode == 0
+        # same pass, same paths: the debt is in scope -> red
+        assert self._check(tmp_path, bl).returncode == 1
+        # a pass set that can't emit BNG020 -> out of scope -> green
+        out = subprocess.run(
+            [sys.executable, "-m", "bng_tpu.analysis", "--root",
+             str(tmp_path), str(tmp_path), "--baseline", str(bl),
+             "--select", "hotpath"],
+            cwd=REPO, capture_output=True, text=True)
+        assert out.returncode == 0, out.stdout + out.stderr
+        # same pass, but the entry's file is outside the scanned paths
+        out = subprocess.run(
+            [sys.executable, "-m", "bng_tpu.analysis", "--root",
+             str(tmp_path),
+             str(tmp_path / "bng_tpu" / "control" / "bar.py"),
+             "--baseline", str(bl), "--select", "handler-audit"],
+            cwd=REPO, capture_output=True, text=True)
+        assert out.returncode == 0, out.stdout + out.stderr
