@@ -14,11 +14,23 @@
 #                       (kill-at-every-fault-point, auditor self-tests,
 #                       scenario suite) plus a double run of
 #                       `bng chaos run --seed 7` compared byte-for-byte
-#                       (the bit-determinism acceptance gate, now
-#                       covering the three zero-downtime transition
-#                       scenarios — the engine-swap scenario compiles
-#                       the fused pipeline, ~30 s/run on CPU). The long
+#                       (the bit-determinism acceptance gate, covering
+#                       the three zero-downtime transition scenarios AND
+#                       the five FULL-SCALE storm scenarios — flash
+#                       crowd at 100k subscribers; the engine-swap/CoA
+#                       scenarios compile the fused pipeline once,
+#                       ~30 s on CPU; ~90-120 s/run total). The long
 #                       soak lives under @pytest.mark.slow.
+#   make verify-storm — storm-suite tests (tests/test_storms.py, `storm`
+#                       marker, < 60 s): fast deterministic variants of
+#                       all five storms (same code as `bng chaos run`,
+#                       reduced --storm-scale), the generator
+#                       byte-identity proof, planted-violation tests for
+#                       the v6/NAT-accounting/QoS-mirror audits, expiry
+#                       batching + lease jitter, exhaustion hygiene.
+#                       A prerequisite of `verify` (whose tier-1 line
+#                       deselects `storm` so the suite runs once; a
+#                       bare ROADMAP tier-1 run still includes it).
 #   make verify-ops   — zero-downtime transition tests (< 60 s): live
 #                       fleet resize / rolling restart / blue-green
 #                       engine swap + rollback, the checkpoint N->M
@@ -52,12 +64,14 @@ PYTEST_FLAGS = -q --continue-on-collection-errors -p no:cacheprovider \
                -p no:xdist -p no:randomly
 
 .PHONY: verify verify-slow verify-all verify-load verify-chaos \
-        verify-telemetry verify-static verify-sanitize verify-ops
+        verify-telemetry verify-static verify-sanitize verify-ops \
+        verify-storm
 
-verify: verify-static
+verify: verify-static verify-storm
 	set -o pipefail; rm -f /tmp/_t1.log; \
 	timeout -k 10 $(TIER1_TIMEOUT) env JAX_PLATFORMS=cpu \
-	$(PY) -m pytest tests/ $(PYTEST_FLAGS) -m 'not slow' 2>&1 | tee /tmp/_t1.log
+	$(PY) -m pytest tests/ $(PYTEST_FLAGS) -m 'not slow and not storm' \
+	2>&1 | tee /tmp/_t1.log
 
 verify-slow:
 	env JAX_PLATFORMS=cpu $(PY) -m pytest tests/ $(PYTEST_FLAGS) -m slow
@@ -66,19 +80,26 @@ verify-all: verify verify-slow
 
 verify-chaos:
 	set -o pipefail; \
-	timeout -k 10 60 env JAX_PLATFORMS=cpu \
+	timeout -k 10 90 env JAX_PLATFORMS=cpu \
 	$(PY) -m pytest tests/test_chaos.py $(PYTEST_FLAGS) -m 'chaos and not slow'
 	set -o pipefail; \
-	timeout -k 10 150 env JAX_PLATFORMS=cpu \
+	timeout -k 10 300 env JAX_PLATFORMS=cpu \
 	$(PY) -m bng_tpu.cli chaos run --seed 7 > /tmp/_chaos_a.json \
-	&& timeout -k 10 150 env JAX_PLATFORMS=cpu \
+	&& timeout -k 10 300 env JAX_PLATFORMS=cpu \
 	$(PY) -m bng_tpu.cli chaos run --seed 7 > /tmp/_chaos_b.json \
 	&& test -s /tmp/_chaos_a.json \
 	&& cmp /tmp/_chaos_a.json /tmp/_chaos_b.json \
 	&& echo "verify-chaos OK: report bit-deterministic (incl. the 3 \
-	transition scenarios)" \
+	transition scenarios + 5 full-scale storms)" \
 	|| { echo "verify-chaos FAILED: scenario failure or same-seed \
 	reports differ"; exit 1; }
+
+verify-storm:
+	set -o pipefail; \
+	timeout -k 10 90 env JAX_PLATFORMS=cpu \
+	$(PY) -m pytest tests/test_storms.py $(PYTEST_FLAGS) \
+	  -m 'storm and not slow' \
+	&& echo "verify-storm OK"
 
 verify-ops:
 	set -o pipefail; \

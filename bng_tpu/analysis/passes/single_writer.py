@@ -55,6 +55,10 @@ ALLOWED_WRITERS = {
     "bng_tpu/parallel/sharded.py": "sharded engine owns its shard tables",
     "bng_tpu/cli.py": "composition root provisioning",
     "bng_tpu/chaos/scenarios.py": "scenario fixtures build table state",
+    "bng_tpu/chaos/storms.py": "storm fixtures build table state (same "
+                               "role as scenarios.py; the CoA qos_hook "
+                               "IS the cli composition-root hook, built "
+                               "standalone)",
     "bng_tpu/chaos/invariants.py": "auditor drains pending deltas",
     "bng_tpu/loadtest/harness.py": "loadtest provisioning",
     "bench.py": "bench provisioning",

@@ -313,22 +313,26 @@ def _table_mirror_findings(report: AuditReport, host, dev_state,
 def _audit_device_mirror(report: AuditReport, engine,
                          max_drain_steps: int = 64) -> None:
     """Authority 5 vs 4: after draining every pending delta, the HBM
-    DHCP tables must equal the host mirrors bit-exact. Only the DHCP
-    fast-path tables are compared — NAT session values and QoS token
-    words are device-WRITTEN (fold_device_authoritative owns those)."""
+    DHCP tables must equal the host mirrors bit-exact, and the QoS way
+    rows must match on every host-authoritative word. NAT session
+    values and the QoS token/last-us words are device-WRITTEN
+    (fold_device_authoritative owns those), so they are masked out."""
     if engine is None:
         return
     fastpath = engine.fastpath
     steps = 0
-    while fastpath.dirty_count() > 0 and steps < max_drain_steps:
+    while engine.pending_dirty() > 0 and steps < max_drain_steps:
         # an empty batch still runs the bounded update drain (and a
         # bulk-build resync if one is pending) — the cheapest way to
-        # ship the remaining deltas without inventing a second drain path
+        # ship the remaining deltas without inventing a second drain
+        # path. pending_dirty covers EVERY drained mirror (dhcp, nat,
+        # qos, antispoof, ...), not just the fastpath tables: the QoS
+        # mirror check below needs its deltas shipped too.
         engine.process([])
         steps += 1
-    if fastpath.dirty_count() > 0:
+    if engine.pending_dirty() > 0:
         report.add("mirror-undrained", "fastpath",
-                   f"{fastpath.dirty_count()} dirty slots after "
+                   f"{engine.pending_dirty()} dirty slots after "
                    f"{steps} drain steps")
         return
     engine.quiesce()
@@ -345,6 +349,37 @@ def _audit_device_mirror(report: AuditReport, engine,
                           np.asarray(engine.tables.dhcp.server)):
         report.add("mirror-mismatch", "fastpath.server",
                    "device server config differs from host")
+    _audit_qos_mirror(report, engine)
+
+
+def _audit_qos_mirror(report: AuditReport, engine) -> None:
+    """QoS host way rows vs device rows, masking the device-written
+    token-bucket words (tokens + last_us) — a CoA policy flap rewrites
+    key/flags/rate/burst/priority through the bounded drain, and after
+    the drain the config words must agree bit-exact on every slot.
+    Caller has drained (pending_dirty()==0) and quiesced."""
+    from bng_tpu.ops.qtable import QW_LAST_US, QW_TOKENS
+
+    for label, host, dev_rows in (
+            ("qos.up", engine.qos.up, engine.tables.qos_up.rows),
+            ("qos.down", engine.qos.down, engine.tables.qos_down.rows)):
+        got = np.asarray(dev_rows)
+        report.checks[f"mirror_slots.{label}"] = host.S
+        if host.rows.shape != got.shape:
+            report.add("qos-mirror-mismatch", label,
+                       f"device rows shape {got.shape} != host "
+                       f"{host.rows.shape}")
+            continue
+        mask = np.ones(host.rows.shape[1], dtype=bool)
+        mask[[QW_TOKENS, QW_LAST_US]] = False
+        bad = np.nonzero(
+            (host.rows[:, mask] != got[:, mask]).any(axis=1))[0]
+        for s in bad[:4]:
+            report.add("qos-mirror-mismatch", f"{label}/slot{int(s)}",
+                       "device config words differ from host way row")
+        if len(bad) > 4:
+            report.add("qos-mirror-mismatch", label,
+                       f"{len(bad)} slots diverge in total")
 
 
 # ---------------------------------------------------------------------------
@@ -418,6 +453,27 @@ def _audit_nat(report: AuditReport, nat) -> None:
                            f"carve cursor {cursor} — a future carve would "
                            f"re-issue it")
 
+    # block-exhaustion accounting: every block the cursor has ever
+    # carved is either allocated to a subscriber or on the free list —
+    # carved != allocated + free means blocks leaked (exhaustion that
+    # never heals) or double-booked. Checked per public IP so an
+    # exhausted IP proves it is exhausted for a REASON.
+    for pub_ip in nat.public_ips:
+        cursor = nat._next_block.get(pub_ip, nat.port_range[0])
+        carved = (cursor - nat.port_range[0]) // span
+        n_alloc = len(by_pub.get(pub_ip, ()))
+        n_free = len(nat._free_blocks.get(pub_ip, ()))
+        if carved != n_alloc + n_free:
+            report.add("nat-block-accounting", _ip(pub_ip),
+                       f"{carved} blocks carved but {n_alloc} allocated "
+                       f"+ {n_free} free — blocks leaked or double-booked")
+        if cursor > nat.port_range[1] + 1:
+            report.add("nat-block-accounting", _ip(pub_ip),
+                       f"carve cursor {cursor} ran past the port range "
+                       f"end {nat.port_range[1]}")
+    report.checks["nat_exhausted_block"] = int(nat.exhausted["block"])
+    report.checks["nat_exhausted_port"] = int(nat.exhausted["port"])
+
     # EIM <-> _ext_ports bijection, mappings inside the owner's block
     report.checks["nat_eim"] = len(nat.eim)
     for key, m in nat.eim.items():
@@ -487,6 +543,101 @@ def _audit_nat(report: AuditReport, nat) -> None:
 
 
 # ---------------------------------------------------------------------------
+# DHCPv6 / PPPoE: lease books vs their pools
+# ---------------------------------------------------------------------------
+
+def _audit_dhcpv6(report: AuditReport, dhcpv6) -> None:
+    """v6 lease book vs pool bitmaps, both directions: every IA_NA/IA_PD
+    binding must be allocated in its pool (a binding outside the bitmap
+    can be re-granted -> v6 double-lease), and every allocated address
+    must have a binding (an orphan allocation is an address leak the
+    pool can never hand out again). Advertise-only allocations release
+    before the server returns, so the book and the bitmaps agree exactly
+    at every quiesce point."""
+    if dhcpv6 is None:
+        return
+    leased_na: dict[bytes, list] = {}
+    leased_pd: dict[bytes, list] = {}
+    for (duid, iaid, is_pd), lease in dhcpv6.leases.items():
+        (leased_pd if is_pd else leased_na).setdefault(
+            lease.address, []).append((duid.hex(), iaid))
+    report.checks["v6_leases_na"] = len(leased_na)
+    report.checks["v6_leases_pd"] = len(leased_pd)
+    for addr, owners in leased_na.items():
+        if len(owners) > 1:
+            report.add("v6-double-lease", _ip6(addr),
+                       f"IA_NA address bound to {len(owners)} clients")
+    for addr, owners in leased_pd.items():
+        if len(owners) > 1:
+            report.add("v6-double-lease", _ip6(addr),
+                       f"IA_PD prefix delegated to {len(owners)} clients")
+    for pool, book, kind in ((dhcpv6.addr_pool, leased_na, "IA_NA"),
+                             (dhcpv6.prefix_pool, leased_pd, "IA_PD")):
+        if pool is None:
+            continue
+        allocated = set(pool._allocated)
+        for addr in book:
+            if addr not in allocated:
+                report.add("v6-lease-not-allocated", _ip6(addr),
+                           f"{kind} binding not marked allocated in its "
+                           f"pool — re-grantable while bound")
+        for addr in allocated - set(book):
+            report.add("v6-alloc-orphan", _ip6(addr),
+                       f"{kind} pool allocation with no binding — the "
+                       f"address leaked out of circulation")
+        # free-list hygiene: a free offset that is also allocated would
+        # double-grant on the next allocate()
+        alloc_offs = set(pool._allocated.values())
+        for off in pool._free:
+            if off in alloc_offs:
+                report.add("v6-free-allocated-overlap", f"{kind}+{off}",
+                           "pool offset is both free and allocated")
+
+
+def _audit_pppoe(report: AuditReport, pppoe, pools) -> None:
+    """PPPoE session store vs the v4 pools: every established session's
+    assigned IP must be allocated in a configured pool, and no address
+    may back two live sessions (the IPCP grant and the pool bitmap are
+    separate writers — exactly the two-authority shape this auditor
+    exists for)."""
+    if pppoe is None:
+        return
+    by_ip: dict[int, list[int]] = {}
+    n = 0
+    for sess in pppoe.sessions.all():
+        if not sess.assigned_ip:
+            continue
+        n += 1
+        by_ip.setdefault(sess.assigned_ip, []).append(sess.session_id)
+        if pools is not None:
+            pool = pools.pool_for_ip(sess.assigned_ip)
+            if pool is None:
+                report.add("pppoe-lease-outside-pools",
+                           _ip(sess.assigned_ip),
+                           f"session {sess.session_id} assigned an IP "
+                           f"outside every configured pool")
+            elif sess.assigned_ip not in pool._allocated:
+                report.add("pppoe-lease-not-allocated",
+                           _ip(sess.assigned_ip),
+                           f"session {sess.session_id} IP not marked "
+                           f"allocated in pool {pool.pool_id}")
+    for ip, sids in by_ip.items():
+        if len(sids) > 1:
+            report.add("pppoe-double-lease", _ip(ip),
+                       f"IP assigned to sessions {sorted(sids)}")
+    report.checks["pppoe_sessions"] = n
+
+
+def _ip6(addr: bytes) -> str:
+    import ipaddress
+
+    try:
+        return str(ipaddress.IPv6Address(int.from_bytes(addr, "big")))
+    except Exception:  # noqa: BLE001 — a bad value is still a subject
+        return addr.hex()
+
+
+# ---------------------------------------------------------------------------
 # checkpoint round trip
 # ---------------------------------------------------------------------------
 
@@ -547,6 +698,7 @@ def _ip(ip: int) -> str:
 
 def audit_invariants(*, engine=None, scheduler=None, fastpath=None,
                      pools=None, dhcp=None, fleet=None, nat=None,
+                     dhcpv6=None, pppoe=None,
                      ha_pair=None, quiesce=True, check_roundtrip=True,
                      metrics=None, epoch=None) -> AuditReport:
     """Run every applicable invariant over the components given.
@@ -577,6 +729,8 @@ def audit_invariants(*, engine=None, scheduler=None, fastpath=None,
     _audit_fastpath_rows(report, fastpath, dhcp, fleet, books)
     _audit_device_mirror(report, engine)
     _audit_nat(report, nat)
+    _audit_dhcpv6(report, dhcpv6)
+    _audit_pppoe(report, pppoe, pools)
     if check_roundtrip:
         active = None
         if ha_pair is not None:
@@ -631,5 +785,6 @@ def audit_app(app, metrics=None, epoch=None) -> AuditReport:
         engine=c.get("engine"), scheduler=c.get("scheduler"),
         fastpath=c.get("fastpath"), pools=c.get("pools"),
         dhcp=c.get("dhcp"), fleet=c.get("fleet"), nat=c.get("nat"),
+        dhcpv6=c.get("dhcpv6"), pppoe=c.get("pppoe"),
         metrics=metrics if metrics is not None else c.get("metrics"),
         epoch=epoch)

@@ -27,8 +27,25 @@ from bng_tpu.chaos.faults import FaultPlan, SimClock, armed
 from bng_tpu.chaos.invariants import audit_invariants
 from bng_tpu.chaos.scenarios import (SCENARIOS, _mac, _release, _renew,
                                      build_fleet, dora_with_retries)
+from bng_tpu.chaos.storms import STORMS
 
 REPORT_SCHEMA = 1
+
+# the full catalog: scripted fault scenarios + the storm suite. Storm
+# callables take (seed, scale); everything else takes (seed).
+ALL_SCENARIOS = {**SCENARIOS, **STORMS}
+
+
+def scenario_catalog() -> list[tuple[str, str]]:
+    """[(name, one-line description)] — the `bng chaos run --list`
+    payload, sourced from each scenario's docstring so the catalog can
+    never drift from the code."""
+    out = []
+    for name in sorted(ALL_SCENARIOS):
+        doc = (ALL_SCENARIOS[name].__doc__ or "").strip()
+        first = " ".join(doc.split(".")[0].split()) if doc else ""
+        out.append((name, first[:120]))
+    return out
 
 # the soak generator draws faults only over points its stack actually
 # visits — scheduling a fault on a point that never fires would make
@@ -44,22 +61,31 @@ def _sub_seed(seed: int, idx: int) -> int:
 
 
 def run_scenarios(seed: int = 1, names: list[str] | None = None,
-                  metrics=None) -> dict:
-    """Run the scripted scenarios; a scenario that *raises* is reported
-    as failed (ok=False) rather than aborting the sweep — chaos tooling
-    that dies on the failure it was hunting is useless."""
-    picked = sorted(names) if names else sorted(SCENARIOS)
-    unknown = [n for n in picked if n not in SCENARIOS]
+                  metrics=None, storm_scale: float = 1.0) -> dict:
+    """Run the scripted scenarios + storm suite; a scenario that
+    *raises* is reported as failed (ok=False) rather than aborting the
+    sweep — chaos tooling that dies on the failure it was hunting is
+    useless. `storm_scale` scales the storm scenarios' subscriber
+    counts (1.0 = the published storms, flash crowd at 100k)."""
+    picked = sorted(names) if names else sorted(ALL_SCENARIOS)
+    unknown = [n for n in picked if n not in ALL_SCENARIOS]
     if unknown:
         raise ValueError(f"unknown scenario(s) {unknown}; "
-                         f"have {sorted(SCENARIOS)}")
+                         f"have {sorted(ALL_SCENARIOS)}")
     out: dict = {"schema": REPORT_SCHEMA, "seed": seed, "scenarios": {}}
-    for idx, name in enumerate(sorted(SCENARIOS)):
+    if storm_scale != 1.0 and any(n in STORMS for n in picked):
+        # the scale changes storm subscriber counts, hence the report
+        # bytes — stamp it so two reports only ever compare like-for-like
+        out["storm_scale"] = storm_scale
+    for idx, name in enumerate(sorted(ALL_SCENARIOS)):
         if name not in picked:
             continue
         sub = _sub_seed(seed, idx)
         try:
-            result = SCENARIOS[name](sub)
+            if name in STORMS:
+                result = STORMS[name](sub, scale=storm_scale)
+            else:
+                result = ALL_SCENARIOS[name](sub)
         except Exception as e:  # noqa: BLE001 — the failure IS the result
             result = {"name": name, "seed": sub, "ok": False,
                       "error": f"{type(e).__name__}: {e}"[:200]}
@@ -131,13 +157,41 @@ def soak(seed: int = 1, epochs: int = 4, n_macs: int = 24,
 
 
 def run_report(seed: int = 1, names: list[str] | None = None,
-               soak_epochs: int = 0, metrics=None) -> dict:
-    """The `bng chaos run` payload: scenarios (+ optional soak)."""
-    report = run_scenarios(seed, names=names, metrics=metrics)
+               soak_epochs: int = 0, metrics=None,
+               storm_scale: float = 1.0) -> dict:
+    """The `bng chaos run` payload: scenarios + storms (+ optional
+    soak)."""
+    report = run_scenarios(seed, names=names, metrics=metrics,
+                           storm_scale=storm_scale)
     if soak_epochs > 0:
         report["soak"] = soak(seed, epochs=soak_epochs, metrics=metrics)
         report["ok"] = report["ok"] and report["soak"]["ok"]
     return report
+
+
+def bench_lines(report: dict) -> list[dict]:
+    """One diffable bench_runs.jsonl line per scenario: the
+    scenario/shed/degraded triple the loadtest BenchmarkResult also
+    carries, so storm runs and load runs diff with the same tooling.
+    (Wallclock stamps are the appender's job — these lines stay
+    deterministic.)"""
+    lines = []
+    for name, r in sorted(report.get("scenarios", {}).items()):
+        degraded = {}
+        for key, label in (("counted_block", "nat_block"),
+                           ("counted_port", "nat_port"),
+                           ("blocks_refused", "nat_block_refused")):
+            if r.get(key):
+                degraded[label] = r[key]
+        lines.append({
+            "metric": "storm", "scenario": name,
+            "ok": bool(r.get("ok", False)),
+            "seed": r.get("seed"),
+            "shed": dict(r.get("shed", {})),
+            "degraded": degraded,
+            "violations": dict(r.get("violations", {})),
+        })
+    return lines
 
 
 def canonical_json(report: dict) -> str:

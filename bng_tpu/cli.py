@@ -72,6 +72,15 @@ class BNGConfig:
     dns_primary: str = "1.1.1.1"
     dns_secondary: str = "8.8.8.8"
     lease_time: int = 3600
+    # per-MAC deterministic lease-time spread in [lt, lt*(1+frac)] —
+    # de-synchronizes the expiry cliff a mass bring-up would otherwise
+    # schedule (storm suite: lease_expiry_avalanche; PERF_NOTES §10)
+    lease_jitter_frac: float = 0.0
+    # per-sweep lease-reap bound (DHCPServer.cleanup_expired max_reaps;
+    # per WORKER when a fleet runs): one synchronized expiry cliff costs
+    # ceil(cliff/batch) ticks instead of starving one dataplane tick.
+    # 0 = unbounded (the pre-storm-suite behavior)
+    expire_batch: int = 8192
     pools: list = dataclasses.field(default_factory=list)
     # RADIUS
     radius_server: str = ""
@@ -556,7 +565,8 @@ class BNGApp:
             pool_manager=pool_mgr, fastpath_tables=fastpath,
             allocator=nexus_alloc,
             authenticator=authenticator, qos_hook=qos_hook,
-            nat_hook=nat_hook, clock=self.clock)
+            nat_hook=nat_hook, clock=self.clock,
+            lease_jitter_frac=cfg.lease_jitter_frac)
         if resilience is not None:
             # heal-time reconciliation (manager.go:342-528): the central
             # store answers who owns each partition-allocated IP, and the
@@ -1273,6 +1283,11 @@ class BNGApp:
                     dns_srv.stats, resolver.stats()))
             collector.add_source(lambda: metrics.collect_pools(
                 {str(pid): st for pid, st in pool_mgr.stats().items()}))
+            # exhaustion counters read c[...] at scrape time (nil-safe):
+            # a fleet resize or engine swap must not strand a captured ref
+            collector.add_source(lambda: metrics.collect_exhaustion(
+                dhcpv6=c.get("dhcpv6"), nat=c.get("nat"),
+                fleet=c.get("fleet")))
             self._on_close(collector.stop)
 
         # 14. checkpoint/warm-restart (runtime/checkpoint.py +
@@ -1637,16 +1652,21 @@ class BNGApp:
                 if ring is not None:
                     ring.tx_inject(frame, from_access=True)
 
-        # slow sweeps on their own cadence
+        # slow sweeps on their own cadence; the reap bound keeps one
+        # synchronized lease cliff from starving this tick (leftovers
+        # are reaped by the next sweeps — see cleanup_expired)
         if now - self._last_expire >= self.EXPIRE_EVERY_S:
             self._last_expire = now
-            c["dhcp"].cleanup_expired(int(now))
+            budget = self.config.expire_batch or None
+            c["dhcp"].cleanup_expired(int(now), max_reaps=budget)
+            if c.get("dhcpv6") is not None:
+                c["dhcpv6"].cleanup_expired(now, max_reaps=budget)
             c["engine"].expire(int(now))
             fleet = c.get("fleet")
             if fleet is not None:
                 # fleet workers own their lease books; the sweep fans
                 # out and the release table-events replay here
-                fleet.expire(int(now))
+                fleet.expire(int(now), max_reaps=budget)
         garden = c.get("walledgarden")
         if garden is not None and now - self._last_garden >= self.GARDEN_EVERY_S:
             self._last_garden = now
@@ -1915,6 +1935,21 @@ def run_loadtest(args) -> int:
     bench = DHCPBenchmark(target, cfg, log=lambda s: print(s, file=sys.stderr))
     try:
         res = bench.run()
+        # counted degradations ride the result (storm-suite hygiene):
+        # shed-by-reason from admission, exhaustion verdicts by resource
+        if fleet is not None:
+            res.shed = dict(fleet.admission.stats.shed)
+        degraded = {}
+        if server.stats.pool_exhausted:
+            degraded["dhcp_pool"] = server.stats.pool_exhausted
+        if fleet is not None:
+            slice_exhausted = fleet.pool_exhausted_total()
+            if slice_exhausted:
+                degraded["fleet_slice"] = slice_exhausted
+        for resource, count in nat.exhausted.items():
+            if count:
+                degraded[f"nat_{resource}"] = count
+        res.degraded = degraded
     finally:
         if tracer is not None:
             from bng_tpu.telemetry import spans as tele_spans
@@ -2231,22 +2266,45 @@ def run_chaos(args) -> int:
         finally:
             app.close()
 
-    from bng_tpu.chaos.runner import canonical_json, run_report
+    from bng_tpu.chaos.runner import (bench_lines, canonical_json,
+                                      run_report, scenario_catalog)
 
+    if getattr(args, "list", False):
+        for name, desc in scenario_catalog():
+            print(f"{name:<28} {desc}")
+        return 0
     # metrics=None: the one-shot CLI run has no scrape endpoint to serve
     # the bng_chaos_* families from — the report IS the output. A live
     # `bng run` process soaking via the runner passes its own BNGMetrics.
     names = [args.scenario] if args.scenario else None
     try:
         report = run_report(args.seed, names=names,
-                            soak_epochs=args.soak_epochs)
+                            soak_epochs=args.soak_epochs,
+                            storm_scale=args.storm_scale)
     except ValueError as e:
         print(f"chaos run: {e}", file=sys.stderr)
+        print("scenario catalog:", file=sys.stderr)
+        for name, desc in scenario_catalog():
+            print(f"  {name:<28} {desc}", file=sys.stderr)
         return 2
     text = canonical_json(report)
     if args.out:
         with open(args.out, "w") as f:
             f.write(text + "\n")
+    if args.bench_log:
+        # diffable per-scenario lines next to bench.py's results; the
+        # wallclock stamp lives only here, never in the compared report
+        import time as _time
+
+        try:
+            with open(args.bench_log, "a") as f:
+                for line in bench_lines(report):
+                    f.write(json.dumps(
+                        {"ts": _time.strftime("%Y-%m-%dT%H:%M:%S"),
+                         **line}) + "\n")
+        except OSError as e:
+            print(f"chaos run: bench-log append failed: {e}",
+                  file=sys.stderr)
     print(text)
     return 0 if report["ok"] else 1
 
@@ -2405,6 +2463,17 @@ def main(argv: list[str] | None = None) -> int:
                            "(traffic + generated faults + audit/epoch)")
     crun.add_argument("--out", default="",
                       help="also write the report JSON to this file")
+    crun.add_argument("--list", action="store_true",
+                      help="print the scenario catalog (one line each) "
+                           "and exit")
+    crun.add_argument("--storm-scale", type=float, default=1.0,
+                      help="scale factor for the storm scenarios' "
+                           "subscriber counts (1.0 = the published "
+                           "storms: flash crowd at 100k)")
+    crun.add_argument("--bench-log", default="",
+                      help="append one diffable line per scenario "
+                           "(scenario/shed/degraded) to this jsonl file "
+                           "(bench_runs.jsonl convention)")
     caud = chaos_sub.add_parser(
         "audit", help="build the app from run flags and audit the state "
                       "authorities; rc=2 on any violation")

@@ -41,6 +41,7 @@ from bng_tpu.control.dhcp_codec import (
 )
 from bng_tpu.control.pool import Pool, PoolExhaustedError, PoolManager
 from bng_tpu.utils.net import mac_to_u64, u32_to_ip
+from bng_tpu.utils.structlog import ErrorLog
 
 
 @dataclass
@@ -73,6 +74,11 @@ class ServerStats:
     inform: int = 0
     auth_reject: int = 0
     expired_cleaned: int = 0
+    # allocation attempts refused because every pool (or the worker's
+    # slice) was exhausted — the DISCOVER stays unanswered per the
+    # protocol, but the degradation is COUNTED and rate-limit logged
+    # (Yuan-class hygiene), never silent
+    pool_exhausted: int = 0
 
 
 class DHCPServer:
@@ -90,6 +96,7 @@ class DHCPServer:
         allocator=None,  # distributed allocator (Nexus role); optional
         lease_time_cap: int | None = None,
         clock: Callable[[], float] = time.time,
+        lease_jitter_frac: float = 0.0,
     ):
         self.server_mac = server_mac
         self.server_ip = server_ip
@@ -102,6 +109,7 @@ class DHCPServer:
         self.accounting_hook = accounting_hook
         self.allocator = allocator
         self.lease_time_cap = lease_time_cap
+        self.lease_jitter_frac = lease_jitter_frac
         self.clock = clock
         self.leases: dict[int, Lease] = {}  # mac_u64 -> Lease
         self.leases_by_cid: dict[bytes, int] = {}  # circuit_id -> mac_u64
@@ -114,6 +122,8 @@ class DHCPServer:
         # BOOTREPLY payload preassembled, per-client words patched in at
         # render time (dhcp_codec.ReplyTemplate) — the hot encode path
         self._reply_template_cache: dict[tuple, dhcp_codec.ReplyTemplate] = {}
+        self._exhaust_log = ErrorLog(
+            "dhcp-pool", "DHCP pool exhausted — DISCOVER left unanswered")
 
     # ------------------------------------------------------------------
     def handle_frame(self, raw: bytes) -> bytes | None:
@@ -184,7 +194,11 @@ class DHCPServer:
             return None
         try:
             return pool.allocate(owner), pool.pool_id
-        except PoolExhaustedError:
+        except PoolExhaustedError as e:
+            # DISCOVER stays unanswered (server.go:529), but the
+            # degradation is counted + rate-limit logged, never silent
+            self.stats.pool_exhausted += 1
+            self._exhaust_log.report(e, mac=owner)
             return None
 
     def _discover(self, req: DHCPPacket, vlans: list[int]) -> DHCPPacket | None:
@@ -247,6 +261,7 @@ class DHCPServer:
         lease_time = profile.get("lease_time", pool.lease_time)
         if self.lease_time_cap:
             lease_time = min(lease_time, self.lease_time_cap)
+        lease_time = self._jittered_lease_time(lease_time, mk)
         cid, rid = req.option82()
         existing = self.leases.get(mk)
         is_renewal = existing is not None and existing.ip == ip
@@ -463,15 +478,53 @@ class DHCPServer:
                 pool.allocate_specific(lease.ip, lease.mac.hex())
         return len(leases)
 
-    def cleanup_expired(self, now: int | None = None) -> int:
-        """Lease expiry sweep (parity: server.go:1100-1163)."""
+    # expiry-jitter quantization: per-MAC lease times land in one of
+    # this many buckets spread over [lt, lt*(1+jitter_frac)], so a mass
+    # bring-up cannot manufacture a synchronized expiry cliff — and the
+    # reply-template cache stays bounded at BUCKETS entries per pool
+    # instead of one per subscriber
+    LEASE_JITTER_BUCKETS = 16
+
+    def _jittered_lease_time(self, lt: int, mk: int) -> int:
+        """Deterministic per-MAC lease-time spread. Only ever EXTENDS the
+        base lease time: the client renews at T1 = lt/2 of the value it
+        was told, so shortening server-side would strand renewals."""
+        frac = self.lease_jitter_frac
+        if frac <= 0.0 or lt <= 0:
+            return lt
+        step = int(lt * frac) // self.LEASE_JITTER_BUCKETS
+        if step <= 0:
+            return lt
+        # golden-ratio multiply: cheap, deterministic, uniform enough to
+        # spread consecutive MACs across all buckets
+        bucket = ((mk * 0x9E3779B97F4A7C15) >> 33) \
+            % self.LEASE_JITTER_BUCKETS
+        return lt + bucket * step
+
+    def cleanup_expired(self, now: int | None = None,
+                        max_reaps: int | None = None) -> int:
+        """Lease expiry sweep (parity: server.go:1100-1163).
+
+        `max_reaps` bounds the teardown work of ONE sweep (pool release,
+        fast-path row removal, NAT/accounting hooks are the expensive
+        part, not the scan): a synchronized lease cliff then costs
+        ceil(cliff/max_reaps) ticks instead of starving one dataplane
+        tick for the whole cliff. Leases past the bound stay expired and
+        are reaped by the next sweep; every intermediate state keeps the
+        cross-authority invariants (a not-yet-reaped lease still owns
+        its address everywhere)."""
         now = now if now is not None else self._now()
         fp = fault_point("dhcp.expire")
         if fp is not None and fp.kind == "skew":
             # chaos: skewed expiry clock — early expiry costs a re-DORA
             # (service), never a double allocation (consistency)
             now = int(now + fp.arg)
-        dead = [mk for mk, l in self.leases.items() if l.expiry < now]
+        dead = []
+        for mk, l in self.leases.items():
+            if l.expiry < now:
+                dead.append(mk)
+                if max_reaps is not None and len(dead) >= max_reaps:
+                    break
         for mk in dead:
             lease = self.leases.pop(mk)
             if lease.circuit_id:

@@ -24,6 +24,7 @@ from bng_tpu.control.dhcpv6.protocol import (
     IAPrefix,
     generate_duid_ll,
 )
+from bng_tpu.utils.structlog import ErrorLog
 
 
 class PoolExhausted6(Exception):
@@ -158,6 +159,11 @@ class DHCPv6Stats:
     no_binding: int = 0
     relay_forw: int = 0
     relay_repl: int = 0
+    # exhaustion split out of no_addrs (which also counts "no pool
+    # configured"): an EXHAUSTED pool is a capacity event worth its own
+    # counter + rate-limited log, not a config state
+    addr_exhausted: int = 0
+    pd_exhausted: int = 0
 
 
 class DHCPv6Server:
@@ -179,6 +185,9 @@ class DHCPv6Server:
         self.stats = DHCPv6Stats()
         # bindings: (duid, iaid, is_pd) -> Lease6
         self.leases: dict[tuple[bytes, int, bool], Lease6] = {}
+        self._exhaust_log = ErrorLog(
+            "dhcpv6-pool",
+            "DHCPv6 pool exhausted — NoAddrsAvail/NoPrefixAvail returned")
 
     MAX_RELAY_HOPS = 8  # RFC 8415 §7.6 HOP_COUNT_LIMIT (8; RFC 3315's 32 is obsolete)
 
@@ -288,10 +297,12 @@ class DHCPv6Server:
         if lease is None:
             try:
                 addr = pool.allocate()
-            except PoolExhausted6:
+            except PoolExhausted6 as e:
                 out = IANA(ia.iaid)
                 out.status = (p6.STATUS_NO_ADDRS_AVAIL, "pool exhausted")
                 self.stats.no_addrs += 1
+                self.stats.addr_exhausted += 1
+                self._exhaust_log.report(e, ia="na", iaid=ia.iaid)
                 return out
             lease = Lease6(duid, ia.iaid, addr, 128, now + pool.valid)
             if commit:
@@ -320,10 +331,12 @@ class DHCPv6Server:
         if lease is None:
             try:
                 prefix, plen = pool.allocate()
-            except PoolExhausted6:
+            except PoolExhausted6 as e:
                 out = IAPD(ia.iaid)
                 out.status = (p6.STATUS_NO_PREFIX_AVAIL, "pool exhausted")
                 self.stats.no_addrs += 1
+                self.stats.pd_exhausted += 1
+                self._exhaust_log.report(e, ia="pd", iaid=ia.iaid)
                 return out
             lease = Lease6(duid, ia.iaid, prefix, plen, now + pool.valid, is_pd=True)
             if commit:
@@ -512,9 +525,18 @@ class DHCPv6Server:
         if self.on_release:
             self.on_release(lease)
 
-    def cleanup_expired(self, now: float | None = None) -> int:
+    def cleanup_expired(self, now: float | None = None,
+                        max_reaps: int | None = None) -> int:
+        """Expired-binding sweep. `max_reaps` bounds one sweep's teardown
+        work (same expiry-batching contract as the v4 server): leftovers
+        stay expired and the next sweep reaps them."""
         now = now if now is not None else self.clock()
-        dead = [k for k, l in self.leases.items() if l.expiry < now]
+        dead = []
+        for k, l in self.leases.items():
+            if l.expiry < now:
+                dead.append(k)
+                if max_reaps is not None and len(dead) >= max_reaps:
+                    break
         for duid, iaid, is_pd in dead:
             self._drop_binding(duid, iaid, is_pd)
         return len(dead)

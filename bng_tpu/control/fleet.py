@@ -438,8 +438,8 @@ class FleetWorker:
             if p is not None:
                 p.grant(ips)
 
-    def expire(self, now: int) -> dict:
-        n = self.server.cleanup_expired(now)
+    def expire(self, now: int, max_reaps: int | None = None) -> dict:
+        n = self.server.cleanup_expired(now, max_reaps=max_reaps)
         return {"expired": n,
                 "events": self.tables.drain() + self._drain_events(),
                 "releases": self._drain_released(),
@@ -453,6 +453,9 @@ class FleetWorker:
             "demux": dict(self.demux.stats),
             "slice_free": {pid: p.free_count
                            for pid, p in self.pools.pools.items()},
+            # slice exhaustion (refill couldn't keep up / parent pool
+            # dry) surfaces through the server's counted degradations
+            "pool_exhausted": self.server.stats.pool_exhausted,
         }
         if self._lat_hist is not None and self._lat_hist.n:
             # ship-and-reset: the parent folds each shipped delta into
@@ -530,7 +533,8 @@ def _worker_main(conn, spec: FleetSpec, worker_id: int,
             elif kind == "grant":
                 worker.apply_grant(msg[1])
             elif kind == "expire":
-                conn.send(("expired", worker.expire(msg[1])))
+                conn.send(("expired", worker.expire(
+                    msg[1], msg[2] if len(msg) > 2 else None)))
             elif kind == "export":
                 conn.send(("state", worker.export_state()))
             elif kind == "export_transfer":
@@ -597,6 +601,12 @@ class SlowPathFleet:
         self.start_method = None  # set for process mode below
         self._pending: list[bytes] = []
         self._last_stats: list[dict] = [{} for _ in range(n_workers)]
+        # monotonic fold of dead worker sets' slice-exhaustion counts:
+        # per-worker ServerStats restart at 0 on resize/rolling restart,
+        # and a counter metric fed from live stats alone would move
+        # BACKWARD across a transition (same ship-and-reset discipline
+        # as the worker latency histograms)
+        self.pool_exhausted_folded = 0
         self._procs: list = []
         self._conns: list = []
         self._inline: list[FleetWorker] = []
@@ -985,15 +995,18 @@ class SlowPathFleet:
 
     # -- maintenance ------------------------------------------------------
 
-    def expire(self, now: int) -> int:
+    def expire(self, now: int, max_reaps: int | None = None) -> int:
         """Lease-expiry sweep across every worker (the parent tick's
-        cleanup_expired role)."""
+        cleanup_expired role). `max_reaps` is a PER-WORKER teardown
+        bound (each worker's sweep is its own serial section; bounding
+        per shard keeps the tick budget proportional to fleet width the
+        same way batch handling is)."""
         total = 0
         if self.mode == "inline":
             for w, worker in enumerate(self._inline):
                 if w in self._dead:
                     continue
-                out = worker.expire(now)
+                out = worker.expire(now, max_reaps)
                 total += self._absorb_expire(w, out)
         else:
             sent = []
@@ -1001,7 +1014,7 @@ class SlowPathFleet:
                 if w in self._dead:
                     continue
                 try:
-                    conn.send(("expire", now))
+                    conn.send(("expire", now, max_reaps))
                     sent.append(w)
                 except (OSError, ValueError):
                     self._note_worker_failure(w)
@@ -1214,6 +1227,7 @@ class SlowPathFleet:
         try:
             self.n = n_new
             self._dead.clear()
+            self._fold_exhaustion()
             self._last_stats = [{} for _ in range(n_new)]
             self._spawn_workers()
             self._initial_grant()
@@ -1241,6 +1255,7 @@ class SlowPathFleet:
                     self._stop_workers()
                     self.n = fallback
                     self._dead.clear()
+                    self._fold_exhaustion()
                     self._last_stats = [{} for _ in range(fallback)]
                     self._spawn_workers()
                     self._initial_grant()
@@ -1310,6 +1325,8 @@ class SlowPathFleet:
                     p, conn = self._spawn_one(w)
                 self._procs[w], self._conns[w] = p, conn
             self._dead.discard(w)
+            self.pool_exhausted_folded += int(
+                self._last_stats[w].get("pool_exhausted", 0) or 0)
             self._last_stats[w] = {}
             if st is None:
                 # fresh slices so the shard serves again
@@ -1337,6 +1354,22 @@ class SlowPathFleet:
 
     # -- observability ----------------------------------------------------
 
+    def _fold_exhaustion(self) -> None:
+        """Absorb the outgoing worker set's slice-exhaustion counts into
+        the monotonic fold — call exactly once per teardown, BEFORE the
+        per-worker stats reset."""
+        self.pool_exhausted_folded += sum(
+            int(w.get("pool_exhausted", 0) or 0)
+            for w in self._last_stats if w)
+
+    def pool_exhausted_total(self) -> int:
+        """Monotonic slice-exhaustion count across worker generations:
+        folded dead-set counts + the live workers' latest payloads (the
+        counter-metric read — never moves backward over a transition)."""
+        return self.pool_exhausted_folded + sum(
+            int(w.get("pool_exhausted", 0) or 0)
+            for w in self._last_stats if w)
+
     def busy_seconds_total(self) -> float:
         """Cumulative handler-busy seconds across the worker set (from
         the latest per-worker stats payloads) — the autoscaler's load
@@ -1360,6 +1393,7 @@ class SlowPathFleet:
             "fallback_frames": self.fallback_frames,
             "fallback_errors": self.fallback_errors,
             "per_worker": list(self._last_stats),
+            "pool_exhausted_total": self.pool_exhausted_total(),
             "admission": self.admission.stats_snapshot(),
         }
 

@@ -218,6 +218,15 @@ class BNGMetrics:
             "bng_pool_available_ips", "Available IPs", ("pool",))
         self.pool_allocated = r.gauge(
             "bng_pool_allocated_ips", "Allocated IPs", ("pool",))
+        # counted degradations (storm-suite hygiene): every allocator
+        # that can refuse work for capacity reasons reports here, by
+        # resource — dhcp_pool / fleet_slice (worker-side dhcp_pool) /
+        # dhcp6_addr / dhcp6_pd / nat_block / nat_port
+        self.pool_exhausted = r.counter(
+            "bng_pool_exhausted_total",
+            "Allocations refused on an exhausted resource (degraded "
+            "verdicts are counted + rate-limit logged, never silent)",
+            ("resource",))
         self.circuit_id_collisions = r.counter(
             "bng_circuit_id_hash_collisions_total", "Circuit-ID hash collisions")
         self.circuit_id_collision_rate = r.gauge(
@@ -504,6 +513,35 @@ class BNGMetrics:
             v = getattr(server_stats, msg, None)
             if v is not None:
                 self.dhcp_requests_total.set_total(v, type=msg)
+        v = getattr(server_stats, "pool_exhausted", None)
+        if v:
+            self.pool_exhausted.set_total(v, resource="dhcp_pool")
+
+    def collect_exhaustion(self, dhcpv6=None, nat=None, fleet=None) -> None:
+        """Mirror the per-subsystem exhaustion counters into
+        bng_pool_exhausted_total (the v4 server's ride along in
+        collect_dhcp_server). Nil-safe per component so one source call
+        covers whatever the composition root actually built."""
+        if dhcpv6 is not None:
+            if dhcpv6.stats.addr_exhausted:
+                self.pool_exhausted.set_total(dhcpv6.stats.addr_exhausted,
+                                              resource="dhcp6_addr")
+            if dhcpv6.stats.pd_exhausted:
+                self.pool_exhausted.set_total(dhcpv6.stats.pd_exhausted,
+                                              resource="dhcp6_pd")
+        if nat is not None:
+            if nat.exhausted["block"]:
+                self.pool_exhausted.set_total(nat.exhausted["block"],
+                                              resource="nat_block")
+            if nat.exhausted["port"]:
+                self.pool_exhausted.set_total(nat.exhausted["port"],
+                                              resource="nat_port")
+        if fleet is not None:
+            # monotonic across resize/rolling-restart (per-worker stats
+            # restart at 0; the fleet folds dead sets' counts)
+            total = fleet.pool_exhausted_total()
+            if total:
+                self.pool_exhausted.set_total(total, resource="fleet_slice")
 
     def collect_garden(self, engine_stats) -> None:
         """Device walled-garden gate counters (EngineStats.garden)."""

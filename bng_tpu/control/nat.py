@@ -59,6 +59,7 @@ from bng_tpu.ops.nat44 import (
 )
 from bng_tpu.ops.parse import PROTO_ICMP, PROTO_TCP, PROTO_UDP
 from bng_tpu.ops.table import HostTable, TableGeom, TableUpdate, apply_update
+from bng_tpu.utils.structlog import ErrorLog
 
 # timeouts in seconds (parity: bpf/nat44.c:49-53)
 UDP_TIMEOUT_S = 120
@@ -98,6 +99,11 @@ def apply_nat_updates(tables: NATTables, upd: tuple) -> NATTables:
         alg_ports=alg,
         config=config,
     )
+
+
+class NATExhaustedError(Exception):
+    """Carrier for the rate-limited exhaustion log lines (the allocator
+    itself returns None/0 — degraded service, not an exception path)."""
 
 
 class NATManager:
@@ -141,6 +147,12 @@ class NATManager:
         # per-subscriber block bookkeeping: priv_ip -> dict
         self.blocks: dict[int, dict] = {}
         self._sub_id_seq = 1
+        # degraded-verdict counters (Yuan-class hygiene): a refused
+        # block carve or port allocation drops the flow by design, but
+        # the decision is counted + rate-limit logged, never silent
+        self.exhausted = {"block": 0, "port": 0}
+        self._exhaust_log = ErrorLog(
+            "cgnat", "CGNAT allocator exhausted — flow/subscriber refused")
 
     # -- logging --
     def _log(self, event: int, sub_id: int, priv_ip: int, pub_ip: int,
@@ -187,6 +199,13 @@ class NATManager:
             self._log(LOG_PORT_BLOCK_ASSIGN, sub_id, private_ip, pub_ip,
                       0, start, 0, start + n - 1, 0, now)
             return block
+        # every public IP's port space is fully carved: the subscriber
+        # gets no NAT (degraded verdict) — counted, never silent
+        self.exhausted["block"] += 1
+        self._exhaust_log.report(
+            NATExhaustedError(f"no free port block for {private_ip:#x} "
+                              f"across {len(self.public_ips)} public IPs"),
+            resource="block")
         return None  # pool exhausted
 
     def restore_block(self, private_ip: int, public_ip: int,
@@ -496,6 +515,12 @@ class NATManager:
         if got is None:
             self._log(LOG_PORT_EXHAUSTION, block["subscriber_id"], src_ip,
                       block["public_ip"], src_port, 0, dst_ip, dst_port, proto, now)
+            self.exhausted["port"] += 1
+            self._exhaust_log.report(
+                NATExhaustedError(f"port block {block['port_start']}-"
+                                  f"{block['port_end']} full for subscriber "
+                                  f"{block['subscriber_id']}"),
+                resource="port")
             return None
         nat_ip, nat_port = got
 

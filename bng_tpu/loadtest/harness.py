@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import struct
 import time
 from typing import Callable
 
@@ -53,6 +54,11 @@ class BenchmarkConfig:
     # program and replies never traverse the TC chain). False = the fused
     # full-pipeline step.
     dhcp_only_program: bool = True
+
+    # label for the traffic shape that drove the run ("" = the default
+    # steady DORA/renewal mix); storm scenarios stamp their name here so
+    # bench_runs.jsonl lines are diffable per scenario
+    scenario: str = ""
 
 
 @dataclasses.dataclass
@@ -93,6 +99,16 @@ class BenchmarkResult:
     # which device program served the run: "dhcp_fastpath" (DHCP-only fast
     # lane) or "fused_pipeline" — numbers are not comparable across the two
     program: str = ""
+    # traffic shape that drove the run (BenchmarkConfig.scenario) — storm
+    # runs stamp their name so bench_runs.jsonl lines diff per scenario
+    scenario: str = ""
+    # admission shed counts by reason (inbox_full / deadline /
+    # request_overflow / chaos) — every shed is a COUNTED degradation
+    shed: dict = dataclasses.field(default_factory=dict)
+    # degraded-but-not-failed verdicts by resource (dhcp_pool /
+    # nat_block / nat_port ... exhaustion): the server stayed up and
+    # answered what it could; these count what it could NOT
+    degraded: dict = dataclasses.field(default_factory=dict)
 
     def meets_targets(self, cfg: BenchmarkConfig) -> list[str]:
         """Returns failed-target descriptions (empty == pass), the
@@ -135,6 +151,14 @@ class BenchmarkResult:
             f"Slow Path:         {self.slowpath_hits}",
             f"Cache Hit Rate:    {self.cache_hit_rate:.2%}",
         ]
+        if self.scenario:
+            lines.insert(1, f"Scenario:          {self.scenario}")
+        if self.shed:
+            lines.append("Shed:              " + ", ".join(
+                f"{k}={v}" for k, v in sorted(self.shed.items()) if v))
+        if self.degraded:
+            lines.append("Degraded:          " + ", ".join(
+                f"{k}={v}" for k, v in sorted(self.degraded.items()) if v))
         return "\n".join(lines)
 
 
@@ -255,7 +279,7 @@ class DHCPBenchmark:
         start_slow_errors = self.engine.stats.slow_errors
         from bng_tpu.telemetry.hist import LatencyHist
 
-        res = BenchmarkResult(program=self._program())
+        res = BenchmarkResult(program=self._program(), scenario=cfg.scenario)
         lat_us: list[float] = []  # whole-batch wall time
         fast_lat_us: list[float] = []  # per-request, pure-fastpath batches
         req_hist = LatencyHist()  # per-request (batch-amortized) latency
@@ -343,3 +367,103 @@ class DHCPBenchmark:
 
 def result_json(res: BenchmarkResult) -> str:
     return json.dumps(res.to_dict(), indent=2)
+
+
+# ---------------------------------------------------------------------------
+# storm traffic generation (the DUMB half of the Jepsen split: generators
+# know how to build traffic shapes, checkers — chaos/storms.py — carry
+# all the intelligence about what must still be true afterwards)
+# ---------------------------------------------------------------------------
+
+class StormFrameFactory:
+    """Preassembled client-frame prototypes with per-subscriber patch-in.
+
+    The flash-crowd storm builds >=100k DISCOVER frames per retry round;
+    at codec speed (~25us/frame: packet object, option encode, ljust,
+    header pack) the GENERATOR would dominate the scenario's wall time.
+    This is dhcp_codec.ReplyTemplate's idea pointed the other way: build
+    one frame per (kind, geometry) through the real codec, then patch
+    only the per-subscriber words. Patching is exact, not approximate —
+    `tests/test_storms.py` pins byte-identity against codec-built frames
+    for every kind.
+
+    Checksum safety: v4 client frames carry UDP checksum 0 (legal in
+    IPv4, and what packets.udp_packet emits), and the IPv4 header
+    checksum covers no patched field except the renew frame's source
+    address — renew() refolds the header checksum the same way
+    udp_packet does.
+    """
+
+    # untagged Eth(14) + IPv4(20) + UDP(8)
+    _BOOTP = 42
+
+    def __init__(self, server_ip: int, pad: int = 300):
+        self.server_ip = server_ip
+        self.pad = pad
+        self._proto: dict[str, bytes] = {}
+
+    # -- prototype construction (once per kind, through the real codec) --
+
+    def _build(self, kind: str) -> bytes:
+        mac0 = b"\x00" * 6
+        if kind == "discover":
+            p = dhcp_codec.build_request(mac0, dhcp_codec.DISCOVER, xid=0)
+            return packets.udp_packet(mac0, b"\xff" * 6, 0, 0xFFFFFFFF,
+                                      68, 67, p.encode().ljust(self.pad,
+                                                               b"\x00"))
+        if kind == "request":
+            p = dhcp_codec.build_request(mac0, dhcp_codec.REQUEST, xid=0,
+                                         requested_ip=1,
+                                         server_id=self.server_ip)
+            return packets.udp_packet(mac0, b"\xff" * 6, 0, 0xFFFFFFFF,
+                                      68, 67, p.encode().ljust(self.pad,
+                                                               b"\x00"))
+        if kind == "renew":
+            p = dhcp_codec.build_request(mac0, dhcp_codec.REQUEST, xid=0,
+                                         ciaddr=1)
+            return packets.udp_packet(mac0, b"\xff" * 6, 1, self.server_ip,
+                                      68, 67, p.encode().ljust(self.pad,
+                                                               b"\x00"))
+        raise ValueError(kind)
+
+    def _template(self, kind: str) -> bytearray:
+        proto = self._proto.get(kind)
+        if proto is None:
+            proto = self._proto[kind] = self._build(kind)
+        return bytearray(proto)
+
+    # -- per-subscriber renders ------------------------------------------
+
+    def discover(self, mac: bytes, xid: int) -> bytes:
+        f = self._template("discover")
+        f[6:12] = mac
+        b = self._BOOTP
+        f[b + 4: b + 8] = struct.pack("!I", xid & 0xFFFFFFFF)
+        f[b + 28: b + 34] = mac
+        return bytes(f)
+
+    def request(self, mac: bytes, ip: int, xid: int) -> bytes:
+        f = self._template("request")
+        f[6:12] = mac
+        b = self._BOOTP
+        f[b + 4: b + 8] = struct.pack("!I", xid & 0xFFFFFFFF)
+        f[b + 28: b + 34] = mac
+        # options: magic(236..240) | (53,1,t) | (50,4,ip) | (54,4,sid)
+        # — build_request's layout; the requested-ip VALUE sits at +245
+        f[b + 245: b + 249] = struct.pack("!I", ip)
+        return bytes(f)
+
+    def renew(self, mac: bytes, ip: int, xid: int) -> bytes:
+        f = self._template("renew")
+        f[6:12] = mac
+        b = self._BOOTP
+        f[b + 4: b + 8] = struct.pack("!I", xid & 0xFFFFFFFF)
+        f[b + 12: b + 16] = struct.pack("!I", ip)  # ciaddr
+        f[b + 28: b + 34] = mac
+        f[26:30] = struct.pack("!I", ip)  # IP src — checksum input
+        # refold the IPv4 header checksum from the actual header bytes
+        # (udp_packet's arithmetic fold would desync silently if its
+        # header fields ever change)
+        f[24:26] = b"\x00\x00"
+        f[24:26] = struct.pack("!H", packets.checksum16(bytes(f[14:34])))
+        return bytes(f)

@@ -404,9 +404,13 @@ class TestCLI:
     def test_chaos_run_bit_deterministic(self, capsys):
         from bng_tpu.cli import main
 
-        assert main(["chaos", "run", "--seed", "5"]) == 0
+        # --storm-scale shrinks the storm scenarios for the tier-1 gate;
+        # make verify-chaos runs the full-scale suite (flash crowd at
+        # 100k) through the same byte-compare
+        flags = ["chaos", "run", "--seed", "5", "--storm-scale", "0.02"]
+        assert main(flags) == 0
         first = capsys.readouterr().out
-        assert main(["chaos", "run", "--seed", "5"]) == 0
+        assert main(flags) == 0
         second = capsys.readouterr().out
         assert first == second
         assert json.loads(first)["ok"] is True
