@@ -47,7 +47,12 @@
 #                       `bng check` must exit 0 against the checked-in
 #                       baseline (bng_tpu/analysis/baseline.json), then
 #                       the analyzer's own planted-violation +
-#                       clean-corpus tests run. Part of `verify`: a PR
+#                       clean-corpus tests run. Includes the
+#                       concurrency-ownership pass (BNG060-BNG064):
+#                       thread-entry discovery, call-graph context
+#                       classification, lock-set propagation — warm
+#                       runs reuse the mtime-keyed extraction cache
+#                       (.bngcheck_cache.json). Part of `verify`: a PR
 #                       that violates a dataplane invariant fails here
 #                       before the test suite even starts.
 #   make verify-sanitize — hotpath-marked engine/scheduler tests under
@@ -56,6 +61,11 @@
 #                       lint. Best-effort on XLA:CPU (d2h guard inert
 #                       there — analysis/sanitize.py documents the
 #                       asymmetry); compile-bound, so not in tier-1.
+#                       Also arms the @owned_by ownership assertions
+#                       and re-runs the race-marked interleaving tests
+#                       (tests/test_concurrency.py): the PR-7 race
+#                       schedules forced with barriers, cross-context
+#                       mutations raising OwnershipViolation.
 
 SHELL := /bin/bash
 PY ?= python
@@ -80,7 +90,7 @@ verify-all: verify verify-slow
 
 verify-chaos:
 	set -o pipefail; \
-	timeout -k 10 90 env JAX_PLATFORMS=cpu \
+	timeout -k 10 180 env JAX_PLATFORMS=cpu \
 	$(PY) -m pytest tests/test_chaos.py $(PYTEST_FLAGS) -m 'chaos and not slow'
 	set -o pipefail; \
 	timeout -k 10 300 env JAX_PLATFORMS=cpu \
@@ -117,7 +127,7 @@ verify-telemetry:
 verify-static:
 	set -o pipefail; \
 	timeout -k 10 30 $(PY) -m bng_tpu.analysis \
-	&& timeout -k 10 30 env JAX_PLATFORMS=cpu \
+	&& timeout -k 10 60 env JAX_PLATFORMS=cpu \
 	$(PY) -m pytest tests/test_analysis.py $(PYTEST_FLAGS) \
 	  -m 'analysis and not slow' \
 	&& echo "verify-static OK"
@@ -126,8 +136,8 @@ verify-sanitize:
 	set -o pipefail; \
 	timeout -k 10 300 env JAX_PLATFORMS=cpu BNG_SANITIZE=1 \
 	$(PY) -m pytest tests/test_sanitize.py tests/test_scheduler.py \
-	  tests/test_dhcp_fastpath.py $(PYTEST_FLAGS) \
-	  -m 'hotpath or analysis' \
+	  tests/test_dhcp_fastpath.py tests/test_concurrency.py $(PYTEST_FLAGS) \
+	  -m 'hotpath or analysis or race' \
 	&& echo "verify-sanitize OK"
 
 verify-load:

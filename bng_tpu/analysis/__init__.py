@@ -1,7 +1,7 @@
 """bngcheck: dataplane-invariant static analysis + runtime sanitizers.
 
 Static half (stdlib `ast`, no jax import): `bng check` /
-`python -m bng_tpu.analysis` runs six passes over the scan set and
+`python -m bng_tpu.analysis` runs seven passes over the scan set and
 compares findings against the checked-in baseline —
 
     hotpath         BNG001-003  dispatch scope never forces; disarmed
@@ -15,11 +15,20 @@ compares findings against the checked-in baseline —
                                 writer modules
     fencing         BNG050      no wall-clock over async dispatch
                                 without a force
+    concurrency     BNG060-064  the `_ctl` thread-ownership discipline:
+                                cross-context mutations hold a common
+                                lock, no check-then-act / unreleased
+                                acquires / blocking under loop locks /
+                                orphan threads — contexts classified
+                                from the repo's own thread entry points
+                                via a cached call-graph fact
 
 Runtime half (`BNG_SANITIZE=1`, analysis/sanitize.py): arms
 jax.transfer_guard + debug_nans around hot-path tests so the transfer
 lint's claims are cross-checked dynamically (best-effort on XLA:CPU —
-see the module docstring for which guards fire where).
+see the module docstring for which guards fire where), plus the
+`@owned_by` ownership assertions — the dynamic cross-check of the
+concurrency pass (unlocked cross-context mutation raises).
 """
 
 from bng_tpu.analysis.core import (Finding, Project, Report,  # noqa: F401

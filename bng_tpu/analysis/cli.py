@@ -128,6 +128,13 @@ def run_check(args: argparse.Namespace) -> int:
         doc = report.to_dict()
         doc["stale_baseline_entries"] = [list(k) for k in stale]
         doc["todo_baseline_entries"] = [list(k) for k in todo]
+        if "concurrency" in report.passes_run:
+            # the per-function context classification, so reviewers can
+            # audit the call-graph facts behind BNG06x findings (the
+            # model is memoized on the Project — no second build)
+            from bng_tpu.analysis import facts
+            doc["contexts"] = facts.build_concurrency_model(
+                project).contexts_report()
         print(json.dumps(doc, indent=2))
     else:
         for f in new:

@@ -2,6 +2,7 @@
 
 from __future__ import annotations
 
+from bng_tpu.analysis.passes.concurrency import ConcurrencyPass
 from bng_tpu.analysis.passes.fencing import FencingPass
 from bng_tpu.analysis.passes.handlers import HandlerAuditPass
 from bng_tpu.analysis.passes.hotpath import HotPathPass
@@ -10,7 +11,8 @@ from bng_tpu.analysis.passes.registry import RegistryPass
 from bng_tpu.analysis.passes.single_writer import SingleWriterPass
 
 ALL_PASSES = (HotPathPass, JitDisciplinePass, HandlerAuditPass,
-              RegistryPass, SingleWriterPass, FencingPass)
+              RegistryPass, SingleWriterPass, FencingPass,
+              ConcurrencyPass)
 
 
 def all_codes() -> dict[str, str]:

@@ -29,11 +29,37 @@ sanitizer is the *cross-check* and the static lint is the gate.
 Wiring: tests/conftest.py applies `sanitized()` around every test
 marked ``hotpath`` when BNG_SANITIZE=1 (`make verify-sanitize`);
 anything may also use it directly as a context manager.
+
+**Ownership assertions (ISSUE 9)** — the dynamic cross-check of the
+static concurrency pass (BNG060-BNG062). `@owned_by("loop",
+guard="_ctl")` stamps a class whose mutable state belongs to one
+execution context. Disarmed (BNG_SANITIZE unset) the decorator returns
+the class untouched — zero overhead, zero behavior change. Armed:
+
+* threads announce their context with `ctx_enter("ctl")` /
+  `with context("scrape"):` (the run loop, OpsServer handlers, the
+  metrics collector, fleet worker mains and the HA SSE reader are
+  pre-wired, each behind the same is-armed check);
+* every attribute write on a stamped object from a *named* context
+  other than the owner raises OwnershipViolation — unless the thread
+  holds the object's guard lock (the instance's `guard` attribute is
+  transparently wrapped in a hold-tracking proxy at construction);
+* writes from unnamed threads (construction, unit tests that don't
+  set a context) stay free, so arming the sanitizer never breaks
+  single-threaded tests;
+* with `owner=None` the first named-context writer stamps the owner
+  per attribute — "records the owning context at first write".
+
+This is how the barrier-forced interleaving tests prove the PR-7 race
+fixes are real: the forced schedule that used to lose an update now
+either takes `_ctl` (passes) or raises OwnershipViolation (the
+reverted-fix run fails loudly instead of silently corrupting).
 """
 
 from __future__ import annotations
 
 import os
+import threading
 from contextlib import contextmanager
 
 SANITIZE_ENV = "BNG_SANITIZE"
@@ -75,3 +101,139 @@ def sanitized(h2d: str = "allow", d2h: str = "disallow",
     finally:
         for c in reversed(entered):
             c.__exit__(None, None, None)
+
+
+# ===========================================================================
+# ownership assertions (ISSUE 9): @owned_by + context stamps
+# ===========================================================================
+
+class OwnershipViolation(AssertionError):
+    """An unlocked cross-context mutation of owned state (the BNG060
+    bug class, caught at runtime)."""
+
+
+_TLS = threading.local()
+
+
+def current_context() -> str | None:
+    return getattr(_TLS, "ctx", None)
+
+
+def ctx_enter(name: str) -> None:
+    """Stamp the calling thread's execution context (sticky). No-op
+    when the sanitizer is disarmed — callers may invoke unconditionally
+    from thread mains; the armed check is one env-cached bool."""
+    if _ARMED:
+        _TLS.ctx = name
+
+
+@contextmanager
+def context(name: str):
+    """Scoped context stamp (tests; request-scoped handler threads)."""
+    if not _ARMED:
+        yield
+        return
+    prev = getattr(_TLS, "ctx", None)
+    _TLS.ctx = name
+    try:
+        yield
+    finally:
+        _TLS.ctx = prev
+
+
+class GuardedLock:
+    """Lock proxy that knows whether the *current thread* holds it —
+    what `@owned_by` needs to distinguish a locked cross-context write
+    (legal) from an unlocked one (violation). Wraps the instance's
+    guard attribute at construction time when armed."""
+
+    def __init__(self, inner):
+        self._inner = inner
+        self._holds: dict[int, int] = {}  # thread ident -> depth
+
+    def acquire(self, *a, **k) -> bool:
+        got = self._inner.acquire(*a, **k)
+        if got:
+            me = threading.get_ident()
+            self._holds[me] = self._holds.get(me, 0) + 1
+        return got
+
+    def release(self) -> None:
+        me = threading.get_ident()
+        depth = self._holds.get(me, 0) - 1
+        if depth <= 0:
+            self._holds.pop(me, None)
+        else:
+            self._holds[me] = depth
+        self._inner.release()
+
+    def locked(self) -> bool:
+        return self._inner.locked()
+
+    def held_by_me(self) -> bool:
+        return self._holds.get(threading.get_ident(), 0) > 0
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+
+def owned_by(owner: str | None, guard: str | None = None,
+             attrs: tuple[str, ...] | None = None):
+    """Class decorator: assert the context-ownership discipline on
+    every attribute write (armed only; disarmed returns cls as-is).
+
+    owner  — the context that may mutate freely ("loop"); None infers
+             it from the first named-context write, per attribute.
+    guard  — name of the instance's lock attribute; a thread HOLDING
+             that lock may mutate from any context (that is the whole
+             point of the `_ctl` discipline).
+    attrs  — restrict checking to these attributes (None = all).
+    """
+
+    def deco(cls):
+        if not _ARMED:
+            return cls
+
+        orig_setattr = cls.__setattr__
+        orig_init = cls.__init__
+
+        def __init__(self, *a, **k):
+            orig_init(self, *a, **k)
+            if guard is not None:
+                g = self.__dict__.get(guard)
+                if g is not None and not isinstance(g, GuardedLock):
+                    self.__dict__[guard] = GuardedLock(g)
+
+        def __setattr__(self, name, value):
+            ctx = getattr(_TLS, "ctx", None)
+            if ctx is None or (attrs is not None and name not in attrs):
+                return orig_setattr(self, name, value)
+            owners = self.__dict__.setdefault("__bng_owners__", {})
+            own = owners.setdefault(name, owner if owner is not None
+                                    else ctx)
+            if ctx != own:
+                g = self.__dict__.get(guard) if guard is not None else None
+                if not (isinstance(g, GuardedLock) and g.held_by_me()):
+                    raise OwnershipViolation(
+                        f"{type(self).__name__}.{name} is owned by "
+                        f"{own!r} but mutated from context {ctx!r} "
+                        f"without holding "
+                        f"{guard if guard else '<no guard declared>'} — "
+                        f"the BNG060 race class, live")
+            return orig_setattr(self, name, value)
+
+        cls.__init__ = __init__
+        cls.__setattr__ = __setattr__
+        cls.__bng_owned__ = (owner, guard, attrs)
+        return cls
+
+    return deco
+
+
+# computed once at import: the decorator and the ctx stamps read it on
+# hot paths (thread mains, per-request handlers) — one global load
+_ARMED = enabled()

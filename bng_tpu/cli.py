@@ -233,9 +233,19 @@ def load_config_file(path: str, cli_set: set[str],
     return base
 
 
+from bng_tpu.analysis.sanitize import ctx_enter as _sanitize_ctx_enter
+from bng_tpu.analysis.sanitize import owned_by as _owned_by
+
+
+@_owned_by("loop", guard="_ctl")
 class BNGApp:
     """Everything `bng run` constructs, with LIFO cleanup
-    (main.go:441-1380)."""
+    (main.go:441-1380).
+
+    Ownership (BNG_SANITIZE): app state belongs to the loop context;
+    any other context (ctl handler, scrape, HA sync) must hold `_ctl`
+    to mutate — the @owned_by stamp makes a dropped `with self._ctl`
+    an OwnershipViolation in sanitizer runs instead of a silent race."""
 
     def __init__(self, config: BNGConfig, clock=time.time):
         self.config = config
@@ -2587,6 +2597,7 @@ def main(argv: list[str] | None = None) -> int:
             # cluster maintenance either way
             has_ring = app.components.get("ring") is not None
             last_tick = 0.0
+            _sanitize_ctx_enter("loop")  # sanitizer ownership context
             while True:
                 if ckptr is not None and stop_flag["sigterm"]:
                     with app._ctl:

@@ -480,6 +480,9 @@ class HTTPActiveProxy:
         since = self._seen_seq
 
         def reader():
+            from bng_tpu.analysis.sanitize import ctx_enter
+
+            ctx_enter("ha-sync")
             try:
                 # since = the snapshot's high-water seq: the server replays
                 # anything newer into the stream, so the window between the

@@ -67,6 +67,7 @@ from bng_tpu.control.pool import PoolExhaustedError, PoolManager
 from bng_tpu.runtime.ring import classify_dhcp
 from bng_tpu.utils.net import fnv1a32, prefix_to_mask
 from bng_tpu.utils.structlog import SlowPathErrorLog, get_logger
+from bng_tpu.analysis.sanitize import ctx_enter, owned_by
 
 
 def shard_for_mac(mac: bytes, n_workers: int) -> int:
@@ -508,6 +509,7 @@ def _worker_main(conn, spec: FleetSpec, worker_id: int,
                  n_workers: int) -> None:
     """Child-process loop: message-driven, never dies on handler input
     (per-frame isolation lives in FleetWorker.handle_batch)."""
+    ctx_enter("worker")
     worker = FleetWorker(spec, worker_id, n_workers)
 
     def refill_now(pool_id: int) -> None:
@@ -551,8 +553,17 @@ def _worker_main(conn, spec: FleetSpec, worker_id: int,
 # the fleet (parent side)
 # ---------------------------------------------------------------------------
 
+@owned_by("loop", attrs=None)
 class SlowPathFleet:
     """N shared-nothing slow-path workers behind admission control.
+
+    Ownership (BNG_SANITIZE): every mutation belongs to the loop
+    context — transitions (resize/rolling restart) run on the loop
+    thread via the OpsController drain, reads from the ctl/scrape
+    threads go through stats_snapshot()/busy_seconds_total() under the
+    app's _ctl. The @owned_by stamp turns a reintroduced cross-context
+    reach-in (the pre-PR-7 `_pending`/`_dead` class) into a loud
+    OwnershipViolation in sanitizer runs.
 
     `handle_batch` is the engine's `slow_path_batch` hook: it fans a
     slow-lane batch out to the owning workers, fans replies back in

@@ -18,6 +18,7 @@ import enum
 from dataclasses import dataclass, field, asdict
 from typing import Callable
 
+from bng_tpu.analysis.sanitize import owned_by
 from bng_tpu.chaos.faults import fault_point
 from bng_tpu.utils.structlog import ErrorLog
 
@@ -207,17 +208,27 @@ class ActiveSyncer:
         return cancel
 
 
+@owned_by(None, guard="_lock")
 class StandbySyncer:
     """Standby side: full sync then live deltas, reconnect with backoff.
 
     Parity: standbyLoop (sync.go:495), performFullSync (:538),
     connectToStream (:596). The `transport` returns the active's
     ActiveSyncer-shaped API or raises ConnectionError.
+
+    Thread ownership (BNG060): over the HTTP transport the subscribed
+    `_on_change` runs on the SSE reader thread while `tick`
+    (reconnect/full-sync) and `checkpoint_state` run on the loop thread
+    — `_lock` serializes every touch of the store / `last_seq` /
+    `stats`, so a delta can never interleave with a full-sync store
+    rebuild or tear a checkpoint snapshot.
     """
 
     def __init__(self, store: InMemorySessionStore,
                  transport: Callable[[], ActiveSyncer],
                  backoff_initial_s: float = 1.0, backoff_max_s: float = 30.0):
+        import threading
+
         self.store = store
         self.transport = transport
         self.connected = False
@@ -227,6 +238,7 @@ class StandbySyncer:
         self._backoff_initial = backoff_initial_s
         self._backoff_max = backoff_max_s
         self._next_attempt = 0.0
+        self._lock = threading.Lock()
         self.stats = {"full_syncs": 0, "deltas": 0, "reconnects": 0,
                       "bootstraps": 0}
 
@@ -240,26 +252,32 @@ class StandbySyncer:
         full_sync() is the fallback only when the active's replay buffer
         has already wrapped past that seq."""
         seq, sessions = parse_ha_checkpoint_state(state)
-        for s in sessions:
-            self.store.put(s)
-        self.last_seq = max(self.last_seq, seq)
-        self.stats["bootstraps"] += 1
+        with self._lock:
+            for s in sessions:
+                self.store.put(s)
+            self.last_seq = max(self.last_seq, seq)
+            self.stats["bootstraps"] += 1
         return len(sessions)
 
     def checkpoint_state(self) -> dict:
         """Snapshot the standby's replicated view (its own checkpoints
         make a standby restart a local bootstrap instead of a full
-        resync off the active)."""
-        return {"seq": self.last_seq,
-                "sessions": [s.to_dict() for s in self.store.all()]}
+        resync off the active). Under _lock: an SSE delta landing
+        mid-snapshot would otherwise pair a new session list with the
+        old seq (replay would then skip that delta on bootstrap)."""
+        with self._lock:
+            return {"seq": self.last_seq,
+                    "sessions": [s.to_dict() for s in self.store.all()]}
 
     def _on_change(self, ch: HAChange) -> None:
-        if ch.op == "put":
-            self.store.put(ch.session)
-        else:
-            self.store.delete(ch.session_id)
-        self.last_seq = ch.seq
-        self.stats["deltas"] += 1
+        # SSE reader thread (HTTP transport) or loop thread (in-process)
+        with self._lock:
+            if ch.op == "put":
+                self.store.put(ch.session)
+            else:
+                self.store.delete(ch.session_id)
+            self.last_seq = ch.seq
+            self.stats["deltas"] += 1
 
     def _connect(self) -> None:
         fp = fault_point("ha.connect")
@@ -270,21 +288,46 @@ class StandbySyncer:
         replay = active.replay_since(self.last_seq) if self.last_seq else None
         if replay is None:
             sessions, seq = active.full_sync()
-            self.store._sessions = {s.session_id: s for s in sessions}
-            self.last_seq = seq
-            self.stats["full_syncs"] += 1
+            with self._lock:
+                self.store._sessions = {s.session_id: s for s in sessions}
+                self.last_seq = seq
+                self.stats["full_syncs"] += 1
         else:
             for ch in replay:
                 self._on_change(ch)
-        self._cancel = active.subscribe(self._on_change)
-        self.connected = True
-        self._backoff = self._backoff_initial
+        # Ordering against the stream dying instantly: subscribe()
+        # starts the reader thread, whose on_stream_end fires
+        # disconnect() possibly BEFORE we return here. `connected`
+        # must therefore be set True BEFORE subscribe — then an
+        # immediate drop's disconnect() lands after and leaves it
+        # False (tick reconnects), instead of us overwriting the drop
+        # with a wedged True for a dead stream.
+        with self._lock:
+            self.connected = True
+            self._backoff = self._backoff_initial
+        try:
+            cancel = active.subscribe(self._on_change)
+        except BaseException:
+            # a subscribe that never opened must not leave `connected`
+            # True — tick()'s backoff owns the retry
+            with self._lock:
+                self.connected = False
+            raise
+        with self._lock:
+            self._cancel = cancel
 
     def disconnect(self) -> None:
-        if self._cancel:
-            self._cancel()
-            self._cancel = None
-        self.connected = False
+        # runs on the SSE reader thread too (cli wires it as the HTTP
+        # transport's on_stream_end) — _cancel/connected are the same
+        # fields the loop's tick/_connect write, so take _lock here as
+        # well; unlocked this both races the reconnect path and trips
+        # the @owned_by stamp in sanitizer runs, wedging `connected`
+        # True forever after a stream drop
+        with self._lock:
+            if self._cancel:
+                self._cancel()
+                self._cancel = None
+            self.connected = False
 
     def tick(self, now: float) -> None:
         if self.connected:
@@ -293,7 +336,8 @@ class StandbySyncer:
             return
         try:
             self._connect()
-            self.stats["reconnects"] += 1
+            with self._lock:
+                self.stats["reconnects"] += 1
         except ConnectionError:
             self._next_attempt = now + self._backoff
             self._backoff = min(self._backoff * 2, self._backoff_max)

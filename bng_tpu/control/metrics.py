@@ -742,6 +742,9 @@ class MetricsCollector:
         self._thread.start()
 
     def _loop(self) -> None:
+        from bng_tpu.analysis.sanitize import ctx_enter
+
+        ctx_enter("scrape")
         while not self._stop.wait(self.interval):
             self.collect_once()
 
@@ -760,6 +763,9 @@ class MetricsCollector:
 
         class Handler(http.server.BaseHTTPRequestHandler):
             def do_GET(self):  # noqa: N802
+                from bng_tpu.analysis.sanitize import ctx_enter
+
+                ctx_enter("scrape")
                 if self.path != "/metrics":
                     self.send_response(404)
                     self.end_headers()

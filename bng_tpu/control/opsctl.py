@@ -40,6 +40,7 @@ import threading
 import time
 from dataclasses import dataclass
 
+from bng_tpu.analysis.sanitize import ctx_enter, owned_by
 from bng_tpu.utils.structlog import get_logger
 
 # ops the controller will route to a BNGApp (name -> app method)
@@ -50,14 +51,23 @@ OPS = {
 }
 
 
+@owned_by(None, guard="_stats_lock", attrs=("executed", "rejected"))
 class OpsController:
-    """Bounded transition queue, drained at the batch boundary."""
+    """Bounded transition queue, drained at the batch boundary.
+
+    Counter ownership (BNG_SANITIZE): executed/rejected are bumped from
+    both the ctl threads and the loop drain — always under _stats_lock;
+    the @owned_by stamp raises if a future edit drops the lock."""
 
     def __init__(self, app, max_queue: int = 8):
         self.app = app
         self._q: queue.Queue = queue.Queue(maxsize=max_queue)
         self.executed = 0
         self.rejected = 0
+        # counters are bumped from BOTH the ctl (HTTP handler) threads
+        # and the loop's drain — a bare `+= 1` is a read-modify-write
+        # that loses updates across contexts (BNG060)
+        self._stats_lock = threading.Lock()
         self._log = get_logger("ops")
 
     def submit(self, op: str, args: dict | None = None,
@@ -68,7 +78,8 @@ class OpsController:
         (no run loop driving — e.g. `bng run --once`)."""
         method = OPS.get(op)
         if method is None:
-            self.rejected += 1
+            with self._stats_lock:
+                self.rejected += 1
             return {"op": op, "outcome": "rejected",
                     "error": f"unknown op {op!r} (have {sorted(OPS)})"}
         done = threading.Event()
@@ -76,7 +87,8 @@ class OpsController:
         try:
             self._q.put_nowait((method, args or {}, done, box))
         except queue.Full:
-            self.rejected += 1
+            with self._stats_lock:
+                self.rejected += 1
             return {"op": op, "outcome": "rejected",
                     "error": "ops queue full: a transition is already "
                              "pending"}
@@ -119,7 +131,8 @@ class OpsController:
                 return n
             if box.setdefault("owner", "loop") != "loop":
                 # the requester timed out and won the claim: cancelled
-                self.rejected += 1
+                with self._stats_lock:
+                    self.rejected += 1
                 done.set()
                 continue
             try:
@@ -130,7 +143,8 @@ class OpsController:
                 box["report"] = {"op": method, "outcome": "failed",
                                  "error": f"{type(e).__name__}: {e}"[:300]}
             finally:
-                self.executed += 1
+                with self._stats_lock:
+                    self.executed += 1
                 done.set()
                 n += 1
 
@@ -158,12 +172,14 @@ class OpsServer:
                 self.wfile.write(body)
 
             def do_GET(self):  # noqa: N802
+                ctx_enter("ctl")
                 if self.path != "/ops/status":
                     self._reply(404, {"error": "unknown path"})
                     return
                 self._reply(200, ctl.app.ops_status())
 
             def do_POST(self):  # noqa: N802
+                ctx_enter("ctl")
                 if not self.path.startswith("/ops/"):
                     self._reply(404, {"error": "unknown path"})
                     return

@@ -31,6 +31,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from bng_tpu.analysis.sanitize import owned_by
 from bng_tpu.chaos.faults import FaultInjectedError, fault_point
 from bng_tpu.telemetry import spans as tele
 from bng_tpu.control.nat import NATManager, apply_nat_updates
@@ -293,6 +294,7 @@ class GardenTables:
         self.allowed[free[0]] = (ip, port, proto)
 
 
+@owned_by("loop", attrs=("tables",))
 class Engine:
     def __init__(
         self,

@@ -565,6 +565,440 @@ def bench(engine, frames):
 
 
 # ---------------------------------------------------------------------------
+# concurrency pass (BNG060-BNG064) — ISSUE 9
+# ---------------------------------------------------------------------------
+#
+# Each fixture tree carries a mini cli.py (the loop-roots fact: BNGApp
+# tick/drive_once) plus a control/ module spawning its own thread, so
+# the pass sees two contexts. The clean twin of every planted tree must
+# stay silent — that asymmetry IS the test.
+
+CONC_CLI = """\
+class BNGApp:
+    def __init__(self):
+        self.w = Widget()
+
+    def tick(self):
+        self.w.poke()
+"""
+
+WIDGET_HEAD = """\
+import threading
+
+
+class Widget:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.flag = 0
+        self._t = None
+
+    def start(self):
+        self._t = threading.Thread(target=self._spin)
+        self._t.start()
+
+    def stop(self):
+        self._t.join()
+
+"""
+
+
+def conc_tree(widget_tail: str) -> dict:
+    return {"bng_tpu/cli.py": CONC_CLI,
+            "bng_tpu/control/widget.py": WIDGET_HEAD + widget_tail}
+
+
+class TestConcurrencyPass:
+    def test_cross_context_unlocked_mutation_flagged(self, tmp_path):
+        # flag written by the widget thread AND the loop, no lock
+        write_tree(tmp_path, conc_tree("""\
+    def _spin(self):
+        self.flag = 1
+
+    def poke(self):
+        self.flag = 2
+"""))
+        found = run_on(tmp_path, {"concurrency"})
+        assert [f.code for f in found] == ["BNG060"]
+        assert found[0].detail == "Widget.flag"
+
+    def test_common_lock_clean(self, tmp_path):
+        write_tree(tmp_path, conc_tree("""\
+    def _spin(self):
+        with self._lock:
+            self.flag = 1
+
+    def poke(self):
+        with self._lock:
+            self.flag = 2
+"""))
+        assert run_on(tmp_path, {"concurrency"}) == []
+
+    def test_constructor_writes_not_shared(self, tmp_path):
+        # __init__ writes precede publication: the widget thread writing
+        # what the constructor also wrote is not a race
+        write_tree(tmp_path, conc_tree("""\
+    def _spin(self):
+        with self._lock:
+            self.flag = 1
+
+    def poke(self):
+        return self.flag
+"""))
+        assert run_on(tmp_path, {"concurrency"}) == []
+
+    def test_check_then_act_without_writers_lock_flagged(self, tmp_path):
+        # writers agree on _lock; the loop tests the flag OUTSIDE it
+        # then writes under it — the stale-decision shape (PR 7)
+        write_tree(tmp_path, conc_tree("""\
+    def _spin(self):
+        with self._lock:
+            self.flag = 1
+
+    def poke(self):
+        if not self.flag:
+            with self._lock:
+                self.flag = 2
+"""))
+        found = run_on(tmp_path, {"concurrency"})
+        assert [f.code for f in found] == ["BNG062"]
+        assert found[0].detail == "Widget.flag"
+
+    def test_check_then_act_inside_lock_clean(self, tmp_path):
+        write_tree(tmp_path, conc_tree("""\
+    def _spin(self):
+        with self._lock:
+            self.flag = 1
+
+    def poke(self):
+        with self._lock:
+            if not self.flag:
+                self.flag = 2
+"""))
+        assert run_on(tmp_path, {"concurrency"}) == []
+
+    def test_bare_acquire_flagged_try_finally_clean(self, tmp_path):
+        write_tree(tmp_path, conc_tree("""\
+    def _spin(self):
+        with self._lock:
+            self.flag = 1
+
+    def poke(self):
+        self._lock.acquire()
+        self.flag = 2
+        self._lock.release()
+
+    def poke_safe(self):
+        self._lock.acquire()
+        try:
+            self.flag = 3
+        finally:
+            self._lock.release()
+"""))
+        found = [f for f in run_on(tmp_path, {"concurrency"})
+                 if f.code == "BNG061"]
+        assert len(found) == 1
+        assert found[0].scope == "Widget.poke"
+
+    def test_blocking_under_loop_lock_flagged(self, tmp_path):
+        write_tree(tmp_path, conc_tree("""\
+    def _spin(self):
+        with self._lock:
+            self.flag = 1
+
+    def poke(self):
+        import time
+        with self._lock:
+            time.sleep(0.1)
+            self.flag = 2
+"""))
+        found = [f for f in run_on(tmp_path, {"concurrency"})
+                 if f.code == "BNG063"]
+        assert len(found) == 1 and "sleep" in found[0].detail
+
+    def test_blocking_outside_lock_clean(self, tmp_path):
+        write_tree(tmp_path, conc_tree("""\
+    def _spin(self):
+        with self._lock:
+            self.flag = 1
+
+    def poke(self):
+        import time
+        time.sleep(0.1)
+        with self._lock:
+            self.flag = 2
+"""))
+        assert [f for f in run_on(tmp_path, {"concurrency"})
+                if f.code == "BNG063"] == []
+
+    def test_string_join_not_blocking(self, tmp_path):
+        write_tree(tmp_path, conc_tree("""\
+    def _spin(self):
+        with self._lock:
+            self.flag = 1
+
+    def poke(self):
+        with self._lock:
+            self.flag = 2
+        return ",".join(str(x) for x in (1, 2))
+"""))
+        assert [f for f in run_on(tmp_path, {"concurrency"})
+                if f.code == "BNG063"] == []
+
+    def test_orphan_thread_flagged_stop_path_clean(self, tmp_path):
+        write_tree(tmp_path, {
+            "bng_tpu/cli.py": CONC_CLI.replace("Widget", "Orphan"),
+            "bng_tpu/control/orphan.py": """\
+import threading
+
+
+class Orphan:
+    def poke(self):
+        pass
+
+    def launch(self):
+        threading.Thread(target=self._spin, daemon=True).start()
+
+    def _spin(self):
+        pass
+"""})
+        found = [f for f in run_on(tmp_path, {"concurrency"})
+                 if f.code == "BNG064"]
+        assert len(found) == 1 and found[0].scope == "Orphan.launch"
+        # the stop-path twin (the same tree's Widget head has stop+join)
+        write_tree(tmp_path, conc_tree("""\
+    def _spin(self):
+        pass
+
+    def poke(self):
+        pass
+"""))
+        clean = [f for f in run_on(tmp_path, {"concurrency"})
+                 if f.code == "BNG064"
+                 and "widget" in f.path]
+        assert clean == []
+
+    def test_unresolvable_thread_target_is_loud(self, tmp_path):
+        write_tree(tmp_path, conc_tree("""\
+    def _spin(self):
+        pass
+
+    def poke(self):
+        pass
+
+    def weird(self, pick):
+        threading.Thread(target=pick()).start()
+"""))
+        found = [f for f in run_on(tmp_path, {"concurrency"})
+                 if f.code == "BNG990"]
+        assert any(f.detail.startswith("thread-target:") for f in found)
+
+    def test_missing_loop_roots_is_loud(self, tmp_path):
+        # no cli.py/BNGApp anywhere: the pass must say the loop context
+        # is unclassifiable, not silently check nothing
+        write_tree(tmp_path, {"bng_tpu/control/solo.py": "X = 1\n"})
+        found = run_on(tmp_path, {"concurrency"})
+        assert any(f.code == "BNG990" and f.detail == "loop-roots"
+                   for f in found)
+
+    def test_same_named_classes_in_different_modules_dont_merge(
+            self, tmp_path):
+        # two `Handler` classes in different control/ modules, each
+        # writing the same attr from a different context: their site
+        # lists must stay separate (same-file class identity), or the
+        # disjoint contexts would fabricate a cross-context BNG060
+        handler = '''\
+import threading
+
+
+class Handler:
+    def serve(self):
+        threading.Thread(target=self._run).start()
+
+    def stop(self):
+        pass
+
+    def _run(self):
+        self.busy = 1
+'''
+        write_tree(tmp_path, {
+            "bng_tpu/cli.py": "class BNGApp:\n    def tick(self):\n"
+                              "        pass\n",
+            "bng_tpu/control/alpha.py": handler,
+            "bng_tpu/control/beta.py": handler,
+        })
+        assert [f for f in run_on(tmp_path, {"concurrency"})
+                if f.code == "BNG060"] == []
+
+    def test_worker_context_excluded_from_races(self, tmp_path):
+        # a multiprocessing target shares no memory with the loop:
+        # loop+worker mutation of the same attr is NOT a BNG060
+        write_tree(tmp_path, {
+            "bng_tpu/cli.py": CONC_CLI,
+            "bng_tpu/control/widget.py": """\
+import multiprocessing
+
+
+class Widget:
+    def __init__(self):
+        self.flag = 0
+
+    def launch(self):
+        multiprocessing.Process(target=self._grind).start()
+
+    def _grind(self):
+        self.flag = 1
+
+    def poke(self):
+        self.flag = 2
+"""})
+        assert [f for f in run_on(tmp_path, {"concurrency"})
+                if f.code == "BNG060"] == []
+
+
+class TestConcurrencyFacts:
+    def test_contexts_json_section(self, tmp_path):
+        write_tree(tmp_path, conc_tree("""\
+    def _spin(self):
+        with self._lock:
+            self.flag = 1
+
+    def poke(self):
+        with self._lock:
+            self.flag = 2
+"""))
+        out = subprocess.run(
+            [sys.executable, "-m", "bng_tpu.analysis", "--root",
+             str(tmp_path), str(tmp_path), "--no-baseline", "--json",
+             "--select", "concurrency"],
+            cwd=REPO, capture_output=True, text=True)
+        doc = json.loads(out.stdout)
+        ctx = doc["contexts"]
+        fns = ctx["functions"]
+        spin = fns["bng_tpu/control/widget.py::Widget._spin"]
+        assert spin["contexts"] == ["thread:widget"]
+        poke = fns["bng_tpu/control/widget.py::Widget.poke"]
+        assert poke["contexts"] == ["loop"]
+        assert any(e["context"] == "thread:widget" for e in ctx["entries"])
+        assert ctx["unresolved_entry_points"] == []
+
+    def test_repo_classification_matches_known_anchors(self, repo_report):
+        """The real repo's classification must agree with the hand-known
+        architecture: ops handlers are ctl, the fleet gather is
+        loop-held-_ctl, the SSE delta apply is ha-sync."""
+        from bng_tpu.analysis import facts
+        from bng_tpu.analysis.core import Project as P
+
+        project = P.load(REPO)
+        model = facts.build_concurrency_model(project)
+        rep = model.contexts_report()
+        fns = rep["functions"]
+        sub = fns["bng_tpu/control/opsctl.py::OpsController.submit"]
+        assert "ctl" in sub["contexts"]
+        gather = fns["bng_tpu/control/fleet.py::SlowPathFleet._gather"]
+        assert gather["contexts"] == ["loop"]
+        assert "_ctl" in gather["locks_held"]
+        onchange = fns["bng_tpu/control/ha.py::StandbySyncer._on_change"]
+        assert "ha-sync" in onchange["contexts"]
+        run_p = fns["bng_tpu/control/opsctl.py::OpsController.run_pending"]
+        assert "loop" in run_p["contexts"]
+
+    def test_extraction_cache_hit_and_invalidation(self, tmp_path):
+        import os
+
+        from bng_tpu.analysis import facts
+        from bng_tpu.analysis.core import Project as P
+
+        write_tree(tmp_path, conc_tree("""\
+    def _spin(self):
+        self.flag = 1
+
+    def poke(self):
+        self.flag = 2
+"""))
+        m1 = facts.build_concurrency_model(P.load(tmp_path, [tmp_path]))
+        assert m1.cache_hit is False
+        assert (tmp_path / facts.CACHE_NAME).exists()
+        m2 = facts.build_concurrency_model(P.load(tmp_path, [tmp_path]))
+        assert m2.cache_hit is True
+        # an edited file must not serve a stale summary: fix the race,
+        # bump mtime past the cached key, re-run -> finding disappears
+        w = tmp_path / "bng_tpu/control/widget.py"
+        w.write_text(w.read_text().replace(
+            "        self.flag = 2",
+            "        with self._lock:\n            self.flag = 2").replace(
+            "    def _spin(self):\n        self.flag = 1",
+            "    def _spin(self):\n        with self._lock:\n"
+            "            self.flag = 1"))
+        st = w.stat()
+        os.utime(w, ns=(st.st_atime_ns, st.st_mtime_ns + 10_000_000))
+        found = run_on(tmp_path, {"concurrency"})
+        assert [f for f in found if f.code == "BNG060"] == []
+
+    def test_narrowed_scan_preserves_other_cache_entries(self, tmp_path):
+        # a path-narrowed run must not evict the full tree's cached
+        # summaries — the next full run should still warm-hit
+        import json as _json
+
+        from bng_tpu.analysis import facts
+        from bng_tpu.analysis.core import Project as P
+
+        write_tree(tmp_path, conc_tree('''\
+    def _spin(self):
+        self.flag = 1
+
+    def poke(self):
+        self.flag = 2
+'''))
+        facts.build_concurrency_model(P.load(tmp_path, [tmp_path]))
+        full = set(_json.loads(
+            (tmp_path / facts.CACHE_NAME).read_text())["files"])
+        assert len(full) == 2
+        narrow = P.load(tmp_path,
+                        [tmp_path / "bng_tpu" / "control" / "widget.py"])
+        facts.build_concurrency_model(narrow)
+        kept = set(_json.loads(
+            (tmp_path / facts.CACHE_NAME).read_text())["files"])
+        assert kept == full
+        m = facts.build_concurrency_model(P.load(tmp_path, [tmp_path]))
+        assert m.cache_hit is True
+
+    def test_selective_update_preserves_concurrency_entries(self, tmp_path):
+        """--select handler-audit --update-baseline must not wipe a
+        justified BNG06x entry (and vice versa) — the scope rule covers
+        the new pass's codes."""
+        write_tree(tmp_path, {"bng_tpu/control/foo.py": "x = 1\n"})
+        bl = tmp_path / "bl.json"
+        baseline_mod.write([
+            Finding(code="BNG063", path="bng_tpu/control/fleet.py", line=7,
+                    message="m", scope="SlowPathFleet._gather",
+                    detail="recv@SlowPathFleet._gather"),
+        ], bl)
+        d = json.loads(bl.read_text())
+        d["findings"][0]["justification"] = "the fan-in IS the batch"
+        bl.write_text(json.dumps(d))
+        out = subprocess.run(
+            [sys.executable, "-m", "bng_tpu.analysis", "--root",
+             str(tmp_path), str(tmp_path), "--baseline", str(bl),
+             "--select", "handler-audit", "--update-baseline"],
+            cwd=REPO, capture_output=True, text=True)
+        assert out.returncode == 0, out.stdout + out.stderr
+        kept = json.loads(bl.read_text())["findings"]
+        assert [(e["code"], e["justification"]) for e in kept] == [
+            ("BNG063", "the fan-in IS the batch")]
+        # a concurrency-selected update on a tree missing fleet.py also
+        # keeps it: the entry's file is outside the scanned set
+        out = subprocess.run(
+            [sys.executable, "-m", "bng_tpu.analysis", "--root",
+             str(tmp_path), str(tmp_path), "--baseline", str(bl),
+             "--select", "concurrency", "--update-baseline"],
+            cwd=REPO, capture_output=True, text=True)
+        assert out.returncode == 0, out.stdout + out.stderr
+        kept = json.loads(bl.read_text())["findings"]
+        assert ("BNG063", "the fan-in IS the batch") in [
+            (e["code"], e["justification"]) for e in kept]
+
+
+# ---------------------------------------------------------------------------
 # baseline mechanics
 # ---------------------------------------------------------------------------
 
@@ -639,7 +1073,8 @@ class TestCleanCorpus:
         for c in ("BNG001", "BNG002", "BNG003", "BNG010", "BNG011",
                   "BNG012", "BNG020", "BNG021", "BNG030", "BNG031",
                   "BNG032", "BNG033", "BNG034", "BNG035", "BNG040",
-                  "BNG041", "BNG050"):
+                  "BNG041", "BNG050", "BNG060", "BNG061", "BNG062",
+                  "BNG063", "BNG064"):
             assert c in codes, c
 
     def test_no_jax_import(self):

@@ -1,0 +1,459 @@
+"""Deterministic race-schedule tests + runtime ownership assertions
+(ISSUE 9) — the dynamic half of the concurrency discipline.
+
+Two kinds of tests here:
+
+* **Forced interleavings** of the PR-7 race schedules, driven by
+  events/barriers (no free-running sleeps deciding the outcome): the
+  OpsController timeout-vs-claim schedules and the `ops_status`-vs-
+  transition schedule. Each test FAILS if the corresponding fix is
+  reverted — the claim going back to check-then-act, the cancellation
+  being dropped, or `ops_status` losing `with self._ctl`.
+* **Ownership assertions** (`BNG_SANITIZE=1` only): `@owned_by`
+  stamps on BNGApp / SlowPathFleet / OpsController turn an unlocked
+  cross-context mutation into an OwnershipViolation, proving the
+  sanitizer closes the same class the static pass (BNG060) flags.
+
+`make verify-sanitize` runs this file with the sanitizer armed; tier-1
+runs the schedule tests disarmed (they assert outcomes, not guard
+mechanics — both must hold).
+"""
+
+import threading
+import time
+
+import pytest
+
+from bng_tpu.analysis import sanitize
+from bng_tpu.control.opsctl import OpsController
+
+pytestmark = pytest.mark.race
+
+needs_sanitizer = pytest.mark.skipif(
+    not sanitize.enabled(),
+    reason="ownership assertions arm only under BNG_SANITIZE=1")
+
+
+def _app():
+    from bng_tpu.cli import BNGApp, BNGConfig
+
+    return BNGApp(BNGConfig(slowpath_workers=2,
+                            slowpath_worker_mode="inline",
+                            dhcpv6_enabled=False, slaac_enabled=False,
+                            metrics_enabled=False, ctl_listen=""))
+
+
+# ---------------------------------------------------------------------------
+# schedule 1: the loop claims the op; the client deadline expires
+# mid-execution (the PR-7 OpsController bug: a check-then-act flag told
+# the client 'timeout' while the op executed anyway — the retry then
+# doubled the transition)
+# ---------------------------------------------------------------------------
+
+class TestOpsTimeoutSchedules:
+    def test_loop_claim_wins_client_waits_out_real_report(self):
+        app = _app()
+        try:
+            ops = app.components["ops"]
+            executing = threading.Event()
+            release = threading.Event()
+            real = app.fleet_resize
+
+            def stalled_resize(n):
+                executing.set()  # claim certainly taken: we are the op
+                assert release.wait(10), "schedule wedged"
+                return real(n)
+
+            app.fleet_resize = stalled_resize
+            result = {}
+
+            def client():
+                with sanitize.context("ctl"):
+                    result["rep"] = ops.submit("fleet/resize", {"n": 3},
+                                               timeout_s=0.05)
+
+            tc = threading.Thread(target=client, daemon=True)
+            tc.start()
+            # wait for the enqueue, then drain on a 'loop' thread: the
+            # claim happens inside run_pending before our stub runs
+            deadline = time.monotonic() + 5
+            while ops._q.qsize() == 0:
+                assert time.monotonic() < deadline, "submit never enqueued"
+            tl = threading.Thread(
+                target=lambda: sanitize.ctx_enter("loop") or
+                ops.run_pending(), daemon=True)
+            tl.start()
+            assert executing.wait(5)
+            # hold the op captive until the client's 50 ms deadline has
+            # certainly expired — the client is now in the loser branch
+            # of the atomic claim
+            time.sleep(0.15)
+            release.set()
+            tc.join(timeout=10)
+            tl.join(timeout=10)
+            assert not tc.is_alive() and not tl.is_alive()
+            # the fix's contract: the client gets the REAL report, not
+            # 'timeout' (reverting the atomic claim fails here), and
+            # exactly one transition executed (no double resize)
+            assert result["rep"]["outcome"] == "ok", result["rep"]
+            assert app.components["fleet"].n == 3
+            assert app.components["fleet"].resizes == 1
+        finally:
+            app.close()
+
+    def test_client_timeout_first_cancels_the_op(self):
+        """The mirror schedule, fully event-ordered: nothing drains
+        until AFTER the client was told 'timeout' — the op must then
+        never fire (the operator is about to retry)."""
+        app = _app()
+        try:
+            ops = app.components["ops"]
+            with sanitize.context("ctl"):
+                rep = ops.submit("fleet/resize", {"n": 3}, timeout_s=0)
+            assert rep["outcome"] == "timeout"
+            # the loop drains strictly after: the claim must already be
+            # the client's, so nothing executes
+            with sanitize.context("loop"):
+                assert ops.run_pending() == 0
+            assert app.components["fleet"].n == 2
+            assert app.components["fleet"].resizes == 0
+            assert ops.stats_snapshot()["rejected"] == 1
+        finally:
+            app.close()
+
+
+# ---------------------------------------------------------------------------
+# schedule 2: ops_status vs a loop-side transition holding _ctl (the
+# PR-7 review fix: the HTTP handler thread read fleet state mid-
+# mutation; ops_status now takes _ctl)
+# ---------------------------------------------------------------------------
+
+class TestOpsStatusVsTransition:
+    def test_status_blocks_until_transition_releases_ctl(self):
+        app = _app()
+        try:
+            in_transition = threading.Event()
+            release = threading.Event()
+            status_done = threading.Event()
+            result = {}
+
+            def loop_side():
+                sanitize.ctx_enter("loop")
+                with app._ctl:  # a transition is mid-flight
+                    in_transition.set()
+                    assert release.wait(10), "schedule wedged"
+
+            def ctl_side():
+                sanitize.ctx_enter("ctl")
+                result["status"] = app.ops_status()
+                status_done.set()
+
+            tl = threading.Thread(target=loop_side, daemon=True)
+            tl.start()
+            assert in_transition.wait(5)
+            tc = threading.Thread(target=ctl_side, daemon=True)
+            tc.start()
+            # the fix's contract: ops_status CANNOT complete while the
+            # transition holds _ctl (reverting `with self._ctl` in
+            # ops_status returns a mid-mutation read here and fails)
+            assert not status_done.wait(0.2), (
+                "ops_status returned while a transition held _ctl — "
+                "it reads fleet state mid-mutation")
+            release.set()
+            assert status_done.wait(5)
+            tl.join(timeout=5)
+            tc.join(timeout=5)
+            st = result["status"]
+            assert st["fleet"]["workers"] == 2
+            assert st["ops"]["pending"] == 0
+        finally:
+            app.close()
+
+
+# ---------------------------------------------------------------------------
+# schedule 3: the SSE stream dies DURING _connect (on_stream_end fires
+# before _connect returns) — `connected` must end up False, not a
+# wedged True for a dead stream
+# ---------------------------------------------------------------------------
+
+class TestStandbyConnectOrdering:
+    def test_stream_dying_during_connect_leaves_disconnected(self):
+        from bng_tpu.control.ha import (ActiveSyncer, InMemorySessionStore,
+                                        StandbySyncer)
+
+        active = ActiveSyncer(InMemorySessionStore())
+        standby = StandbySyncer(InMemorySessionStore(), lambda: active)
+
+        class DyingStream:
+            """Transport whose stream drops the instant it opens: the
+            reader's finally fires on_stream_end (-> disconnect) before
+            subscribe() returns to _connect — forced synchronously, the
+            worst legal interleaving."""
+
+            full_sync = staticmethod(active.full_sync)
+            replay_since = staticmethod(active.replay_since)
+
+            @staticmethod
+            def subscribe(cb):
+                cancel = active.subscribe(cb)
+                standby.disconnect()  # the drop lands mid-_connect
+                return cancel
+
+        standby.transport = lambda: DyingStream()
+        standby.tick(0.0)
+        # pre-fix: _connect set connected=True AFTER subscribe and
+        # overwrote the drop — tick() then early-returned forever
+        assert standby.connected is False
+        # the backoff path stays live: a later healthy connect works
+        standby.transport = lambda: active
+        standby.tick(10.0)
+        assert standby.connected is True
+
+
+# ---------------------------------------------------------------------------
+# ownership assertions (BNG_SANITIZE=1): the dynamic BNG060 check
+# ---------------------------------------------------------------------------
+
+@needs_sanitizer
+class TestOwnedBy:
+    def _widget(self, owner="loop", guard="_ctl", attrs=None):
+        @sanitize.owned_by(owner, guard=guard, attrs=attrs)
+        class Widget:
+            def __init__(self):
+                self._ctl = threading.Lock()
+                self.x = 0
+
+        return Widget()
+
+    def _run(self, fn):
+        box = {}
+
+        def run():
+            try:
+                fn()
+            except BaseException as e:  # noqa: BLE001 — the box IS the report
+                box["err"] = e
+
+        t = threading.Thread(target=run, daemon=True)
+        t.start()
+        t.join(5)
+        return box.get("err")
+
+    def test_unnamed_context_writes_free(self):
+        w = self._widget()
+        w.x = 1  # no context stamp: construction/unit-test writes pass
+        assert w.x == 1
+
+    def test_owner_context_writes_free(self):
+        w = self._widget()
+        with sanitize.context("loop"):
+            w.x = 2
+        assert w.x == 2
+
+    def test_cross_context_unlocked_write_raises(self):
+        w = self._widget()
+
+        def rogue():
+            sanitize.ctx_enter("ctl")
+            w.x = 3
+
+        err = self._run(rogue)
+        assert isinstance(err, sanitize.OwnershipViolation)
+        assert "owned by 'loop'" in str(err) and w.x == 0
+
+    def test_cross_context_write_under_guard_allowed(self):
+        w = self._widget()
+
+        def polite():
+            sanitize.ctx_enter("ctl")
+            with w._ctl:
+                w.x = 4
+
+        assert self._run(polite) is None
+        assert w.x == 4
+
+    def test_owner_inferred_at_first_named_write(self):
+        w = self._widget(owner=None)
+        with sanitize.context("scrape"):
+            w.x = 5  # scrape stamps ownership of x
+
+        def rogue():
+            sanitize.ctx_enter("ctl")
+            w.x = 6
+
+        err = self._run(rogue)
+        assert isinstance(err, sanitize.OwnershipViolation)
+        assert "owned by 'scrape'" in str(err)
+
+    def test_attr_filter_limits_checking(self):
+        w = self._widget(attrs=("x",))
+
+        def rogue():
+            sanitize.ctx_enter("ctl")
+            w.other = 1  # unchecked attr: free
+
+        assert self._run(rogue) is None
+
+    def test_guarded_lock_reentrancy_bookkeeping(self):
+        g = sanitize.GuardedLock(threading.RLock())
+        assert not g.held_by_me()
+        with g:
+            assert g.held_by_me()
+            with g:
+                assert g.held_by_me()
+            assert g.held_by_me()
+        assert not g.held_by_me()
+
+
+@needs_sanitizer
+class TestProductOwnership:
+    def test_fleet_reach_in_from_ctl_raises(self):
+        """The pre-PR-7 bug class, live: a ctl-side thread mutating
+        fleet state directly (instead of routing through the ops queue
+        to the loop) trips the @owned_by('loop') stamp."""
+        app = _app()
+        try:
+            fleet = app.components["fleet"]
+            err = {}
+
+            def rogue():
+                sanitize.ctx_enter("ctl")
+                try:
+                    fleet.batches += 1
+                except sanitize.OwnershipViolation as e:
+                    err["e"] = e
+
+            t = threading.Thread(target=rogue, daemon=True)
+            t.start()
+            t.join(5)
+            assert "e" in err, "ctl-context fleet mutation not caught"
+        finally:
+            app.close()
+
+    def test_app_mutation_needs_ctl_from_other_contexts(self):
+        app = _app()
+        try:
+            err = {}
+
+            def unlocked():
+                sanitize.ctx_enter("ctl")
+                try:
+                    app._last_expire = 1.0
+                except sanitize.OwnershipViolation as e:
+                    err["e"] = e
+
+            def locked():
+                sanitize.ctx_enter("ctl")
+                with app._ctl:
+                    app._last_expire = 2.0
+
+            t = threading.Thread(target=unlocked, daemon=True)
+            t.start()
+            t.join(5)
+            assert "e" in err, "unlocked ctl-context app mutation passed"
+            t = threading.Thread(target=locked, daemon=True)
+            t.start()
+            t.join(5)
+            assert app._last_expire == 2.0  # _ctl held: legal
+        finally:
+            app.close()
+
+    def test_ops_counters_locked_bumps_pass(self):
+        """The BNG060 fix for OpsController.rejected: submit's bump
+        happens under _stats_lock from the ctl context — the stamp
+        accepts it (and would reject a lock-dropping regression)."""
+        app = _app()
+        try:
+            ops = app.components["ops"]
+
+            def client():
+                sanitize.ctx_enter("ctl")
+                rep = ops.submit("bogus/op", {})
+                assert rep["outcome"] == "rejected"
+
+            t = threading.Thread(target=client, daemon=True)
+            t.start()
+            t.join(5)
+            assert ops.rejected == 1
+        finally:
+            app.close()
+
+    def test_engine_tables_rebind_from_ctl_raises(self):
+        app = _app()
+        try:
+            engine = app.components["engine"]
+            err = {}
+
+            def rogue():
+                sanitize.ctx_enter("ctl")
+                try:
+                    engine.tables = None
+                except sanitize.OwnershipViolation as e:
+                    err["e"] = e
+
+            t = threading.Thread(target=rogue, daemon=True)
+            t.start()
+            t.join(5)
+            assert "e" in err, "ctl-context engine.tables rebind passed"
+            assert engine.tables is not None
+        finally:
+            app.close()
+
+    def test_standby_stream_drop_from_reader_thread_heals(self):
+        """The SSE reader's on_stream_end calls disconnect() on the
+        reader ('ha-sync') thread while _cancel/connected are
+        loop-stamped — disconnect must take _lock (unlocked it both
+        races tick/_connect and trips the stamp, wedging `connected`
+        True forever after a stream drop)."""
+        from bng_tpu.control.ha import (ActiveSyncer, InMemorySessionStore,
+                                        StandbySyncer)
+
+        active = ActiveSyncer(InMemorySessionStore())
+        standby = StandbySyncer(InMemorySessionStore(), lambda: active)
+        with sanitize.context("loop"):
+            standby.tick(0.0)  # connect: stamps _cancel/connected 'loop'
+        assert standby.connected
+        err = {}
+
+        def stream_end():
+            sanitize.ctx_enter("ha-sync")
+            try:
+                standby.disconnect()
+            except sanitize.OwnershipViolation as e:
+                err["e"] = e
+
+        t = threading.Thread(target=stream_end, daemon=True)
+        t.start()
+        t.join(5)
+        assert "e" not in err, f"locked disconnect rejected: {err['e']}"
+        assert not standby.connected  # tick() can reconnect again
+        with sanitize.context("loop"):
+            standby.tick(1.0)
+        assert standby.connected
+
+    def test_standby_syncer_delta_under_lock_passes(self):
+        """The BNG060 HA fix: a 'ha-sync'-context delta apply goes
+        through _on_change's _lock and is accepted by the stamp."""
+        from bng_tpu.control.ha import (ActiveSyncer, HAChange,
+                                        InMemorySessionStore, SessionState,
+                                        StandbySyncer)
+
+        active = ActiveSyncer(InMemorySessionStore())
+        standby = StandbySyncer(InMemorySessionStore(), lambda: active)
+        standby.tick(0.0)  # connect on the "loop" side
+        err = {}
+
+        def sse_reader():
+            sanitize.ctx_enter("ha-sync")
+            try:
+                standby._on_change(HAChange(
+                    "put", session=SessionState(session_id="s1", ip=7),
+                    seq=active._seq + 1))
+            except sanitize.OwnershipViolation as e:
+                err["e"] = e
+
+        t = threading.Thread(target=sse_reader, daemon=True)
+        t.start()
+        t.join(5)
+        assert "e" not in err, f"locked delta apply rejected: {err}"
+        assert standby.store.get("s1").ip == 7
