@@ -21,6 +21,14 @@
 #                       scenarios compile the fused pipeline once,
 #                       ~30 s on CPU; ~90-120 s/run total). The long
 #                       soak lives under @pytest.mark.slow.
+#   make verify-perf  — SLO engine + perf-ledger tests (`perf` marker,
+#                       tests/test_slo.py + tests/test_ledger.py, < 30 s)
+#                       then `bng perf gate` against the repo's real
+#                       bench_runs.jsonl (rc contract: 0 clean / 1
+#                       regression / 2 internal / 3 incomparable-cohort).
+#                       A prerequisite of `verify` (whose tier-1 line
+#                       deselects `perf`; a bare ROADMAP tier-1 run
+#                       still includes it).
 #   make verify-storm — storm-suite tests (tests/test_storms.py, `storm`
 #                       marker, < 60 s): fast deterministic variants of
 #                       all five storms (same code as `bng chaos run`,
@@ -75,12 +83,13 @@ PYTEST_FLAGS = -q --continue-on-collection-errors -p no:cacheprovider \
 
 .PHONY: verify verify-slow verify-all verify-load verify-chaos \
         verify-telemetry verify-static verify-sanitize verify-ops \
-        verify-storm
+        verify-storm verify-perf
 
-verify: verify-static verify-storm
+verify: verify-static verify-storm verify-perf
 	set -o pipefail; rm -f /tmp/_t1.log; \
 	timeout -k 10 $(TIER1_TIMEOUT) env JAX_PLATFORMS=cpu \
-	$(PY) -m pytest tests/ $(PYTEST_FLAGS) -m 'not slow and not storm' \
+	$(PY) -m pytest tests/ $(PYTEST_FLAGS) \
+	-m 'not slow and not storm and not perf' \
 	2>&1 | tee /tmp/_t1.log
 
 verify-slow:
@@ -110,6 +119,15 @@ verify-storm:
 	$(PY) -m pytest tests/test_storms.py $(PYTEST_FLAGS) \
 	  -m 'storm and not slow' \
 	&& echo "verify-storm OK"
+
+verify-perf:
+	set -o pipefail; \
+	timeout -k 10 30 env JAX_PLATFORMS=cpu \
+	$(PY) -m pytest tests/test_slo.py tests/test_ledger.py \
+	  $(PYTEST_FLAGS) -m 'perf and not slow' \
+	&& timeout -k 10 30 env JAX_PLATFORMS=cpu \
+	$(PY) -m bng_tpu.cli perf gate --ledger bench_runs.jsonl \
+	&& echo "verify-perf OK"
 
 verify-ops:
 	set -o pipefail; \

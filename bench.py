@@ -571,14 +571,13 @@ def _probe_window() -> float:
 
 def _persist(line: dict) -> None:
     """Append every bench result to bench_runs.jsonl (r2 ADVICE: per-config
-    measurements must live in artifacts, not review prose)."""
-    path = os.environ.get("BNG_BENCH_LOG",
-                          os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                                       "bench_runs.jsonl"))
+    measurements must live in artifacts, not review prose). The appender
+    stamps the ledger schema (schema_version, run_id, ts —
+    telemetry/ledger.py) so every new line is perf-gate-comparable."""
+    from bng_tpu.telemetry import ledger
+
     try:
-        with open(path, "a") as f:
-            f.write(json.dumps({"ts": time.strftime("%Y-%m-%dT%H:%M:%S"),
-                                **line}) + "\n")
+        ledger.append(ledger.default_ledger_path(), line)
     except OSError:
         pass  # read-only checkout: stdout still carries the result
 
@@ -1382,6 +1381,15 @@ def _child_dispatch(config: int, verify_lowering: bool = False,
                     require_tpu: bool = False) -> None:
     """Run one benchmark config in this process (the supervised child)."""
     try:
+        # environment fingerprint (device kind / jaxlib / hostname) on
+        # EVERY emitted JSON line — today `device`+`compile_s` is all a
+        # reader gets, and the perf gate's cohorts key on this identity.
+        # Stamped before config 1 (which never probes a backend: the
+        # fingerprint must not trigger jax init) and refreshed after the
+        # guarded probe once the device identity is known.
+        from bng_tpu.telemetry.ledger import environment_fingerprint
+
+        _DIAG["env"] = environment_fingerprint()
         if config == 1 and not verify_lowering and not scheduler:
             config1_dhcp_slowpath()
             return
@@ -1422,6 +1430,7 @@ def _child_dispatch(config: int, verify_lowering: bool = False,
         )
         on_tpu = platform not in ("cpu",)
         _mark(f"backend: {platform}" + (f" (fallback: {err})" if err else ""))
+        _DIAG["env"] = environment_fingerprint()  # now with device identity
         if err:
             _DIAG["backend_fallback"] = "cpu"
             _DIAG["backend_error"] = err
@@ -1636,6 +1645,12 @@ def main_dispatch() -> None:
     ap.add_argument("--require-tpu", action="store_true",
                     help="exit nonzero (rc=3) instead of publishing "
                          "CPU-fallback numbers — the CI headline gate")
+    ap.add_argument("--gate", action="store_true",
+                    help="after the run, trend-gate the appended ledger "
+                         "line against its comparable cohort "
+                         "(bng_tpu/telemetry/ledger.py); exits with the "
+                         "gate rc: 0 clean / 1 regression / 2 internal "
+                         "/ 3 incomparable-cohort")
     args = ap.parse_args()
 
     if args.chaos_overhead:
@@ -1660,6 +1675,19 @@ def main_dispatch() -> None:
                  + _probe_window())
     env = dict(os.environ)
     env["BNG_BENCH_CHILD"] = "1"
+    # --gate ties its verdict to THIS run: remember how many ledger
+    # lines exist before the child, so a run that appends nothing (read
+    # -only checkout) or only an error line can never earn a CLEAN
+    # verdict about stale history
+    gate_path = gate_pre_lines = None
+    if args.gate:
+        from bng_tpu.telemetry import ledger
+
+        gate_path = ledger.default_ledger_path()
+        try:
+            gate_pre_lines = len(ledger.read(gate_path))
+        except OSError:
+            gate_pre_lines = 0
     try:
         res = subprocess.run(
             [sys.executable, os.path.abspath(__file__), *sys.argv[1:]],
@@ -1672,19 +1700,44 @@ def main_dispatch() -> None:
         else:
             print(_error_line(args.config,
                               f"child rc={res.returncode}, no JSON emitted"))
-        if args.verify_lowering or args.scheduler or args.require_tpu:
+        if (args.verify_lowering or args.scheduler or args.require_tpu) \
+                and res.returncode != 0:
             # CI pre-step / scheduler mode / headline gate: propagate the
             # child verdict (scheduler exits 2 when lowering verification
             # refused it; --require-tpu exits 3 on CPU fallback)
             sys.exit(res.returncode)
+        if args.gate:
+            # the run appended its ledger line; trend-gate it now and
+            # make the regression verdict THIS process's exit code —
+            # but only if the candidate IS this run's line
+            from bng_tpu.telemetry import ledger
+
+            try:
+                lines = ledger.read(gate_path)
+            except OSError as e:
+                print(f"perf gate: cannot read ledger {gate_path}: {e}",
+                      file=sys.stderr)
+                sys.exit(2)
+            idx = ledger.newest_gateable_index(lines)
+            if idx is None or idx < gate_pre_lines:
+                print("perf gate: this run appended no gateable ledger "
+                      f"line to {gate_path} (read-only checkout or "
+                      "error run) — refusing a verdict about stale "
+                      "history (rc=2)", file=sys.stderr)
+                sys.exit(2)
+            rep = ledger.gate(lines)
+            print(rep.format_text(), file=sys.stderr)
+            sys.exit(rep.rc)
     except subprocess.TimeoutExpired:
         print(_error_line(args.config,
                           f"benchmark child timed out after {timeout_s:.0f}s"))
-        if args.verify_lowering or args.scheduler or args.require_tpu:
+        if (args.verify_lowering or args.scheduler or args.require_tpu
+                or args.gate):
             sys.exit(1)  # a gate that never ran is a failed gate
     except Exception as e:  # pragma: no cover - spawn failure
         print(_error_line(args.config, f"supervisor error: {type(e).__name__}: {e}"))
-        if args.verify_lowering or args.scheduler or args.require_tpu:
+        if (args.verify_lowering or args.scheduler or args.require_tpu
+                or args.gate):
             sys.exit(1)
 
 

@@ -183,14 +183,21 @@ def bench_lines(report: dict) -> list[dict]:
                            ("blocks_refused", "nat_block_refused")):
             if r.get(key):
                 degraded[label] = r[key]
-        lines.append({
+        line = {
             "metric": "storm", "scenario": name,
             "ok": bool(r.get("ok", False)),
             "seed": r.get("seed"),
             "shed": dict(r.get("shed", {})),
             "degraded": degraded,
             "violations": dict(r.get("violations", {})),
-        })
+        }
+        # the SLO verdict (telemetry/slo.py check_budget) rides every
+        # storm bench line so the perf gate's consumers see WHICH stage
+        # blew its envelope, not just a boolean
+        if isinstance(r.get("budget"), dict):
+            line["slo"] = {"ok": bool(r["budget"].get("ok", False)),
+                           "breaches": list(r["budget"].get("breaches", ()))}
+        lines.append(line)
     return lines
 
 

@@ -53,10 +53,6 @@ tier-1 tests run reduced scales of the SAME code.
 
 from __future__ import annotations
 
-from dataclasses import dataclass
-
-import numpy as np
-
 from bng_tpu.chaos.faults import FaultPlan, FaultSpec, SimClock, SKEW, armed
 from bng_tpu.chaos.invariants import audit_invariants
 from bng_tpu.chaos.scenarios import (SERVER_IP, SERVER_MAC, _mac, _reply,
@@ -68,41 +64,14 @@ from bng_tpu.utils.net import ip_to_u32
 
 
 # ---------------------------------------------------------------------------
-# the stage budget: the scenario's latency checker
+# the stage budget: re-homed onto the SLO engine (telemetry/slo.py) so
+# storm budgets and production SLOs share one vocabulary and one
+# evaluator. Re-exported here because storms ARE the budget's main
+# author; verdict semantics are byte-identical to the PR-8 originals
+# (the verify-chaos bit-determinism gate pins that).
 # ---------------------------------------------------------------------------
 
-@dataclass(frozen=True)
-class BudgetLine:
-    """One stage envelope: the stage's mean lap, divided by `per` (the
-    units of work one lap covers — frames per batch for batch-scoped
-    stages), must stay under `limit_us`. `required` stages must have
-    samples at all: a storm whose instrumented stage recorded NOTHING
-    is a coverage hole, not a pass."""
-
-    stage: str
-    limit_us: float
-    per: float = 1.0
-    required: bool = True
-
-
-def check_budget(tracer, lines: tuple[BudgetLine, ...]) -> dict:
-    """Evaluate the envelope. Only deterministic facts reach the report:
-    the verdict and WHICH stages breached — measured values go to the
-    flight recorder / PERF_NOTES, never into the bit-compared bytes."""
-    bd = tracer.breakdown() if tracer is not None else {}
-    breaches = []
-    for ln in lines:
-        s = bd.get(ln.stage)
-        if s is None:
-            if ln.required:
-                breaches.append(f"{ln.stage}:missing")
-            continue
-        if s["mean_us"] / ln.per > ln.limit_us:
-            breaches.append(ln.stage)
-    if breaches:
-        tele.trigger("latency_excursion",
-                     f"storm budget breached: {sorted(breaches)}")
-    return {"ok": not breaches, "breaches": sorted(breaches)}
+from bng_tpu.telemetry.slo import BudgetLine, check_budget  # noqa: E402,F401
 
 
 class _traced:

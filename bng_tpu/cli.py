@@ -182,6 +182,17 @@ class BNGConfig:
     telemetry_enabled: bool = False
     trace_dir: str = ""  # "" -> $BNG_TRACE_DIR or <tmp>/bng-flightrec
     trace_budget_us: float = 0.0  # latency-excursion dump trigger; 0=off
+    # SLO engine (bng_tpu/telemetry/slo.py): live burn-rate evaluation
+    # of per-stage latency budgets over the armed tracer's histograms.
+    # Active only when telemetry is armed (no tracer -> nothing to
+    # evaluate); breach -> slo_breach flight dump + bng_slo_* families.
+    slo_enabled: bool = True
+    slo_window_s: float = 30.0  # burn-rate window length
+    slo_burn_windows: int = 2  # consecutive bad windows before a breach
+    # per-stage budget overrides, "stage:limit_us[:per]" (default:
+    # telemetry/slo.py DEFAULT_SLOS — envelopes 1-2 orders above the
+    # CPU-dev means, the paper's 50us target on the fenced device stage)
+    slo_budgets: list = dataclasses.field(default_factory=list)
     # metrics
     metrics_port: int = 9090
     metrics_enabled: bool = True
@@ -303,6 +314,24 @@ class BNGApp:
             self.log.info("telemetry armed",
                           trace_dir=recorder.cfg.out_dir or "(default)",
                           budget_us=self.config.trace_budget_us)
+            if self.config.slo_enabled:
+                # the SLO engine rides the armed tracer: rolling
+                # burn-rate windows over the stage histograms, ticked
+                # by the 1 Hz heartbeat; breach -> slo_breach flight
+                # dump + bng_slo_* (collect_slo at step 13)
+                from bng_tpu.telemetry import slo as slo_mod
+
+                budgets = (slo_mod.parse_budgets(
+                    list(self.config.slo_budgets))
+                    if self.config.slo_budgets else slo_mod.DEFAULT_SLOS)
+                self.components["slo"] = slo_mod.SLOMonitor(
+                    tracer, slos=budgets,
+                    window_s=self.config.slo_window_s,
+                    burn_windows=self.config.slo_burn_windows)
+                self.log.info("slo monitor armed",
+                              window_s=self.config.slo_window_s,
+                              burn_windows=self.config.slo_burn_windows,
+                              budgets=len(budgets))
 
         from bng_tpu.control import walledgarden as wg
         from bng_tpu.control.dhcp_server import DHCPServer
@@ -1288,6 +1317,12 @@ class BNGApp:
                 metrics.attach_telemetry(tele_tr)
                 collector.add_source(
                     lambda: metrics.collect_telemetry(tele_tr))
+            if "slo" in c:
+                slo_mon = c["slo"]
+                # burn-rate verdicts + configured budgets per stage:
+                # collect_slo reads one locked monitor snapshot
+                collector.add_source(
+                    lambda: metrics.collect_slo(slo_mon))
             if cfg.dns_enabled:
                 collector.add_source(lambda: metrics.collect_dns(
                     dns_srv.stats, resolver.stats()))
@@ -1700,6 +1735,16 @@ class BNGApp:
         if ckptr is not None:
             ckptr.tick(now)
 
+        # live SLO burn-rate window (telemetry/slo.py): evaluates only
+        # when a window elapsed; a breach fires the slo_breach flight
+        # dump and is logged here so the operator sees WHICH stage
+        slo_mon = c.get("slo")
+        if slo_mon is not None:
+            breached = slo_mon.tick(now)
+            if breached:
+                self.log.warning("slo breach", stages=sorted(breached),
+                                 window_s=slo_mon.window_s)
+
         # watermark-driven fleet elasticity: the autoscaler recommends,
         # the SAME resize verb the operator uses executes (already under
         # _ctl here — tick() took it)
@@ -1969,12 +2014,49 @@ def run_loadtest(args) -> int:
             fleet_snap = fleet.stats_snapshot()
             fleet.close()
 
+    stage_breakdown = tracer.breakdown() if tracer is not None else {}
+    if tracer is not None:
+        # SLO verdict over the per-stage breakdown (telemetry/slo.py):
+        # the same vocabulary the storm budgets and `bng run`'s live
+        # monitor gate on, persisted so the lines are gate-consumable
+        from bng_tpu.telemetry import slo as slo_mod
+
+        res.slo = slo_mod.evaluate(stage_breakdown)
+    if getattr(args, "bench_log", ""):
+        # schema'd ledger line (telemetry/ledger.py): stage_breakdown +
+        # SLO verdict + env fingerprint ride every loadtest run so
+        # `bng perf gate` can trend it like a bench line
+        from bng_tpu.telemetry import ledger as ledger_mod
+
+        try:
+            ledger_mod.append(args.bench_log, {
+                "metric": "loadtest req/s",
+                "value": round(res.rps, 1),
+                "unit": "req/s",
+                "scenario": res.scenario,
+                "batch": args.batch_size,
+                "subscribers": args.macs,
+                "workers": workers,
+                "program": res.program,
+                "latency_p99_us": round(res.latency_p99_us, 1),
+                "request_p99_us": res.request_p99_us,
+                "shed": res.shed,
+                "degraded": res.degraded,
+                # only present on traced runs: an empty dict would read
+                # as "instrumentation on, every stage vanished"
+                **({"slo": res.slo, "stage_breakdown": stage_breakdown}
+                   if tracer is not None else {}),
+                "env": ledger_mod.environment_fingerprint(),
+            })
+        except OSError as e:
+            print(f"loadtest: bench-log append failed: {e}",
+                  file=sys.stderr)
     if args.json_out:
         out = res.to_dict()
         if fleet is not None:
             out["fleet"] = fleet_snap
         if tracer is not None:
-            out["stage_breakdown"] = tracer.breakdown()
+            out["stage_breakdown"] = stage_breakdown
         print(json.dumps(out, indent=2))
     else:
         print(res.summary())
@@ -1985,9 +2067,11 @@ def run_loadtest(args) -> int:
                   f"{sum(adm['shed'].values())} shed")
         if tracer is not None:
             print("Stage breakdown (us):")
-            for stage, s in tracer.breakdown().items():
+            for stage, s in stage_breakdown.items():
                 print(f"  {stage:<12} p50 {s['p50_us']:>9.1f}   "
                       f"p99 {s['p99_us']:>9.1f}   n {s['count']}")
+            if not res.slo["ok"]:
+                print(f"SLO BREACHED: {', '.join(res.slo['breaches'])}")
     if args.validate:
         failures = res.meets_targets(cfg)
         for f in failures:
@@ -2303,20 +2387,79 @@ def run_chaos(args) -> int:
             f.write(text + "\n")
     if args.bench_log:
         # diffable per-scenario lines next to bench.py's results; the
-        # wallclock stamp lives only here, never in the compared report
-        import time as _time
+        # wallclock/run_id/schema stamp lives only in the appender
+        # (telemetry/ledger.py), never in the compared report bytes
+        from bng_tpu.telemetry import ledger as ledger_mod
 
         try:
-            with open(args.bench_log, "a") as f:
-                for line in bench_lines(report):
-                    f.write(json.dumps(
-                        {"ts": _time.strftime("%Y-%m-%dT%H:%M:%S"),
-                         **line}) + "\n")
+            for line in bench_lines(report):
+                ledger_mod.append(args.bench_log, line)
         except OSError as e:
             print(f"chaos run: bench-log append failed: {e}",
                   file=sys.stderr)
     print(text)
     return 0 if report["ok"] else 1
+
+
+def run_perf(args) -> int:
+    """`bng perf gate|import` — the perf-regression ledger verbs
+    (telemetry/ledger.py; no jax import, runs cold in milliseconds).
+
+    gate: robust per-stage trend regression detection for the newest
+    ledger line against its last-K COMPARABLE predecessors (same
+    metric + backend class + device kind + batch geometry — a
+    CPU-fallback run is never scored against a TPU cohort). rc contract:
+    0 clean / 1 regression (stderr names the stage) / 2 internal /
+    3 incomparable cohort.
+
+    import: one-shot normalizer migrating pre-schema bench_runs.jsonl
+    lines to the current schema (schema_version 0 tag, stable legacy
+    run_ids, best-effort env fingerprint from the `device` field)."""
+    from bng_tpu.telemetry import ledger as ledger_mod
+
+    path = args.ledger or ledger_mod.default_ledger_path()
+    if args.perf_cmd == "import":
+        try:
+            lines = ledger_mod.read(path)
+        except OSError as e:
+            print(f"perf import: cannot read {path}: {e}", file=sys.stderr)
+            return 2
+        migrated = ledger_mod.import_legacy(lines)
+        n_legacy = sum(1 for ln in migrated
+                       if ln.get("schema_version") == 0)
+        out_path = args.out
+        if args.in_place:
+            out_path = path
+            backup = path + ".bak"
+            import shutil
+
+            shutil.copyfile(path, backup)
+            print(f"perf import: backup at {backup}", file=sys.stderr)
+        if not out_path:
+            for ln in migrated:
+                print(json.dumps(ln))
+        else:
+            with open(out_path, "w") as f:
+                for ln in migrated:
+                    f.write(json.dumps(ln) + "\n")
+        print(f"perf import: {len(migrated)} lines "
+              f"({n_legacy} tagged schema_version 0)"
+              + (f" -> {out_path}" if out_path else " -> stdout"),
+              file=sys.stderr)
+        return 0
+
+    # gate
+    rep = ledger_mod.gate_file(
+        path, last_k=args.last_k, min_cohort=args.min_cohort,
+        include_legacy=not args.no_legacy, metric=args.metric)
+    if args.json_out:
+        print(json.dumps(rep.to_dict(), indent=2, sort_keys=True))
+    else:
+        print(rep.format_text())
+    if rep.regressions:
+        names = ", ".join(r["key"] for r in rep.regressions)
+        print(f"perf gate: REGRESSION in {names}", file=sys.stderr)
+    return rep.rc
 
 
 # ---------------------------------------------------------------------------
@@ -2401,6 +2544,11 @@ def main(argv: list[str] | None = None) -> int:
     loadp.add_argument("--trace", action="store_true",
                        help="arm the telemetry tracer for the run and "
                             "report the per-stage latency breakdown")
+    loadp.add_argument("--bench-log", default="",
+                       help="append a schema'd perf-ledger line (stage "
+                            "breakdown + SLO verdict + env fingerprint) "
+                            "to this jsonl file — gate with `bng perf "
+                            "gate --ledger FILE`")
 
     # telemetry subsystem (bng_tpu/telemetry)
     tracep = sub.add_parser("trace", help="telemetry: flight-recorder "
@@ -2514,6 +2662,44 @@ def main(argv: list[str] | None = None) -> int:
                      "+ delta replay + audited atomic flip (rollback on "
                      "failure)")
 
+    # perf ledger + regression gate (telemetry/ledger.py)
+    perfp = sub.add_parser(
+        "perf", help="perf-regression ledger over bench_runs.jsonl: "
+                     "schema import + per-stage trend gate")
+    perf_sub = perfp.add_subparsers(dest="perf_cmd", required=True)
+    pgate = perf_sub.add_parser(
+        "gate", help="gate the newest ledger line against its last-K "
+                     "comparable runs (median/MAD per stage); rc: 0 "
+                     "clean / 1 regression / 2 internal / 3 "
+                     "incomparable-cohort")
+    pgate.add_argument("--ledger", default="",
+                       help="ledger path (default $BNG_BENCH_LOG or the "
+                            "repo's bench_runs.jsonl)")
+    pgate.add_argument("--metric", default="",
+                       help="gate the newest line of this metric only")
+    pgate.add_argument("--last-k", type=int, default=8,
+                       help="cohort depth: compare against the last K "
+                            "comparable runs")
+    pgate.add_argument("--min-cohort", type=int, default=3,
+                       help="minimum comparable history before the "
+                            "trend gate claims anything")
+    pgate.add_argument("--no-legacy", action="store_true",
+                       help="exclude schema_version<1 (pre-schema) "
+                            "lines from cohorts")
+    pgate.add_argument("--json", action="store_true", dest="json_out")
+    pimp = perf_sub.add_parser(
+        "import", help="one-shot normalizer: migrate pre-schema ledger "
+                       "lines to the current schema (schema_version 0 "
+                       "tag, legacy run_ids, env from `device`)")
+    pimp.add_argument("--ledger", default="",
+                      help="ledger path (default $BNG_BENCH_LOG or the "
+                           "repo's bench_runs.jsonl)")
+    pimp.add_argument("--out", default="",
+                      help="write migrated lines here (default stdout)")
+    pimp.add_argument("--in-place", action="store_true",
+                      help="rewrite the ledger in place (backup at "
+                           "<ledger>.bak)")
+
     checkp = sub.add_parser(
         "check", help="bngcheck: dataplane-invariant static analyzer "
                       "(rc=1 on any non-baselined finding)")
@@ -2542,6 +2728,8 @@ def main(argv: list[str] | None = None) -> int:
         return run_ctl(args)
     if args.command == "trace":
         return run_trace(args)
+    if args.command == "perf":
+        return run_perf(args)
     if args.command in ("run", "stats"):
         app = BNGApp(_config_from_args(args))
         try:

@@ -459,6 +459,46 @@ class BNGMetrics:
             "bng_telemetry_records_dropped_total",
             "Batch records dropped because the open-slot pool was full")
         self._stage_latency_export = None  # attach_telemetry wires it
+        # SLO engine (telemetry/slo.py SLOMonitor): live burn-rate
+        # verdicts over the per-stage budgets. The budget gauge exports
+        # the configured line so dashboards draw target vs observed
+        # from one scrape.
+        lbl_stage = ("stage",)
+        self.slo_breaches = r.counter(
+            "bng_slo_breaches_total",
+            "Burn-rate SLO breaches by stage (slo_breach flight dumps "
+            "fire alongside)", lbl_stage)
+        self.slo_burning = r.gauge(
+            "bng_slo_burning_windows",
+            "Consecutive over-budget windows per stage (resets on a "
+            "healthy window)", lbl_stage)
+        self.slo_window_p99 = r.gauge(
+            "bng_slo_window_p99_us",
+            "Windowed p99 per stage from the live SLO monitor",
+            lbl_stage)
+        self.slo_budget = r.gauge(
+            "bng_slo_budget_us",
+            "Configured per-stage p99 budget (amortized by the spec's "
+            "per divisor)", lbl_stage)
+        self.slo_ok = r.gauge(
+            "bng_slo_ok", "1 while no stage is burning its SLO budget")
+        # sharded-path telemetry (parallel/sharded.py ShardTelemetry):
+        # per-shard verdict/punt counters + per-shard stage p99s — the
+        # observability the 8-chip serving-path promotion gates on
+        self.shard_frames = r.counter(
+            "bng_shard_frames_total",
+            "Real frames processed per shard by verdict",
+            ("shard", "verdict"))
+        self.shard_nat_punts = r.counter(
+            "bng_shard_nat_punts_total",
+            "NAT egress-miss punts per shard", ("shard",))
+        self.shard_psum_hits = r.counter(
+            "bng_shard_psum_dhcp_hits_total",
+            "DHCP fast-path hits psum-reduced over the mesh")
+        self.shard_stage_p99 = r.gauge(
+            "bng_shard_stage_p99_us",
+            "Per-shard stage p99 from the sharded-path histograms",
+            ("shard", "stage"))
 
     # -- telemetry (bng_tpu/telemetry) ----------------------------------
 
@@ -480,6 +520,37 @@ class BNGMetrics:
         if rec is not None:
             for reason, n in rec.triggers.items():
                 self.flight_dumps.set_total(n, reason=reason)
+
+    def collect_slo(self, monitor) -> None:
+        """Live SLO monitor (telemetry/slo.py) -> bng_slo_* families.
+        Reads one locked snapshot — never monitor internals — so the
+        scrape thread can never observe a half-evaluated window."""
+        snap = monitor.snapshot()
+        self.slo_ok.set(1.0 if snap["ok"] else 0.0)
+        for stage, limit in snap["budgets_us"].items():
+            self.slo_budget.set(limit, stage=stage)
+        for stage, n in snap["breaches"].items():
+            self.slo_breaches.set_total(n, stage=stage)
+        for stage, n in snap["burning"].items():
+            self.slo_burning.set(n, stage=stage)
+        for stage, p99 in snap["window_p99_us"].items():
+            self.slo_window_p99.set(p99, stage=stage)
+
+    def collect_sharded(self, cluster) -> None:
+        """Sharded-path telemetry (parallel/sharded.py ShardTelemetry)
+        -> bng_shard_* families: per-shard verdict/punt counters + the
+        per-shard stage p99s, from one snapshot."""
+        snap = cluster.telemetry.snapshot()
+        self.shard_psum_hits.set_total(snap["psum_dhcp_hits"])
+        for i, sh in enumerate(snap["per_shard"]):
+            shard = str(i)
+            for verdict, n in sh["verdicts"].items():
+                self.shard_frames.set_total(n, shard=shard,
+                                            verdict=verdict)
+            self.shard_nat_punts.set_total(sh["nat_punts"], shard=shard)
+            for stage, s in sh["stages"].items():
+                self.shard_stage_p99.set(s["p99_us"], shard=shard,
+                                         stage=stage)
 
     # -- collection (metrics.go:555-623) -------------------------------
 

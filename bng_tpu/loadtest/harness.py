@@ -109,6 +109,11 @@ class BenchmarkResult:
     # nat_block / nat_port ... exhaustion): the server stayed up and
     # answered what it could; these count what it could NOT
     degraded: dict = dataclasses.field(default_factory=dict)
+    # per-stage SLO verdict (telemetry/slo.py evaluate over the armed
+    # tracer's breakdown): {"ok": bool, "breaches": [stage...]} — empty
+    # when the run was untraced. Rides to_dict so loadtest JSON and
+    # --bench-log ledger lines are perf-gate-consumable.
+    slo: dict = dataclasses.field(default_factory=dict)
 
     def meets_targets(self, cfg: BenchmarkConfig) -> list[str]:
         """Returns failed-target descriptions (empty == pass), the

@@ -31,6 +31,7 @@ arrays with a leading mesh dimension.
 from __future__ import annotations
 
 import functools
+import time
 from typing import NamedTuple
 
 import numpy as np
@@ -178,6 +179,134 @@ def _sharded_dhcp_jit(mesh: Mesh, geom: PipelineGeom, n: int):
     return jax.jit(sharded, donate_argnums=(0,))
 
 
+class ShardTelemetry:
+    """Per-shard stage histograms + verdict/punt counters — the
+    observability prerequisite for promoting the 8-chip dryrun to the
+    serving path (ROADMAP [scale]).
+
+    The sharded step is ONE program over the mesh, so host-visible
+    per-shard latency attribution has exactly two honest quantities:
+    the host `dispatch` cost (device_put + drain + enqueue) and the
+    `device_wait` force — each recorded as one lap per step into every
+    shard's histogram that had real lanes in the batch (an idle shard
+    accumulates nothing; `total` = dispatch + wait). What DOES differ
+    per shard is the work: verdict counts (tx/fwd/drop/pass), NAT
+    egress-miss punts and antispoof violations are counted from each
+    shard's lane region of the batch. PASS lanes are a mixed class —
+    legitimate slow-path punts (DHCP misses answered by the host
+    server) AND wrong-shard punts (a data frame landing where its
+    chip-local state is not) both PASS, so `pass_total` is the upper
+    bound the wrong-shard rate lives under: growth beyond the expected
+    slow-path rate is steering drift. DHCP hits are psum-reduced ON
+    DEVICE (ops cross-shard answer) — the host folds the global
+    counter.
+
+    Histograms are telemetry/hist.py LatencyHists, so per-shard
+    distributions merge into a fleet-wide view by plain counter
+    addition — the same associative/commutative merge law the
+    slow-path fleet's worker histograms use (test-pinned).
+    """
+
+    STAGES = ("dispatch", "device_wait", "total")
+    VERDICT_NAMES = ("pass", "drop", "tx", "fwd")
+
+    def __init__(self, n_shards: int, batch_per_shard: int):
+        from bng_tpu.telemetry.hist import LatencyHist
+
+        self.n = n_shards
+        self.b = batch_per_shard
+        self.hists = [{s: LatencyHist() for s in self.STAGES}
+                      for _ in range(n_shards)]
+        self.frames = np.zeros((n_shards,), dtype=np.int64)
+        self.verdicts = np.zeros((n_shards, 4), dtype=np.int64)
+        self.nat_punts = np.zeros((n_shards,), dtype=np.int64)
+        self.violations = np.zeros((n_shards,), dtype=np.int64)
+        self.dhcp_replies = np.zeros((n_shards,), dtype=np.int64)
+        self.psum_dhcp_hits = 0
+        self.steps = 0
+
+    def _active(self, length) -> np.ndarray:
+        real = (np.asarray(length) > 0).reshape(self.n, self.b)
+        self.frames += real.sum(axis=1)
+        return real
+
+    def _lap(self, shard_active: np.ndarray, dispatch_us: float,
+             wait_us: float) -> None:
+        for i in np.nonzero(shard_active)[0]:
+            h = self.hists[int(i)]
+            h["dispatch"].record(dispatch_us)
+            h["device_wait"].record(wait_us)
+            h["total"].record(dispatch_us + wait_us)
+        self.steps += 1
+
+    def record_fused(self, length, verdict, nat_punt, viol,
+                     dhcp_hits: int, dispatch_us: float,
+                     wait_us: float) -> None:
+        real = self._active(length)
+        v = np.asarray(verdict).reshape(self.n, self.b)
+        for k in range(4):
+            self.verdicts[:, k] += ((v == k) & real).sum(axis=1)
+        if nat_punt is not None:
+            self.nat_punts += (np.asarray(nat_punt).reshape(self.n, self.b)
+                               & real).sum(axis=1)
+        if viol is not None:
+            self.violations += (np.asarray(viol).reshape(self.n, self.b)
+                                & real).sum(axis=1)
+        self.psum_dhcp_hits += int(dhcp_hits)
+        self._lap(real.any(axis=1), dispatch_us, wait_us)
+
+    def record_dhcp(self, length, is_reply, dhcp_hits: int,
+                    dispatch_us: float, wait_us: float) -> None:
+        real = self._active(length)
+        rep = np.asarray(is_reply).reshape(self.n, self.b) & real
+        self.dhcp_replies += rep.sum(axis=1)
+        self.verdicts[:, 2] += rep.sum(axis=1)  # replies TX
+        self.verdicts[:, 0] += (real & ~rep).sum(axis=1)  # misses punt
+        self.psum_dhcp_hits += int(dhcp_hits)
+        self._lap(real.any(axis=1), dispatch_us, wait_us)
+
+    def merged(self):
+        """Fold every shard's histograms into one per-stage view —
+        LatencyHist.merge (counter addition), the fleet's worker-
+        histogram discipline, so order never matters."""
+        from bng_tpu.telemetry.hist import LatencyHist
+
+        out = {s: LatencyHist() for s in self.STAGES}
+        for shard in self.hists:
+            for s in self.STAGES:
+                out[s].merge(shard[s])
+        return out
+
+    def snapshot(self) -> dict:
+        """The MULTICHIP JSON / metrics payload: per-shard stage
+        summaries + counters, the merged view, and the psum-reduced
+        global DHCP hit counter."""
+        per_shard = []
+        for i in range(self.n):
+            per_shard.append({
+                "frames": int(self.frames[i]),
+                "verdicts": {name: int(self.verdicts[i, k])
+                             for k, name in enumerate(self.VERDICT_NAMES)},
+                "nat_punts": int(self.nat_punts[i]),
+                "violations": int(self.violations[i]),
+                "dhcp_replies": int(self.dhcp_replies[i]),
+                "stages": {s: self.hists[i][s].summary()
+                           for s in self.STAGES if self.hists[i][s].n},
+            })
+        return {
+            "shards": self.n,
+            "steps": self.steps,
+            "psum_dhcp_hits": self.psum_dhcp_hits,
+            # upper bound on wrong-shard punts: PASS also covers
+            # legitimate slow-path punts (see class docstring)
+            "pass_total": int(self.verdicts[:, 0].sum()),
+            "nat_punt_total": int(self.nat_punts.sum()),
+            "per_shard": per_shard,
+            "merged_stages": {s: h.summary()
+                              for s, h in self.merged().items() if h.n},
+        }
+
+
 class ShardedCluster:
     """N-shard BNG over a 1D mesh. Control-plane writes route to owners."""
 
@@ -260,6 +389,13 @@ class ShardedCluster:
         from bng_tpu.utils.structlog import SlowPathErrorLog
 
         self._slow_err_log = SlowPathErrorLog("sharded")
+        # per-shard stage histograms + psum-hit/punt counters (merged
+        # like the fleet's worker histograms). dryrun_multichip stamps
+        # the snapshot into its MULTICHIP JSON; a composition root that
+        # owns a cluster AND a BNGMetrics exports it via
+        # BNGMetrics.collect_sharded (the serving-path promotion's
+        # scrape source — `bng run` has no cluster yet)
+        self.telemetry = ShardTelemetry(n_shards, batch_per_shard)
 
     # ---- owner routing (must match device shard_owner) ----
     def dhcp_sub_shard(self, mac) -> int:
@@ -570,14 +706,23 @@ class ShardedCluster:
         NAT/QoS/antispoof deltas stay queued for the next fused step.
         Returns {"is_reply", "out_pkt", "out_len", "dhcp_stats"}.
         """
+        from bng_tpu.ops.dhcp import ST_HIT
+
+        t0 = time.perf_counter()
         is_reply, out_pkt, out_len, stats = self._dispatch_dhcp(
             pkt, length, now_s)
-        return {
+        t1 = time.perf_counter()
+        out = {
             "is_reply": np.asarray(is_reply),
             "out_pkt": out_pkt,
             "out_len": np.asarray(out_len),
             "dhcp_stats": np.asarray(stats),
         }
+        t2 = time.perf_counter()
+        self.telemetry.record_dhcp(
+            length, out["is_reply"], int(out["dhcp_stats"][ST_HIT]),
+            (t1 - t0) * 1e6, (t2 - t1) * 1e6)
+        return out
 
     def process_ring(self, ring, now_s: int, now_us: int,
                      pkt_slot: int = 2048, slow_path=None,
@@ -690,6 +835,7 @@ class ShardedCluster:
 
         real = length > 0
         all_ctrl = bool(((flags[real] & FLAG_DHCP_CTRL) != 0).all())
+        t0 = time.perf_counter()
         if all_ctrl:  # the multichip OFFER-latency fast lane
             is_reply, out_pkt, out_len, stats = self._dispatch_dhcp(
                 pkt, length, now_s)
@@ -697,25 +843,36 @@ class ShardedCluster:
         else:
             out = ("fused", self._dispatch_fused(
                 pkt, length, (flags & 0x1) != 0, now_s, now_us))
-        return (ring, out, pkt, length, got, now_s)
+        dispatch_us = (time.perf_counter() - t0) * 1e6
+        return (ring, out, pkt, length, got, now_s, dispatch_us)
 
     def _retire(self, entry, slow_path, violation_sink) -> int:
         """Force a dispatched window's outputs and demux verdicts back to
         its ring (the sync half of the beat)."""
         if entry is None:
             return 0
+        from bng_tpu.ops.dhcp import ST_HIT
         from bng_tpu.runtime.ring import VERDICT_PASS, VERDICT_TX
 
-        ring, out, pkt, length, got, now_s = entry
+        ring, out, pkt, length, got, now_s, dispatch_us = entry
         B = self.n * self.b
         real = length > 0
+        t0 = time.perf_counter()
         if out[0] == "dhcp":
             _, is_reply, out_pkt, out_len, stats = out
-            verdict = np.where(np.asarray(is_reply), np.uint8(VERDICT_TX),
+            is_reply_h = np.asarray(is_reply)
+            verdict = np.where(is_reply_h, np.uint8(VERDICT_TX),
                                np.uint8(VERDICT_PASS))
             punt = np.zeros((B,), dtype=bool)
             viol = np.zeros((B,), dtype=bool)
-            self._fold_stats(dhcp=np.asarray(stats))
+            stats_h = np.asarray(stats)
+            self._fold_stats(dhcp=stats_h)
+            out_pkt_h = np.asarray(out_pkt)
+            out_len_h = np.asarray(out_len).astype(np.uint32)
+            wait_us = (time.perf_counter() - t0) * 1e6
+            self.telemetry.record_dhcp(length, is_reply_h,
+                                       int(stats_h[ST_HIT]),
+                                       dispatch_us, wait_us)
         else:
             (verdict_d, out_pkt, out_len, _tables, dhcp_stats, nat_stats,
              qos_stats, spoof_stats, nat_punt, viol_d, *tails) = out[1]
@@ -725,7 +882,8 @@ class ShardedCluster:
             verdict = np.asarray(verdict_d).astype(np.uint8)
             punt = np.asarray(nat_punt)
             viol = np.asarray(viol_d)
-            self._fold_stats(dhcp=np.asarray(dhcp_stats),
+            dhcp_h = np.asarray(dhcp_stats)
+            self._fold_stats(dhcp=dhcp_h,
                              nat=np.asarray(nat_stats),
                              qos=np.asarray(qos_stats),
                              spoof=np.asarray(spoof_stats),
@@ -733,8 +891,13 @@ class ShardedCluster:
                                      if g_stats is not None else None),
                              pppoe=(np.asarray(p_stats)
                                     if p_stats is not None else None))
-        ring.complete(verdict, np.asarray(out_pkt),
-                      np.asarray(out_len).astype(np.uint32), B)
+            out_pkt_h = np.asarray(out_pkt)
+            out_len_h = np.asarray(out_len).astype(np.uint32)
+            wait_us = (time.perf_counter() - t0) * 1e6
+            self.telemetry.record_fused(length, verdict, punt, viol,
+                                        int(dhcp_h[ST_HIT]),
+                                        dispatch_us, wait_us)
+        ring.complete(verdict, out_pkt_h, out_len_h, B)
 
         if violation_sink is not None:
             for lane in np.nonzero(viol)[0]:
@@ -798,13 +961,17 @@ class ShardedCluster:
         Returns (verdict, out_pkt, out_len, stats tuple...) — batch-sharded
         outputs are fetched to host.
         """
+        from bng_tpu.ops.dhcp import ST_HIT
+
+        t0 = time.perf_counter()
         out = self._dispatch_fused(pkt, length, from_access, now_s, now_us)
+        t1 = time.perf_counter()
         (verdict, out_pkt, out_len, _new_tables, dhcp_stats, nat_stats,
          qos_stats, spoof_stats, nat_punt, viol, *tails) = out
         tails = list(tails)
         garden_stats = [tails.pop(0)] if self.garden is not None else []
         pppoe_stats = [tails.pop(0)] if self.pppoe is not None else []
-        return {
+        res = {
             "verdict": np.asarray(verdict),
             "out_pkt": out_pkt,
             "out_len": np.asarray(out_len),
@@ -819,3 +986,9 @@ class ShardedCluster:
             **({"pppoe_stats": np.asarray(pppoe_stats[0])}
                if pppoe_stats else {}),
         }
+        t2 = time.perf_counter()
+        self.telemetry.record_fused(
+            length, res["verdict"], res["nat_punt"], res["violation"],
+            int(res["dhcp_stats"][ST_HIT]),
+            (t1 - t0) * 1e6, (t2 - t1) * 1e6)
+        return res

@@ -47,6 +47,23 @@ def _bucket_bounds(idx: int) -> tuple[float, float]:
     return float(lo), float(lo + width)
 
 
+def counts_percentile(counts: np.ndarray, q: float) -> float:
+    """q-th percentile (us, bucket-midpoint, <=12.5% rel. error) from a
+    raw bucket-count vector — the ONE rank/cumsum/midpoint core.
+    LatencyHist.percentile wraps it (adding the observed min/max
+    clamp); the SLO monitor's windowed p99 calls it directly on
+    bucket-count DELTAS, so the two can never drift apart."""
+    n = int(counts.sum())
+    if n == 0:
+        return 0.0
+    rank = q / 100.0 * (n - 1)
+    target = int(np.floor(rank)) + 1  # 1-based sample index
+    cum = np.cumsum(counts)
+    idx = int(np.searchsorted(cum, target))
+    lo, hi = _bucket_bounds(idx)
+    return (lo + hi) / 2.0 / 1000.0
+
+
 class LatencyHist:
     """One mergeable latency distribution. The public unit is
     MICROSECONDS (the stage-latency quantity); storage is ns buckets."""
@@ -100,12 +117,7 @@ class LatencyHist:
         """q-th percentile in us (bucket-midpoint; <=12.5% rel. error)."""
         if self.n == 0:
             return 0.0
-        rank = q / 100.0 * (self.n - 1)
-        target = int(np.floor(rank)) + 1  # 1-based sample index
-        cum = np.cumsum(self.counts)
-        idx = int(np.searchsorted(cum, target))
-        lo, hi = _bucket_bounds(idx)
-        mid_us = (lo + hi) / 2.0 / 1000.0
+        mid_us = counts_percentile(self.counts, q)
         # clamp into the observed range: midpoints can overshoot max
         return float(min(max(mid_us, self.min_us), self.max_us))
 

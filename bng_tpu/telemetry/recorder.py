@@ -42,6 +42,9 @@ TRIG_SHED = "shed_burst"
 TRIG_WORKER = "worker_death"
 TRIG_INVARIANT = "invariant_violation"
 TRIG_BACKEND = "backend_fallback"
+# an SLO burn-rate window (telemetry/slo.py SLOMonitor) or a storm
+# budget (slo.check_budget) crossed its per-stage latency budget
+TRIG_SLO = "slo_breach"
 
 
 def default_trace_dir() -> str:

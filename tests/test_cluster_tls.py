@@ -287,4 +287,7 @@ class TestETSITLSDelivery:
         assert sink.stats["delivered"] == 0
         lea.accepting = True  # collector recovers; NOBODY calls flush()
         assert wait_until(lambda: sink.stats["delivered"] == 1, timeout=5.0)
-        assert len(lea.pdus) == 1
+        # the sink counts `delivered` at socket write; the collector
+        # THREAD appends to pdus after its read — wait for that side
+        # too instead of racing it on a loaded host
+        assert wait_until(lambda: len(lea.pdus) == 1, timeout=5.0)
