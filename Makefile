@@ -63,6 +63,20 @@
 #                       (.bngcheck_cache.json). Part of `verify`: a PR
 #                       that violates a dataplane invariant fails here
 #                       before the test suite even starts.
+#   make verify-kernels — Pallas table-probe kernel gate (ISSUE 11):
+#                       the `kernels`-marked tests (interpret-mode
+#                       bit-exactness vs xla_lookup AND the host
+#                       mirror across every table geometry, impl
+#                       dispatch, HLO no-narrow-gather pins, the
+#                       sharded step under the kernel), the BNG014
+#                       narrow-gather lint, and `bench.py --autotune
+#                       --dry-run` (tiny CPU sweep to a temp ledger —
+#                       proves the sweep/ledger plumbing without
+#                       hardware). A prerequisite of `verify` (whose
+#                       tier-1 line deselects `kernels`; a bare
+#                       ROADMAP tier-1 run still includes them).
+#                       Mosaic lowering itself is TPU-gated
+#                       (runtime/verify.py, tpu_run.sh A/B step).
 #   make verify-sanitize — hotpath-marked engine/scheduler tests under
 #                       BNG_SANITIZE=1 (transfer_guard + debug_nans):
 #                       the dynamic cross-check of the static transfer
@@ -83,14 +97,30 @@ PYTEST_FLAGS = -q --continue-on-collection-errors -p no:cacheprovider \
 
 .PHONY: verify verify-slow verify-all verify-load verify-chaos \
         verify-telemetry verify-static verify-sanitize verify-ops \
-        verify-storm verify-perf
+        verify-storm verify-perf verify-kernels
 
-verify: verify-static verify-storm verify-perf
+verify: verify-static verify-storm verify-perf verify-kernels
 	set -o pipefail; rm -f /tmp/_t1.log; \
 	timeout -k 10 $(TIER1_TIMEOUT) env JAX_PLATFORMS=cpu \
 	$(PY) -m pytest tests/ $(PYTEST_FLAGS) \
-	-m 'not slow and not storm and not perf' \
+	-m 'not slow and not storm and not perf and not kernels' \
 	2>&1 | tee /tmp/_t1.log
+
+verify-kernels:
+	set -o pipefail; \
+	timeout -k 10 240 env JAX_PLATFORMS=cpu \
+	$(PY) -m pytest tests/ $(PYTEST_FLAGS) \
+	  -m 'kernels and not slow' \
+	&& timeout -k 10 30 $(PY) -m bng_tpu.analysis --select gather \
+	&& timeout -k 10 180 env JAX_PLATFORMS=cpu BNG_BENCH_PROBE_WINDOW=0 \
+	  BNG_BENCH_TIMEOUT=150 $(PY) bench.py --autotune --dry-run \
+	| $(PY) -c "import json,sys; \
+	r=json.loads([l for l in sys.stdin if l.startswith('{')][-1]); \
+	assert r['metric'] == 'autotune best point' and r['points'] >= 2, r; \
+	assert r['best']['table_impl'] in ('xla', 'pallas'), r; \
+	print('verify-kernels OK: best', r['best']['table_impl'], \
+	'B=%d' % r['best']['batch'], '%.3f Mpps' % r['value'])" \
+	&& echo "verify-kernels OK"
 
 verify-slow:
 	env JAX_PLATFORMS=cpu $(PY) -m pytest tests/ $(PYTEST_FLAGS) -m slow

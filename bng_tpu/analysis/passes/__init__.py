@@ -4,6 +4,7 @@ from __future__ import annotations
 
 from bng_tpu.analysis.passes.concurrency import ConcurrencyPass
 from bng_tpu.analysis.passes.fencing import FencingPass
+from bng_tpu.analysis.passes.gather import NarrowGatherPass
 from bng_tpu.analysis.passes.handlers import HandlerAuditPass
 from bng_tpu.analysis.passes.hotpath import HotPathPass
 from bng_tpu.analysis.passes.jit_discipline import JitDisciplinePass
@@ -12,7 +13,7 @@ from bng_tpu.analysis.passes.single_writer import SingleWriterPass
 
 ALL_PASSES = (HotPathPass, JitDisciplinePass, HandlerAuditPass,
               RegistryPass, SingleWriterPass, FencingPass,
-              ConcurrencyPass)
+              ConcurrencyPass, NarrowGatherPass)
 
 
 def all_codes() -> dict[str, str]:

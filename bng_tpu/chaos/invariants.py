@@ -522,8 +522,10 @@ def _audit_nat(report: AuditReport, nat) -> None:
         r_src = 0 if proto == PROTO_ICMP else dst_port
         rkey = nat._key(dst_ip, nat_ip, r_src, nat_port, proto)
         rv = nat.reverse.lookup(rkey)
+        # reverse rows are the 4 session-key words padded to the 8-word
+        # gather-fast shape — only the key words carry meaning
         if rv is None or not np.array_equal(
-                np.asarray(rv, dtype=np.uint32),
+                np.asarray(rv, dtype=np.uint32)[:4],
                 np.asarray(key, dtype=np.uint32)):
             report.add("nat-missing-reverse", _ip(src_ip),
                        f"session slot {int(s)} has no matching reverse row")

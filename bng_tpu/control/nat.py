@@ -38,6 +38,7 @@ from bng_tpu.ops.nat44 import (
     FLAG_PORT_PARITY,
     NATGeom,
     NATTables,
+    REVERSE_WORDS,
     SESSION_WORDS,
     SUBNAT_WORDS,
     SV_BYTES_IN,
@@ -120,7 +121,12 @@ class NATManager:
         log_sink: Callable[[NATLogEntry], None] | None = None,
     ):
         self.sessions = HostTable(sessions_nbuckets, key_words=4, val_words=SESSION_WORDS, stash=stash, name="nat_sessions")
-        self.reverse = HostTable(sessions_nbuckets, key_words=4, val_words=4, stash=stash, name="nat_reverse")
+        self.reverse = HostTable(sessions_nbuckets, key_words=4,
+                                 val_words=REVERSE_WORDS, stash=stash,
+                                 name="nat_reverse",
+                                 # pre-ISSUE-11 checkpoints carried bare
+                                 # 4-word key rows; live 8 is a pure pad
+                                 compat_val_pad_from=(4,))
         self.sub_nat = HostTable(sub_nat_nbuckets, key_words=1, val_words=SUBNAT_WORDS, stash=stash, name="subscriber_nat")
         self.hairpin = np.zeros((256,), dtype=np.uint32)
         self.alg = np.zeros((64,), dtype=np.uint32)
@@ -420,7 +426,9 @@ class NATManager:
                 [dst_ips, nat_ip,
                  ((r_src & 0xFFFF) << np.uint32(16)) | (nat_port & 0xFFFF),
                  protos], axis=1).astype(np.uint32)
-            self.reverse.bulk_insert(rkey[sel], skey[sel])
+            rrows = np.zeros((len(skey), REVERSE_WORDS), dtype=np.uint32)
+            rrows[:, :4] = skey
+            self.reverse.bulk_insert(rkey[sel], rrows[sel])
         return nat_ip, nat_port, ok
 
     def release_nat(self, private_ip: int, now: int = 0) -> bool:
@@ -542,7 +550,9 @@ class NATManager:
         # (parity: nat44.c:846-851 — ingress src_port=0, dst_port=id)
         r_src_port = 0 if proto == PROTO_ICMP else dst_port
         rkey = self._key(dst_ip, nat_ip, r_src_port, nat_port, proto)
-        self.reverse.insert(rkey, np.asarray(skey, dtype=np.uint32))
+        rrow = np.zeros((REVERSE_WORDS,), dtype=np.uint32)
+        rrow[:4] = skey
+        self.reverse.insert(rkey, rrow)
         self._log(LOG_SESSION_CREATE, block["subscriber_id"], src_ip, nat_ip,
                   src_port, nat_port, dst_ip, dst_port, proto, now,
                   flags=1 if is_hairpin else 0)

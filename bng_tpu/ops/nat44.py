@@ -46,6 +46,12 @@ from bng_tpu.ops.table import TableGeom, TableState, lookup
  SV_PKTS_OUT, SV_PKTS_IN, SV_BYTES_OUT, SV_BYTES_IN) = range(15)
 SESSION_WORDS = 16
 
+# reverse rows carry the 4 original-session key words, padded to the
+# 8-word gather-fast row shape (BNG014: <8-word value rows are the
+# PERF_NOTES §2 serialization class — the pad is free HBM, the narrow
+# gather was not)
+REVERSE_WORDS = 8
+
 # subscriber_nat value layout (parity: struct port_block, nat44.c:144-155)
 (BV_PUBLIC_IP, BV_PORT_START, BV_PORT_END, BV_NEXT_PORT, BV_IN_USE,
  BV_SUB_ID, BV_FLAGS) = range(7)
@@ -66,7 +72,7 @@ NAT_NSTATS = 11
 
 class NATTables(NamedTuple):
     sessions: TableState  # K=4, V=SESSION_WORDS
-    reverse: TableState  # K=4, V=4 (original key words)
+    reverse: TableState  # K=4, V=8 (original key words + gather pad)
     sub_nat: TableState  # K=1, V=SUBNAT_WORDS
     hairpin_ips: jax.Array  # [H] uint32 (0 = empty)
     alg_ports: jax.Array  # [A] uint32 (port<<16|proto; 0 = empty)

@@ -39,9 +39,10 @@ PPP_IPV4 = 0x0021
 PPP_IPV6 = 0x0057
 PPPOE_HDR = 8  # 6B PPPoE header + 2B PPP protocol
 
-# session table value words (device mirror of control.pppoe.PPPoESession)
+# session table value words (device mirror of control.pppoe.PPPoESession);
+# padded to the 8-word gather-fast row shape (BNG014 / PERF_NOTES §2)
 (PS_SESSION_ID, PS_MAC_HI, PS_MAC_LO, PS_IP, PS_FLAGS) = range(5)
-PPPOE_WORDS = 6
+PPPOE_WORDS = 8
 
 # stats
 (PST_DECAP, PST_ENCAP, PST_CTRL_PUNT, PST_BAD, PST_MISS) = range(5)

@@ -26,8 +26,11 @@ subscriber table (bpf/maps.h:10 MAX_SUBSCRIBERS) fits in 2^18 buckets x 4.
 
 from __future__ import annotations
 
-import numpy as np
+import contextlib
+import os
 from typing import NamedTuple
+
+import numpy as np
 
 import jax
 import jax.numpy as jnp
@@ -36,6 +39,75 @@ from bng_tpu.ops.hashing import SEED1, SEED2, hash_words, mix32
 
 WAYS = 4  # slots per bucket; one bucket = one contiguous gather
 MAX_KICKS = 128  # bounded cuckoo eviction walk (host side)
+
+# Probe implementation (the qos_kernel[sort|pallas] mold):
+#   "xla"    — the composed wide-gather cascade below (every backend)
+#   "pallas" — the fused probe kernel (ops.pallas_table); on CPU it runs
+#              in interpret mode (tests), on TPU compiled via Mosaic
+#   "auto"   — self-timed: bench.py races both post-compile and pins the
+#              winner (set_auto_choice); until resolved, pallas on TPU
+#              and xla elsewhere
+# Default from BNG_TABLE_IMPL; "xla" until the pallas path has been
+# timed on hardware (flip to "auto" once it wins — PERF_NOTES §13).
+TABLE_IMPL = os.environ.get("BNG_TABLE_IMPL", "xla")
+
+TABLE_IMPLS = ("xla", "pallas")
+
+# resolved "auto" winner (bench.py --autotune / _pick_table_impl)
+_AUTO_CHOICE: str | None = None
+
+# trace-time override stack: jitted-program factories (engine, sharded)
+# pin the impl PER COMPILED PROGRAM so one process can hold programs
+# traced under different impls (the A/B race) without global races
+_FORCED: list[str] = []
+
+
+def set_auto_choice(impl: str | None) -> None:
+    """Pin the winner of an auto self-timing race (None clears)."""
+    global _AUTO_CHOICE
+    if impl is not None and impl not in TABLE_IMPLS:
+        raise ValueError(f"unknown table impl {impl!r}")
+    _AUTO_CHOICE = impl
+
+
+@contextlib.contextmanager
+def forced_impl(impl: str):
+    """Trace-time impl pin — wrap the traced body, not the jit call."""
+    if impl not in TABLE_IMPLS:
+        raise ValueError(f"unknown table impl {impl!r}")
+    _FORCED.append(impl)
+    try:
+        yield
+    finally:
+        _FORCED.pop()
+
+
+def resolved_table_impl() -> str:
+    """The impl device_lookup dispatches to at trace time."""
+    if _FORCED:
+        return _FORCED[-1]
+    impl = TABLE_IMPL
+    if impl == "auto":
+        if _AUTO_CHOICE is not None:
+            return _AUTO_CHOICE
+        # Mosaic lowering is TPU-only; un-raced auto favors the kernel
+        # there and the known-good cascade everywhere else
+        return "pallas" if jax.default_backend() == "tpu" else "xla"
+    if impl not in TABLE_IMPLS:
+        raise ValueError(
+            f"BNG_TABLE_IMPL={impl!r}: expected one of "
+            f"{TABLE_IMPLS + ('auto',)}")
+    return impl
+
+
+def current_impl_label() -> str:
+    """Best-effort impl label for fingerprints/bench lines — never
+    raises and never triggers backend init beyond what is already up
+    (ledger.environment_fingerprint calls this via sys.modules)."""
+    try:
+        return resolved_table_impl()
+    except Exception:  # noqa: BLE001 — a bad env var must not sink a line
+        return TABLE_IMPL
 
 
 def way_stride(key_words: int) -> int:
@@ -216,6 +288,24 @@ def sharded_lookup(state: TableState, query: jax.Array, g: TableGeom) -> LookupR
 
 
 def device_lookup(state: TableState, query: jax.Array, nbuckets: int, stash: int) -> LookupResult:
+    """Impl-dispatched batched probe (every hot-path kernel funnels
+    here: DHCP 3-tier chain, NAT44 forward/reverse, antispoof, garden,
+    PPPoE, and the sharded step's local probe).
+
+    Resolution happens at TRACE time (resolved_table_impl): the fused
+    Pallas kernel when selected, else the XLA wide-gather cascade.
+    Both are bit-identical (tests/test_pallas_table.py pins it).
+
+    query: [B, K] uint32 key words.
+    """
+    if resolved_table_impl() == "pallas":
+        from bng_tpu.ops.pallas_table import pallas_lookup
+
+        return pallas_lookup(state, query, nbuckets, stash)
+    return xla_lookup(state, query, nbuckets, stash)
+
+
+def xla_lookup(state: TableState, query: jax.Array, nbuckets: int, stash: int) -> LookupResult:
     """Branch-free batched lookup: 2 wide bucket-row gathers + stash
     broadcast + 1 value-row gather — no narrow gathers anywhere.
 
@@ -271,7 +361,9 @@ class HostTable:
     (loader.go:352-442) re-hosted: map writes become HBM scatters.
     """
 
-    def __init__(self, nbuckets: int, key_words: int, val_words: int, stash: int = 64, name: str = ""):
+    def __init__(self, nbuckets: int, key_words: int, val_words: int,
+                 stash: int = 64, name: str = "",
+                 compat_val_pad_from: tuple[int, ...] = ()):
         if nbuckets & (nbuckets - 1):
             raise ValueError("nbuckets must be a power of two")
         self.nbuckets = nbuckets
@@ -280,6 +372,9 @@ class HostTable:
         self.V = val_words
         self.stash = stash
         self.name = name
+        # historical val_words this table's live layout is a PURE
+        # zero-pad of (checkpoint restore migration — see restore_arrays)
+        self.compat_val_pad_from = tuple(compat_val_pad_from)
         S = nbuckets * WAYS + stash
         self.S = S
         self.keys = np.zeros((S, key_words), dtype=np.uint32)
@@ -595,20 +690,45 @@ class HostTable:
         a silently reshaped table would corrupt every later probe).
         Abandons delta tracking like bulk_insert: the caller must follow
         with a full device upload (device_state / resync_tables).
-        Returns the restored row count."""
-        if geom != self.checkpoint_geom():
-            raise ValueError(
-                f"table {self.name!r}: checkpoint geometry {geom} != "
-                f"live geometry {self.checkpoint_geom()}")
+        Returns the restored row count.
+
+        One sanctioned mismatch: a checkpoint whose val_words appears in
+        `compat_val_pad_from` (a construction-time declaration that the
+        live width is a PURE zero-pad of that historical layout — the
+        ISSUE 11 row widenings) restores with the value rows
+        zero-padded, so warm restarts and HA failover survive the
+        upgrade instead of cold-starting away all session state. Any
+        other difference still rejects: only the declaring table knows
+        its old words kept their meaning."""
+        live = self.checkpoint_geom()
+        pad_vals_from = None
+        if geom != live:
+            narrow = dict(geom)
+            vw = narrow.pop("val_words", None)
+            wide = dict(live)
+            wide.pop("val_words")
+            if (narrow == wide and vw in self.compat_val_pad_from):
+                pad_vals_from = int(vw)
+            else:
+                raise ValueError(
+                    f"table {self.name!r}: checkpoint geometry {geom} != "
+                    f"live geometry {live}")
         for name, target in (("keys", self.keys), ("vals", self.vals),
                              ("used", self.used)):
             src = arrays[name]
-            if src.shape != target.shape or src.dtype != target.dtype:
+            expect = target.shape
+            if name == "vals" and pad_vals_from is not None:
+                expect = (target.shape[0], pad_vals_from)
+            if src.shape != expect or src.dtype != target.dtype:
                 raise ValueError(
                     f"table {self.name!r}: checkpoint array {name!r} is "
                     f"{src.dtype}{src.shape}, expected "
-                    f"{target.dtype}{target.shape}")
-            target[:] = src
+                    f"{target.dtype}{expect}")
+            if name == "vals" and pad_vals_from is not None:
+                target[:] = 0
+                target[:, :pad_vals_from] = src
+            else:
+                target[:] = src
         self.count = int(np.count_nonzero(self.used))
         self._dirty.clear()
         self._dirty_all = True

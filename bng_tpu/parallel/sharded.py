@@ -41,6 +41,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from bng_tpu.control.nat import NATManager
 from bng_tpu.ops.pipeline import PipelineGeom, PipelineTables, pipeline_step
+from bng_tpu.ops import table as table_mod
 from bng_tpu.ops.table import TableGeom, shard_owner
 from bng_tpu.runtime.engine import (AntispoofTables, GardenTables, QoSTables,
                                     _apply_all_updates)
@@ -101,7 +102,11 @@ def _sharded_geom(geom: PipelineGeom, n: int) -> PipelineGeom:
 
 
 @functools.lru_cache(maxsize=4)
-def _sharded_step_jit(mesh: Mesh, geom: PipelineGeom, n: int):
+def _sharded_step_jit(mesh: Mesh, geom: PipelineGeom, n: int,
+                      table_impl: str = "xla"):
+    """`table_impl` pins the device_lookup implementation (Pallas fused
+    probe vs XLA cascade — ops.table.forced_impl) for this compiled
+    mesh program, same discipline as Engine._pipeline_jit."""
     geom_sh = _sharded_geom(geom, n)
 
     has_garden = geom.garden is not None
@@ -114,7 +119,9 @@ def _sharded_step_jit(mesh: Mesh, geom: PipelineGeom, n: int):
         # host table deltas land here, inside the donated step — the
         # bpf_map_update_elem replacement, same as the single-chip Engine
         tables = _apply_all_updates(tables, upd)
-        res = pipeline_step(tables, pkt, length, fa, geom_sh, now_s, now_us)
+        with table_mod.forced_impl(table_impl):
+            res = pipeline_step(tables, pkt, length, fa, geom_sh,
+                                now_s, now_us)
         new_tables1 = jax.tree.map(lambda x: x[None], res.tables)
         # global stats over ICI (per-CPU map -> one counter)
         dhcp_stats = jax.lax.psum(res.dhcp_stats, AXIS)
@@ -146,7 +153,8 @@ def _sharded_step_jit(mesh: Mesh, geom: PipelineGeom, n: int):
 
 
 @functools.lru_cache(maxsize=4)
-def _sharded_dhcp_jit(mesh: Mesh, geom: PipelineGeom, n: int):
+def _sharded_dhcp_jit(mesh: Mesh, geom: PipelineGeom, n: int,
+                      table_impl: str = "xla"):
     """Sharded DHCP-only program — the multichip OFFER latency fast lane.
 
     Mirrors Engine._dhcp_jit (reference hook-order parity: the DHCP fast
@@ -165,8 +173,9 @@ def _sharded_dhcp_jit(mesh: Mesh, geom: PipelineGeom, n: int):
         dhcp = jax.tree.map(lambda x: x[0], dhcp1)
         upd = jax.tree.map(lambda x: x[0], upd1)
         dhcp = apply_fastpath_updates(dhcp, upd)
-        par = parse_batch(pkt, length)
-        res = dhcp_fastpath(pkt, length, par, dhcp, dhcp_geom, now_s)
+        with table_mod.forced_impl(table_impl):
+            par = parse_batch(pkt, length)
+            res = dhcp_fastpath(pkt, length, par, dhcp, dhcp_geom, now_s)
         return (jax.tree.map(lambda x: x[None], dhcp), res.is_reply,
                 res.out_pkt, res.out_len, jax.lax.psum(res.stats, AXIS))
 
@@ -375,8 +384,15 @@ class ShardedCluster:
             garden=self.garden[0].geom if garden_enabled else None,
             pppoe=self.pppoe[0].geom if pppoe_enabled else None,
         )
-        self._step = _sharded_step_jit(self.mesh, self.geom, self.n)
-        self._dhcp_step = _sharded_dhcp_jit(self.mesh, self.geom, self.n)
+        # table-probe impl resolved once at cluster construction (the
+        # Engine discipline); dryrun_multichip stamps it into the
+        # MULTICHIP-TELEMETRY line so a Pallas multichip artifact can
+        # never read as an XLA one
+        self.table_impl = table_mod.resolved_table_impl()
+        self._step = _sharded_step_jit(self.mesh, self.geom, self.n,
+                                       self.table_impl)
+        self._dhcp_step = _sharded_dhcp_jit(self.mesh, self.geom, self.n,
+                                            self.table_impl)
         self.tables = None  # lazily built on first step / sync()
         # ping-pong ring staging: the in-flight batch owns one buffer set
         # while the next assembles into the other (Engine._staging role)

@@ -297,10 +297,15 @@ class PPPoEFastPathTables:
     def __init__(self, nbuckets: int = 1 << 12, stash: int = 64,
                  update_slots: int = 128,
                  server_mac: bytes = b"\x02\xbb\x00\x00\x00\x01"):
+        # pre-ISSUE-11 checkpoints carried 6-word session rows; live 8 is
+        # a pure zero-pad (PS_* indices unchanged) — warm restarts keep
+        # working across the widening
         self.by_sid = HostTable(nbuckets, key_words=1, val_words=PPPOE_WORDS,
-                                stash=stash, name="pppoe_by_sid")
+                                stash=stash, name="pppoe_by_sid",
+                                compat_val_pad_from=(6,))
         self.by_ip = HostTable(nbuckets, key_words=1, val_words=PPPOE_WORDS,
-                               stash=stash, name="pppoe_by_ip")
+                               stash=stash, name="pppoe_by_ip",
+                               compat_val_pad_from=(6,))
         self.geom = TableGeom(nbuckets, stash)
         self.update_slots = update_slots
         # AC MAC, stamped as L2 source of every encapped downstream frame

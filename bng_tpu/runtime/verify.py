@@ -68,6 +68,61 @@ def _check_pallas_raw() -> None:
             ).lower(slot, vec).compile()
 
 
+def _rep_table_state(nbuckets: int = 1 << 10, K: int = 2, V: int = 8,
+                     stash: int = 64):
+    """Representative populated table (the dhcp sub-table shape)."""
+    from bng_tpu.ops.table import HostTable
+
+    t = HostTable(nbuckets, K, V, stash=stash, name="verify")
+    rng = np.random.default_rng(5)
+    keys = rng.integers(0, 2**32, size=(256, K), dtype=np.uint32)
+    for k in np.unique(keys, axis=0):
+        t.insert(k, np.arange(V, dtype=np.uint32))
+    q = jnp.asarray(keys[:256])
+    return t.device_state(), q, t.nbuckets, t.stash
+
+
+def _check_table(impl: str, interpret: bool | None = None) -> None:
+    """Compile the impl-dispatched probe (the surface every hot-path
+    kernel funnels through). impl='pallas', interpret=False forces real
+    Mosaic lowering — the TPU gate for the fused probe kernel."""
+    from bng_tpu.ops import table as table_mod
+
+    state, q, nb, stash = _rep_table_state()
+
+    def look(state, q):
+        with table_mod.forced_impl(impl):
+            if impl == "pallas" and interpret is not None:
+                from bng_tpu.ops.pallas_table import pallas_lookup
+
+                r = pallas_lookup(state, q, nb, stash, interpret=interpret)
+            else:
+                from bng_tpu.ops.table import device_lookup
+
+                r = device_lookup(state, q, nb, stash)
+        return r.found, r.slot, r.vals
+
+    _lower_compile(look, state, q)
+
+
+def _check_dhcp_express(impl: str) -> None:
+    """The express-lane OFFER program (donated chain + aliased packet
+    batch) under one table impl — the program the 50us target gates."""
+    from bng_tpu.runtime.engine import _dhcp_jit
+    from bng_tpu.runtime.tables import FastPathTables
+    from bng_tpu.utils.net import ip_to_u32
+
+    B, L = 64, 512
+    fp = FastPathTables(sub_nbuckets=1 << 10, vlan_nbuckets=256,
+                        cid_nbuckets=256, max_pools=4, stash=64)
+    fp.set_server_config(bytes.fromhex("02aabbccdd01"), ip_to_u32("10.0.0.1"))
+    step = _dhcp_jit(fp.geom, impl)
+    step.lower(fp.device_tables(), fp.make_updates(),
+               jnp.zeros((B, L), dtype=jnp.uint8),
+               jnp.zeros((B,), dtype=jnp.uint32),
+               np.uint32(1)).compile()
+
+
 def _check_pipeline() -> None:
     from bng_tpu.control.nat import NATManager
     from bng_tpu.ops.pipeline import PipelineGeom, PipelineTables, pipeline_step
@@ -130,6 +185,16 @@ CHECKS: list[tuple[str, Callable[[], None], bool]] = [
     ("qos_kernel[sort]", lambda: _check_qos("sort"), False),
     ("qos_kernel[pallas]", lambda: _check_qos("pallas"), True),
     ("pallas_seg_prefix_total", _check_pallas_raw, True),
+    # the impl-dispatched cuckoo probe (ISSUE 11): the interp variant
+    # exercises the Pallas harness on every backend; the compiled
+    # variant is the Mosaic gate for the fused probe kernel
+    ("table_lookup[xla]", lambda: _check_table("xla"), False),
+    ("table_lookup[pallas-interp]",
+     lambda: _check_table("pallas", interpret=True), False),
+    ("table_lookup[pallas]",
+     lambda: _check_table("pallas", interpret=False), True),
+    ("dhcp_express[xla]", lambda: _check_dhcp_express("xla"), False),
+    ("dhcp_express[pallas]", lambda: _check_dhcp_express("pallas"), True),
     ("fused_pipeline_step", _check_pipeline, False),
     ("sharded_step", _check_sharded, False),
 ]

@@ -71,8 +71,11 @@ HARD_CAP = 0.9
 HARD_CAP_VALUE = 0.45  # higher-is-better keys: a 2x slowdown halves value
 
 # geometry keys that define a cohort (present-only: legacy lines missing
-# a key match other lines missing it)
-GEOMETRY_KEYS = ("batch", "subscribers", "flows")
+# a key match other lines missing it). `depth` is the autotune sweep's
+# pipeline-depth knob — two points differing only in depth are different
+# operating points, not a trend (a depth-2 point gated against depth-8
+# history would read as a fabricated 2-4x regression).
+GEOMETRY_KEYS = ("batch", "subscribers", "flows", "depth")
 
 # headline keys gated besides per-stage p99s; direction by unit/name
 LOWER_BETTER_KEYS = ("offer_device_only_p99_us",)
@@ -99,6 +102,15 @@ def environment_fingerprint() -> dict:
             env["device_kind"] = (getattr(dev, "device_kind", "")
                                   or str(dev))
         except Exception:  # noqa: BLE001 — backend may be half-up
+            pass
+    # table-probe impl (xla | pallas): rides the fingerprint so Pallas
+    # and XLA runs are never silently compared (cohort_key keys on it).
+    # sys.modules only — importing ops.table here would drag jax in.
+    tbl = sys.modules.get("bng_tpu.ops.table")
+    if tbl is not None:
+        try:
+            env["table_impl"] = tbl.current_impl_label()
+        except Exception:  # noqa: BLE001 — fingerprint is best-effort
             pass
     return env
 
@@ -156,9 +168,27 @@ def geometry(line: dict) -> tuple:
                  if line.get(k) is not None)
 
 
+def table_impl(line: dict) -> str:
+    """Which table-probe implementation served the run (ISSUE 11): the
+    top-level stamp wins (bench records the resolved choice on every
+    line), then the env fingerprint. Legacy/unstamped lines predate the
+    Pallas kernel and are, by construction, `xla` — defaulting keeps
+    them one cohort instead of voiding all existing history.
+
+    Host-class lines (config-1 pure control-plane runs, no device) never
+    probe a device table, so their stamp is identity noise: a
+    BNG_TABLE_IMPL=pallas config-1 run must keep gating against its
+    host history, not void it behind an rc=3 refusal for a knob that
+    cannot affect the metric."""
+    if backend_class(line) == "host":
+        return "xla"
+    env = line.get("env") or {}
+    return str(line.get("table_impl") or env.get("table_impl") or "xla")
+
+
 def cohort_key(line: dict) -> tuple:
     return (line.get("metric"), backend_class(line), device_kind(line),
-            geometry(line))
+            table_impl(line), geometry(line))
 
 
 def _gateable(line: dict) -> bool:
@@ -391,25 +421,29 @@ def gate(lines: list[dict], last_k: int = 8, min_cohort: int = 3,
     cohort = [ln for ln in history if cohort_key(ln) == key][-last_k:]
     rep.cohort_n = len(cohort)
     if len(cohort) < min_cohort:
-        # ZERO same-backend history while same-metric/geometry history
-        # exists on a DIFFERENT backend is the cross-backend refusal
-        # class (a CPU-fallback run must never score against TPU runs).
-        # A merely YOUNG same-backend cohort (1..min_cohort-1 lines) is
-        # not: after a backend migration the trend gate passes
+        # ZERO same-cohort history while same-metric/geometry history
+        # exists on a DIFFERENT backend class or table impl is the
+        # cross-identity refusal class (a CPU-fallback run must never
+        # score against TPU runs; a Pallas run must never score against
+        # XLA history — the kernels are different programs). A merely
+        # YOUNG same-identity cohort (1..min_cohort-1 lines) is not:
+        # after a backend/impl migration the trend gate passes
         # vacuously while its new history accumulates.
         relaxed = [ln for ln in history
                    if ln.get("metric") == cand.get("metric")
                    and geometry(ln) == geometry(cand)
-                   and backend_class(ln) != backend_class(cand)]
+                   and (backend_class(ln) != backend_class(cand)
+                        or table_impl(ln) != table_impl(cand))]
         if not cohort and len(relaxed) >= min_cohort:
-            others = sorted({backend_class(ln) for ln in relaxed})
+            others = sorted({f"{backend_class(ln)}/{table_impl(ln)}"
+                             for ln in relaxed})
             rep.rc = GATE_INCOMPARABLE
             rep.notes.append(
-                f"candidate ran on backend {backend_class(cand)!r} "
-                f"(device {device_kind(cand) or 'none'!r}) with no "
-                f"same-backend history for this metric+geometry — the "
-                f"existing history is on {others}: refusing the "
-                f"cross-backend comparison")
+                f"candidate ran as {backend_class(cand)!r}/"
+                f"{table_impl(cand)!r} (device "
+                f"{device_kind(cand) or 'none'!r}) with no same-identity "
+                f"history for this metric+geometry — the existing history "
+                f"is on {others}: refusing the cross-identity comparison")
             return rep
         rep.notes.append(
             f"cohort too small (n={len(cohort)} < {min_cohort}): trend "

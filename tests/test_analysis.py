@@ -1041,6 +1041,133 @@ class TestBaseline:
             assert e["justification"] and "TODO" not in e["justification"], e
 
 
+class TestNarrowGatherPass:
+    """BNG014 (ISSUE 11): <8-word table/value rows — the PERF_NOTES §2
+    gather-serialization shape — are machine-checked, not folklore."""
+
+    TABLE_STUB = "WAYS = 4\n\n\nclass HostTable:\n    pass\n"
+
+    def test_narrow_val_words_literal_flagged(self, tmp_path):
+        write_tree(tmp_path, {
+            "bng_tpu/ops/table.py": self.TABLE_STUB,
+            "bng_tpu/control/newmap.py": """\
+from bng_tpu.ops.table import HostTable
+
+
+class Manager:
+    def __init__(self):
+        self.fwd = HostTable(1024, 4, val_words=16, name="wide_ok")
+        self.rev = HostTable(1024, key_words=4, val_words=4,
+                             name="narrow_rev")
+"""})
+        found = run_on(tmp_path, {"gather"})
+        assert codes_of(found) == {"BNG014"}
+        assert len(found) == 1
+        assert "narrow_rev" in found[0].detail
+
+    def test_narrow_val_words_via_constant_flagged(self, tmp_path):
+        """Widths resolve through module-level constants anywhere in
+        the scan set — the repo's *_WORDS convention."""
+        write_tree(tmp_path, {
+            "bng_tpu/ops/table.py": self.TABLE_STUB,
+            "bng_tpu/ops/widths.py": "SHORT_WORDS = 6\nLONG_WORDS = 8\n",
+            "bng_tpu/control/newmap.py": """\
+from bng_tpu.ops.table import HostTable
+from bng_tpu.ops.widths import LONG_WORDS, SHORT_WORDS
+
+t_ok = HostTable(64, 1, LONG_WORDS, name="padded")
+t_bad = HostTable(64, 1, SHORT_WORDS, name="short")
+"""})
+        found = run_on(tmp_path, {"gather"})
+        assert len(found) == 1 and found[0].code == "BNG014"
+        assert "short" in found[0].detail
+
+    def test_conflicting_constant_names_resolve_same_file_first(
+            self, tmp_path):
+        """A cross-module name collision must not silently mis-resolve a
+        width (the PR-9 collision lesson): the defining file's own value
+        wins, and a name with CONFLICTING foreign definitions is
+        unresolved — never first-scan-order-wins."""
+        write_tree(tmp_path, {
+            "bng_tpu/ops/table.py": self.TABLE_STUB,
+            # scan order puts this wide same-named constant FIRST
+            "bng_tpu/control/a_wide.py": "ROW_WORDS = 8\n",
+            "bng_tpu/control/narrowmap.py": """\
+from bng_tpu.ops.table import HostTable
+
+ROW_WORDS = 4
+
+t = HostTable(64, 1, ROW_WORDS, name="shadowed_narrow")
+"""})
+        found = run_on(tmp_path, {"gather"})
+        assert codes_of(found) == {"BNG014"}
+        assert "shadowed_narrow" in found[0].detail
+        # ambiguous foreign-only reference -> unresolved, not flagged
+        write_tree(tmp_path, {
+            "bng_tpu/control/narrowmap.py": """\
+from bng_tpu.ops.table import HostTable
+from bng_tpu.control.b_conflict import OTHER_WORDS
+
+t = HostTable(64, 1, OTHER_WORDS, name="ambiguous")
+""",
+            "bng_tpu/control/b_conflict.py": "OTHER_WORDS = 4\n",
+            "bng_tpu/control/c_conflict.py": "OTHER_WORDS = 8\n"})
+        assert run_on(tmp_path, {"gather"}) == []
+
+    def test_wide_tables_clean(self, tmp_path):
+        write_tree(tmp_path, {
+            "bng_tpu/ops/table.py": self.TABLE_STUB,
+            "bng_tpu/control/newmap.py": """\
+from bng_tpu.ops.table import HostTable
+
+t = HostTable(64, 2, val_words=8, name="fine")
+"""})
+        assert run_on(tmp_path, {"gather"}) == []
+
+    def test_device_narrow_array_gather_flagged(self, tmp_path):
+        """A fresh jnp array with <8-word literal rows gathered by a
+        computed index inside ops/ device code."""
+        write_tree(tmp_path, {"bng_tpu/ops/newkernel.py": """\
+import jax.numpy as jnp
+
+
+def kernel(slots):
+    scratch = jnp.zeros((1024, 4), dtype=jnp.uint32)
+    rows = scratch[slots]          # BNG014: 4-word rows, computed index
+    head = scratch[0]              # constant index: not a gather
+    window = scratch[2:6]          # slice: not a gather
+    wide = jnp.zeros((1024, 8), dtype=jnp.uint32)
+    ok = wide[slots]               # 8-word rows: fine
+    return rows, head, window, ok
+"""})
+        found = run_on(tmp_path, {"gather"})
+        assert codes_of(found) == {"BNG014"}
+        assert len(found) == 1 and found[0].detail == "scratch-rows-4"
+
+    def test_host_numpy_masks_not_flagged(self, tmp_path):
+        """HostTable.bulk_insert-style numpy boolean masking is host
+        code — it never reaches the TPU gather unit."""
+        write_tree(tmp_path, {"bng_tpu/ops/hostside.py": """\
+import numpy as np
+
+
+def place(used, idxs):
+    unplaced = np.ones((1024,), dtype=bool)
+    take = idxs[unplaced[idxs]]
+    unplaced[take] = False
+    return unplaced
+"""})
+        assert run_on(tmp_path, {"gather"}) == []
+
+    def test_missing_fact_source_is_loud(self, tmp_path):
+        """ops/table.py present but no HostTable construction anywhere:
+        the width facts are unextractable -> BNG990, never silence."""
+        write_tree(tmp_path, {
+            "bng_tpu/ops/table.py": "WAYS = 4\n"})
+        found = run_on(tmp_path, {"gather"})
+        assert codes_of(found) == {"BNG990"}
+
+
 # ---------------------------------------------------------------------------
 # the clean corpus + CLI (the acceptance gates)
 # ---------------------------------------------------------------------------
@@ -1071,10 +1198,10 @@ class TestCleanCorpus:
     def test_code_catalog_complete(self):
         codes = all_codes()
         for c in ("BNG001", "BNG002", "BNG003", "BNG010", "BNG011",
-                  "BNG012", "BNG020", "BNG021", "BNG030", "BNG031",
-                  "BNG032", "BNG033", "BNG034", "BNG035", "BNG040",
-                  "BNG041", "BNG050", "BNG060", "BNG061", "BNG062",
-                  "BNG063", "BNG064"):
+                  "BNG012", "BNG014", "BNG020", "BNG021", "BNG030",
+                  "BNG031", "BNG032", "BNG033", "BNG034", "BNG035",
+                  "BNG040", "BNG041", "BNG050", "BNG060", "BNG061",
+                  "BNG062", "BNG063", "BNG064"):
             assert c in codes, c
 
     def test_no_jax_import(self):

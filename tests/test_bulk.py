@@ -150,7 +150,8 @@ class TestNATBulk:
             assert v is not None and int(v[0]) == nip[i] and int(v[1]) == nport[i]
             rk = [int(dst[i]), int(nip[i]), (443 << 16) | int(nport[i]), 17]
             rv = m.reverse.lookup(rk)
-            assert rv is not None and list(rv) == skey
+            # key words lead the 8-word gather-fast reverse row
+            assert rv is not None and list(rv[:4]) == skey
         # external ports unique per (pub_ip, port)
         pairs = set(zip(nip.tolist(), nport.tolist()))
         assert len(pairs) == n

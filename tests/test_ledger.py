@@ -119,7 +119,93 @@ class TestCohorts:
         ledger.append(path, cpu)
         rep = ledger.gate_file(path)
         assert rep.rc == ledger.GATE_INCOMPARABLE
-        assert "refusing the cross-backend comparison" in rep.notes[0]
+        assert "refusing the cross-identity comparison" in rep.notes[0]
+
+    def test_pallas_never_scored_against_xla_history(self, tmp_path):
+        """ISSUE 11: the table-probe impl is cohort identity. A Pallas
+        candidate against an xla-only history (legacy lines default to
+        xla) is the rc=3 refusal, never a silent comparison."""
+        path = str(tmp_path / "ledger.jsonl")
+        for line in _cohort():
+            ledger.append(path, line)  # no table_impl stamp -> 'xla'
+        pallas = _tpu_line(9, scale=5.0)  # looks like a huge regression
+        pallas["table_impl"] = "pallas"
+        ledger.append(path, pallas)
+        rep = ledger.gate_file(path)
+        assert rep.rc == ledger.GATE_INCOMPARABLE
+        assert "'pallas'" in rep.notes[0]
+        assert "xla" in rep.notes[0]
+
+    def test_pallas_cohort_gates_within_itself(self, tmp_path):
+        """Once Pallas history exists, a regressed Pallas run is caught
+        against ITS cohort (and the xla lines never dilute it)."""
+        path = str(tmp_path / "ledger.jsonl")
+        for line in _cohort():
+            ledger.append(path, line)  # xla history at scale 1.0
+        for i in range(4):  # pallas cohort: 2x the xla throughput
+            ln = _tpu_line(20 + i, scale=2.0)
+            ln["table_impl"] = "pallas"
+            ledger.append(path, ln)
+        bad = _tpu_line(30, scale=1.1)  # ~45% below the pallas median,
+        bad["table_impl"] = "pallas"    # yet still above xla's history
+        ledger.append(path, bad)
+        rep = ledger.gate_file(path)
+        assert rep.rc == ledger.GATE_REGRESSION, rep.to_dict()
+
+    def test_autotune_depth_is_cohort_identity(self, tmp_path):
+        """Sweep points differing only in pipeline depth are different
+        operating points: a depth-2 point must not be trend-gated
+        against depth-8 history (a fabricated 2-4x 'regression')."""
+        path = str(tmp_path / "ledger.jsonl")
+        for i in range(4):  # depth-8 history: 4x the depth-2 throughput
+            ledger.append(path, {
+                "metric": "autotune sweep point", "value": 40.0,
+                "unit": "Mpps", "batch": 8192, "depth": 8,
+                "table_impl": "pallas",
+                "env": {"platform": "tpu", "device_kind": "TPU v5e"},
+                "device": "TPU v5e chip0"})
+        point = {"metric": "autotune sweep point", "value": 10.0,
+                 "unit": "Mpps", "batch": 8192, "depth": 2,
+                 "table_impl": "pallas",
+                 "env": {"platform": "tpu", "device_kind": "TPU v5e"},
+                 "device": "TPU v5e chip0"}
+        ledger.append(path, point)
+        rep = ledger.gate_file(path)
+        # different cohort (depth differs) -> vacuous pass, never rc=1/3
+        assert rep.rc == ledger.GATE_OK, rep.to_dict()
+        assert rep.cohort_n == 0
+
+    def test_host_class_lines_never_impl_split(self, tmp_path):
+        """A pure-host metric (config-1 control plane: no device, no
+        table probe) keeps ONE cohort whatever BNG_TABLE_IMPL said —
+        the stamp cannot affect the metric, so it must not void the
+        regression history behind an rc=3 refusal."""
+        path = str(tmp_path / "ledger.jsonl")
+        for i in range(4):
+            ledger.append(path, {
+                "metric": "DHCP slow-path req/s (config 1)",
+                "value": 50_000.0, "unit": "req/s",
+                "env": {"host": "h", "jaxlib": "0.4.37"}})
+        bad = {"metric": "DHCP slow-path req/s (config 1)",
+               "value": 20_000.0, "unit": "req/s",
+               "table_impl": "pallas",  # stamped, but host-class
+               "env": {"host": "h", "jaxlib": "0.4.37",
+                       "table_impl": "pallas"}}
+        ledger.append(path, bad)
+        assert ledger.backend_class(bad) == "host"
+        assert ledger.table_impl(bad) == "xla"
+        rep = ledger.gate_file(path)
+        assert rep.rc == ledger.GATE_REGRESSION, rep.to_dict()
+
+    def test_env_fingerprint_table_impl_reaches_cohort(self, tmp_path):
+        """The bench emitters stamp table_impl inside env too; either
+        spelling lands in the same cohort key."""
+        a = {"metric": "m", "value": 1.0, "unit": "Mpps", "batch": 64,
+             "device": "TPU v5e_0", "table_impl": "pallas"}
+        b = {"metric": "m", "value": 1.0, "unit": "Mpps", "batch": 64,
+             "device": "TPU v5e_0", "env": {"table_impl": "pallas"}}
+        assert ledger.cohort_key(a) == ledger.cohort_key(b)
+        assert ledger.table_impl({"metric": "m"}) == "xla"  # legacy default
 
     def test_young_same_backend_cohort_is_vacuous_not_refused(
             self, tmp_path):

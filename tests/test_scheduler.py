@@ -292,7 +292,8 @@ class TestUpdateDrainCadence:
     def test_bulk_drains_every_n_dispatches(self):
         engine, _, clock = build_stack(batch_size=8)
         sched = TieredScheduler(engine, SchedulerConfig(
-            bulk_batch=8, bulk_depth=2, drain_every=3), clock=clock)
+            bulk_batch=8, bulk_depth=2, drain_every=3,
+            overlap_drain=False), clock=clock)
         nat_calls = []
         orig = engine.nat.make_updates
         engine.nat.make_updates = lambda: (nat_calls.append(1), orig())[1]
@@ -304,8 +305,58 @@ class TestUpdateDrainCadence:
         # drains at bulk_seq 0, 3 — every third dispatch only
         assert len(nat_calls) == 2
         assert sched._drains_applied == 2
+        assert sched._drains_prefetched == 0
         # the no-drain steps reused the cached no-op scatter buffers
         assert engine.nat.sessions._empty_upd_cache
+
+    def test_overlap_drain_prefetches_next_scatter(self):
+        """overlap_drain (default): the drain-due step's scatter is built
+        right after the PREVIOUS dispatch (overlapping step N's device
+        execution), the in-dispatch cadence is unchanged, and a trailing
+        prefetch that no batch consumed reaches the device at flush —
+        never stranded (host dirty sets were already drained into it)."""
+        engine, _, clock = build_stack(batch_size=8)
+        sched = TieredScheduler(engine, SchedulerConfig(
+            bulk_batch=8, bulk_depth=2, drain_every=3), clock=clock)
+        nat_calls = []
+        orig = engine.nat.make_updates
+        engine.nat.make_updates = lambda: (nat_calls.append(1), orig())[1]
+        for i in range(6 * 8):
+            sched.submit(data_frame(i))
+        sched.poll()
+        sched.flush()
+        assert sched.bulk.stats.batches == 6
+        # builds: in-dispatch at seq 0, prefetched for seq 3 and seq 6;
+        # seq 6 never dispatched, so its batch applied at flush
+        assert len(nat_calls) == 3
+        assert sched._drains_prefetched == 2
+        assert sched._drains_applied == 3  # seq 0, seq 3, flush-applied
+        assert sched._prefetched_upd is None
+
+    def test_overlap_drain_flush_ships_pending_delta(self):
+        """A host write drained into a prefetched batch must be ON the
+        device after flush even when no later bulk batch ever runs —
+        the dangling-prefetch divergence hazard, pinned end-to-end."""
+        import numpy as np
+
+        engine, _, clock = build_stack(batch_size=8)
+        sched = TieredScheduler(engine, SchedulerConfig(
+            bulk_batch=8, bulk_depth=2, drain_every=1), clock=clock)
+        for i in range(8):
+            sched.submit(data_frame(i))
+        sched.poll()
+        sched.flush()  # drains consumed; a prefetched batch may linger
+        # new host delta -> consumed by the NEXT prefetch, no more frames
+        engine.qos.set_subscriber(ip_to_u32("10.9.9.9"), 8_000_000, 8_000_000)
+        for i in range(8):
+            sched.submit(data_frame(100 + i))
+        sched.poll()
+        sched.flush()
+        assert engine.qos.up.dirty_count() == 0  # drained somewhere...
+        slot = engine.qos.up._find(ip_to_u32("10.9.9.9"))
+        assert slot is not None
+        dev_row = np.asarray(engine.tables.qos_up.rows)[slot]
+        assert np.array_equal(dev_row, engine.qos.up.rows[slot])  # ...and on device
 
     def test_no_drain_steps_carry_live_dense_config(self):
         """The no-op batch must NOT snapshot the dense config arrays: the

@@ -52,7 +52,17 @@ step "config5-shard" 900  "python bench.py --config 5"
 # endpoints — VERDICT r4 item 8's no-throughput-cliff check vs the 100k
 # config-2 number. Build alone is ~75s host-side; budget accordingly.
 step "config2-4M"    1500 "BNG_BENCH_FLOWS=4000000 BNG_BENCH_EIM_SHARE=2 python bench.py --config 2"
-step "headline-1M"   2400 "BNG_BENCH_SUBS=1000000 BNG_BENCH_FLOWS=1000000 python bench.py"
+# Pallas-vs-XLA table-probe A/B (ISSUE 11): the same configs under both
+# impls, impl-keyed ledger cohorts (never silently compared, rc=3 gate),
+# then the stage-driven autotune sweep. The headline runs IMPL=auto so
+# the unattended round self-times both and ships the winner — the bench
+# line records the choice. Taint-marker semantics unchanged: a failed
+# step marks FAILED, the window keeps going.
+step "config3-ab-pallas" 900 "BNG_TABLE_IMPL=pallas python bench.py --config 3"
+step "config6-ab-pallas" 900 "BNG_TABLE_IMPL=pallas python bench.py --config 6"
+step "autotune"      1800 "BNG_TABLE_IMPL=auto python bench.py --autotune"
+step "headline-1M"   2400 "BNG_BENCH_SUBS=1000000 BNG_BENCH_FLOWS=1000000 BNG_TABLE_IMPL=auto python bench.py"
+step "headline-1M-xla" 2400 "BNG_BENCH_SUBS=1000000 BNG_BENCH_FLOWS=1000000 BNG_TABLE_IMPL=xla python bench.py"
 if [ "$FAILED" -ne 0 ]; then
   echo "DONE WITH FAILURES $(date -u +%H:%M:%S)" | tee -a "$LOG"; exit 1
 fi
