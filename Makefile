@@ -77,6 +77,18 @@
 #                       ROADMAP tier-1 run still includes them).
 #                       Mosaic lowering itself is TPU-gated
 #                       (runtime/verify.py, tpu_run.sh A/B step).
+#   make verify-sharded — the ICI-sharded SERVING path (ISSUE 12):
+#                       `sharded`-marked tests on the forced
+#                       8-host-device CPU mesh (< 60 s): steered-ring
+#                       missteer accounting (exact split from legit
+#                       slow-path punts), sharded checkpoint N->M and
+#                       N->1->N re-shard round-trips + reject paths,
+#                       sharded blue/green swap + crash-at-flip, the
+#                       composed `bng run --shards 2` DORA-and-renewal
+#                       end-to-end, and the ledger n_shards cohort
+#                       identity. A prerequisite of `verify` (whose
+#                       tier-1 line deselects `sharded`; a bare ROADMAP
+#                       tier-1 run still includes them).
 #   make verify-sanitize — hotpath-marked engine/scheduler tests under
 #                       BNG_SANITIZE=1 (transfer_guard + debug_nans):
 #                       the dynamic cross-check of the static transfer
@@ -97,14 +109,22 @@ PYTEST_FLAGS = -q --continue-on-collection-errors -p no:cacheprovider \
 
 .PHONY: verify verify-slow verify-all verify-load verify-chaos \
         verify-telemetry verify-static verify-sanitize verify-ops \
-        verify-storm verify-perf verify-kernels
+        verify-storm verify-perf verify-kernels verify-sharded
 
-verify: verify-static verify-storm verify-perf verify-kernels
+verify: verify-static verify-storm verify-perf verify-kernels verify-sharded
 	set -o pipefail; rm -f /tmp/_t1.log; \
 	timeout -k 10 $(TIER1_TIMEOUT) env JAX_PLATFORMS=cpu \
 	$(PY) -m pytest tests/ $(PYTEST_FLAGS) \
-	-m 'not slow and not storm and not perf and not kernels' \
+	-m 'not slow and not storm and not perf and not kernels and not sharded' \
 	2>&1 | tee /tmp/_t1.log
+
+verify-sharded:
+	set -o pipefail; \
+	timeout -k 10 90 env JAX_PLATFORMS=cpu \
+	XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+	$(PY) -m pytest tests/test_sharded_serving.py $(PYTEST_FLAGS) \
+	  -m 'sharded and not slow' \
+	&& echo "verify-sharded OK"
 
 verify-kernels:
 	set -o pipefail; \
@@ -132,13 +152,13 @@ verify-chaos:
 	timeout -k 10 180 env JAX_PLATFORMS=cpu \
 	$(PY) -m pytest tests/test_chaos.py $(PYTEST_FLAGS) -m 'chaos and not slow'
 	set -o pipefail; \
-	timeout -k 10 300 env JAX_PLATFORMS=cpu \
+	timeout -k 10 360 env JAX_PLATFORMS=cpu \
 	$(PY) -m bng_tpu.cli chaos run --seed 7 > /tmp/_chaos_a.json \
-	&& timeout -k 10 300 env JAX_PLATFORMS=cpu \
+	&& timeout -k 10 360 env JAX_PLATFORMS=cpu \
 	$(PY) -m bng_tpu.cli chaos run --seed 7 > /tmp/_chaos_b.json \
 	&& test -s /tmp/_chaos_a.json \
 	&& cmp /tmp/_chaos_a.json /tmp/_chaos_b.json \
-	&& echo "verify-chaos OK: report bit-deterministic (incl. the 3 \
+	&& echo "verify-chaos OK: report bit-deterministic (incl. the 4 \
 	transition scenarios + 5 full-scale storms)" \
 	|| { echo "verify-chaos FAILED: scenario failure or same-seed \
 	reports differ"; exit 1; }

@@ -1150,6 +1150,172 @@ def config5_sharded(on_tpu):
           hits_per_step=hit, compile_s=round(compile_s, 1))
 
 
+def sharded_serving_bench(on_tpu: bool, n_shards: int) -> None:
+    """`--shards N`: the SERVING-PATH aggregate headline (ISSUE 12).
+
+    Where config 5 feeds the sharded step raw host arrays, this drives
+    the promoted production loop end to end: a STEERED ring
+    (ShardedCluster.make_ring — owner-shard hash + NAT public-IP
+    ownership registered), ring-classified batches through
+    process_ring_pipelined with depth-2 windows in flight, a mixed
+    renewal-DISCOVER + NAT-data workload, and verdict demux back to the
+    ring. The aggregate Mpps therefore prices everything the paper's
+    ≥100 Mpps target has to pay on a real slice: ring assemble/steer,
+    host dispatch, the mesh step, retire + TX drain.
+
+    Ledger identity: `n_shards` rides every emitted line and the cohort
+    key (telemetry/ledger.py) so an aggregate 8-shard number can never
+    trend against single-device history. The per-shard stage breakdown
+    (merged ShardTelemetry histograms) lands in stage_breakdown for the
+    per-stage gate, and the run REFUSES to publish if any steered frame
+    misteered (missteer_total must be 0 on a ring this bench built)."""
+    import jax
+
+    from bng_tpu.parallel.sharded import ShardedCluster
+    from bng_tpu.utils.net import ip_to_u32
+
+    n_avail = len(jax.devices())
+    if n_avail < n_shards:
+        print(json.dumps(_order_line({
+            "metric": "Sharded serving Mpps (ring-steered)", "value": 0.0,
+            "unit": "Mpps", "vs_baseline": 0.0, "n_shards": n_shards,
+            "error": f"need {n_shards} devices, backend has {n_avail}",
+            **_DIAG})))
+        sys.exit(3)
+    now = 1_753_000_000
+    B_per = int(os.environ.get("BNG_BENCH_BATCH", 4096 if on_tpu else 64))
+    STEPS = int(os.environ.get("BNG_BENCH_STEPS", 100 if on_tpu else 8))
+    N = int(os.environ.get("BNG_BENCH_SUBS",
+                           1_000_000 if on_tpu else 2_000))
+    N_FLOWS = int(os.environ.get("BNG_BENCH_FLOWS", 10_000 if on_tpu
+                                 else 256))
+    sub_nb = 1 << max(10, (N * 2 // 4 // n_shards).bit_length())
+    _mark(f"sharded serving: {n_shards} shards x B={B_per}, {N} subs, "
+          f"{N_FLOWS} flows...")
+    # port blocks: each shard owns ONE public IP here, so the block
+    # width bounds flows/shard at (port_range / width) — size it for
+    # the flow count (the reference's CGNAT posture, not 1:1024)
+    ppsub = 1 << max(4, ((65535 - 1024) * n_shards
+                         // max(1, 2 * N_FLOWS)).bit_length() - 1)
+    cl = ShardedCluster(n_shards, batch_per_shard=B_per,
+                        sub_nbuckets=sub_nb,
+                        nat_sessions_nbuckets=max(256, sub_nb // 4),
+                        nat_ports_per_subscriber=min(1024, ppsub),
+                        qos_nbuckets=256, spoof_nbuckets=256,
+                        max_pools=64, garden_enabled=False)
+    cl.set_server_config_all(bytes.fromhex("02aabbccdd01"),
+                             ip_to_u32("10.0.0.1"))
+    n_pools = max(1, (N >> 16) + 1)
+    for pid in range(n_pools):
+        cl.add_pool_all(pid + 1, ip_to_u32(f"10.{pid}.0.0") & 0xFFFF0000,
+                        16, ip_to_u32("10.0.0.1"), lease_time=86400)
+    macs_u64 = np.arange(N, dtype=np.uint64) + 0x02B500000000
+    idx = np.arange(N, dtype=np.uint64)
+    sub_ips = ((10 << 24) + 2 + idx).astype(np.uint32)
+    cl.add_subscribers_bulk(
+        macs_u64, pool_ids=(idx >> np.uint64(16)).astype(np.uint32) + 1,
+        ips=sub_ips, lease_expiries=np.uint32(now + 86400))
+    # NAT flows on their owner shards (affinity placement): data lanes
+    # must FWD on device, never punt
+    ext_ip = ip_to_u32("93.184.216.34")
+    flow_subs = sub_ips[:N_FLOWS]
+    for ip in flow_subs:
+        cl.allocate_nat(int(ip), now)  # port block on the owner shard
+        _o, flow = cl.handle_new_flow(int(ip), ext_ip, 40000, 443, 17,
+                                      600, now)
+        assert flow is not None, f"NAT flow setup failed for {ip:#x}"
+    cl.sync_tables()
+
+    B = n_shards * cl.b
+    ring = cl.make_ring(nframes=1 << max(8, (4 * B).bit_length()),
+                        frame_size=2048, depth=max(1024, B_per))
+    rng = np.random.default_rng(13)
+    from bng_tpu.control import packets
+
+    # preassembled frame pool: half cached-renewal DISCOVERs (device
+    # DHCP hits -> TX), half established-flow data (NAT44 -> FWD); the
+    # ring classifies and steers each to its owner shard
+    POOL = max(256, 2 * B)
+    frames = []
+    for k in range(POOL):
+        if k % 2 == 0:
+            frames.append(_discover_row(
+                int(macs_u64[int(rng.integers(N))]), 0x4000 + k))
+        else:
+            src = int(flow_subs[int(rng.integers(len(flow_subs)))])
+            frames.append(packets.udp_packet(
+                (0x02B500000000 + (src - ((10 << 24) + 2))).to_bytes(6, "big"),
+                bytes.fromhex("02aabbccdd01"), src, ext_ip, 40000, 443,
+                b"d" * 400))
+
+    def _feed(n_frames: int) -> int:
+        fed = 0
+        for _ in range(n_frames):
+            if not ring.rx_push(frames[(_feed.i) % POOL],
+                                from_access=True):
+                break
+            _feed.i += 1
+            fed += 1
+        return fed
+
+    _feed.i = 0
+
+    def _drain_tx() -> int:
+        got = 0
+        while ring.tx_pop() is not None or ring.fwd_pop() is not None:
+            got += 1
+        return got
+
+    _mark(f"sharded serving: compiling mesh programs over {n_shards} "
+          f"device(s)...")
+    t_c = time.time()
+    _feed(B)
+    cl.process_ring_pipelined(ring, now, 0)
+    cl.flush_pipeline()
+    _drain_tx()
+    compile_s = time.time() - t_c
+
+    _mark(f"sharded serving: measuring {STEPS} pipelined windows...")
+    processed = 0
+    t0 = time.time()
+    for k in range(STEPS):
+        _feed(B)
+        processed += cl.process_ring_pipelined(
+            ring, now + k + 1, (k + 1) * 1000)
+        _drain_tx()
+    processed += cl.flush_pipeline()
+    _drain_tx()
+    dt = time.time() - t0
+    mpps = processed / dt / 1e6
+
+    snap = cl.telemetry.snapshot()
+    if snap["missteer_total"] != 0:
+        # a steered synthetic ring must place every frame on its owner:
+        # a missteer here is a steering bug, not a number to publish
+        print(json.dumps(_order_line({
+            "metric": "Sharded serving Mpps (ring-steered)", "value": 0.0,
+            "unit": "Mpps", "vs_baseline": 0.0, "n_shards": n_shards,
+            "error": f"{snap['missteer_total']} missteered frames on a "
+                     f"steered ring (steering bug — refusing to publish)",
+            "steering": {"missteer_total": snap["missteer_total"],
+                         "pass_total": snap["pass_total"]},
+            **_DIAG})))
+        sys.exit(2)
+    stage_breakdown = {s: {"p50_us": h["p50_us"], "p99_us": h["p99_us"],
+                           "count": h["count"]}
+                       for s, h in snap["merged_stages"].items()}
+    _emit("Sharded serving Mpps (ring-steered)", mpps, "Mpps",
+          12.5 * n_shards, devices=n_shards, n_shards=n_shards,
+          batch=B, subscribers=N, flows=N_FLOWS,
+          processed=processed, compile_s=round(compile_s, 1),
+          steering={"missteer_total": int(snap["missteer_total"]),
+                    "pass_total": int(snap["pass_total"]),
+                    "nat_punt_total": int(snap["nat_punt_total"]),
+                    "psum_dhcp_hits": int(snap["psum_dhcp_hits"])},
+          per_shard_frames=[sh["frames"] for sh in snap["per_shard"]],
+          stage_breakdown=stage_breakdown)
+
+
 def scheduler_bench(on_tpu: bool, checkpoint_interval_s: float = 0.0) -> None:
     """`--scheduler`: latency mode through the tiered scheduler.
 
@@ -1677,7 +1843,8 @@ def _child_dispatch(config: int, verify_lowering: bool = False,
                     checkpoint_interval_s: float = 0.0,
                     require_tpu: bool = False,
                     autotune: bool = False,
-                    autotune_dry_run: bool = False) -> None:
+                    autotune_dry_run: bool = False,
+                    shards: int = 0) -> None:
     """Run one benchmark config in this process (the supervised child)."""
     try:
         # environment fingerprint (device kind / jaxlib / hostname) on
@@ -1726,6 +1893,10 @@ def _child_dispatch(config: int, verify_lowering: bool = False,
             window_s=window,
             backoff=float(os.environ.get(
                 "BNG_BENCH_PROBE_BACKOFF", 1.6 if window > 0 else 1.0)),
+            # --shards on a chipless box: the CPU fallback mesh must be
+            # wide enough for the requested shard count (forced host
+            # devices, the tier-1 posture)
+            cpu_devices=max(8, shards),
         )
         on_tpu = platform not in ("cpu",)
         _mark(f"backend: {platform}" + (f" (fallback: {err})" if err else ""))
@@ -1772,6 +1943,12 @@ def _child_dispatch(config: int, verify_lowering: bool = False,
         cache_dir = enable_compilation_cache()
         if cache_dir:
             _mark(f"compilation cache: {cache_dir}")
+        if shards > 1:
+            # cohort identity: EVERY line this run emits (result or
+            # error) carries the shard count (ledger.n_shards keys on it)
+            _DIAG["n_shards"] = shards
+            sharded_serving_bench(on_tpu, shards)
+            return
         if autotune:
             autotune_mode(on_tpu, dry_run=autotune_dry_run)
             return
@@ -1966,6 +2143,13 @@ def main_dispatch() -> None:
     ap.add_argument("--dry-run", action="store_true",
                     help="with --autotune: tiny CPU-safe sweep to a temp "
                          "ledger (the make verify-kernels smoke)")
+    ap.add_argument("--shards", type=int, default=0,
+                    help="serving-path aggregate headline (ISSUE 12): "
+                         "drive the N-shard ShardedCluster through its "
+                         "steered ring loop (process_ring_pipelined) "
+                         "and publish aggregate Mpps with n_shards in "
+                         "the ledger cohort key; on CPU the mesh is "
+                         "forced host devices (tier-1 posture)")
     ap.add_argument("--require-tpu", action="store_true",
                     help="exit nonzero (rc=3) instead of publishing "
                          "CPU-fallback numbers — the CI headline gate")
@@ -1991,7 +2175,8 @@ def main_dispatch() -> None:
                         checkpoint_interval_s=args.checkpoint_interval_s,
                         require_tpu=args.require_tpu,
                         autotune=args.autotune,
-                        autotune_dry_run=args.dry_run)
+                        autotune_dry_run=args.dry_run,
+                        shards=args.shards)
         return
 
     # BNG_BENCH_TIMEOUT bounds the benchmark itself; the probe window is

@@ -759,6 +759,125 @@ def engine_swap_crash_rollback(seed: int) -> dict:
     return out_rep
 
 
+def sharded_swap_crash_rollback(seed: int) -> dict:
+    """The ICI-sharded serving path's swap discipline (ISSUE 12): DORA
+    through a 2-shard cluster's STEERED ring (ring-classified control
+    batches on the sharded DHCP fast lane, slow-path misses answered by
+    the host server writing rows to their OWNER shards), then (a) a
+    clean sharded blue/green swap — standby hydrated from the in-memory
+    sharded snapshot, partition-audited BEFORE the flip, renewals served
+    ON DEVICE by the standby with zero missteers; (b) a chaos crash at
+    the flip barrier (ops.swap fail) — the active cluster keeps serving,
+    untouched; (c) an io_error on the snapshot encode — failed before a
+    standby ever existed. Final cross-authority sharded audit clean."""
+    import numpy as np
+
+    from bng_tpu.control.dhcp_server import DHCPServer
+    from bng_tpu.parallel.sharded import ShardedCluster, ShardedFastPathSink
+    from bng_tpu.runtime.ops import sharded_blue_green_swap
+    from bng_tpu.utils.net import ip_to_u32, parse_mac
+
+    clock = SimClock()
+    server_mac = parse_mac("02:aa:bb:cc:dd:01")
+    server_ip = ip_to_u32("10.0.0.1")
+    cl = ShardedCluster(2, batch_per_shard=8, sub_nbuckets=64,
+                        vlan_nbuckets=64, cid_nbuckets=64,
+                        nat_sessions_nbuckets=64, qos_nbuckets=64,
+                        spoof_nbuckets=64, garden_enabled=False)
+    # resolver: post-swap DORA writes must land on the SERVING cluster
+    cl_ref = {"cluster": cl}
+    sink = ShardedFastPathSink(lambda: cl_ref["cluster"])
+    sink.set_server_config(server_mac, server_ip)
+    pools = _make_pools(sink)
+    server = DHCPServer(server_mac, server_ip, pools,
+                        fastpath_tables=sink, clock=clock)
+    ring = cl.make_ring(nframes=256, frame_size=2048, depth=64)
+
+    def _drive(frame: bytes) -> bytes | None:
+        """One frame through the steered ring; returns the reply frame
+        (device TX or slow-path inject), if any."""
+        assert ring.rx_push(frame, from_access=True)
+        cl_ref["cluster"].process_ring(ring, int(clock()), 0,
+                                       pkt_slot=2048,
+                                       slow_path=server.handle_frame)
+        got = ring.tx_pop()
+        return got[0] if got is not None else None
+
+    macs = [_mac((seed % 61) * 100 + i) for i in range(6)]
+    cl_ref.update(pools=pools, dhcp=server)
+    # DORA: DISCOVER punts to the host server (OFFER via TX inject),
+    # REQUEST binds the lease; the sink lands each row on its owner
+    for i, m in enumerate(macs):
+        offer = _drive(_discover(m, 0x800 + i))
+        assert offer is not None, "DORA discover went unanswered"
+        ack = _drive(_request(m, _reply(offer).yiaddr, 0x900 + i))
+        assert ack is not None and _reply(ack).msg_type == dhcp_codec.ACK
+
+    def _renew_on_device(i: int) -> bool:
+        """A cached DISCOVER must be answered BY THE MESH (verdict TX on
+        the sharded DHCP fast lane), proving the serving cluster's
+        device chain carries the subscriber rows."""
+        m = macs[i % len(macs)]
+        clock.advance(5.0)
+        tx_before = cl_ref["cluster"].telemetry.verdicts[:, 2].sum()
+        assert ring.rx_push(_discover(m, 0xA00 + i), from_access=True)
+        cl_ref["cluster"].process_ring(ring, int(clock()), 0,
+                                      pkt_slot=2048,
+                                      slow_path=server.handle_frame)
+        reply = ring.tx_pop()
+        on_dev = (cl_ref["cluster"].telemetry.verdicts[:, 2].sum()
+                  > tx_before)
+        return bool(reply is not None and on_dev)
+
+    out_rep: dict = {"name": "sharded_swap_crash_rollback", "seed": seed,
+                     "leased": len(server.leases),
+                     "renew_before_swap": _renew_on_device(0)}
+
+    # (a) clean swap
+    active = cl_ref["cluster"]
+    rep = sharded_blue_green_swap(cl_ref, clock=clock)
+    out_rep["swap_outcome"] = rep["outcome"]
+    out_rep["swap_audit_ok"] = rep.get("audit_ok", False)
+    out_rep["swapped_cluster"] = cl_ref["cluster"] is not active
+    out_rep["renew_after_swap"] = _renew_on_device(1)
+
+    # (b) crash at the flip barrier -> active keeps serving
+    active = cl_ref["cluster"]
+    plan = FaultPlan(seed, [FaultSpec("ops.swap", FAIL, at_hit=1)])
+    with armed(plan, log=False):
+        rep_b = sharded_blue_green_swap(cl_ref, clock=clock)
+    out_rep["crash_outcome"] = rep_b["outcome"]
+    out_rep["crash_kept_active"] = cl_ref["cluster"] is active
+    out_rep["renew_after_crash"] = _renew_on_device(2)
+
+    # (c) io_error on the snapshot encode
+    plan = FaultPlan(seed, [FaultSpec("ops.snapshot", IO_ERROR, at_hit=1)])
+    with armed(plan, log=False):
+        rep_c = sharded_blue_green_swap(cl_ref, clock=clock)
+    out_rep["snapshot_fault_outcome"] = rep_c["outcome"]
+    out_rep["renew_after_snapshot_fault"] = _renew_on_device(3)
+
+    audit = audit_invariants(cluster=cl_ref["cluster"], pools=pools,
+                             dhcp=server, check_roundtrip=False)
+    snap = cl_ref["cluster"].telemetry.snapshot()
+    out_rep["missteers"] = int(snap["missteer_total"])
+    out_rep["audit_ok"] = audit.ok
+    out_rep["violations"] = audit.violations_by_kind()
+    out_rep["ok"] = (out_rep["renew_before_swap"]
+                     and out_rep["swap_outcome"] == "ok"
+                     and out_rep["swap_audit_ok"]
+                     and out_rep["swapped_cluster"]
+                     and out_rep["renew_after_swap"]
+                     and out_rep["crash_outcome"] == "failed"
+                     and out_rep["crash_kept_active"]
+                     and out_rep["renew_after_crash"]
+                     and out_rep["snapshot_fault_outcome"] == "failed"
+                     and out_rep["renew_after_snapshot_fault"]
+                     and out_rep["missteers"] == 0
+                     and out_rep["audit_ok"])
+    return out_rep
+
+
 SCENARIOS = {
     "dora_worker_crash": dora_worker_crash,
     "corrupt_restore_cold_start": corrupt_restore_cold_start,
@@ -768,4 +887,5 @@ SCENARIOS = {
     "fleet_resize_under_kill": fleet_resize_under_kill,
     "rolling_restart_under_kill": rolling_restart_under_kill,
     "engine_swap_crash_rollback": engine_swap_crash_rollback,
+    "sharded_swap_crash_rollback": sharded_swap_crash_rollback,
 }

@@ -38,6 +38,18 @@ class BNGConfig:
     server_ip: str = "10.0.0.1"
     server_mac: str = "02:aa:bb:cc:dd:01"
     batch_size: int = 256
+    # ICI-sharded serving path (parallel/sharded.py, ISSUE 12): >1 makes
+    # `bng run` drive an N-shard ShardedCluster instead of the single-
+    # device Engine — tables hash-sharded over the mesh, the ring
+    # classifier steering popped batches to owner shards, checkpoints/
+    # blue-green swap/chaos audit all sharded-aware. On a machine with
+    # no accelerator the mesh is CPU-virtual (forced host device count,
+    # the tier-1 posture); set JAX_PLATFORMS=tpu to use real chips.
+    # batch_size is the AGGREGATE batch (split evenly across shards).
+    shards: int = 1
+    # per-shard table geometry for the sharded path (buckets per cuckoo
+    # table; sized for the per-shard subscriber slice)
+    shard_nbuckets: int = 1 << 10
     # latency-tiered scheduler (runtime/scheduler.py): express DHCP lane +
     # depth-pipelined bulk lane instead of the monolithic pipelined loop
     scheduler_enabled: bool = False
@@ -274,6 +286,10 @@ class BNGApp:
 
         self._ctl = _threading.Lock()
         self._syn_i = 0
+        # sharded serving: the per-beat slow-path handler (demux or the
+        # DHCP server) — the cluster takes it per call, unlike the
+        # engine which owns a reference
+        self._slow_path = None
         self.components: dict[str, object] = {}
         try:
             self._build()
@@ -350,8 +366,67 @@ class BNGApp:
         cfg = self.config
         c = self.components
 
-        # 1. device tables (the Loader.Load role, main.go:498-506)
-        fastpath = c["fastpath"] = FastPathTables()
+        # 1. device tables (the Loader.Load role, main.go:498-506).
+        # --shards N promotes the ICI-sharded dataplane to the serving
+        # path (ISSUE 12): an N-shard ShardedCluster replaces the
+        # single-device Engine, and every fast-path write routes to its
+        # owner shard through the ShardedFastPathSink facade. Features
+        # whose wiring is engine-specific degrade with a warning
+        # (tracked in sharded_blockers, exported like fleet_blockers).
+        self.sharded_blockers: list[str] = []
+        if cfg.shards > 1:
+            import os as _sh_os
+
+            if "tpu" not in _sh_os.environ.get("JAX_PLATFORMS", "").lower():
+                # CPU tier-1 posture: force the host-device mesh BEFORE
+                # any backend init (XLA_FLAGS
+                # --xla_force_host_platform_device_count)
+                from bng_tpu.utils.jaxenv import force_cpu
+
+                force_cpu(cfg.shards)
+            from bng_tpu.parallel.sharded import (ShardedCluster,
+                                                  ShardedFastPathSink)
+
+            self.sharded_blockers = [name for flag, name in (
+                (cfg.scheduler_enabled, "scheduler"),
+                (cfg.pppoe_enabled, "pppoe"),
+                (cfg.wire_if, "wire"),
+                (cfg.slowpath_workers > 1, "slowpath-fleet")) if flag]
+            if self.sharded_blockers:
+                # same posture as the fleet blockers: the sharded path
+                # serves, the engine-specific feature degrades LOUDLY
+                self.log.warning(
+                    "sharded serving: engine-specific features disabled",
+                    blockers=self.sharded_blockers, shards=cfg.shards)
+            pub_ips = [ip_to_u32(ip) for ip in cfg.nat_public_ips]
+            while len(pub_ips) < cfg.shards:
+                # each shard must own its public IPs exclusively
+                # (downstream ring steering is by-IP): extend the
+                # configured block consecutively
+                pub_ips.append((pub_ips[-1] + 1) if pub_ips
+                               else ip_to_u32("203.0.113.1") + len(pub_ips))
+            cluster = c["cluster"] = ShardedCluster(
+                cfg.shards,
+                batch_per_shard=max(8, cfg.batch_size // cfg.shards),
+                sub_nbuckets=cfg.shard_nbuckets,
+                vlan_nbuckets=max(64, cfg.shard_nbuckets // 4),
+                cid_nbuckets=max(64, cfg.shard_nbuckets // 4),
+                nat_sessions_nbuckets=cfg.shard_nbuckets,
+                qos_nbuckets=cfg.shard_nbuckets,
+                spoof_nbuckets=cfg.shard_nbuckets,
+                public_ips=pub_ips,
+                garden_enabled=cfg.walled_garden_enabled,
+                server_mac=parse_mac(cfg.server_mac))
+            # resolver, NOT the object: a blue/green swap replaces
+            # c["cluster"] and every later DHCP/pool write must follow
+            # the flip to the serving cluster
+            fastpath = c["fastpath_sink"] = ShardedFastPathSink(
+                lambda: c["cluster"])
+            self.log.info("sharded cluster built", shards=cfg.shards,
+                          batch_per_shard=cluster.b,
+                          nbuckets=cfg.shard_nbuckets)
+        else:
+            fastpath = c["fastpath"] = FastPathTables()
         fastpath.set_server_config(
             parse_mac(cfg.server_mac),
             ip_to_u32(cfg.server_ip))
@@ -554,20 +629,41 @@ class BNGApp:
                 return profile
 
         # 6. QoS (main.go:977-995)
-        qos = c["qos"] = QoSTables()
+        qos = None if cfg.shards > 1 else QoSTables()
+        if qos is not None:
+            c["qos"] = qos
         policies = c["policies"] = PolicyManager()
         qos_hook = None
         if cfg.qos_enabled:
-            def qos_hook(ip, policy_name):
-                p = policies.get(policy_name or cfg.default_policy)
-                if p is not None:
-                    qos.set_subscriber(ip, p.download_bps, p.upload_bps,
-                                       priority=p.priority)
+            if cfg.shards > 1:
+                # owner-shard routing: the policy row lands on the
+                # subscriber's affinity shard (the only shard the ring
+                # ever steers its traffic to)
+                def qos_hook(ip, policy_name):
+                    p = policies.get(policy_name or cfg.default_policy)
+                    if p is not None:
+                        c["cluster"].set_qos(
+                            ip, down_bps=p.download_bps,
+                            up_bps=p.upload_bps, priority=p.priority)
+            else:
+                def qos_hook(ip, policy_name):
+                    p = policies.get(policy_name or cfg.default_policy)
+                    if p is not None:
+                        qos.set_subscriber(ip, p.download_bps, p.upload_bps,
+                                           priority=p.priority)
 
-        # 7. NAT + compliance logger (main.go:1000-1060)
+        # 7. NAT + compliance logger (main.go:1000-1060). Sharded: NAT
+        # state is chip-local per shard inside the cluster (subscriber-
+        # affinity placement); the hook routes allocations to the owner.
+        # The per-event compliance logger is engine-wiring and degrades
+        # (documented in README "Sharded serving").
         nat = None
         nat_hook = None
-        if cfg.nat_enabled:
+        if cfg.shards > 1:
+            if cfg.nat_enabled:
+                def nat_hook(ip, now):
+                    c["cluster"].allocate_nat(ip, int(now))
+        elif cfg.nat_enabled:
             nat_logger = c["nat_logger"] = NATComplianceLogger(
                 NATLoggerConfig(file_path=cfg.nat_log_path,
                                 fmt=cfg.nat_log_format,
@@ -666,23 +762,29 @@ class BNGApp:
         # device-side garden gate compiles in only when the walled garden
         # is enabled (a disabled feature must cost zero per batch).
         garden_tables = None
-        if cfg.walled_garden_enabled:
+        if cfg.walled_garden_enabled and cfg.shards <= 1:
             from bng_tpu.runtime.engine import GardenTables
 
             garden_tables = GardenTables()
         pppoe_tables = None
-        if cfg.pppoe_enabled:
+        if cfg.pppoe_enabled and cfg.shards <= 1:
             from bng_tpu.runtime.tables import PPPoEFastPathTables
 
             pppoe_tables = c["pppoe_tables"] = PPPoEFastPathTables(
                 server_mac=parse_mac(cfg.server_mac))
-        c["engine"] = Engine(
-            fastpath=fastpath, nat=nat, qos=qos, antispoof=c["antispoof"],
-            garden=garden_tables, pppoe=pppoe_tables,
-            batch_size=cfg.batch_size, slow_path=dhcp.handle_frame,
-            clock=self.clock)
-        self.log.info("engine built", batch_size=cfg.batch_size,
-                      nat=cfg.nat_enabled, qos=cfg.qos_enabled)
+        if cfg.shards > 1:
+            # the cluster IS the dataplane: drive_once feeds its steered
+            # ring loop; the slow path is attached per beat (10b)
+            self._slow_path = dhcp.handle_frame
+        else:
+            c["engine"] = Engine(
+                fastpath=fastpath, nat=nat, qos=qos,
+                antispoof=c["antispoof"],
+                garden=garden_tables, pppoe=pppoe_tables,
+                batch_size=cfg.batch_size, slow_path=dhcp.handle_frame,
+                clock=self.clock)
+            self.log.info("engine built", batch_size=cfg.batch_size,
+                          nat=cfg.nat_enabled, qos=cfg.qos_enabled)
         if "telemetry" in c:
             import jax as _jax
 
@@ -694,7 +796,7 @@ class BNGApp:
         # 9a. latency-tiered scheduler over the engine's two programs
         # (express DHCP / depth-pipelined bulk) — opt-in; drive_once then
         # feeds it frame-wise instead of the monolithic pipelined step
-        if cfg.scheduler_enabled:
+        if cfg.scheduler_enabled and cfg.shards <= 1:
             from bng_tpu.runtime.scheduler import (SchedulerConfig,
                                                    TieredScheduler)
 
@@ -723,7 +825,25 @@ class BNGApp:
             from bng_tpu.utils.net import u32_to_ip
 
             garden = c["walledgarden"]
-            gt = c["engine"].garden
+            if cfg.shards > 1:
+                # owner-shard routing facade: membership lands on the
+                # subscriber's affinity shard, allowed destinations are
+                # policy (replicated to every shard). Resolves the live
+                # cluster per call so garden writes follow a swap.
+                class _ShardedGardenGate:
+                    def __init__(self, resolve):
+                        self._resolve = resolve
+
+                    def set_gardened(self, ip, gardened):
+                        self._resolve().set_gardened(ip, gardened)
+
+                    def allow_destination(self, ip, port=0, proto=0):
+                        self._resolve().allow_garden_destination(
+                            ip, port, proto)
+
+                gt = _ShardedGardenGate(lambda: c["cluster"])
+            else:
+                gt = c["engine"].garden
             resolver = c.get("dns_resolver")
             # allowed destinations (manager.go:95-103): the portal on ANY
             # TCP port (the DNS-redirect flow lands on the original URL's
@@ -900,7 +1020,10 @@ class BNGApp:
             demux = c["slowpath"] = SlowPathDemux(
                 dhcp=dhcp, dhcpv6=c.get("dhcpv6"), slaac=c.get("slaac"),
                 pppoe=c.get("pppoe"), clock=self.clock)
-            c["engine"].slow_path = demux
+            if cfg.shards > 1:
+                self._slow_path = demux
+            else:
+                c["engine"].slow_path = demux
 
         # 10b2. slow-path fleet: shard DHCPv4 across N shared-nothing
         # workers (control/fleet.py). Workers own per-worker lease
@@ -916,6 +1039,7 @@ class BNGApp:
             blockers = [name for flag, name in (
                 (cfg.radius_server, "radius"), (cfg.nexus_url, "nexus"),
                 (cfg.ha_role, "ha"), (cfg.pppoe_enabled, "pppoe"),
+                (cfg.shards > 1, "sharded"),
                 (cfg.peer_pool_cidr, "peer-pool")) if flag]
             if blockers:
                 # more than a log line: the degradation is exported as
@@ -1204,7 +1328,17 @@ class BNGApp:
         # role, loader.go:294-315). Always build the ring when a wire or
         # synthetic source is requested; the attach mode is whatever rung
         # the environment supports (zerocopy -> copy -> in-memory).
-        if cfg.wire_if or cfg.synthetic_subs:
+        if cfg.shards > 1 and (cfg.wire_if or cfg.synthetic_subs):
+            # sharded serving ring: built BY the cluster so the steering
+            # tables (NAT public-IP ownership, owner-shard hash) are
+            # registered — shard i's batch region holds shard i's
+            # subscribers and the common case never punts. AF_XDP attach
+            # is an engine-path feature for now (sharded_blockers).
+            ring = c["ring"] = c["cluster"].make_ring(frame_size=2048)
+            self._on_close(ring.close)
+            self._on_close(lambda: c["cluster"].flush_pipeline(
+                self._slow_path))
+        elif cfg.wire_if or cfg.synthetic_subs:
             from bng_tpu.runtime import xsk as xsk_mod
             from bng_tpu.runtime.ring import make_ring
 
@@ -1288,15 +1422,19 @@ class BNGApp:
         if cfg.metrics_enabled:
             metrics = c["metrics"] = BNGMetrics()
             collector = c["collector"] = MetricsCollector(metrics)
-            # engine sources read c["engine"] at scrape time, never a
-            # captured reference: a blue/green swap replaces the engine
-            # object mid-run and the dashboard must follow the flip
-            collector.add_source(
-                lambda: metrics.collect_engine(c["engine"].stats))
+            # engine/cluster sources read c[...] at scrape time, never a
+            # captured reference: a blue/green swap replaces the object
+            # mid-run and the dashboard must follow the flip
+            if cfg.shards > 1:
+                collector.add_source(
+                    lambda: metrics.collect_sharded(c["cluster"]))
+            else:
+                collector.add_source(
+                    lambda: metrics.collect_engine(c["engine"].stats))
             collector.add_source(lambda: metrics.collect_dhcp_server(dhcp.stats))
             if self.fleet_blockers:
                 metrics.record_fleet_blocked(self.fleet_blockers)
-            if cfg.walled_garden_enabled:
+            if cfg.walled_garden_enabled and cfg.shards <= 1:
                 collector.add_source(
                     lambda: metrics.collect_garden(c["engine"].stats))
             if "scheduler" in c:
@@ -1349,14 +1487,23 @@ class BNGApp:
 
             store = c["checkpoint_store"] = CheckpointStore(
                 cfg.checkpoint_dir)
-            engine = c["engine"]
             ha_sync = c.get("ha")
             if store.has_checkpoints():
                 try:
                     snap, path = store.load_latest()
-                    rows = ckpt_mod.restore_checkpoint(
-                        snap, engine=engine, dhcp=dhcp, ha=ha_sync,
-                        fleet=c.get("fleet"))
+                    if cfg.shards > 1:
+                        # sharded restore: slot-exact at matching
+                        # topology, owner-routed re-shard on N->M (the
+                        # fleet lease-book discipline); a single-engine
+                        # snapshot rejects to cold start
+                        rows = ckpt_mod.restore_sharded_checkpoint(
+                            snap, c["cluster"], dhcp=dhcp, ha=ha_sync,
+                            fleet=c.get("fleet"),
+                            now=int(self.clock()))
+                    else:
+                        rows = ckpt_mod.restore_checkpoint(
+                            snap, engine=c["engine"], dhcp=dhcp,
+                            ha=ha_sync, fleet=c.get("fleet"))
                     c["checkpoint_restored"] = rows
                     self.log.info("warm restart from checkpoint",
                                   path=str(path), seq=snap.seq,
@@ -1372,9 +1519,13 @@ class BNGApp:
                         c["metrics"].record_restore({}, outcome="rejected")
 
             def _snapshot(seq, now, _dhcp=dhcp, _ha=ha_sync):
-                # c["engine"] read at snapshot time: after a blue/green
-                # swap the checkpoint must fold device words from the
-                # SERVING engine's chain, not the retired one's
+                # c["engine"]/c["cluster"] read at snapshot time: after
+                # a blue/green swap the checkpoint must fold device
+                # words from the SERVING chain, not the retired one's
+                if cfg.shards > 1:
+                    return ckpt_mod.build_sharded_checkpoint(
+                        c["cluster"], seq, now, dhcp=_dhcp, ha=_ha,
+                        fleet=c.get("fleet"), node_id=cfg.node_id)
                 return ckpt_mod.build_checkpoint(
                     seq, now, engine=c["engine"],
                     scheduler=c.get("scheduler"), dhcp=_dhcp, ha=_ha,
@@ -1455,13 +1606,22 @@ class BNGApp:
     def engine_swap(self) -> dict:
         """Blue/green engine swap: hydrate a standby from an in-memory
         snapshot, replay the delta, audit, flip atomically — rollback on
-        any failure with the active untouched (runtime/ops.py)."""
-        from bng_tpu.runtime.ops import blue_green_swap
+        any failure with the active untouched (runtime/ops.py). On the
+        sharded serving path the standby is a ShardedCluster hydrated
+        from a sharded snapshot, partition-audited before the flip."""
+        from bng_tpu.runtime.ops import blue_green_swap, sharded_blue_green_swap
 
         with self._ctl:
-            report = blue_green_swap(
-                self.components, metrics=self.components.get("metrics"),
-                node_id=self.config.node_id)
+            if "cluster" in self.components:
+                report = sharded_blue_green_swap(
+                    self.components,
+                    metrics=self.components.get("metrics"),
+                    node_id=self.config.node_id, clock=self.clock)
+            else:
+                report = blue_green_swap(
+                    self.components,
+                    metrics=self.components.get("metrics"),
+                    node_id=self.config.node_id)
             self.log.info("engine swap", outcome=report.get("outcome"),
                           delta_rows=report.get("delta_rows"),
                           error=report.get("error"))
@@ -1537,8 +1697,18 @@ class BNGApp:
             pumped = att.xsk.pump()  # kernel -> ring before the step
         if self.config.synthetic_subs:
             self._push_synthetic(ring)
+        cluster = self.components.get("cluster")
         sched = self.components.get("scheduler")
-        if sched is not None and hasattr(ring, "rx_pop"):
+        if cluster is not None:
+            # the promoted serving path: double-buffered sharded ring
+            # loop — ring-steered owner-shard batches, depth-2 windows
+            # in flight, slow-path punts handled lane-aligned
+            now = self.clock()
+            with self._ctl:
+                moved = self.components["cluster"].process_ring_pipelined(
+                    ring, int(now), int(now * 1e6) & 0xFFFFFFFF,
+                    slow_path=self._slow_path)
+        elif sched is not None and hasattr(ring, "rx_pop"):
             with self._ctl:
                 moved = self._drive_scheduler(ring, sched)
         else:
@@ -1706,7 +1876,10 @@ class BNGApp:
             c["dhcp"].cleanup_expired(int(now), max_reaps=budget)
             if c.get("dhcpv6") is not None:
                 c["dhcpv6"].cleanup_expired(now, max_reaps=budget)
-            c["engine"].expire(int(now))
+            if "cluster" in c:
+                c["cluster"].expire(int(now))
+            else:
+                c["engine"].expire(int(now))
             fleet = c.get("fleet")
             if fleet is not None:
                 # fleet workers own their lease books; the sweep fans
@@ -1773,8 +1946,20 @@ class BNGApp:
             # per-subscriber counters the same way before each interim)
             if acct.sessions and now - self._last_acct_sync >= self.ACCT_SYNC_EVERY_S:
                 self._last_acct_sync = now
-                octets = c["engine"].nat.subscriber_octets(
-                    c["engine"].fetch_session_vals())
+                if "cluster" in c:
+                    # sharded: fold every shard's device-authoritative
+                    # session words (a subscriber's NAT state lives on
+                    # exactly its affinity shard, so the per-shard dicts
+                    # are disjoint)
+                    cl = c["cluster"]
+                    octets = {}
+                    if cl.tables is not None:
+                        for i in range(cl.n):
+                            octets.update(cl.nat[i].subscriber_octets(
+                                cl.fetch_session_vals(i)))
+                else:
+                    octets = c["engine"].nat.subscriber_octets(
+                        c["engine"].fetch_session_vals())
                 for s in list(acct.sessions.values()):
                     got = octets.get(s.framed_ip)
                     if got is not None:
@@ -1797,6 +1982,11 @@ class BNGApp:
             out["engine"] = {
                 "batches": eng.stats.batches, "tx": eng.stats.tx,
                 "passed": eng.stats.passed, "dropped": eng.stats.dropped}
+        cluster = self.components.get("cluster")
+        if cluster is not None:
+            out["sharded"] = cluster.stats_summary()
+            if self.sharded_blockers:
+                out["sharded_blockers"] = list(self.sharded_blockers)
         dhcp = self.components.get("dhcp")
         if dhcp is not None:
             out["dhcp"] = {k: getattr(dhcp.stats, k) for k in
@@ -2360,6 +2550,14 @@ def run_chaos(args) -> int:
         finally:
             app.close()
 
+    # the scenario suite is CPU-deterministic by contract (two runs of
+    # one --seed must emit identical bytes) and the sharded swap
+    # scenario needs a multi-device mesh: pin the hermetic CPU backend
+    # with 8 virtual devices BEFORE anything initializes a backend —
+    # the same guard the test conftest and dryrun_multichip use
+    from bng_tpu.utils.jaxenv import force_cpu
+
+    force_cpu(8)
     from bng_tpu.chaos.runner import (bench_lines, canonical_json,
                                       run_report, scenario_catalog)
 
@@ -2754,6 +2952,14 @@ def main(argv: list[str] | None = None) -> int:
                       f"{','.join(app.fleet_blockers)} not yet "
                       f"fleet-aware — see README 'Slow-path fleet'",
                       file=sys.stderr)
+            if getattr(app, "sharded_blockers", None):
+                print(f"sharded serving: "
+                      f"{','.join(app.sharded_blockers)} disabled "
+                      f"(engine-path features) — see README "
+                      f"'Sharded serving'", file=sys.stderr)
+            if app.config.shards > 1:
+                print(f"sharded dataplane: {app.config.shards} shards "
+                      f"(ring-steered owner batches)", file=sys.stderr)
             ops = app.components.get("ops")
             if ops is not None and app.config.ctl_listen:
                 from bng_tpu.control.opsctl import OpsServer

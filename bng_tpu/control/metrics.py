@@ -492,6 +492,11 @@ class BNGMetrics:
         self.shard_nat_punts = r.counter(
             "bng_shard_nat_punts_total",
             "NAT egress-miss punts per shard", ("shard",))
+        self.shard_missteers = r.counter(
+            "bng_shard_missteer_total",
+            "Wrong-shard punts counted exactly at retire (a PASS lane "
+            "whose affinity owner is a different shard): nonzero means "
+            "steering drift, not slow-path load", ("shard",))
         self.shard_psum_hits = r.counter(
             "bng_shard_psum_dhcp_hits_total",
             "DHCP fast-path hits psum-reduced over the mesh")
@@ -548,6 +553,7 @@ class BNGMetrics:
                 self.shard_frames.set_total(n, shard=shard,
                                             verdict=verdict)
             self.shard_nat_punts.set_total(sh["nat_punts"], shard=shard)
+            self.shard_missteers.set_total(sh["missteers"], shard=shard)
             for stage, s in sh["stages"].items():
                 self.shard_stage_p99.set(s["p99_us"], shard=shard,
                                          stage=stage)

@@ -449,6 +449,12 @@ def restore_checkpoint(ckpt: Checkpoint, *, engine=None, fastpath=None,
     after the snapshot was taken) simply starts empty. Returns restored
     row counts per component (the bng_ckpt_restore_rows feed).
     """
+    if ckpt.meta.get("sharded") is not None:
+        raise CheckpointError(
+            f"sharded checkpoint "
+            f"(n_shards={ckpt.meta['sharded'].get('n_shards')}) cannot "
+            f"hydrate a single-engine process: restore with --shards / "
+            f"restore_sharded_checkpoint")
     if engine is not None:
         fastpath = fastpath if fastpath is not None else engine.fastpath
         nat = nat if nat is not None else engine.nat
@@ -555,4 +561,325 @@ def restore_checkpoint(ckpt: Checkpoint, *, engine=None, fastpath=None,
     if engine is not None:
         # one full device upload — the same bulk path a cold start takes
         engine.resync_tables()
+    return rows
+
+
+# ---------------------------------------------------------------------------
+# sharded (ICI dataplane) snapshot / restore — ISSUE 12
+# ---------------------------------------------------------------------------
+# One file holds EVERY shard's host authorities namespaced
+# `shard<i>/<component>/...` plus the flat non-shard components (lease
+# book, HA store, fleet books) exactly as the single-engine format
+# carries them. `meta["sharded"]` records the topology; restore either
+# hydrates slot-exact (same shard count + geometry) or RE-SHARDS every
+# row onto its owner under the new topology — the same FNV-1a32 owner
+# discipline the fleet lease-book re-shard uses. NAT port-block
+# placements cannot move verbatim across a topology change (each shard
+# owns its public IPs exclusively), so blocks re-allocate on the new
+# owner shard and live flows re-establish through the normal new-flow
+# punt; everything host-authoritative (leases, subscriber rows, QoS
+# policy, bindings, garden membership, PPPoE sessions) moves losslessly.
+
+def _shard_prefix(i: int) -> str:
+    return f"shard{i}"
+
+
+def build_sharded_checkpoint(cluster, seq: int, now: float, *, dhcp=None,
+                             ha=None, fleet=None, quiesce: bool = True,
+                             node_id: str = "") -> Checkpoint:
+    """Snapshot an N-shard ShardedCluster (parallel/sharded.py) plus the
+    flat control-plane components, at the cluster quiesce barrier with
+    device-authoritative words folded back — the sharded analog of
+    build_checkpoint(engine=...)."""
+    if quiesce:
+        cluster.quiesce()
+        cluster.fold_device_authoritative()
+    base = build_checkpoint(seq, now, dhcp=dhcp, ha=ha, fleet=fleet,
+                            node_id=node_id)
+    meta = base.meta
+    arrays = dict(base.arrays)
+    meta["sharded"] = {"n_shards": int(cluster.n), "shards": []}
+    for i in range(cluster.n):
+        sub = build_checkpoint(seq, now, node_id=node_id,
+                               **cluster.shard_components(i))
+        meta["sharded"]["shards"].append(sub.meta["components"])
+        pref = _shard_prefix(i)
+        arrays.update({f"{pref}/{k}": v for k, v in sub.arrays.items()})
+    return Checkpoint(meta=meta, arrays=arrays)
+
+
+def _shard_sub_checkpoint(ckpt: Checkpoint, i: int, comps: dict) -> Checkpoint:
+    """Shard i's slice of a sharded checkpoint, re-shaped into the flat
+    single-engine format (components meta + de-prefixed arrays) so the
+    existing verify/restore machinery applies unchanged."""
+    pref = _shard_prefix(i) + "/"
+    arrays = {k[len(pref):]: v for k, v in ckpt.arrays.items()
+              if k.startswith(pref)}
+    return Checkpoint(meta={"components": comps}, arrays=arrays)
+
+
+def _sharded_meta(ckpt: Checkpoint) -> tuple[int, list[dict]]:
+    sh = ckpt.meta.get("sharded")
+    if not isinstance(sh, dict):
+        raise CheckpointError(
+            "not a sharded checkpoint (no sharded topology meta): "
+            "refusing to hydrate a cluster from a single-engine snapshot")
+    try:
+        src_n = int(sh["n_shards"])
+        shards = list(sh["shards"])
+    except (KeyError, TypeError, ValueError) as e:
+        raise CheckpointError(f"corrupt sharded topology meta: {e}") from e
+    if src_n < 1 or len(shards) != src_n:
+        raise CheckpointError(
+            f"corrupt sharded topology meta: n_shards={src_n} but "
+            f"{len(shards)} shard component sets")
+    return src_n, shards
+
+
+def _used_rows(arrays: dict, name: str, label: str):
+    """(keys[used], vals[used]) of one checkpointed HostTable, with the
+    structural validation the re-shard walk needs."""
+    keys = arrays.get(f"{name}.keys")
+    vals = arrays.get(f"{name}.vals")
+    used = arrays.get(f"{name}.used")
+    if keys is None or vals is None or used is None:
+        raise CheckpointError(f"{label}: checkpoint missing {name} arrays")
+    if not (keys.ndim == 2 and vals.ndim == 2
+            and keys.shape[0] == vals.shape[0] == used.shape[0]):
+        raise CheckpointError(
+            f"{label}: inconsistent {name} array shapes "
+            f"{keys.shape}/{vals.shape}/{used.shape}")
+    m = used.astype(bool)
+    return keys[m], vals[m]
+
+
+def _reshard_walk(ckpt: Checkpoint, shards_meta: list[dict], src_n: int,
+                  target, now: int) -> dict[str, int]:
+    """Re-insert every source shard's rows into `target` (a fresh
+    ShardedCluster clone) under ITS owner routing — FNV-1a32 key hash
+    for the DHCP tables, subscriber-IP affinity for the chip-local
+    state. Raises CheckpointError on structural problems; an insert
+    overflow (target shards too small for the re-balanced load) also
+    rejects — the caller's throwaway target makes that safe."""
+    from bng_tpu.ops.antispoof import AB_IPV4
+    from bng_tpu.ops.pppoe import PS_IP
+    from bng_tpu.ops.qtable import (QW_BURST, QW_FLAGS, QW_KEY,
+                                    QW_PRIORITY, QW_RATE_HI, QW_RATE_LO)
+    from bng_tpu.ops.table import shard_owner
+
+    rows = {"dhcp_rows": 0, "qos_rows": 0, "spoof_rows": 0,
+            "garden_rows": 0, "pppoe_rows": 0, "nat_blocks": 0}
+    try:
+        for i in range(src_n):
+            comps = dict(shards_meta[i])
+            sub = _shard_sub_checkpoint(ckpt, i, comps)
+            for name in _PAYLOAD_JSON_COMPONENTS:
+                if name in comps:
+                    comps[name] = _resolve_component_meta(sub, comps, name)
+            a = sub.arrays
+            label = _shard_prefix(i)
+
+            if "fastpath" in comps:
+                fa = _denamespace("fastpath", a)
+                for t in ("sub", "vlan", "cid"):
+                    keys, vals = _used_rows(fa, t, f"{label}.fastpath")
+                    if len(keys) == 0:
+                        continue
+                    owners = shard_owner(
+                        [keys[:, k] for k in range(keys.shape[1])],
+                        target.n)
+                    for r in range(len(keys)):
+                        getattr(target.fastpath[int(owners[r])],
+                                t).insert(keys[r], vals[r])
+                        rows["dhcp_rows"] += 1
+                # pool/server config is replicated cluster-wide: shard
+                # 0's copy is authoritative for every target shard
+                if i == 0:
+                    for fp in target.fastpath:
+                        _check_dense(fa, "pools", fp.pools,
+                                     f"{label}.fastpath")
+                        _check_dense(fa, "server", fp.server,
+                                     f"{label}.fastpath")
+                        fp.pools[:] = fa["pools"]
+                        fp.server[:] = fa["server"]
+
+            if "qos" in comps:
+                qa = _denamespace("qos", a)
+                for side in ("up", "down"):
+                    rws = qa.get(f"{side}.rows")
+                    if rws is None or rws.ndim != 2:
+                        raise CheckpointError(
+                            f"{label}.qos: missing/odd {side} rows")
+                    for r in rws[(rws[:, QW_FLAGS] & 1) != 0]:
+                        ip = int(r[QW_KEY])
+                        o = target.affinity_shard_ip(ip)
+                        rate = int(r[QW_RATE_LO]) | (int(r[QW_RATE_HI]) << 32)
+                        # tokens re-seed to full burst on the new owner
+                        # (host cannot carry device tokens across a
+                        # re-hash — same rule as in-table relocation)
+                        getattr(target.qos[o], side).insert(
+                            ip, rate, int(r[QW_BURST]),
+                            int(r[QW_PRIORITY]))
+                        rows["qos_rows"] += 1
+
+            if "antispoof" in comps:
+                sa = _denamespace("antispoof", a)
+                keys, vals = _used_rows(sa, "bindings", f"{label}.antispoof")
+                for r in range(len(keys)):
+                    o = target.affinity_shard_ip(int(vals[r][AB_IPV4]))
+                    target.spoof[o].bindings.insert(keys[r], vals[r])
+                    rows["spoof_rows"] += 1
+                if i == 0:
+                    for sp in target.spoof:
+                        _check_dense(sa, "ranges", sp.ranges,
+                                     f"{label}.antispoof")
+                        _check_dense(sa, "config", sp.config,
+                                     f"{label}.antispoof")
+                        sp.ranges[:] = sa["ranges"]
+                        sp.config[:] = sa["config"]
+
+            if "garden" in comps and target.garden is None:
+                raise CheckpointError(
+                    f"{label} carries garden state but the target "
+                    f"cluster has no garden gate: refusing a partial "
+                    f"restore")
+            if "pppoe" in comps and target.pppoe is None:
+                raise CheckpointError(
+                    f"{label} carries pppoe state but the target "
+                    f"cluster has pppoe disabled: refusing a partial "
+                    f"restore")
+            if "garden" in comps and target.garden is not None:
+                ga = _denamespace("garden", a)
+                keys, vals = _used_rows(ga, "subscribers", f"{label}.garden")
+                for r in range(len(keys)):
+                    o = target.affinity_shard_ip(int(keys[r][0]))
+                    target.garden[o].subscribers.insert(keys[r], vals[r])
+                    rows["garden_rows"] += 1
+                if i == 0:
+                    for gd in target.garden:
+                        _check_dense(ga, "allowed", gd.allowed,
+                                     f"{label}.garden")
+                        gd.allowed[:] = ga["allowed"]
+
+            if "pppoe" in comps and target.pppoe is not None:
+                pa = _denamespace("pppoe", a)
+                for t in ("by_sid", "by_ip"):
+                    keys, vals = _used_rows(pa, t, f"{label}.pppoe")
+                    for r in range(len(keys)):
+                        # both directions land on the session's affinity
+                        # shard — the ring steers both sides there
+                        o = target.affinity_shard_ip(int(vals[r][PS_IP]))
+                        getattr(target.pppoe[o], t).insert(keys[r], vals[r])
+                        rows["pppoe_rows"] += 1
+                if i == 0 and pa.get("server_mac") is not None:
+                    for pe in target.pppoe:
+                        pe.server_mac[:] = pa["server_mac"]
+
+            if "nat" in comps:
+                from bng_tpu.control.nat import NATManager
+
+                parsed = NATManager.parse_checkpoint_meta(comps["nat"])
+                # port blocks re-allocate on the new owner (public-IP
+                # ownership is per-shard and exclusive; a block cannot
+                # move between public IPs verbatim). Live flows
+                # re-establish via the device's new-flow punt.
+                for priv_ip in sorted(parsed["blocks"]):
+                    o = target.affinity_shard_ip(int(priv_ip))
+                    if target.nat[o].allocate_nat(int(priv_ip),
+                                                  int(now)) is None:
+                        # exhaustion is NOT recoverable-by-punt (the
+                        # punt's allocation hits the same empty pool):
+                        # reject like any other overflow, loudly
+                        raise CheckpointError(
+                            f"NAT block for {priv_ip:#x} does not fit "
+                            f"shard {o}'s port space under the new "
+                            f"topology ({target.n} shards): provision "
+                            f"more public IPs / wider port ranges "
+                            f"before re-sharding down")
+                    rows["nat_blocks"] += 1
+                na = _denamespace("nat", a)
+                if i == 0 and na.get("hairpin") is not None \
+                        and na.get("alg") is not None:
+                    # hairpin/ALG policy config is cluster-global
+                    for nm in target.nat:
+                        nm.hairpin[:] = na["hairpin"]
+                        nm.alg[:] = na["alg"]
+    except CheckpointError:
+        raise
+    except (KeyError, IndexError, TypeError, ValueError, RuntimeError) as e:
+        raise CheckpointError(
+            f"sharded re-shard rejected: {type(e).__name__}: {e}") from e
+    return rows
+
+
+def restore_sharded_checkpoint(ckpt: Checkpoint, cluster, *, dhcp=None,
+                               ha=None, fleet=None,
+                               now: int = 0) -> dict[str, int]:
+    """Hydrate a ShardedCluster (and the flat components) from a sharded
+    checkpoint, then one full device upload — reject-on-mismatch like
+    the single-engine restore, all-or-nothing across EVERY shard.
+
+    Topology aware: a checkpoint taken at N shards restores into an
+    M-shard cluster by re-inserting every row on its owner under the
+    new topology (the fleet lease-book re-shard discipline). The
+    hydration happens into a throwaway geometry clone first and the
+    host authorities are adopted wholesale on success, so a reject can
+    never leave the live cluster half-hydrated.
+    """
+    src_n, shards_meta = _sharded_meta(ckpt)
+
+    tmp = cluster.clone_empty()
+    if src_n == cluster.n:
+        # slot-exact fast path: verify EVERY shard against the clone's
+        # geometry, then hydrate shard by shard (preserves cuckoo/stash
+        # placement and the folded device-authoritative words)
+        subs = []
+        for i in range(src_n):
+            comps = dict(shards_meta[i])
+            sub = _shard_sub_checkpoint(ckpt, i, comps)
+            for name in _PAYLOAD_JSON_COMPONENTS:
+                if name in comps:
+                    comps[name] = _resolve_component_meta(sub, comps, name)
+            targets = tmp.shard_components(i)
+            missing = sorted(set(comps) - set(targets))
+            if missing:
+                raise CheckpointError(
+                    f"shard{i} carries {missing} but the live cluster "
+                    f"has no such component(s): refusing a partial "
+                    f"restore")
+            _verify_components(sub, comps, targets)
+            subs.append((sub, comps, targets))
+        rows: dict[str, int] = {}
+        for i, (sub, _comps, targets) in enumerate(subs):
+            # the flat restore path knows every component shape; reuse
+            # it wholesale per shard (no engine kwarg: the one device
+            # upload happens once, below, for all shards together)
+            got = restore_checkpoint(sub, **targets)
+            rows.update({f"shard{i}.{k}": v for k, v in got.items() if v})
+    else:
+        rows = _reshard_walk(ckpt, shards_meta, src_n, tmp, now)
+        rows["resharded_from"] = src_n
+        rows["resharded_to"] = cluster.n
+
+    # flat components (lease book / HA / fleet) hydrate exactly like the
+    # single-engine path — the book formats are topology-independent
+    flat_comps = dict(ckpt.meta.get("components", {}))
+    if flat_comps:
+        flat = Checkpoint(
+            meta={"components": ckpt.meta.get("components", {})},
+            arrays={k: v for k, v in ckpt.arrays.items()
+                    if not k.startswith("shard")})
+        rows.update(restore_checkpoint(flat, dhcp=dhcp, ha=ha, fleet=fleet))
+
+    # adopt the hydrated authorities wholesale (tmp is a geometry clone,
+    # so presence/absence of garden/pppoe matches); then the one full
+    # upload — the same bulk path a cold start takes
+    cluster.fastpath = tmp.fastpath
+    cluster.nat = tmp.nat
+    cluster.qos = tmp.qos
+    cluster.spoof = tmp.spoof
+    cluster.garden = tmp.garden
+    cluster.pppoe = tmp.pppoe
+    cluster._pub_owner_cache = None
+    cluster.resync_tables()
     return rows

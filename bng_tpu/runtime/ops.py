@@ -277,3 +277,94 @@ def blue_green_swap(components, *, audit: bool = True, metrics=None,
     if metrics is not None:
         metrics.record_transition(report)
     return report
+
+
+def sharded_blue_green_swap(components, *, audit: bool = True, metrics=None,
+                            node_id: str = "bluegreen",
+                            clock=time.time) -> dict:
+    """Blue/green swap for the ICI-sharded serving path (ISSUE 12):
+    hydrate a STANDBY ShardedCluster from an in-memory sharded snapshot
+    and flip the composition root's cluster reference — or discard the
+    standby with the active cluster untouched.
+
+    Differences from the engine swap that make this one simpler, not
+    weaker: callers hold the app's control lock for the whole
+    transition (the sharded drive loop cannot run concurrently), so the
+    host authorities cannot move between snapshot and flip — no delta
+    replay pass is needed; and the standby is built from a geometry
+    clone sharing the live mesh, so its jit caches hit the compiled
+    programs instead of recompiling. The same failure surfaces stay
+    armed: the snapshot round-trips through the versioned codec
+    (`ops.snapshot` io_error), the restore runs the full
+    all-verified-then-hydrate gate, the cross-authority sharded audit
+    must pass BEFORE the flip, and the `ops.swap` chaos point crashes
+    at the flip barrier — any failure leaves the ACTIVE cluster
+    serving (it was never mutated)."""
+    from bng_tpu.runtime.checkpoint import (build_sharded_checkpoint,
+                                            restore_sharded_checkpoint)
+
+    cl = components["cluster"]
+    report: dict = {"op": "sharded_swap", "outcome": "failed",
+                    "shards": cl.n}
+    t_all = time.perf_counter()
+    try:
+        # 1. quiesce + in-memory snapshot, codec round-trip verified
+        t0 = tele.t()
+        t_q = time.perf_counter()
+        report["frames_deferred"] = cl.quiesce()
+        # the DHCP lease book is NOT part of the snapshot: the live
+        # server keeps the host authority across the flip (engine-swap
+        # discipline — only the device-backed shard state swaps)
+        ckpt = build_sharded_checkpoint(cl, 0, clock(), node_id=node_id)
+        ckpt = roundtrip_checkpoint(ckpt)  # ops.snapshot chaos point
+        report["quiesce_s"] = time.perf_counter() - t_q
+        tele.lap(tele.OPS, t0)
+
+        # 2. standby hydration: geometry clone + verified restore + one
+        # full device upload (inside restore_sharded_checkpoint)
+        t0 = tele.t()
+        t_h = time.perf_counter()
+        standby = cl.clone_empty()
+        report["restored_rows"] = restore_sharded_checkpoint(
+            ckpt, standby, now=int(clock()))
+        report["hydrate_s"] = time.perf_counter() - t_h
+        tele.lap(tele.OPS, t0)
+
+        # 3. chaos flip barrier + the sharded cross-authority audit —
+        # the standby must prove the partition invariants BEFORE serving
+        fp = fault_point("ops.swap")
+        if fp is not None and fp.kind == "fail":
+            raise FaultInjectedError("chaos: injected crash mid-swap")
+        if audit:
+            from bng_tpu.chaos.invariants import audit_invariants
+
+            t0 = tele.t()
+            audit_rep = audit_invariants(
+                cluster=standby, pools=components.get("pools"),
+                dhcp=components.get("dhcp"), check_roundtrip=False)
+            report["audit_ok"] = audit_rep.ok
+            report["violations"] = audit_rep.violations_by_kind()
+            tele.lap(tele.OPS, t0)
+            if not audit_rep.ok:
+                raise CheckpointError(
+                    f"standby cluster failed the invariant audit: "
+                    f"{audit_rep.violations_by_kind()}")
+
+        # 4. the flip: one reference store (the drive loop reads
+        # components["cluster"] every beat)
+        t0 = tele.t()
+        t_f = time.perf_counter()
+        components["cluster"] = standby
+        report["flip_s"] = time.perf_counter() - t_f
+        tele.lap(tele.OPS, t0)
+        report["outcome"] = "ok"
+    except Exception as e:  # noqa: BLE001 — ANY failure keeps the active
+        # the active cluster was never mutated (the snapshot reads, the
+        # standby owns every write): discard the standby and keep serving
+        report["outcome"] = "failed"
+        report["error"] = f"{type(e).__name__}: {e}"[:300]
+        _log.error("sharded swap did not flip", error=report["error"])
+    report["duration_s"] = time.perf_counter() - t_all
+    if metrics is not None:
+        metrics.record_transition(report)
+    return report

@@ -108,7 +108,7 @@ def shard_of(frame: bytes, flags: int, n_shards: int,
         if (et == 0x8864 and (flags & FLAG_FROM_ACCESS)
                 and len(frame) >= off + 8 + 20
                 and frame[off] == 0x11 and frame[off + 1] == 0
-                and (frame[off + 6] << 8) | frame[off + 7] == 0x0021
+                and ((frame[off + 6] << 8) | frame[off + 7]) == 0x0021
                 and (frame[off + 8] >> 4) == 4):
             # PPPoE session DATA (PPP proto IPv4): steer by the INNER
             # source IP — the same affinity key the decap'd packet's

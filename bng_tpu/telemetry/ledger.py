@@ -186,9 +186,29 @@ def table_impl(line: dict) -> str:
     return str(line.get("table_impl") or env.get("table_impl") or "xla")
 
 
+def n_shards(line: dict) -> int:
+    """How many dataplane shards served the run (ISSUE 12): the
+    top-level stamp wins (`bench.py --shards` records it on every
+    line), then the legacy spelling `devices` (the config-5 sharded
+    bench always recorded its mesh width there), then the env
+    fingerprint. Unstamped lines are single-device by construction —
+    defaulting to 1 keeps existing history one cohort. An aggregate
+    8-shard Mpps line must never trend against single-device history:
+    the cohort keys on this."""
+    v = line.get("n_shards")
+    if v is None:
+        v = line.get("devices")
+    if v is None:
+        v = (line.get("env") or {}).get("n_shards")
+    try:
+        return int(v) if v is not None else 1
+    except (TypeError, ValueError):
+        return 1
+
+
 def cohort_key(line: dict) -> tuple:
     return (line.get("metric"), backend_class(line), device_kind(line),
-            table_impl(line), geometry(line))
+            table_impl(line), n_shards(line), geometry(line))
 
 
 def _gateable(line: dict) -> bool:
@@ -433,17 +453,21 @@ def gate(lines: list[dict], last_k: int = 8, min_cohort: int = 3,
                    if ln.get("metric") == cand.get("metric")
                    and geometry(ln) == geometry(cand)
                    and (backend_class(ln) != backend_class(cand)
-                        or table_impl(ln) != table_impl(cand))]
+                        or table_impl(ln) != table_impl(cand)
+                        or n_shards(ln) != n_shards(cand))]
         if not cohort and len(relaxed) >= min_cohort:
-            others = sorted({f"{backend_class(ln)}/{table_impl(ln)}"
-                             for ln in relaxed})
+            others = sorted({
+                f"{backend_class(ln)}/{table_impl(ln)}"
+                f"/shards={n_shards(ln)}" for ln in relaxed})
             rep.rc = GATE_INCOMPARABLE
             rep.notes.append(
                 f"candidate ran as {backend_class(cand)!r}/"
-                f"{table_impl(cand)!r} (device "
+                f"{table_impl(cand)!r}/shards={n_shards(cand)} (device "
                 f"{device_kind(cand) or 'none'!r}) with no same-identity "
                 f"history for this metric+geometry — the existing history "
-                f"is on {others}: refusing the cross-identity comparison")
+                f"is on {others}: refusing the cross-identity comparison "
+                f"(an aggregate sharded number never trends against a "
+                f"different shard count's cohort)")
             return rep
         rep.notes.append(
             f"cohort too small (n={len(cohort)} < {min_cohort}): trend "

@@ -63,6 +63,13 @@ step "config6-ab-pallas" 900 "BNG_TABLE_IMPL=pallas python bench.py --config 6"
 step "autotune"      1800 "BNG_TABLE_IMPL=auto python bench.py --autotune"
 step "headline-1M"   2400 "BNG_BENCH_SUBS=1000000 BNG_BENCH_FLOWS=1000000 BNG_TABLE_IMPL=auto python bench.py"
 step "headline-1M-xla" 2400 "BNG_BENCH_SUBS=1000000 BNG_BENCH_FLOWS=1000000 BNG_TABLE_IMPL=xla python bench.py"
+# the AGGREGATE serving headline (ISSUE 12): the promoted sharded path —
+# steered ring + process_ring_pipelined over every chip on the slice —
+# under auto AND pinned xla. n_shards rides the ledger cohort key, so
+# these lines gate only against sharded history (rc=3 vs single-device).
+N_CHIPS=$(timeout 75 python -c "import jax; print(len(jax.devices()))" 2>/dev/null || echo 8)
+step "sharded-headline" 2400 "BNG_BENCH_SUBS=1000000 BNG_TABLE_IMPL=auto python bench.py --shards $N_CHIPS"
+step "sharded-headline-xla" 2400 "BNG_BENCH_SUBS=1000000 BNG_TABLE_IMPL=xla python bench.py --shards $N_CHIPS"
 if [ "$FAILED" -ne 0 ]; then
   echo "DONE WITH FAILURES $(date -u +%H:%M:%S)" | tee -a "$LOG"; exit 1
 fi
