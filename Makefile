@@ -89,6 +89,20 @@
 #                       identity. A prerequisite of `verify` (whose
 #                       tier-1 line deselects `sharded`; a bare ROADMAP
 #                       tier-1 run still includes them).
+#   make verify-express — AOT express OFFER-path gate (ISSUE 13):
+#                       ALL `express`-marked tests (slow included —
+#                       this target owns the full 4-geometry x 2-impl
+#                       byte-identity matrix vs `_dhcp_jit`; the
+#                       heavier combos are slow-marked so the ROADMAP
+#                       tier-1 run carries only geometry 0 under both
+#                       impls): descriptor-parse semantics, express-
+#                       reply identity vs the codec-built reply, AOT
+#                       cache hit-without-retrace and loud-miss
+#                       fallback (counter + flight dump + ring-meta
+#                       program identity), ledger express_path
+#                       identity, and the SLO device-budget smoke. A
+#                       prerequisite of `verify` (whose tier-1 line
+#                       deselects `express`).
 #   make verify-sanitize — hotpath-marked engine/scheduler tests under
 #                       BNG_SANITIZE=1 (transfer_guard + debug_nans):
 #                       the dynamic cross-check of the static transfer
@@ -109,13 +123,15 @@ PYTEST_FLAGS = -q --continue-on-collection-errors -p no:cacheprovider \
 
 .PHONY: verify verify-slow verify-all verify-load verify-chaos \
         verify-telemetry verify-static verify-sanitize verify-ops \
-        verify-storm verify-perf verify-kernels verify-sharded
+        verify-storm verify-perf verify-kernels verify-sharded \
+        verify-express
 
-verify: verify-static verify-storm verify-perf verify-kernels verify-sharded
+verify: verify-static verify-storm verify-perf verify-kernels \
+        verify-sharded verify-express
 	set -o pipefail; rm -f /tmp/_t1.log; \
 	timeout -k 10 $(TIER1_TIMEOUT) env JAX_PLATFORMS=cpu \
 	$(PY) -m pytest tests/ $(PYTEST_FLAGS) \
-	-m 'not slow and not storm and not perf and not kernels and not sharded' \
+	-m 'not slow and not storm and not perf and not kernels and not sharded and not express' \
 	2>&1 | tee /tmp/_t1.log
 
 verify-sharded:
@@ -125,6 +141,13 @@ verify-sharded:
 	$(PY) -m pytest tests/test_sharded_serving.py $(PYTEST_FLAGS) \
 	  -m 'sharded and not slow' \
 	&& echo "verify-sharded OK"
+
+verify-express:
+	set -o pipefail; \
+	timeout -k 10 240 env JAX_PLATFORMS=cpu \
+	$(PY) -m pytest tests/test_express.py $(PYTEST_FLAGS) \
+	  -m 'express' \
+	&& echo "verify-express OK"
 
 verify-kernels:
 	set -o pipefail; \

@@ -1374,9 +1374,15 @@ def scheduler_bench(on_tpu: bool, checkpoint_interval_s: float = 0.0) -> None:
     nat, flows = _build_nat_flows(max(1000, N_SUBS), max(250, N_SUBS // 4),
                                   now, sub_nat_nbuckets=sub_nb)
     engine = Engine(fp, nat, batch_size=B_BULK, pkt_slot=512)
+    # express_aot pinned OFF: this mode's device-isolated metric
+    # profiles the FULL `_dhcp_jit` program, so the scheduler must
+    # actually serve that architecture — its ledger lines stay in the
+    # legacy `jit-full` express_path cohort. The AOT minimal-program
+    # lane is measured by `--express-ab`, which emits both cohorts
+    # under distinct identities.
     sched = TieredScheduler(engine, SchedulerConfig(
         express_batch=B_EXPR, bulk_batch=B_BULK, bulk_depth=depth,
-        drain_every=drain_every))
+        drain_every=drain_every, express_aot=False))
     setup_s = time.time() - t_setup
 
     # optional checkpoint cadence riding the measured loops: the
@@ -1531,6 +1537,9 @@ def scheduler_bench(on_tpu: bool, checkpoint_interval_s: float = 0.0) -> None:
         # name whichever mode produced the artifact)
         "offer_device_only_p50_us": round(offer_device_p50, 1),
         "offer_device_only_p99_us": round(offer_device_p99, 1),
+        # explicit cohort identity (matches the unstamped-legacy default:
+        # this mode serves and profiles the full program)
+        "express_path": "jit-full",
         "device_time_source": device_source,
         "offer_hits_warm": offer_hits,
         "express_under_load_p50_us": round(under_load_p50, 1),
@@ -1564,6 +1573,277 @@ def scheduler_bench(on_tpu: bool, checkpoint_interval_s: float = 0.0) -> None:
                                    if k not in line}})
     print(json.dumps(line))
     _persist(line)
+
+
+def express_ab_bench(on_tpu: bool) -> None:
+    """`--express-ab`: one-flag A/B of the two express-lane architectures
+    (ISSUE 13) — the jit full-program path (`_dhcp_jit`: on-device parse
+    + reply compose) vs the AOT minimal-program path (ops/express.py:
+    admission-extracted descriptors, table probe + verdict block on
+    device, host template patch-in).
+
+    Emits ONE ledger line per cohort, both under the scheduler OFFER
+    metric, with `express_path` joining the cohort identity — the trend
+    gate can therefore gate each architecture against its own history
+    and REFUSES (rc=3, naming both identities) to trend one against the
+    other. Each cohort carries:
+      - `offer_device_only_p99_us`: profiler-fenced per-execution device
+        time of that cohort's express program (the 50us target quantity);
+      - the host-side submit-to-dispatch overhead split the AOT path
+        exists to shrink: `submit_us_per_batch` (admission incl.
+        descriptor extraction) and the `dispatch` stage breakdown
+        (batch close -> device enqueue: staging + update drain + the
+        jit-cache lookup the AOT path eliminates);
+      - blocked end-to-end OFFER latency through the scheduler.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from bng_tpu.ops.dhcp import dhcp_fastpath
+    from bng_tpu.ops.express import XD_WORDS, express_verdicts, parse_express
+    from bng_tpu.ops.parse import parse_batch
+    from bng_tpu.runtime.engine import Engine
+    from bng_tpu.runtime.scheduler import SchedulerConfig, TieredScheduler
+    from bng_tpu.runtime.verify import verify_tpu_lowering
+    from bng_tpu.telemetry import FlightRecorder, RecorderConfig
+    from bng_tpu.telemetry import spans as tele
+    from bng_tpu.utils.profiling import profile_step_durations
+
+    _mark("express A/B: verifying program lowering...")
+    results = verify_tpu_lowering(verbose=True, tpu=on_tpu)
+    failures = [n for n, e in results if e is not None]
+    if failures:
+        print(json.dumps({
+            "metric": "OFFER p99 device-isolated (scheduler)", "value": 0.0,
+            "unit": "us", "vs_baseline": 0.0,
+            "error": "express A/B refused: lowering verification failed "
+                     f"for {failures}", "failures": failures, **_DIAG}))
+        sys.exit(2)
+
+    dev = jax.devices()[0]
+    B_EXPR = int(os.environ.get("BNG_SCHED_EXPRESS_BATCH", 64))
+    N_SUBS = int(os.environ.get("BNG_BENCH_SUBS",
+                                1_000_000 if on_tpu else 2_000))
+    LAT_STEPS = int(os.environ.get("BNG_BENCH_LAT_STEPS",
+                                   400 if on_tpu else 30))
+    # the kill switch must not reach the A/B: a lingering
+    # BNG_EXPRESS_AOT=0 would make the "aot-express" stack silently
+    # serve jit-full and publish its numbers under the wrong cohort
+    # identity — exactly what the rc=3 refusal exists to prevent
+    if os.environ.pop("BNG_EXPRESS_AOT", None) == "0":
+        _mark("express A/B: ignoring BNG_EXPRESS_AOT=0 (the A/B measures "
+              "both architectures by definition)")
+    now = int(time.time())
+    rng = np.random.default_rng(42)
+    _mark(f"express A/B: {N_SUBS} subscribers, express B={B_EXPR}, "
+          f"{LAT_STEPS} batches per cohort...")
+
+    # build BOTH stacks up front and INTERLEAVE the measured batches:
+    # the two cohorts see the same box noise (GC, sibling load, cache
+    # state), so the host-overhead delta is an architecture fact, not a
+    # phase-of-run artifact. Each cohort keeps its OWN tracer — the
+    # per-stage breakdowns must never mix the two architectures'
+    # samples (that mixing is exactly the comparison the ledger's
+    # express_path identity forbids).
+    stacks: dict[str, dict] = {}
+    macs = None
+    for path_name, aot in (("jit-full", False), ("aot-express", True)):
+        recorder = FlightRecorder(RecorderConfig())
+        recorder.set_backend(jax.default_backend())
+        tracer = tele.Tracer(recorder=recorder)
+        tele.arm(tracer)
+        t_setup = time.time()
+        fp, macs, sub_nb = _build_dhcp_tables(N_SUBS, now)
+        nat, _flows = _build_nat_flows(1000, 250, now,
+                                       sub_nat_nbuckets=sub_nb)
+        engine = Engine(fp, nat, batch_size=256, pkt_slot=512)
+        sched = TieredScheduler(engine, SchedulerConfig(
+            express_batch=B_EXPR, bulk_batch=256, express_aot=aot))
+        setup_s = time.time() - t_setup
+        _mark(f"[{path_name}] compiling + warming...")
+        t_c = time.time()
+        warm = sched.process(
+            [_discover_row(macs[int(rng.integers(N_SUBS))], 0x8000 + k)
+             for k in range(B_EXPR)])
+        stacks[path_name] = {
+            "aot": aot, "engine": engine, "sched": sched, "fp": fp,
+            "tracer": tracer, "setup_s": setup_s,
+            "compile_s": time.time() - t_c,
+            "offer_hits": len(warm["tx"]),
+            "llat": [], "submit_us": [],
+        }
+        tele.disarm()
+        if aot:
+            # identity gate: the aot-express cohort must actually have
+            # been SERVED by the AOT program — a compile failure here
+            # would file jit-full measurements under the aot identity
+            ex_snap = sched.stats_snapshot()["express"]
+            if not ex_snap["aot_dispatches"] or ex_snap["aot_misses"]:
+                print(json.dumps({
+                    "metric": "OFFER p99 device-isolated (scheduler)",
+                    "value": 0.0, "unit": "us", "vs_baseline": 0.0,
+                    "error": "express A/B refused: the aot-express stack "
+                             "did not serve via the AOT program "
+                             f"(dispatches={ex_snap['aot_dispatches']}, "
+                             f"misses={ex_snap['aot_misses']}) — "
+                             "publishing it would mislabel the cohort",
+                    **_DIAG}))
+                sys.exit(2)
+
+    def discover_batch(base_xid):
+        return [_discover_row(macs[int(rng.integers(N_SUBS))],
+                              base_xid + k) for k in range(B_EXPR)]
+
+    _mark(f"interleaved measurement: {LAT_STEPS} batches per cohort...")
+    for k in range(LAT_STEPS):
+        frames = discover_batch(0x9000 + k * B_EXPR)
+        for path_name, st in stacks.items():
+            sched = st["sched"]
+            tele.arm(st["tracer"])
+            t1 = time.perf_counter()
+            for f in frames:
+                sched.submit(f, from_access=True)
+            t2 = time.perf_counter()
+            sched.flush()
+            t3 = time.perf_counter()
+            sched.drain_completions()
+            tele.disarm()
+            st["submit_us"].append((t2 - t1) * 1e6)
+            st["llat"].append((t3 - t1) * 1e6)
+
+    cohorts: dict[str, dict] = {}
+    for path_name, st in stacks.items():
+        aot, engine, sched, fp = (st["aot"], st["engine"], st["sched"],
+                                  st["fp"])
+        tele.arm(st["tracer"])
+        dispatch_bd = st["tracer"].breakdown().get("dispatch", {})
+        reply_bd = st["tracer"].breakdown().get("reply", {})
+
+        # ---- profiler-isolated device time of THIS cohort's program ----
+        # non-donating twins over the live chain (the scheduler_bench
+        # discipline): per-execution events carry pure program time
+        def place(x):
+            return (jax.device_put(x, sched._express_dev)
+                    if sched._express_dev is not None else x)
+
+        frames = discover_batch(0xA000)
+        dtables = engine.tables.dhcp
+        dev_p50 = dev_p99 = 0.0
+        device_source = "none"
+        try:
+            if aot:
+                desc = np.zeros((B_EXPR, XD_WORDS), dtype=np.uint32)
+                for i, f in enumerate(frames):
+                    d = parse_express(f)
+                    if d is not None:
+                        desc[i] = d.words
+                desc_d = place(jnp.asarray(desc))
+                geom = fp.geom
+
+                @jax.jit
+                def prof_step(dt, dd):
+                    res = express_verdicts(dt, dd, geom, jnp.uint32(now))
+                    return res.block, res.stats
+            else:
+                lpkt = np.zeros((B_EXPR, 512), dtype=np.uint8)
+                llen = np.zeros((B_EXPR,), dtype=np.uint32)
+                for i, f in enumerate(frames):
+                    lpkt[i, : len(f)] = np.frombuffer(f, dtype=np.uint8)
+                    llen[i] = len(f)
+                lpkt_d, llen_d = place(jnp.asarray(lpkt)), place(jnp.asarray(llen))
+                geom = fp.geom
+
+                # the batch rides as a real ARGUMENT (a closed-over
+                # array is a trace constant XLA would fold the parse
+                # and most of the compose against, flattering the full
+                # program) — the aot twin's descriptor is an argument
+                # for the same reason
+                @jax.jit
+                def prof_step(dt, dd):
+                    pkt_a, len_a = dd
+                    par = parse_batch(pkt_a, len_a)
+                    res = dhcp_fastpath(pkt_a, len_a, par, dt, geom,
+                                        jnp.uint32(now))
+                    return res.is_reply, res.out_pkt, res.out_len
+                desc_d = (lpkt_d, llen_d)
+            jax.block_until_ready(prof_step(dtables, desc_d))
+            sd = profile_step_durations(
+                lambda: prof_step(dtables, desc_d),
+                iters=max(20, min(LAT_STEPS, 200)))
+            if sd.us:
+                dev_p50, dev_p99 = sd.percentile(50), sd.percentile(99)
+                device_source = sd.source
+                tele.tracer().observe_many(tele.DEVICE, sd.us)
+            else:
+                _DIAG[f"ab_{path_name}_profile_error"] = "no events in trace"
+        except Exception as e:  # profiling must never sink the benchmark
+            _DIAG[f"ab_{path_name}_profile_error"] = f"{type(e).__name__}: {e}"
+
+        snap = sched.stats_snapshot()
+        llat, submit_us = st["llat"], st["submit_us"]
+        line = {
+            "metric": "OFFER p99 device-isolated (scheduler)",
+            "value": round(dev_p99, 1),
+            "unit": "us",
+            "vs_baseline": round(50.0 / dev_p99, 3) if dev_p99 else 0.0,
+            # the cohort identity the ledger keys on: the gate refuses
+            # to trend the two architectures against each other (rc=3)
+            "express_path": path_name,
+            "offer_device_only_p50_us": round(dev_p50, 1),
+            "offer_device_only_p99_us": round(dev_p99, 1),
+            "device_time_source": device_source,
+            "offer_p50_us": round(float(np.percentile(llat, 50)), 1),
+            "offer_p99_us": round(float(np.percentile(llat, 99)), 1),
+            "submit_us_per_batch": round(float(np.percentile(submit_us, 50)), 1),
+            "dispatch_host_p50_us": dispatch_bd.get("p50_us", 0.0),
+            "dispatch_host_p99_us": dispatch_bd.get("p99_us", 0.0),
+            "reply_host_p50_us": reply_bd.get("p50_us", 0.0),
+            "offer_hits_warm": st["offer_hits"],
+            "express_batch": B_EXPR,
+            "express_aot_misses": snap["express"]["aot_misses"],
+            "subscribers": N_SUBS,
+            "sched": snap,
+            "device": str(dev),
+            "compile_s": round(st["compile_s"], 1),
+            "setup_s": round(st["setup_s"], 1),
+            **_DIAG,
+        }
+        # breakdown taken AFTER the profiling pass so the cohort line
+        # carries the profiler-fenced `device` stage the SLO gate reads
+        line["stage_breakdown"] = st["tracer"].breakdown()
+        _finalize_diag()
+        line = _order_line({**line, **{k: v for k, v in _DIAG.items()
+                                       if k not in line}})
+        print(json.dumps(line))
+        _persist(line)
+        cohorts[path_name] = line
+        sched.flush()
+        tele.disarm()
+        _mark(f"[{path_name}] device p99 {dev_p99:.1f}us, dispatch host "
+              f"p50 {dispatch_bd.get('p50_us', 0.0)}us, submit "
+              f"{line['submit_us_per_batch']}us/batch")
+
+    # one summary line (its own metric: never a trend point for either
+    # cohort) with the host-overhead delta the AB exists to measure
+    jit_l, aot_l = cohorts["jit-full"], cohorts["aot-express"]
+    jit_host = jit_l["submit_us_per_batch"] + jit_l["dispatch_host_p50_us"]
+    aot_host = aot_l["submit_us_per_batch"] + aot_l["dispatch_host_p50_us"]
+    summary = _order_line({
+        "metric": "express A/B host dispatch overhead delta",
+        "value": round(jit_host - aot_host, 1),
+        "unit": "us",
+        "vs_baseline": round(jit_host / aot_host, 3) if aot_host else 0.0,
+        "jit_full_host_us": round(jit_host, 1),
+        "aot_express_host_us": round(aot_host, 1),
+        "jit_full_device_p99_us": jit_l["offer_device_only_p99_us"],
+        "aot_express_device_p99_us": aot_l["offer_device_only_p99_us"],
+        "express_batch": B_EXPR,
+        "subscribers": N_SUBS,
+        "device": str(dev),
+        **_DIAG,
+    })
+    print(json.dumps(summary))
+    _persist(summary)
 
 
 def autotune_mode(on_tpu: bool, dry_run: bool = False) -> None:
@@ -1844,7 +2124,8 @@ def _child_dispatch(config: int, verify_lowering: bool = False,
                     require_tpu: bool = False,
                     autotune: bool = False,
                     autotune_dry_run: bool = False,
-                    shards: int = 0) -> None:
+                    shards: int = 0,
+                    express_ab: bool = False) -> None:
     """Run one benchmark config in this process (the supervised child)."""
     try:
         # environment fingerprint (device kind / jaxlib / hostname) on
@@ -1951,6 +2232,9 @@ def _child_dispatch(config: int, verify_lowering: bool = False,
             return
         if autotune:
             autotune_mode(on_tpu, dry_run=autotune_dry_run)
+            return
+        if express_ab:
+            express_ab_bench(on_tpu)
             return
         if scheduler:
             scheduler_bench(on_tpu, checkpoint_interval_s=checkpoint_interval_s)
@@ -2135,6 +2419,13 @@ def main_dispatch() -> None:
                     help="measure the disarmed telemetry span hook cost "
                          "vs slow-path run-to-run noise (PERF_NOTES §8); "
                          "host-only, no device")
+    ap.add_argument("--express-ab", action="store_true",
+                    help="one-flag A/B of the express-lane architectures "
+                         "(ISSUE 13): jit full-program vs AOT "
+                         "minimal-program express — emits one "
+                         "offer_device_only_p99_us cohort per "
+                         "express_path identity (rc=2 if lowering "
+                         "verification fails)")
     ap.add_argument("--autotune", action="store_true",
                     help="stage-breakdown-driven sweep of batch geometry "
                          "x pipeline depth x table impl (ISSUE 11): "
@@ -2176,7 +2467,8 @@ def main_dispatch() -> None:
                         require_tpu=args.require_tpu,
                         autotune=args.autotune,
                         autotune_dry_run=args.dry_run,
-                        shards=args.shards)
+                        shards=args.shards,
+                        express_ab=args.express_ab)
         return
 
     # BNG_BENCH_TIMEOUT bounds the benchmark itself; the probe window is
@@ -2211,8 +2503,8 @@ def main_dispatch() -> None:
         else:
             print(_error_line(args.config,
                               f"child rc={res.returncode}, no JSON emitted"))
-        if (args.verify_lowering or args.scheduler or args.require_tpu) \
-                and res.returncode != 0:
+        if (args.verify_lowering or args.scheduler or args.express_ab
+                or args.require_tpu) and res.returncode != 0:
             # CI pre-step / scheduler mode / headline gate: propagate the
             # child verdict (scheduler exits 2 when lowering verification
             # refused it; --require-tpu exits 3 on CPU fallback)
@@ -2242,13 +2534,13 @@ def main_dispatch() -> None:
     except subprocess.TimeoutExpired:
         print(_error_line(args.config,
                           f"benchmark child timed out after {timeout_s:.0f}s"))
-        if (args.verify_lowering or args.scheduler or args.require_tpu
-                or args.gate):
+        if (args.verify_lowering or args.scheduler or args.express_ab
+                or args.require_tpu or args.gate):
             sys.exit(1)  # a gate that never ran is a failed gate
     except Exception as e:  # pragma: no cover - spawn failure
         print(_error_line(args.config, f"supervisor error: {type(e).__name__}: {e}"))
-        if (args.verify_lowering or args.scheduler or args.require_tpu
-                or args.gate):
+        if (args.verify_lowering or args.scheduler or args.express_ab
+                or args.require_tpu or args.gate):
             sys.exit(1)
 
 

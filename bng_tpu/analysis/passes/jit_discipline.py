@@ -37,9 +37,21 @@ from bng_tpu.analysis.core import (Finding, Pass, Project, call_name,
 
 APPLY_FNS = {"apply_fastpath_updates", "apply_nat_updates", "apply_update",
              "apply_qupdate", "_apply_all_updates", "apply_all_updates"}
+# the AOT-compiled express entry (ops/express.py): a jitted step whose
+# body runs the express probe program threads (and must donate) the
+# dhcp chain AND the descriptor batch — the program's output verdict
+# block aliases the descriptor staging buffer, so an undonated express
+# step silently doubles both the table HBM and the per-dispatch
+# allocation (ISSUE 13). Recognized like the apply fns: donation is
+# required even if a refactor ever drops the in-step update apply.
+EXPRESS_ENTRY_FNS = {"express_verdicts"}
 CACHE_DECORATORS = {"lru_cache", "cache"}
-# jitted-step callables at call sites (the engine/scheduler convention)
-STEP_CALLEES = {"_step", "_dhcp_step", "step_fn"}
+# jitted-step callables at call sites (the engine/scheduler convention).
+# `express_exe` is the AOT-compiled express executable (the engine's
+# run_express_aot parameter name): same scalar discipline at call sites
+# — an AOT executable rejects nothing at trace time (there is none), so
+# a weak-typed scalar would surface as a shape error at dispatch.
+STEP_CALLEES = {"_step", "_dhcp_step", "step_fn", "express_exe"}
 
 
 def _is_jax_jit(node: ast.Call) -> tuple[bool, ast.Call | None]:
@@ -131,17 +143,18 @@ class JitDisciplinePass(Pass):
                 "partial") and len(jit_call.args) > 1) else (
                 jit_call.args[0] if jit_call.args else None)
             inner = self._resolve_local_fn(node, target)
+        must_donate = APPLY_FNS | EXPRESS_ENTRY_FNS
         applies = False
         if inner is not None:
             applies = any(isinstance(n, ast.Call)
-                          and call_name(n) in APPLY_FNS
+                          and call_name(n) in must_donate
                           for n in ast.walk(inner))
         elif fn is not None:
             # factory whose inner fn we couldn't chase (shard_map wrap):
             # any sibling local function applying updates counts
             applies = any(
                 isinstance(s, ast.FunctionDef) and any(
-                    isinstance(n, ast.Call) and call_name(n) in APPLY_FNS
+                    isinstance(n, ast.Call) and call_name(n) in must_donate
                     for n in ast.walk(s))
                 for s in ast.walk(fn))
         if applies:
@@ -150,9 +163,10 @@ class JitDisciplinePass(Pass):
             if donate is None:
                 yield Finding(
                     "BNG011", path, node.lineno,
-                    "jitted step applies table updates but has no "
-                    "donate_argnums — the pre-step table buffers stay "
-                    "live and HBM holds every table twice",
+                    "jitted step applies table updates (or runs the "
+                    "express probe program) but has no donate_argnums — "
+                    "the pre-step table buffers stay live and HBM holds "
+                    "every table twice",
                     scope=scope, detail="missing-donate")
         # unhashable static args
         for kw_name in ("static_argnums", "static_argnames"):
@@ -180,13 +194,15 @@ class JitDisciplinePass(Pass):
                 f"functools.lru_cache — a new trace cache per call "
                 f"(the `_pipeline_jit` factory pattern is the fix)",
                 scope=scope, detail=f"jit-in-{fn.name}")
-        if any(isinstance(n, ast.Call) and call_name(n) in APPLY_FNS
+        if any(isinstance(n, ast.Call)
+               and call_name(n) in (APPLY_FNS | EXPRESS_ENTRY_FNS)
                for n in ast.walk(decorated)):
             yield Finding(
                 "BNG011", path, dec.lineno,
-                "jitted step applies table updates but has no "
-                "donate_argnums — the pre-step table buffers stay "
-                "live and HBM holds every table twice",
+                "jitted step applies table updates (or runs the "
+                "express probe program) but has no donate_argnums — "
+                "the pre-step table buffers stay live and HBM holds "
+                "every table twice",
                 scope=scope, detail="missing-donate")
 
     @staticmethod

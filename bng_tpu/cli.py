@@ -55,6 +55,13 @@ class BNGConfig:
     scheduler_enabled: bool = False
     sched_express_batch: int = 64
     sched_express_max_wait_us: float = 200.0
+    # AOT express OFFER path (ISSUE 13): minimal-program lane compiled
+    # ahead of time for the express batch geometry, replies patched
+    # into preassembled wire templates host-side; a geometry miss falls
+    # back to the jit full-program path loudly
+    # (bng_express_aot_miss_total + flight-recorder note). Also
+    # disabled via BNG_EXPRESS_AOT=0.
+    sched_express_aot: bool = True
     sched_bulk_depth: int = 2
     sched_drain_every: int = 1
     # slow-path fleet (control/fleet.py + control/admission.py): N
@@ -803,12 +810,14 @@ class BNGApp:
             c["scheduler"] = TieredScheduler(c["engine"], SchedulerConfig(
                 express_batch=cfg.sched_express_batch,
                 express_max_wait_us=cfg.sched_express_max_wait_us,
+                express_aot=cfg.sched_express_aot,
                 bulk_batch=cfg.batch_size,
                 bulk_depth=cfg.sched_bulk_depth,
                 drain_every=cfg.sched_drain_every), clock=self.clock)
             self._on_close(c["scheduler"].close)
             self.log.info("scheduler built",
                           express_batch=cfg.sched_express_batch,
+                          express_aot=cfg.sched_express_aot,
                           bulk_depth=cfg.sched_bulk_depth)
 
         # 9b. walled-garden enforcement sync. One MAC-state feed drives

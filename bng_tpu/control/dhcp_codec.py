@@ -10,6 +10,8 @@ from __future__ import annotations
 import struct
 from dataclasses import dataclass, field
 
+from bng_tpu.control.packets import ipv4_header, udp_header
+
 DHCP_MAGIC = 0x63825363
 
 # Message types
@@ -166,6 +168,7 @@ def encode_options(options: list[tuple[int, bytes]]) -> bytes:
 
 # fixed-field offsets in the BOOTP payload (RFC 2131 figure 1)
 _OFF_XID = 4
+_OFF_SECS = 8
 _OFF_FLAGS = 10
 _OFF_CIADDR = 12
 _OFF_YIADDR = 16
@@ -206,9 +209,11 @@ class ReplyTemplate:
         self.options = list(options)
 
     def render(self, xid: int, chaddr: bytes, yiaddr: int = 0,
-               flags: int = 0, ciaddr: int = 0, giaddr: int = 0) -> bytes:
+               flags: int = 0, ciaddr: int = 0, giaddr: int = 0,
+               secs: int = 0) -> bytes:
         buf = bytearray(self._proto)
         struct.pack_into("!I", buf, _OFF_XID, xid)
+        struct.pack_into("!H", buf, _OFF_SECS, secs)
         struct.pack_into("!H", buf, _OFF_FLAGS, flags)
         struct.pack_into("!II", buf, _OFF_CIADDR, ciaddr, yiaddr)
         struct.pack_into("!I", buf, _OFF_GIADDR, giaddr)
@@ -276,3 +281,107 @@ def build_request(
             sub += bytes([OPT82_REMOTE_ID, len(remote_id)]) + remote_id
         p.options.append((OPT_RELAY_AGENT_INFO, sub))
     return p
+
+
+# ---------------------------------------------------------------------------
+# Express wire templates (ISSUE 13): the AOT express retire path
+# ---------------------------------------------------------------------------
+
+class ExpressWireTemplate:
+    """Preassembled full-wire DHCP reply for the AOT express path.
+
+    The express device program (ops/express.py) emits only
+    verdict + yiaddr + pool/lease words; everything byte-static per
+    (pool config, server config, reply type) is assembled ONCE here —
+    the canonical IPv4+UDP header pair with the broadcast checksum
+    folded, and the whole BOOTREPLY payload through a `ReplyTemplate`
+    (the same preassembled machinery the slow-path server renders
+    through, so the express retire path can never re-enter the generic
+    per-option TLV encode). `render` patches only the per-client words
+    and copies the request's tag stack verbatim — byte-identical to the
+    device compose in ops/dhcp.py (option order 53,54,51,1,3,[6],58,59,
+    END; TTL 64, IP id 0, UDP checksum 0, relayed/broadcast/unicast
+    addressing), pinned by tests/test_express.py.
+    """
+
+    __slots__ = ("_src_mac", "_server_ip", "_bootp", "_l3", "_udp_len")
+
+    def __init__(self, server_mac: bytes, server_ip: int, gateway: int,
+                 dns1: int, dns2: int, lease_t: int, mask: int,
+                 reply_type: int):
+        opts = [
+            (OPT_MSG_TYPE, bytes([reply_type])),
+            (OPT_SERVER_ID, struct.pack("!I", server_ip)),
+            (OPT_LEASE_TIME, struct.pack("!I", lease_t)),
+            (OPT_SUBNET_MASK, struct.pack("!I", mask)),
+            (OPT_ROUTER, struct.pack("!I", gateway)),
+        ]
+        if dns1:
+            dns = struct.pack("!I", dns1)
+            if dns2:
+                dns += struct.pack("!I", dns2)
+            opts.append((OPT_DNS, dns))
+        opts.append((OPT_RENEWAL_TIME, struct.pack("!I", lease_t // 2)))
+        opts.append((OPT_REBIND_TIME, struct.pack("!I", (lease_t * 7) // 8)))
+        self._src_mac = server_mac
+        self._server_ip = server_ip
+        self._bootp = ReplyTemplate(opts, siaddr=server_ip)
+        # canonical non-relayed L3+L4 prototype via the shared header
+        # helpers (ONE copy of the IPv4 checksum arithmetic, the same
+        # one the slow-path frames fold through) — ops/dhcp.py parity:
+        # TTL 64, id 0, UDP checksum 0, broadcast dst
+        self._udp_len = 8 + len(self._bootp._proto)
+        self._l3 = (ipv4_header(server_ip, 0xFFFFFFFF, self._udp_len, 17)
+                    + udp_header(67, 68, len(self._bootp._proto)))
+
+    def render(self, frame: bytes, vlan_off: int, dhcp_off: int,
+               relayed: bool, use_bcast: bool, yiaddr: int) -> bytes:
+        """Patch the per-client words into the prototype. `frame` is the
+        original request; xid/secs/flags/ciaddr/giaddr/chaddr and the
+        VLAN tag stack are copied from it exactly as the device compose
+        copies them."""
+        xid, secs, flags16 = struct.unpack_from("!IHH", frame, dhcp_off + 4)
+        ciaddr, = struct.unpack_from("!I", frame, dhcp_off + 12)
+        giaddr, = struct.unpack_from("!I", frame, dhcp_off + 24)
+        chaddr = frame[dhcp_off + 28: dhcp_off + 44]
+        payload = self._bootp.render(xid, chaddr, yiaddr=yiaddr,
+                                     flags=flags16, ciaddr=ciaddr,
+                                     giaddr=giaddr, secs=secs)
+        if relayed:
+            # unicast to the relay on port 67 (ops/dhcp.py :734/:740)
+            l3b = (ipv4_header(self._server_ip, giaddr, self._udp_len, 17)
+                   + udp_header(67, 67, len(self._bootp._proto)))
+            dst_mac = frame[6:12]  # requester (relay) src MAC
+        else:
+            l3b = self._l3
+            dst_mac = b"\xff" * 6 if use_bcast else chaddr[:6]
+        return dst_mac + self._src_mac + frame[12: 14 + vlan_off] + l3b + payload
+
+
+class ExpressTemplateCache:
+    """Bounded value-keyed cache of ExpressWireTemplates.
+
+    Keys carry every option-relevant VALUE (same discipline as the
+    slow-path server's _static_reply_options key): a reconfigured pool
+    or server can never serve a stale template, it simply builds a new
+    entry. The lease time comes from the DEVICE-reported lease words,
+    so the rendered option 51/58/59 always reflects the table state
+    that actually served the probe."""
+
+    def __init__(self, maxsize: int = 1024):
+        self.maxsize = maxsize
+        self._cache: dict[tuple, ExpressWireTemplate] = {}
+
+    def get(self, server_mac: bytes, server_ip: int, gateway: int,
+            dns1: int, dns2: int, lease_t: int, mask: int,
+            reply_type: int) -> ExpressWireTemplate:
+        key = (server_mac, server_ip, gateway, dns1, dns2, lease_t, mask,
+               reply_type)
+        tmpl = self._cache.get(key)
+        if tmpl is None:
+            tmpl = ExpressWireTemplate(server_mac, server_ip, gateway,
+                                       dns1, dns2, lease_t, mask, reply_type)
+            if len(self._cache) >= self.maxsize:
+                self._cache.pop(next(iter(self._cache)))
+            self._cache[key] = tmpl
+        return tmpl

@@ -319,6 +319,18 @@ class BNGMetrics:
             "bng_sched_dispatch_latency_seconds",
             "Oldest-frame submit->retire latency per dispatched batch",
             lbl_lane)
+        # AOT express OFFER path (ISSUE 13): which program served the
+        # express lane, and how often the AOT geometry missed — a miss
+        # falls back to the jit full-program path, so a rising miss
+        # counter under steady traffic IS a fallback storm
+        self.express_program_dispatches = r.counter(
+            "bng_express_program_dispatches_total",
+            "Express-lane device dispatches by serving program",
+            ("program",))
+        self.express_aot_miss = r.counter(
+            "bng_express_aot_miss_total",
+            "Express dispatches that missed the AOT program cache and "
+            "fell back to the jit full-program path")
         # slow-path fleet (control/fleet.py + control/admission.py). The
         # reference's concurrency is invisible goroutines; here worker
         # sharding, admission shedding and lease-slice refill are
@@ -643,6 +655,12 @@ class BNGMetrics:
         self.sched_oversize_dropped.set_total(snap.get("oversize_dropped", 0))
         self.sched_completions_evicted.set_total(
             snap.get("completions_dropped", 0))
+        ex = snap.get("express") or {}
+        self.express_program_dispatches.set_total(
+            ex.get("aot_dispatches", 0), program="aot-express")
+        self.express_program_dispatches.set_total(
+            ex.get("jit_dispatches", 0), program="jit-full")
+        self.express_aot_miss.set_total(ex.get("aot_misses", 0))
 
     def collect_fleet(self, fleet) -> None:
         """SlowPathFleet.stats_snapshot() -> bng_slowpath_* families."""

@@ -47,6 +47,11 @@ class PendingFrame(NamedTuple):
     from_access: bool
     enq_t: float  # lane clock at submit (dispatch-latency origin)
     tag: object  # caller correlation token (e.g. submission index)
+    # express descriptor (ops/express.ExpressDesc), extracted ONCE at
+    # admission — the AOT express dispatch consumes these columns
+    # directly instead of re-peeking the frame bytes at batch close.
+    # None on the bulk lane and on the jit-full express path.
+    desc: object = None
 
 
 @dataclass
@@ -77,14 +82,14 @@ class Lane:
         return len(self.q)
 
     def push(self, frame: bytes, from_access: bool, now: float | None = None,
-             tag: object = None) -> bool:
+             tag: object = None, desc: object = None) -> bool:
         """Queue a frame; False = lane over max_queue (frame dropped —
         the caller counts it as backpressure, like an RX ring overflow)."""
         if len(self.q) >= self.cfg.max_queue:
             self.stats.dropped_overflow += 1
             return False
         now = now if now is not None else self.clock()
-        self.q.append(PendingFrame(frame, from_access, now, tag))
+        self.q.append(PendingFrame(frame, from_access, now, tag, desc))
         self.stats.enqueued += 1
         return True
 
@@ -131,6 +136,12 @@ class InflightEntry:
     dispatch_t: float
     close_reason: str
     trace: object = None  # telemetry batch-record token (None = disarmed)
+    # dispatch-epoch snapshot the retire path must read instead of the
+    # live host mirrors (the AOT express retire renders replies from
+    # pool/server config that must match the table epoch the device
+    # verdict was computed against — a config rewrite between dispatch
+    # and retire would otherwise produce a mixed-epoch reply)
+    meta: object = None
 
 
 class CompletionRing:

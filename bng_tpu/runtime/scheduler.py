@@ -42,6 +42,7 @@ loadtest harness drives.
 
 from __future__ import annotations
 
+import os
 from collections import deque
 from dataclasses import dataclass
 from typing import Callable, NamedTuple
@@ -50,11 +51,20 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from bng_tpu.control.dhcp_codec import ACK, DISCOVER, OFFER, ExpressTemplateCache
+from bng_tpu.ops.dhcp import (PV_DNS1, PV_DNS2, PV_GATEWAY, PV_PREFIX,
+                              SC_IP, SC_MAC_HI, SC_MAC_LO)
+from bng_tpu.ops.express import (VB_LEASE_T, VB_POOL, VB_VERDICT, VB_YIADDR,
+                                 XD_WORDS, parse_express)
 from bng_tpu.ops.pipeline import VERDICT_DROP, VERDICT_FWD, VERDICT_TX
 from bng_tpu.telemetry import spans as tele
+from bng_tpu.telemetry.recorder import TRIG_EXPRESS_AOT_MISS
+from bng_tpu.runtime.engine import _ExpressAotResult
 from bng_tpu.runtime.lanes import (CLOSE_FLUSH, CompletionRing, InflightEntry,
                                    Lane, LaneConfig, LANE_BULK, LANE_EXPRESS)
 from bng_tpu.runtime.ring import classify_dhcp
+from bng_tpu.utils.net import prefix_to_mask
+from bng_tpu.utils.structlog import get_logger
 
 
 @dataclass
@@ -63,7 +73,16 @@ class SchedulerConfig:
 
     express_batch: int = 64
     express_max_wait_us: float = 200.0
-    express_depth: int = 1  # in-flight express dispatches within one poll
+    # depth-k pipelining on the fast lane: up to `express_depth` express
+    # dispatches stay in flight inside one poll, so host-side retire
+    # work (template patch-in, completions) overlaps device execution
+    express_depth: int = 2
+    # AOT express OFFER path (ISSUE 13): descriptors extracted at
+    # admission, the minimal express program compiled ahead of time for
+    # this lane's batch geometry, replies patched into preassembled
+    # wire templates at retire. False = the jit full-program path
+    # (also reachable via BNG_EXPRESS_AOT=0).
+    express_aot: bool = True
     bulk_batch: int | None = None  # None = engine.B
     bulk_max_wait_us: float = 2000.0
     bulk_depth: int = 2  # completion-ring depth (>=2: never block per step)
@@ -131,6 +150,41 @@ class TieredScheduler:
         self._replica_refreshes = 0
         self._express_dev = self._pick_express_device()
         self._bulk_dev = jax.devices()[0]
+        # AOT express path: compile the minimal program for THIS lane's
+        # fixed batch geometry at init (never on the dispatch path). A
+        # compile failure downgrades to the jit-full path loudly and
+        # permanently — every subsequent express dispatch counts as an
+        # AOT miss, so a silent downgrade is impossible.
+        self._log = get_logger("scheduler")
+        self.express_aot_misses = 0
+        self.express_aot_dispatches = 0
+        self.express_jit_dispatches = 0
+        self._aot_enabled = (self.cfg.express_aot
+                             and os.environ.get("BNG_EXPRESS_AOT") != "0")
+        # _aot_ready gates the per-frame admission parse only: after a
+        # permanent compile failure no executable will ever consume a
+        # descriptor, so submit() must not keep paying parse_express on
+        # the latency-critical path. Dispatch-side miss accounting keys
+        # on _aot_enabled alone — the degraded state stays loud.
+        self._aot_ready = False
+        self._express_templates = ExpressTemplateCache()
+        if self._aot_enabled:
+            self._compile_express_aot()
+
+    def _compile_express_aot(self) -> None:
+        # reset FIRST: an adopt-time recompile failure (new engine
+        # geometry that refuses to lower) must drop readiness from the
+        # previous engine's success, or submit() keeps paying the
+        # per-frame descriptor parse for a program that no longer exists
+        self._aot_ready = False
+        try:
+            self.engine.compile_express_aot(self.express.cfg.batch,
+                                            self._express_dev)
+            self._aot_ready = True
+        except Exception as e:  # noqa: BLE001 — downgrade, never brick
+            self._log.warning("express AOT compile failed; jit-full "
+                              "fallback will serve (counted as misses)",
+                              error=f"{type(e).__name__}: {e}")
 
     def _pick_express_device(self):
         idx = self.cfg.express_device_index
@@ -170,8 +224,17 @@ class TieredScheduler:
             self.oversize_dropped += 1
             return None
         lane_name = lane or self.classify(frame, from_access)
-        lane_obj = self.express if lane_name == LANE_EXPRESS else self.bulk
-        return lane_name if lane_obj.push(frame, from_access, now, tag) else None
+        if lane_name == LANE_EXPRESS:
+            # admission→dispatch bypass (ISSUE 13): the express
+            # descriptor (MAC/xid/vlan/cid lane columns) is extracted
+            # exactly once, HERE — batch close stages descriptor rows
+            # straight to the device with no second peek at the frame
+            # bytes. None (AOT off / frame the device would PASS anyway)
+            # rides along and retires through the slow path.
+            desc = parse_express(frame) if self._aot_ready else None
+            ok = self.express.push(frame, from_access, now, tag, desc=desc)
+            return LANE_EXPRESS if ok else None
+        return lane_name if self.bulk.push(frame, from_access, now, tag) else None
 
     # -- the beat --------------------------------------------------------
 
@@ -248,6 +311,11 @@ class TieredScheduler:
         self.engine = engine
         self._bulk_dhcp = None
         self._replica_resync = -1
+        if self._aot_enabled:
+            # the standby's geometry usually matches (cache hit); a
+            # changed geometry compiles here, at the flip, not on the
+            # first post-flip dispatch
+            self._compile_express_aot()
         return retired
 
     # -- express lane ----------------------------------------------------
@@ -264,7 +332,15 @@ class TieredScheduler:
 
     def _dispatch_express(self, pend, now: float, reason: str) -> int:
         """Dispatch one express batch; returns frames retired as a side
-        effect of the completion ring overflowing its depth."""
+        effect of the completion ring overflowing its depth.
+
+        AOT path: descriptor rows (staged at admission) go straight to
+        the compiled minimal program. A geometry miss — the compiled
+        executable for this batch shape is absent (compile failed, lane
+        geometry changed under a live scheduler) — falls back to the
+        jit-full `_dhcp_jit` path, counts `bng_express_aot_miss_total`
+        and drops a flight-recorder note: a fallback storm can never
+        masquerade as a healthy express hit."""
         if not pend:
             return 0
         eng = self.engine
@@ -274,19 +350,54 @@ class TieredScheduler:
             # deadline close bounds (computed from enqueue stamps, so the
             # per-frame submit path pays no telemetry cost at all)
             tele.observe(tele.LANE_WAIT, (now - pend[0].enq_t) * 1e6, tok)
-        pkt, length = eng._pack_frames([p.frame for p in pend],
-                                       self.express.cfg.batch)
+        exe = None
+        if self._aot_enabled:
+            # _aot_ready gate: pending frames carry descriptors only
+            # when the init-time compile succeeded — an executable from
+            # the shared cache must not serve descriptor-less frames
+            exe = (eng.express_aot(self.express.cfg.batch,
+                                   self._express_dev)
+                   if self._aot_ready else None)
+            if exe is None:
+                self.express_aot_misses += 1
+                tele.trigger(TRIG_EXPRESS_AOT_MISS,
+                             f"no compiled express program for batch="
+                             f"{self.express.cfg.batch} impl="
+                             f"{eng.table_impl}: jit-full fallback served")
         t0 = tele.t()
+        cfg_epoch = None
         try:
-            res = eng._run_dhcp_batch(pkt, length, now,
-                                      device=self._express_dev)
+            if exe is not None:
+                desc = np.zeros((self.express.cfg.batch, XD_WORDS),
+                                dtype=np.uint32)
+                for i, p in enumerate(pend):
+                    if p.desc is not None:
+                        desc[i] = p.desc.words
+                res = eng.run_express_aot(exe, desc, now,
+                                          device=self._express_dev)
+                # snapshot the pool/server config of THIS dispatch's
+                # table epoch: the retire (one poll later at depth>1)
+                # must render from the rows the device verdict saw, not
+                # from mirrors a control-plane write may have moved on
+                cfg_epoch = (eng.fastpath.pools.copy(),
+                             eng.fastpath.server.copy())
+                self.express_aot_dispatches += 1
+                tele.set_meta("express_program", "aot-express")
+            else:
+                pkt, length = eng._pack_frames([p.frame for p in pend],
+                                               self.express.cfg.batch)
+                res = eng._run_dhcp_batch(pkt, length, now,
+                                          device=self._express_dev)
+                self.express_jit_dispatches += 1
+                tele.set_meta("express_program", "jit-full")
         except BaseException:
             tele.cancel_batch(tok)  # a failed dispatch must not leak a slot
             raise
         tele.lap(tele.DISPATCH, t0, tok)
         self._observe_dispatch(LANE_EXPRESS, len(pend), reason)
         over = self._express_ring.push(
-            InflightEntry(res, pend, now, reason, trace=tok))
+            InflightEntry(res, pend, now, reason, trace=tok,
+                          meta=cfg_epoch))
         return self._retire_express(over) if over is not None else 0
 
     def _retire_express_all(self) -> int:
@@ -300,6 +411,8 @@ class TieredScheduler:
     def _retire_express(self, entry: InflightEntry) -> int:
         """Force + demux one express batch (TX replies / PASS to the slow
         path). Blocks only on the express program's own outputs."""
+        if isinstance(entry.res, _ExpressAotResult):
+            return self._retire_express_aot(entry)
         eng = self.engine
         res = entry.res
         n = len(entry.pending)
@@ -334,6 +447,64 @@ class TieredScheduler:
         tele.end_batch(entry.trace)
         self._observe_retire(LANE_EXPRESS, entry, now)
         return n
+
+    def _retire_express_aot(self, entry: InflightEntry) -> int:
+        """Retire one AOT express batch: force the verdict block, patch
+        on-device answers into preassembled wire templates
+        (control/dhcp_codec.ExpressWireTemplate — UNCONDITIONALLY; the
+        express retire path never re-enters the generic per-option
+        reply encode), hand the rest to the slow path."""
+        eng = self.engine
+        n = len(entry.pending)
+        tele.focus(entry.trace)
+        t0 = tele.t()
+        block = np.asarray(entry.res.block)[:n]
+        tele.lap(tele.DEVICE_WAIT, t0, entry.trace)
+        eng._fold_stats(entry.res)
+        now = self.clock()
+        slow_items = [(i, p.frame, p.enq_t)
+                      for i, p in enumerate(entry.pending)
+                      if not block[i, VB_VERDICT]]
+        replies = dict(eng._handle_slow_lanes(slow_items,
+                                              path="sched_express"))
+        t0 = tele.t()
+        pools, server = entry.meta  # the dispatch-epoch config snapshot
+        for i, p in enumerate(entry.pending):
+            if block[i, VB_VERDICT]:
+                eng.stats.tx += 1
+                self._complete(p, LANE_EXPRESS, "tx",
+                               self._express_reply(p, block[i], pools,
+                                                   server), now)
+            else:
+                eng.stats.passed += 1
+                self._complete(p, LANE_EXPRESS, "slow", replies.get(i), now)
+        tele.lap(tele.REPLY, t0, entry.trace)
+        tele.end_batch(entry.trace)
+        self._observe_retire(LANE_EXPRESS, entry, now)
+        return n
+
+    def _express_reply(self, p, row: np.ndarray, pools: np.ndarray,
+                       server: np.ndarray) -> bytes:
+        """One verdict row -> reply bytes: select the per-(pool, reply
+        type) wire template and patch the per-client words. Pool/server
+        config comes from the DISPATCH-EPOCH snapshot (the device
+        pools/server arrays were refreshed from exactly those rows at
+        dispatch; reading the live mirrors here could mix a newer
+        config into a verdict computed against the old one); the lease
+        words come from the DEVICE-reported block, so the rendered
+        lease triplet always reflects the serving table."""
+        prow = pools[int(row[VB_POOL])]
+        server_ip = int(server[SC_IP]) or int(prow[PV_GATEWAY])
+        server_mac = (int(server[SC_MAC_HI]).to_bytes(2, "big")
+                      + int(server[SC_MAC_LO]).to_bytes(4, "big"))
+        d = p.desc
+        tmpl = self._express_templates.get(
+            server_mac, server_ip, int(prow[PV_GATEWAY]),
+            int(prow[PV_DNS1]), int(prow[PV_DNS2]), int(row[VB_LEASE_T]),
+            prefix_to_mask(int(prow[PV_PREFIX])),
+            OFFER if d.msg_type == DISCOVER else ACK)
+        return tmpl.render(p.frame, d.vlan_off, d.dhcp_off, d.relayed,
+                           d.use_bcast, int(row[VB_YIADDR]))
 
     # -- bulk lane -------------------------------------------------------
 
@@ -558,6 +729,10 @@ class TieredScheduler:
         out["express"]["own_device"] = (str(self._express_dev)
                                         if self._express_dev is not None
                                         else None)
+        out["express"]["aot_enabled"] = self._aot_enabled
+        out["express"]["aot_dispatches"] = self.express_aot_dispatches
+        out["express"]["jit_dispatches"] = self.express_jit_dispatches
+        out["express"]["aot_misses"] = self.express_aot_misses
         out["completions_dropped"] = self.completions_dropped
         out["oversize_dropped"] = self.oversize_dropped
         return out

@@ -123,6 +123,27 @@ def _check_dhcp_express(impl: str) -> None:
                np.uint32(1)).compile()
 
 
+def _check_express_aot(impl: str) -> None:
+    """The AOT express OFFER program (ISSUE 13): descriptor in, verdict
+    block out, tables + descriptor donated. Exactly the lower+compile
+    the serving path performs at scheduler init — a program that fails
+    HERE would turn every express dispatch into a counted jit-full
+    fallback, so the gate refuses it up front."""
+    from bng_tpu.ops.express import XD_WORDS
+    from bng_tpu.runtime.engine import _express_jit
+    from bng_tpu.runtime.tables import FastPathTables
+    from bng_tpu.utils.net import ip_to_u32
+
+    B = 64
+    fp = FastPathTables(sub_nbuckets=1 << 10, vlan_nbuckets=256,
+                        cid_nbuckets=256, max_pools=4, stash=64)
+    fp.set_server_config(bytes.fromhex("02aabbccdd01"), ip_to_u32("10.0.0.1"))
+    step = _express_jit(fp.geom, impl)
+    step.lower(fp.device_tables(), fp.empty_updates(),
+               jnp.zeros((B, XD_WORDS), dtype=jnp.uint32),
+               jnp.uint32(1)).compile()
+
+
 def _check_pipeline() -> None:
     from bng_tpu.control.nat import NATManager
     from bng_tpu.ops.pipeline import PipelineGeom, PipelineTables, pipeline_step
@@ -195,6 +216,10 @@ CHECKS: list[tuple[str, Callable[[], None], bool]] = [
      lambda: _check_table("pallas", interpret=False), True),
     ("dhcp_express[xla]", lambda: _check_dhcp_express("xla"), False),
     ("dhcp_express[pallas]", lambda: _check_dhcp_express("pallas"), True),
+    # the AOT minimal OFFER program (ISSUE 13) — the architecture the
+    # offer_device_only_p99_us gate measures on the express lane
+    ("express_aot[xla]", lambda: _check_express_aot("xla"), False),
+    ("express_aot[pallas]", lambda: _check_express_aot("pallas"), True),
     ("fused_pipeline_step", _check_pipeline, False),
     ("sharded_step", _check_sharded, False),
 ]

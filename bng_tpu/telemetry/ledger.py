@@ -186,6 +186,19 @@ def table_impl(line: dict) -> str:
     return str(line.get("table_impl") or env.get("table_impl") or "xla")
 
 
+def express_path(line: dict) -> str:
+    """Which express-lane architecture served the run (ISSUE 13):
+    `aot-express` (minimal AOT program + host template patch-in) vs
+    `jit-full` (the full `_dhcp_jit` device program). Unstamped lines
+    predate the AOT path and measured the full program — defaulting to
+    `jit-full` keeps existing scheduler/OFFER history one cohort
+    instead of voiding it. The two architectures are different
+    programs: the gate must never trend one against the other (rc=3
+    refusal, same discipline as table_impl)."""
+    v = line.get("express_path")
+    return str(v) if v else "jit-full"
+
+
 def n_shards(line: dict) -> int:
     """How many dataplane shards served the run (ISSUE 12): the
     top-level stamp wins (`bench.py --shards` records it on every
@@ -208,7 +221,8 @@ def n_shards(line: dict) -> int:
 
 def cohort_key(line: dict) -> tuple:
     return (line.get("metric"), backend_class(line), device_kind(line),
-            table_impl(line), n_shards(line), geometry(line))
+            table_impl(line), n_shards(line), express_path(line),
+            geometry(line))
 
 
 def _gateable(line: dict) -> bool:
@@ -454,20 +468,25 @@ def gate(lines: list[dict], last_k: int = 8, min_cohort: int = 3,
                    and geometry(ln) == geometry(cand)
                    and (backend_class(ln) != backend_class(cand)
                         or table_impl(ln) != table_impl(cand)
-                        or n_shards(ln) != n_shards(cand))]
+                        or n_shards(ln) != n_shards(cand)
+                        or express_path(ln) != express_path(cand))]
         if not cohort and len(relaxed) >= min_cohort:
             others = sorted({
                 f"{backend_class(ln)}/{table_impl(ln)}"
-                f"/shards={n_shards(ln)}" for ln in relaxed})
+                f"/shards={n_shards(ln)}/express={express_path(ln)}"
+                for ln in relaxed})
             rep.rc = GATE_INCOMPARABLE
             rep.notes.append(
                 f"candidate ran as {backend_class(cand)!r}/"
-                f"{table_impl(cand)!r}/shards={n_shards(cand)} (device "
+                f"{table_impl(cand)!r}/shards={n_shards(cand)}"
+                f"/express={express_path(cand)!r} (device "
                 f"{device_kind(cand) or 'none'!r}) with no same-identity "
                 f"history for this metric+geometry — the existing history "
                 f"is on {others}: refusing the cross-identity comparison "
                 f"(an aggregate sharded number never trends against a "
-                f"different shard count's cohort)")
+                f"different shard count's cohort, and the AOT express "
+                f"architecture never trends against the jit full-program "
+                f"path)")
             return rep
         rep.notes.append(
             f"cohort too small (n={len(cohort)} < {min_cohort}): trend "

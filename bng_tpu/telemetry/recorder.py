@@ -45,6 +45,11 @@ TRIG_BACKEND = "backend_fallback"
 # an SLO burn-rate window (telemetry/slo.py SLOMonitor) or a storm
 # budget (slo.check_budget) crossed its per-stage latency budget
 TRIG_SLO = "slo_breach"
+# an express dispatch found no AOT-compiled program for its batch
+# geometry and fell back to the jit full-program path (ISSUE 13): the
+# gray-failure class where a fallback storm serves every OFFER through
+# the slow architecture while the aggregate counters look healthy
+TRIG_EXPRESS_AOT_MISS = "express_aot_miss"
 
 
 def default_trace_dir() -> str:

@@ -302,6 +302,19 @@ def trigger(reason: str, detail: str = "") -> str | None:
     return _ACTIVE.recorder.trigger(reason, detail)
 
 
+def set_meta(key: str, value) -> None:
+    """Stamp a fact into the flight-record ring metadata — the
+    backend-identity discipline (recorder.set_backend) generalized: the
+    serving identity is per-process/per-path state, not per-batch, so
+    it rides `meta` and lands in every dump. Used by the scheduler to
+    record which express program (aot-express vs jit-full) served the
+    last dispatch, so a fallback storm is diagnosable from one dump.
+    Disarmed: global load + None compare."""
+    if _ACTIVE is None or _ACTIVE.recorder is None:
+        return
+    _ACTIVE.recorder.meta[key] = value
+
+
 class _NoopSpan:
     __slots__ = ()
 

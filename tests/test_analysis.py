@@ -246,6 +246,55 @@ def make_step(geom):
         found = run_on(tmp_path, {"jit-discipline"})
         assert codes_of(found) == {"BNG011"}
 
+    def test_missing_donate_on_express_entry_flagged(self, tmp_path):
+        # ISSUE 13: the AOT-compiled express entry threads the dhcp
+        # chain AND the descriptor batch (verdict block aliases it) —
+        # a jitted step running the express probe program must donate
+        # even when a refactor drops the in-step update apply
+        write_tree(tmp_path, {"bng_tpu/runtime/thing.py": """\
+import functools
+
+import jax
+
+
+@functools.lru_cache(maxsize=8)
+def make_express(geom):
+    def step(tables, desc, now_s):
+        res = express_verdicts(tables, desc, geom, now_s)
+        return tables, res.block
+    return jax.jit(step)          # BNG011: express entry, no donation
+"""})
+        found = run_on(tmp_path, {"jit-discipline"})
+        assert codes_of(found) == {"BNG011"}
+
+    def test_donated_express_entry_clean(self, tmp_path):
+        write_tree(tmp_path, {"bng_tpu/runtime/thing.py": """\
+import functools
+
+import jax
+
+
+@functools.lru_cache(maxsize=8)
+def make_express(geom):
+    def step(tables, upd, desc, now_s):
+        tables = apply_fastpath_updates(tables, upd)
+        res = express_verdicts(tables, desc, geom, now_s)
+        return tables, res.block
+    return jax.jit(step, donate_argnums=(0, 2))
+"""})
+        assert run_on(tmp_path, {"jit-discipline"}) == []
+
+    def test_bare_scalar_at_express_exe_call_flagged(self, tmp_path):
+        # the AOT executable call site obeys the same fixed-width
+        # scalar discipline as the jitted steps
+        write_tree(tmp_path, {"bng_tpu/runtime/thing.py": """\
+class Engine:
+    def go(self, express_exe, tables, upd, desc, now):
+        return self.express_exe(tables, upd, desc, int(now))  # BNG012
+"""})
+        found = run_on(tmp_path, {"jit-discipline"})
+        assert [f.code for f in found] == ["BNG012"]
+
     def test_bare_scalar_at_step_call_flagged(self, tmp_path):
         write_tree(tmp_path, {"bng_tpu/runtime/thing.py": """\
 class Engine:

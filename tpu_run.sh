@@ -60,6 +60,13 @@ step "config2-4M"    1500 "BNG_BENCH_FLOWS=4000000 BNG_BENCH_EIM_SHARE=2 python 
 # step marks FAILED, the window keeps going.
 step "config3-ab-pallas" 900 "BNG_TABLE_IMPL=pallas python bench.py --config 3"
 step "config6-ab-pallas" 900 "BNG_TABLE_IMPL=pallas python bench.py --config 6"
+# AOT express OFFER A/B (ISSUE 13): jit full-program vs AOT minimal-
+# program express on hardware — both offer_device_only_p99_us cohorts
+# land in the ledger under distinct express_path identities (the gate
+# refuses a cross-architecture trend with rc=3), and the 50us verdict
+# is finally measured against the architecture built to pass it.
+step "express-ab"    1200 "python bench.py --express-ab"
+step "express-ab-pallas" 1200 "BNG_TABLE_IMPL=pallas python bench.py --express-ab"
 step "autotune"      1800 "BNG_TABLE_IMPL=auto python bench.py --autotune"
 step "headline-1M"   2400 "BNG_BENCH_SUBS=1000000 BNG_BENCH_FLOWS=1000000 BNG_TABLE_IMPL=auto python bench.py"
 step "headline-1M-xla" 2400 "BNG_BENCH_SUBS=1000000 BNG_BENCH_FLOWS=1000000 BNG_TABLE_IMPL=xla python bench.py"
