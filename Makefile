@@ -103,6 +103,14 @@
 #                       identity, and the SLO device-budget smoke. A
 #                       prerequisite of `verify` (whose tier-1 line
 #                       deselects `express`).
+#   make verify-hostpath — vectorized host serving path (ISSUE 14):
+#                       scalar-vs-vector byte identity over the frame
+#                       corpus (classify/steer/peek kernels, PyRing
+#                       assemble/complete/pops, batched admission,
+#                       fleet pre-pass, staging pools, batched express
+#                       render) in <60s. A prerequisite of `verify`
+#                       (whose tier-1 line deselects `hostpath`; the
+#                       ROADMAP tier-1 command still includes them).
 #   make verify-sanitize — hotpath-marked engine/scheduler tests under
 #                       BNG_SANITIZE=1 (transfer_guard + debug_nans):
 #                       the dynamic cross-check of the static transfer
@@ -124,14 +132,14 @@ PYTEST_FLAGS = -q --continue-on-collection-errors -p no:cacheprovider \
 .PHONY: verify verify-slow verify-all verify-load verify-chaos \
         verify-telemetry verify-static verify-sanitize verify-ops \
         verify-storm verify-perf verify-kernels verify-sharded \
-        verify-express
+        verify-express verify-hostpath
 
 verify: verify-static verify-storm verify-perf verify-kernels \
-        verify-sharded verify-express
+        verify-sharded verify-express verify-hostpath
 	set -o pipefail; rm -f /tmp/_t1.log; \
 	timeout -k 10 $(TIER1_TIMEOUT) env JAX_PLATFORMS=cpu \
 	$(PY) -m pytest tests/ $(PYTEST_FLAGS) \
-	-m 'not slow and not storm and not perf and not kernels and not sharded and not express' \
+	-m 'not slow and not storm and not perf and not kernels and not sharded and not express and not hostpath' \
 	2>&1 | tee /tmp/_t1.log
 
 verify-sharded:
@@ -148,6 +156,13 @@ verify-express:
 	$(PY) -m pytest tests/test_express.py $(PYTEST_FLAGS) \
 	  -m 'express' \
 	&& echo "verify-express OK"
+
+verify-hostpath:
+	set -o pipefail; \
+	timeout -k 10 60 env JAX_PLATFORMS=cpu \
+	$(PY) -m pytest tests/test_hostpath.py $(PYTEST_FLAGS) \
+	  -m 'hostpath and not slow' \
+	&& echo "verify-hostpath OK"
 
 verify-kernels:
 	set -o pipefail; \

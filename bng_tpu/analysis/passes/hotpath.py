@@ -1,4 +1,5 @@
-"""Hot-path sync lint (BNG001) + disarmed-hook hygiene (BNG002/BNG003).
+"""Hot-path sync lint (BNG001) + disarmed-hook hygiene (BNG002/BNG003)
++ batch-native serving-path lint (BNG004).
 
 The dataplane's latency discipline has two halves:
 
@@ -17,6 +18,21 @@ The dataplane's latency discipline has two halves:
   an allocation (literal, comprehension, f-string, lambda) reachable
   before the guard. Hooks are discovered, not listed: any module-level
   function in spans.py/faults.py that delegates to `_ACTIVE.<attr>`.
+
+* **The serving path is batch-native.** ISSUE 14 rebuilt the
+  ring->dispatch->reply host path as vectorized NumPy over
+  structure-of-arrays staging; a reintroduced `for frame in batch`
+  loop in one of those functions silently re-caps host throughput at
+  per-frame-Python speed. BNG004 flags any `for`/`while` statement in
+  the BATCH_SCOPE functions, EXCEPT `for ... in range(<int literal>)`
+  (bounded vectorized iteration — the 2-tag VLAN walk, the 64-step TLV
+  scan — iterates a constant, never the batch). Comprehensions are
+  deliberately NOT flagged: a list comprehension feeding one stacked
+  NumPy assignment is the batch-native staging idiom, and the
+  per-frame handler boundaries (worker scatter, fallback demux) live
+  behind them. Surviving per-frame loops — the scalar oracle twins the
+  vector path is pinned against, and the pressured-path fallbacks with
+  genuine sequential coupling — are baselined with justifications.
 
 Taint for BNG001 is function-local and deliberately simple: a name
 assigned from a dispatch call (`self._step(...)`, `_run_dhcp_batch`,
@@ -69,6 +85,30 @@ ALLOC_NODES = (ast.List, ast.Dict, ast.Set, ast.ListComp, ast.DictComp,
                ast.SetComp, ast.GeneratorExp, ast.Lambda, ast.JoinedStr)
 ALLOC_CALLS = {"list", "dict", "set", "zeros", "empty", "ones", "full",
                "deque", "defaultdict"}
+
+# batch-native scope (BNG004): the per-BATCH serving-path functions that
+# must not loop per frame. file suffix -> function (simple) names; both
+# the vector implementations (loop-free, enforced) and their scalar
+# oracle twins (baselined) are listed — a NEW loop in either shows up.
+BATCH_SCOPE: dict[str, set[str]] = {
+    "bng_tpu/runtime/ring.py": {
+        "rx_push_batch", "_rx_push_batch_vec", "_push_scalar",
+        "assemble", "_assemble_vec",
+        "assemble_sharded", "_assemble_sharded_vec", "complete",
+        "_complete_vec", "_scatter_frames", "_scatter_rows_from",
+        "_gather_rows", "tx_pop_batch",
+    },
+    "bng_tpu/runtime/engine.py": {"_pack_frames"},
+    "bng_tpu/runtime/scheduler.py": {"_dispatch_express",
+                                     "_express_replies_vec"},
+    "bng_tpu/control/admission.py": {"admit_batch", "is_known_batch",
+                                     "_admit_scalar_fallback"},
+    "bng_tpu/control/fleet.py": {"handle_batch", "_admit_vec"},
+    "bng_tpu/runtime/hostpath.py": {
+        "pack_into", "classify_dhcp_batch", "shard_of_batch",
+        "peek_dhcp_batch", "bootp_off_batch", "fnv1a32_cols", "stage",
+    },
+}
 
 
 def _is_force_call(node: ast.Call) -> bool:
@@ -136,6 +176,8 @@ class HotPathPass(Pass):
                   "hook",
         "BNG003": "hook delegates to _ACTIVE without a disarmed "
                   "fast-path guard",
+        "BNG004": "per-frame Python loop in a batch-native serving-path "
+                  "function",
     }
 
     def run(self, project: Project) -> list[Finding]:
@@ -150,7 +192,51 @@ class HotPathPass(Pass):
                     out.extend(self._check_dispatch_fn(sf.path, node))
             if suffix.endswith(("spans.py", "faults.py")):
                 out.extend(self._check_hooks(sf.path, sf.tree))
+        for suffix, fn_names in BATCH_SCOPE.items():
+            sf = project.find_file(suffix)
+            if sf is None:
+                continue
+            for node in ast.walk(sf.tree):
+                if (isinstance(node, ast.FunctionDef)
+                        and node.name in fn_names):
+                    out.extend(self._check_batch_fn(sf.path, node))
         return out
+
+    # -- BNG004 ----------------------------------------------------------
+
+    @staticmethod
+    def _const_range(it: ast.AST) -> bool:
+        """`range(<int literal>...)` — bounded vectorized iteration (the
+        2-tag VLAN walk, the 64-step TLV scan), never the batch."""
+        return (isinstance(it, ast.Call)
+                and isinstance(it.func, ast.Name)
+                and it.func.id == "range"
+                and len(it.args) >= 1
+                and all(isinstance(a, ast.Constant)
+                        and isinstance(a.value, int) for a in it.args))
+
+    def _check_batch_fn(self, path: str, fn: ast.FunctionDef):
+        scope = (scope_of(fn) + "." + fn.name).lstrip(".")
+        for node in ast.walk(fn):
+            if isinstance(node, ast.While):
+                yield Finding(
+                    "BNG004", path, node.lineno,
+                    f"`while` loop in batch-native serving function "
+                    f"`{fn.name}` — the vectorized host path must not "
+                    f"iterate per frame (ISSUE 14); express the work as "
+                    f"a NumPy pass or baseline the scalar oracle",
+                    scope=scope, detail="while")
+            elif isinstance(node, ast.For):
+                if self._const_range(node.iter):
+                    continue
+                yield Finding(
+                    "BNG004", path, node.lineno,
+                    f"`for` loop in batch-native serving function "
+                    f"`{fn.name}` — the vectorized host path must not "
+                    f"iterate per frame (ISSUE 14); express the work as "
+                    f"a NumPy pass or baseline the scalar oracle",
+                    scope=scope,
+                    detail=f"for:{ast.unparse(node.target)}")
 
     # -- BNG001 ----------------------------------------------------------
 

@@ -243,6 +243,16 @@ class FaultInjector:
 _ACTIVE: FaultInjector | None = None
 
 
+def any_armed() -> bool:
+    """Is ANY fault plan armed? The vectorized host paths (ISSUE 14)
+    check this once per batch and fall back to their per-frame scalar
+    twins when chaos is live: fault plans count per-call hits, so a
+    batched path that skipped N-1 of N fault_point() visits would
+    silently shift every later hit in the plan. Disarmed (production):
+    one global load + None compare, same contract as fault_point."""
+    return _ACTIVE is not None
+
+
 def fault_point(name: str) -> FaultSpec | None:
     """The instrumentation hook. Disarmed (the production state) this is
     a global load + None compare — nothing else. Armed, it asks the

@@ -59,6 +59,7 @@ from bng_tpu.ops.express import (VB_LEASE_T, VB_POOL, VB_VERDICT, VB_YIADDR,
 from bng_tpu.ops.pipeline import VERDICT_DROP, VERDICT_FWD, VERDICT_TX
 from bng_tpu.telemetry import spans as tele
 from bng_tpu.telemetry.recorder import TRIG_EXPRESS_AOT_MISS
+from bng_tpu.runtime import hostpath
 from bng_tpu.runtime.engine import _ExpressAotResult
 from bng_tpu.runtime.lanes import (CLOSE_FLUSH, CompletionRing, InflightEntry,
                                    Lane, LaneConfig, LANE_BULK, LANE_EXPRESS)
@@ -168,8 +169,31 @@ class TieredScheduler:
         # on _aot_enabled alone — the degraded state stays loud.
         self._aot_ready = False
         self._express_templates = ExpressTemplateCache()
+        # host-path snapshot (ISSUE 14): vector = cycling descriptor
+        # staging buffers (no per-dispatch np.zeros) + batched template
+        # patch-in at the AOT express retire
+        self._vec = hostpath.resolved_host_path() == "vector"
+        # express_depth dispatches may be in flight plus one staging;
+        # run_express_aot copies the staged rows to the device
+        self._desc_bufs = [
+            np.zeros((self.cfg.express_batch, XD_WORDS), dtype=np.uint32)
+            for _ in range(self.cfg.express_depth + 2)]
+        self._desc_i = 0
+        self._ensure_engine_staging()
         if self._aot_enabled:
             self._compile_express_aot()
+
+    def _ensure_engine_staging(self) -> None:
+        """Declare this scheduler's worst-case in-flight dispatch count
+        to the engine's frame staging pool (vector host path): both
+        lanes stage through it, the depths are configurable, and
+        express_batch == bulk_batch would even share one B-keyed buffer
+        ring — the pool must cycle past every dispatch that could still
+        be reading a staged buffer."""
+        pool = getattr(self.engine, "_stage_pool", None)
+        if pool is not None:
+            pool.ensure_depth(self.cfg.express_depth
+                              + self.cfg.bulk_depth + 2)
 
     def _compile_express_aot(self) -> None:
         # reset FIRST: an adopt-time recompile failure (new engine
@@ -311,6 +335,8 @@ class TieredScheduler:
         self.engine = engine
         self._bulk_dhcp = None
         self._replica_resync = -1
+        self._ensure_engine_staging()  # the standby's pool starts at
+        # the construction default; re-declare this scheduler's depths
         if self._aot_enabled:
             # the standby's geometry usually matches (cache hit); a
             # changed geometry compiles here, at the flip, not on the
@@ -368,11 +394,19 @@ class TieredScheduler:
         cfg_epoch = None
         try:
             if exe is not None:
-                desc = np.zeros((self.express.cfg.batch, XD_WORDS),
-                                dtype=np.uint32)
-                for i, p in enumerate(pend):
-                    if p.desc is not None:
-                        desc[i] = p.desc.words
+                # descriptor rows staged into a cycling preallocated
+                # buffer (run_express_aot copies host->device, so the
+                # buffer is free to rewrite after depth+1 dispatches);
+                # the fill is ONE stacked numpy assignment, not a
+                # per-frame copy loop
+                desc = self._desc_bufs[self._desc_i]
+                self._desc_i = (self._desc_i + 1) % len(self._desc_bufs)
+                desc[:] = 0
+                rows = [p.desc.words for p in pend if p.desc is not None]
+                if rows:
+                    idxs = [i for i, p in enumerate(pend)
+                            if p.desc is not None]
+                    desc[idxs] = rows
                 res = eng.run_express_aot(exe, desc, now,
                                           device=self._express_dev)
                 # snapshot the pool/server config of THIS dispatch's
@@ -469,10 +503,13 @@ class TieredScheduler:
                                               path="sched_express"))
         t0 = tele.t()
         pools, server = entry.meta  # the dispatch-epoch config snapshot
+        txr = (self._express_replies_vec(entry.pending, block, pools,
+                                         server) if self._vec else None)
         for i, p in enumerate(entry.pending):
             if block[i, VB_VERDICT]:
                 eng.stats.tx += 1
                 self._complete(p, LANE_EXPRESS, "tx",
+                               txr[i] if txr is not None else
                                self._express_reply(p, block[i], pools,
                                                    server), now)
             else:
@@ -482,6 +519,43 @@ class TieredScheduler:
         tele.end_batch(entry.trace)
         self._observe_retire(LANE_EXPRESS, entry, now)
         return n
+
+    def _express_replies_vec(self, pend, block: np.ndarray,
+                             pools: np.ndarray,
+                             server: np.ndarray) -> dict:
+        """Batched express reply render (ISSUE 14): TX lanes grouped by
+        (template, addressing) identity — one storm batch is typically
+        ONE group — then each group's per-client words are patched in a
+        single vectorized pass (ExpressWireTemplate.render_batch,
+        byte-identical to the per-frame render). Returns lane->bytes."""
+        server_ip0 = int(server[SC_IP])
+        server_mac = (int(server[SC_MAC_HI]).to_bytes(2, "big")
+                      + int(server[SC_MAC_LO]).to_bytes(4, "big"))
+        groups: dict[tuple, list] = {}
+        for i, p in enumerate(pend):
+            if block[i, VB_VERDICT]:
+                d = p.desc
+                groups.setdefault(
+                    (int(block[i, VB_POOL]), int(block[i, VB_LEASE_T]),
+                     d.msg_type, d.vlan_off, d.dhcp_off, d.relayed,
+                     d.use_bcast), []).append(i)
+        out: dict[int, bytes] = {}
+        for key, lanes in groups.items():
+            (pool_id, lease_t, msg, vlan_off, dhcp_off, relayed,
+             use_bcast) = key
+            prow = pools[pool_id]
+            tmpl = self._express_templates.get(
+                server_mac, server_ip0 or int(prow[PV_GATEWAY]),
+                int(prow[PV_GATEWAY]), int(prow[PV_DNS1]),
+                int(prow[PV_DNS2]), lease_t,
+                prefix_to_mask(int(prow[PV_PREFIX])),
+                OFFER if msg == DISCOVER else ACK)
+            fmat, _l = hostpath.pack_rows([pend[i].frame for i in lanes])
+            reps = tmpl.render_batch(
+                fmat, vlan_off, dhcp_off, relayed, use_bcast,
+                block[np.asarray(lanes, dtype=np.int64), VB_YIADDR])
+            out.update(zip(lanes, reps))
+        return out
 
     def _express_reply(self, p, row: np.ndarray, pools: np.ndarray,
                        server: np.ndarray) -> bytes:

@@ -112,6 +112,14 @@ def environment_fingerprint() -> dict:
             env["table_impl"] = tbl.current_impl_label()
         except Exception:  # noqa: BLE001 — fingerprint is best-effort
             pass
+    # host serving path (scalar | vector, ISSUE 14): same discipline —
+    # a vectorized-host run must never trend against scalar history
+    hp = sys.modules.get("bng_tpu.runtime.hostpath")
+    if hp is not None:
+        try:
+            env["host_path"] = hp.current_host_path_label()
+        except Exception:  # noqa: BLE001 — fingerprint is best-effort
+            pass
     return env
 
 
@@ -199,6 +207,24 @@ def express_path(line: dict) -> str:
     return str(v) if v else "jit-full"
 
 
+def host_path(line: dict) -> str:
+    """Which HOST serving path staged the run (ISSUE 14): `scalar` (the
+    original per-frame ring/admission/pack loops) vs `vector` (the
+    batch-native SoA path behind BNG_HOST_PATH). The top-level stamp
+    wins (`bench.py --host-ab` records it per cohort), then the env
+    fingerprint. Unstamped lines predate the vector path and ran the
+    per-frame loops — defaulting to `scalar` keeps existing history one
+    cohort. The two paths do the same work with different host
+    machinery: a host-stage trend across them is an architecture
+    comparison, not a regression signal (rc=3 refusal, the table_impl
+    discipline)."""
+    v = line.get("host_path")
+    if v:
+        return str(v)
+    env = line.get("env") or {}
+    return str(env.get("host_path") or "scalar")
+
+
 def n_shards(line: dict) -> int:
     """How many dataplane shards served the run (ISSUE 12): the
     top-level stamp wins (`bench.py --shards` records it on every
@@ -222,7 +248,7 @@ def n_shards(line: dict) -> int:
 def cohort_key(line: dict) -> tuple:
     return (line.get("metric"), backend_class(line), device_kind(line),
             table_impl(line), n_shards(line), express_path(line),
-            geometry(line))
+            host_path(line), geometry(line))
 
 
 def _gateable(line: dict) -> bool:
@@ -469,24 +495,28 @@ def gate(lines: list[dict], last_k: int = 8, min_cohort: int = 3,
                    and (backend_class(ln) != backend_class(cand)
                         or table_impl(ln) != table_impl(cand)
                         or n_shards(ln) != n_shards(cand)
-                        or express_path(ln) != express_path(cand))]
+                        or express_path(ln) != express_path(cand)
+                        or host_path(ln) != host_path(cand))]
         if not cohort and len(relaxed) >= min_cohort:
             others = sorted({
                 f"{backend_class(ln)}/{table_impl(ln)}"
                 f"/shards={n_shards(ln)}/express={express_path(ln)}"
+                f"/host={host_path(ln)}"
                 for ln in relaxed})
             rep.rc = GATE_INCOMPARABLE
             rep.notes.append(
                 f"candidate ran as {backend_class(cand)!r}/"
                 f"{table_impl(cand)!r}/shards={n_shards(cand)}"
-                f"/express={express_path(cand)!r} (device "
+                f"/express={express_path(cand)!r}"
+                f"/host={host_path(cand)!r} (device "
                 f"{device_kind(cand) or 'none'!r}) with no same-identity "
                 f"history for this metric+geometry — the existing history "
                 f"is on {others}: refusing the cross-identity comparison "
                 f"(an aggregate sharded number never trends against a "
-                f"different shard count's cohort, and the AOT express "
+                f"different shard count's cohort, the AOT express "
                 f"architecture never trends against the jit full-program "
-                f"path)")
+                f"path, and the vectorized host path never trends against "
+                f"the scalar per-frame path)")
             return rep
         rep.notes.append(
             f"cohort too small (n={len(cohort)} < {min_cohort}): trend "

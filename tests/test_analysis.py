@@ -154,6 +154,48 @@ class Engine:
 """})
         assert run_on(tmp_path, {"hotpath"}) == []
 
+    def test_batch_scope_loop_flagged(self, tmp_path):
+        # BNG004: per-frame loops in batch-native serving functions
+        write_tree(tmp_path, {"bng_tpu/runtime/ring.py": """\
+class PyRing:
+    def _assemble_vec(self, out, out_len, out_flags):
+        for i, f in enumerate(self._pending):   # BNG004: per-frame
+            out[i] = f
+        return len(self._pending)
+
+    def _complete_vec(self, verdict, out, out_len, n):
+        i = 0
+        while i < n:                            # BNG004: per-frame
+            i += 1
+"""})
+        found = run_on(tmp_path, {"hotpath"})
+        assert [f.code for f in found].count("BNG004") == 2
+        details = {f.detail for f in found}
+        assert "for:(i, f)" in details and "while" in details
+
+    def test_batch_scope_const_range_not_flagged(self, tmp_path):
+        # bounded vectorized iteration (the 2-tag VLAN walk / 64-step
+        # TLV scan shape) and comprehensions are the batch-native idiom
+        write_tree(tmp_path, {"bng_tpu/runtime/hostpath.py": """\
+def classify_dhcp_batch(buf, lens):
+    et = buf[:, 12]
+    for _ in range(2):
+        et = et + 1
+    rows = [r for r in (1, 2, 3)]
+    return et
+"""})
+        assert run_on(tmp_path, {"hotpath"}) == []
+
+    def test_batch_scope_other_function_not_flagged(self, tmp_path):
+        # a per-frame loop OUTSIDE the batch scope (retire-side helper)
+        write_tree(tmp_path, {"bng_tpu/runtime/ring.py": """\
+class PyRing:
+    def _retire_helper(self, batch):
+        for f in batch:
+            yield f
+"""})
+        assert run_on(tmp_path, {"hotpath"}) == []
+
     def test_hook_missing_guard_flagged(self, tmp_path):
         write_tree(tmp_path, {"bng_tpu/telemetry/spans.py": """\
 _ACTIVE = None

@@ -197,6 +197,47 @@ class TestCohorts:
         rep = ledger.gate_file(path)
         assert rep.rc == ledger.GATE_REGRESSION, rep.to_dict()
 
+    def test_vector_host_path_never_scored_against_scalar(self, tmp_path):
+        """ISSUE 14: the host serving path is cohort identity. A
+        vectorized-host candidate against scalar-only history (legacy
+        lines default to scalar) is the rc=3 refusal naming BOTH host
+        paths, never a silent comparison."""
+        path = str(tmp_path / "ledger.jsonl")
+        for line in _cohort():
+            ledger.append(path, line)  # no host_path stamp -> 'scalar'
+        vec = _tpu_line(9, scale=5.0)  # looks like a huge regression
+        vec["host_path"] = "vector"
+        ledger.append(path, vec)
+        rep = ledger.gate_file(path)
+        assert rep.rc == ledger.GATE_INCOMPARABLE
+        assert "host='vector'" in rep.notes[0]
+        assert "host=scalar" in rep.notes[0]
+        assert "vectorized host path never trends" in rep.notes[0]
+
+    def test_host_path_cohort_gates_within_itself(self, tmp_path):
+        """Once vector-host history exists, a regressed vector run is
+        caught against ITS cohort."""
+        path = str(tmp_path / "ledger.jsonl")
+        for line in _cohort():
+            ledger.append(path, line)  # scalar history
+        for i in range(4):
+            ln = _tpu_line(20 + i, scale=2.0)
+            ln["host_path"] = "vector"
+            ledger.append(path, ln)
+        bad = _tpu_line(30, scale=1.1)
+        bad["host_path"] = "vector"
+        ledger.append(path, bad)
+        rep = ledger.gate_file(path)
+        assert rep.rc == ledger.GATE_REGRESSION, rep.to_dict()
+
+    def test_host_path_env_spelling_reaches_cohort(self):
+        a = {"metric": "m", "value": 1.0, "unit": "Mpps", "batch": 64,
+             "device": "TPU v5e_0", "host_path": "vector"}
+        b = {"metric": "m", "value": 1.0, "unit": "Mpps", "batch": 64,
+             "device": "TPU v5e_0", "env": {"host_path": "vector"}}
+        assert ledger.cohort_key(a) == ledger.cohort_key(b)
+        assert ledger.host_path({"metric": "m"}) == "scalar"  # legacy
+
     def test_env_fingerprint_table_impl_reaches_cohort(self, tmp_path):
         """The bench emitters stamp table_impl inside env too; either
         spelling lands in the same cohort key."""

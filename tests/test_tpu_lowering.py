@@ -24,6 +24,10 @@ def test_all_hot_programs_lower_for_tpu():
 
 
 @pytest.mark.skipif(_ON_TPU, reason="redundant on TPU: the full gate runs")
+# tier-1 budget: ~47s compiling the whole lowering-gate harness on CPU
+# — slow tier (verify-slow/verify-all); bench.py --verify-lowering and
+# runtime/verify.py subsets still gate lowering in their own targets
+@pytest.mark.slow
 def test_gate_harness_compiles_on_any_backend():
     """The non-Mosaic checks must compile everywhere, so harness API drift
     (round 3: a stale NATManager signature broke the gate itself) is caught

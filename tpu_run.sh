@@ -67,6 +67,13 @@ step "config6-ab-pallas" 900 "BNG_TABLE_IMPL=pallas python bench.py --config 6"
 # is finally measured against the architecture built to pass it.
 step "express-ab"    1200 "python bench.py --express-ab"
 step "express-ab-pallas" 1200 "BNG_TABLE_IMPL=pallas python bench.py --express-ab"
+
+# Host serving-loop A/B (ISSUE 14): scalar per-frame vs vectorized
+# batch-native host path feeding real chips — both summed-host-stage
+# cohorts land under distinct host_path identities, and the recorded
+# host_mpps_ceiling is the number every future on-chip headline is
+# bounded by (the device can't outrun the host that feeds it).
+step "host-ab"       1200 "python bench.py --host-ab"
 step "autotune"      1800 "BNG_TABLE_IMPL=auto python bench.py --autotune"
 step "headline-1M"   2400 "BNG_BENCH_SUBS=1000000 BNG_BENCH_FLOWS=1000000 BNG_TABLE_IMPL=auto python bench.py"
 step "headline-1M-xla" 2400 "BNG_BENCH_SUBS=1000000 BNG_BENCH_FLOWS=1000000 BNG_TABLE_IMPL=xla python bench.py"
