@@ -111,6 +111,21 @@
 #                       render) in <60s. A prerequisite of `verify`
 #                       (whose tier-1 line deselects `hostpath`; the
 #                       ROADMAP tier-1 command still includes them).
+#   make verify-wire  — AF_XDP wire pump (ISSUE 15): batch-pump
+#                       bit-identity vs the scalar oracle over the
+#                       edge-case corpus (partial fill, full fill
+#                       ring, TX stall, headroom offsets, forged RX
+#                       lengths), the frame-accounting satellite pins,
+#                       and the memory-rung four-scenario serving twin
+#                       (DORA + NAT punt + QoS drop + PPPoE through
+#                       the full kernel-rings->pump->engine loop) in
+#                       <60s, plus the `bench.py --wire-ab` plumbing
+#                       smoke against a TEMP ledger (the repo ledger
+#                       stays legacy-only). The veth e2e (slow tier)
+#                       self-skips without CAP_NET_ADMIN. A
+#                       prerequisite of `verify` (whose tier-1 line
+#                       deselects `wire`; the ROADMAP tier-1 command
+#                       still includes them).
 #   make verify-sanitize — hotpath-marked engine/scheduler tests under
 #                       BNG_SANITIZE=1 (transfer_guard + debug_nans):
 #                       the dynamic cross-check of the static transfer
@@ -132,14 +147,14 @@ PYTEST_FLAGS = -q --continue-on-collection-errors -p no:cacheprovider \
 .PHONY: verify verify-slow verify-all verify-load verify-chaos \
         verify-telemetry verify-static verify-sanitize verify-ops \
         verify-storm verify-perf verify-kernels verify-sharded \
-        verify-express verify-hostpath
+        verify-express verify-hostpath verify-wire
 
 verify: verify-static verify-storm verify-perf verify-kernels \
-        verify-sharded verify-express verify-hostpath
+        verify-sharded verify-express verify-hostpath verify-wire
 	set -o pipefail; rm -f /tmp/_t1.log; \
 	timeout -k 10 $(TIER1_TIMEOUT) env JAX_PLATFORMS=cpu \
 	$(PY) -m pytest tests/ $(PYTEST_FLAGS) \
-	-m 'not slow and not storm and not perf and not kernels and not sharded and not express and not hostpath' \
+	-m 'not slow and not storm and not perf and not kernels and not sharded and not express and not hostpath and not wire' \
 	2>&1 | tee /tmp/_t1.log
 
 verify-sharded:
@@ -163,6 +178,25 @@ verify-hostpath:
 	$(PY) -m pytest tests/test_hostpath.py $(PYTEST_FLAGS) \
 	  -m 'hostpath and not slow' \
 	&& echo "verify-hostpath OK"
+
+verify-wire:
+	set -o pipefail; \
+	timeout -k 10 60 env JAX_PLATFORMS=cpu \
+	$(PY) -m pytest tests/test_wire_pump.py $(PYTEST_FLAGS) \
+	  -m 'wire and not slow' \
+	&& timeout -k 10 120 env JAX_PLATFORMS=cpu BNG_BENCH_PROBE_WINDOW=0 \
+	  BNG_BENCH_TIMEOUT=90 BNG_BENCH_LOG=/tmp/_wire_ab.jsonl \
+	  BNG_WIRE_AB_BATCH=1024 BNG_BENCH_LAT_STEPS=10 \
+	  $(PY) bench.py --wire-ab \
+	| $(PY) -c "import json,sys; \
+	r=json.loads([l for l in sys.stdin if l.startswith('{')][-1]); \
+	assert r['metric'].startswith('wire A/B'), r; \
+	assert r['value'] >= 2.0, ('ISSUE 15 exit: vector pump < 2x', r); \
+	assert r['pump_stats_match'], r; \
+	print('verify-wire OK: vector %.1fx, ceiling %.2f -> %.2f Mpps' \
+	% (r['value'], r['scalar_wire_mpps_ceiling'], \
+	r['vector_wire_mpps_ceiling']))" \
+	&& echo "verify-wire OK"
 
 verify-kernels:
 	set -o pipefail; \

@@ -2107,6 +2107,207 @@ def host_ab_bench(on_tpu: bool) -> None:
     _persist(summary)
 
 
+def wire_ab_bench(on_tpu: bool) -> None:
+    """`--wire-ab`: one-flag A/B of the two WIRE PUMP implementations
+    (ISSUE 15) — `scalar` (the original per-frame ctypes loop with the
+    copy-mode normalizing memmove) vs `vector` (array-in/array-out over
+    the native batch verbs, headroom-aware descriptors) behind
+    BNG_WIRE_PUMP.
+
+    Drives the full wire loop on the memory rung — far-end inject ->
+    kernel rings (SimKernelRings over the REAL UMEM, copy-mode headroom
+    shape) -> WirePump -> NativeRing -> batch assemble/complete ->
+    WirePump -> far-end drain — steady-state pipelined so every
+    measured pump round moves a full batch in BOTH directions. The
+    ring consumer is a host-only reflector (assemble -> verdict TX ->
+    complete): the wire_rx/wire_tx stages lap only inside pump(), so
+    device compute would add wall time without touching the measured
+    quantity — the --host-ab replay discipline taken to its limit.
+    Steps INTERLEAVE between the cohorts so box noise cancels (the
+    --express-ab discipline). Emits ONE ledger line per cohort under
+    the wire-stage metric with `wire_pump` joining the cohort identity
+    — the gate trends each pump against its own history and refuses
+    (rc=3, naming both paths) to trend one against the other. The
+    headline quantity is the SUMMED wire-stage p50 (wire_rx + wire_tx):
+    the kernel<->UMEM cost every batch pays regardless of chip speed,
+    whose reciprocal is the wire Mpps ceiling
+    (`wire_mpps_ceiling = batch / summed_p50_us`)."""
+    from bng_tpu.control import packets
+    from bng_tpu.runtime import xsk as xsk_mod
+    from bng_tpu.runtime.ring import VERDICT_TX, NativeRing
+    from bng_tpu.telemetry import FlightRecorder, RecorderConfig
+    from bng_tpu.telemetry import spans as tele
+
+    B = int(os.environ.get("BNG_WIRE_AB_BATCH", 2048))
+    STEPS = int(os.environ.get("BNG_BENCH_LAT_STEPS",
+                               60 if on_tpu else 30))
+    WARMUP = 3
+    HEADROOM = 256  # the copy-mode RX shape: scalar pays the per-frame
+    #                 normalizing memmove here, vector submits as-is
+    SLOT = 512
+    WIRE_STAGES = ("wire_rx", "wire_tx")
+    nframes = 1 << (8 * B - 1).bit_length()
+    kring = 1 << (2 * B - 1).bit_length()
+    _mark(f"wire A/B: batch {B}, {STEPS} interleaved steps per cohort, "
+          f"copy-mode headroom {HEADROOM}...")
+
+    # one shared corpus: established-flow UDP data frames (classify ->
+    # data path, steer -> shard 0), built once and injected identically
+    # into both cohorts' far ends
+    rng = np.random.default_rng(42)
+    frames = [packets.udp_packet(
+        b"\x02" * 6, b"\x04" * 6, 0x0A000000 + int(rng.integers(1 << 16)),
+        0xC6336401, 1024 + k % 40000, 443, b"x" * 180)
+        for k in range(B)]
+
+    stacks: dict[str, dict] = {}
+    for path_name in ("scalar", "vector"):
+        ring = NativeRing(nframes=nframes, frame_size=2048, depth=kring)
+        kern = xsk_mod.SimKernelRings(ring, headroom=HEADROOM,
+                                      ring_size=kring)
+        pump = xsk_mod.WirePump(ring, kern, path=path_name)
+        recorder = FlightRecorder(RecorderConfig())
+        out = np.zeros((B, SLOT), dtype=np.uint8)
+        out_len = np.zeros(B, dtype=np.uint32)
+        out_flags = np.zeros(B, dtype=np.uint32)
+        verdict = np.full(B, VERDICT_TX, dtype=np.uint8)
+        stacks[path_name] = {
+            "ring": ring, "kern": kern, "pump": pump,
+            "tracer": tele.Tracer(recorder=recorder),
+            "out": out, "out_len": out_len, "out_flags": out_flags,
+            "verdict": verdict, "wall_s": 0.0, "replies": 0,
+        }
+
+    def reflect(st) -> int:
+        """Host-only ring consumer: assemble -> all-TX -> complete
+        (replies echo the request bytes; the wire loop's cost under
+        test is the PUMP, not the verdict producer)."""
+        ring = st["ring"]
+        n = ring.assemble(st["out"], st["out_len"], st["out_flags"])
+        if n:
+            ring.complete(st["verdict"][:n], st["out"][:n],
+                          st["out_len"][:n], n)
+        return n
+
+    # prime the pipeline: after warmup every step's pump round moves B
+    # frames in (this step's inject) AND B frames out (last step's
+    # reflected verdicts) — full-duplex laps, unimodal distributions
+    for st in stacks.values():
+        for _ in range(WARMUP):
+            st["kern"].inject_many(frames)
+            st["pump"].pump(budget=B)
+            st["kern"].deliver()  # first rounds: fill was empty at inject
+            st["pump"].pump(budget=B)
+            reflect(st)
+            st["kern"].drain_egress()
+
+    _mark(f"interleaved measurement: {STEPS} steps per cohort...")
+    for _k in range(STEPS):
+        for path_name, st in stacks.items():
+            st["kern"].inject_many(frames)  # far-end NIC work: unmeasured
+            tele.arm(st["tracer"])
+            t0 = time.perf_counter()
+            st["pump"].pump(budget=B)
+            st["wall_s"] += time.perf_counter() - t0
+            tele.disarm()
+            st["replies"] += len(st["kern"].drain_egress())
+            reflect(st)
+
+    cohorts: dict[str, dict] = {}
+    for path_name, st in stacks.items():
+        # identity gate: the cohort must have run the pump it claims
+        # (a silent scalar fallback would publish mislabeled numbers)
+        assert st["pump"].last_path == path_name, (
+            f"cohort {path_name!r} last ran {st['pump'].last_path!r}")
+        bd = st["tracer"].breakdown()
+        p50 = {s: bd.get(s, {}).get("p50_us", 0.0) for s in WIRE_STAGES}
+        p99 = {s: bd.get(s, {}).get("p99_us", 0.0) for s in WIRE_STAGES}
+        sum_p50 = round(sum(p50.values()), 1)
+        sum_p99 = round(sum(p99.values()), 1)
+        # 2B frames (B rx + B tx) per measured pump round
+        wall_mpps = (2 * B * STEPS / st["wall_s"] / 1e6
+                     if st["wall_s"] else 0.0)
+        line = {
+            "metric": "wire pump p50 (wire_rx+wire_tx)",
+            "value": sum_p50,
+            "unit": "us",
+            "vs_baseline": 0.0,  # filled below: scalar_sum / this_sum
+            # the cohort identity the ledger keys on: the gate refuses
+            # to trend the two pump implementations against each other
+            "wire_pump": path_name,
+            "wire_rung": "memory",
+            "wire_stage_sum_p50_us": sum_p50,
+            "wire_stage_sum_p99_us": sum_p99,
+            # the wire-side throughput ceiling this batch size implies:
+            # one full-duplex batch costs sum_p50 us of pump work, so
+            # the pump alone caps the wire loop at batch/pump-seconds
+            # regardless of how fast the chips and the host path behind
+            # it are
+            "wire_mpps_ceiling": (round(B / sum_p50, 3) if sum_p50
+                                  else 0.0),
+            "wall_mpps": round(wall_mpps, 3),
+            **{f"{s}_p50_us": p50[s] for s in WIRE_STAGES},
+            **{f"{s}_p99_us": p99[s] for s in WIRE_STAGES},
+            "pump_stats": dict(st["pump"].pump_stats),
+            "replies": st["replies"],
+            "batch": B,
+            "headroom": HEADROOM,
+            "ring_stats": st["ring"].stats(),
+            **_DIAG,
+        }
+        line["stage_breakdown"] = bd
+        cohorts[path_name] = line
+
+    sc, ve = cohorts["scalar"], cohorts["vector"]
+    # same deterministic workload over the same verbs: the two pumps'
+    # frame accounting must agree exactly (the bit-identity corpus in
+    # tests/test_wire_pump.py pins the per-frame cases; this is the
+    # aggregate check at bench scale)
+    stats_match = sc["pump_stats"] == ve["pump_stats"]
+    if not stats_match:
+        _mark(f"WARNING: cohort pump_stats diverge: scalar="
+              f"{sc['pump_stats']} vector={ve['pump_stats']}")
+    for path_name, line in cohorts.items():
+        base = sc["wire_stage_sum_p50_us"]
+        line["vs_baseline"] = (round(base / line["wire_stage_sum_p50_us"], 3)
+                               if line["wire_stage_sum_p50_us"] else 0.0)
+        line["pump_stats_match"] = stats_match
+        _finalize_diag()
+        out = _order_line({**line, **{k: v for k, v in _DIAG.items()
+                                      if k not in line}})
+        print(json.dumps(out))
+        _persist(out)
+        _mark(f"[{path_name}] wire stages p50 "
+              + " ".join(f"{s}={line[f'{s}_p50_us']}us"
+                         for s in WIRE_STAGES)
+              + f" sum={line['wire_stage_sum_p50_us']}us "
+              f"ceiling={line['wire_mpps_ceiling']}Mpps "
+              f"wall={line['wall_mpps']}Mpps")
+
+    speedup = (sc["wire_stage_sum_p50_us"] / ve["wire_stage_sum_p50_us"]
+               if ve["wire_stage_sum_p50_us"] else 0.0)
+    summary = _order_line({
+        "metric": "wire A/B vector speedup (summed wire-stage p50)",
+        "value": round(speedup, 3),
+        "unit": "x",
+        "vs_baseline": round(speedup / 2.0, 3),  # ISSUE 15 exit: >=2x
+        "scalar_wire_sum_p50_us": sc["wire_stage_sum_p50_us"],
+        "vector_wire_sum_p50_us": ve["wire_stage_sum_p50_us"],
+        "scalar_wire_mpps_ceiling": sc["wire_mpps_ceiling"],
+        "vector_wire_mpps_ceiling": ve["wire_mpps_ceiling"],
+        "scalar_wall_mpps": sc["wall_mpps"],
+        "vector_wall_mpps": ve["wall_mpps"],
+        "pump_stats_match": stats_match,
+        "batch": B,
+        "headroom": HEADROOM,
+        **_DIAG,
+    })
+    print(json.dumps(summary))
+    _persist(summary)
+    for st in stacks.values():
+        st["ring"].close()
+
+
 def autotune_mode(on_tpu: bool, dry_run: bool = False) -> None:
     """`--autotune`: stage-breakdown-driven sweep of batch geometry
     (B=256..16384) x bulk pipeline depth (2..8) x table impl (ISSUE 11).
@@ -2387,7 +2588,8 @@ def _child_dispatch(config: int, verify_lowering: bool = False,
                     autotune_dry_run: bool = False,
                     shards: int = 0,
                     express_ab: bool = False,
-                    host_ab: bool = False) -> None:
+                    host_ab: bool = False,
+                    wire_ab: bool = False) -> None:
     """Run one benchmark config in this process (the supervised child)."""
     try:
         # environment fingerprint (device kind / jaxlib / hostname) on
@@ -2500,6 +2702,9 @@ def _child_dispatch(config: int, verify_lowering: bool = False,
             return
         if host_ab:
             host_ab_bench(on_tpu)
+            return
+        if wire_ab:
+            wire_ab_bench(on_tpu)
             return
         if scheduler:
             scheduler_bench(on_tpu, checkpoint_interval_s=checkpoint_interval_s)
@@ -2697,6 +2902,13 @@ def main_dispatch() -> None:
                          "batch-native ring/admission/staging — emits "
                          "one summed-host-stage-p50 cohort per "
                          "host_path identity plus a speedup summary")
+    ap.add_argument("--wire-ab", action="store_true",
+                    help="one-flag A/B of the WIRE PUMP implementations "
+                         "(ISSUE 15): scalar per-frame ctypes vs "
+                         "batch-native vector over the native batch "
+                         "verbs, full wire loop on the memory rung — "
+                         "emits one summed-wire-stage-p50 cohort per "
+                         "wire_pump identity plus a speedup summary")
     ap.add_argument("--autotune", action="store_true",
                     help="stage-breakdown-driven sweep of batch geometry "
                          "x pipeline depth x table impl (ISSUE 11): "
@@ -2740,7 +2952,8 @@ def main_dispatch() -> None:
                         autotune_dry_run=args.dry_run,
                         shards=args.shards,
                         express_ab=args.express_ab,
-                        host_ab=args.host_ab)
+                        host_ab=args.host_ab,
+                        wire_ab=args.wire_ab)
         return
 
     # BNG_BENCH_TIMEOUT bounds the benchmark itself; the probe window is
@@ -2776,7 +2989,8 @@ def main_dispatch() -> None:
             print(_error_line(args.config,
                               f"child rc={res.returncode}, no JSON emitted"))
         if (args.verify_lowering or args.scheduler or args.express_ab
-                or args.host_ab or args.require_tpu) and res.returncode != 0:
+                or args.host_ab or args.wire_ab
+                or args.require_tpu) and res.returncode != 0:
             # CI pre-step / scheduler mode / headline gate: propagate the
             # child verdict (scheduler exits 2 when lowering verification
             # refused it; --require-tpu exits 3 on CPU fallback)
@@ -2807,12 +3021,14 @@ def main_dispatch() -> None:
         print(_error_line(args.config,
                           f"benchmark child timed out after {timeout_s:.0f}s"))
         if (args.verify_lowering or args.scheduler or args.express_ab
-                or args.host_ab or args.require_tpu or args.gate):
+                or args.host_ab or args.wire_ab or args.require_tpu
+                or args.gate):
             sys.exit(1)  # a gate that never ran is a failed gate
     except Exception as e:  # pragma: no cover - spawn failure
         print(_error_line(args.config, f"supervisor error: {type(e).__name__}: {e}"))
         if (args.verify_lowering or args.scheduler or args.express_ab
-                or args.host_ab or args.require_tpu or args.gate):
+                or args.host_ab or args.wire_ab or args.require_tpu
+                or args.gate):
             sys.exit(1)
 
 

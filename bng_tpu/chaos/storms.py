@@ -222,8 +222,12 @@ def flash_crowd_reconnect(seed: int, scale: float = 1.0) -> dict:
                                  fastpath=fastpath)
         budget = check_budget(tracer, (
             # per-frame envelopes (per=chunk amortizes the batch laps);
-            # observed means are ~2-15us/frame on CPU — PERF_NOTES §10
-            BudgetLine("admit", limit_us=200.0, per=chunk),
+            # observed means are ~2-15us/frame isolated but 60-110us
+            # late in a full tier-1 process (heap/GC pressure), and the
+            # admit mean covers only a couple of laps — the envelope
+            # must sit an order above the WORST healthy observation or
+            # one GC pause flakes the bit-determinism gate
+            BudgetLine("admit", limit_us=500.0, per=chunk),
             BudgetLine("fleet", limit_us=2_000.0, per=chunk),
             # per-frame worker handler latency (its histogram is
             # already per-frame): observed ~40-90us
@@ -826,7 +830,10 @@ def dual_stack_bringup(seed: int, scale: float = 1.0) -> dict:
                                  fastpath=fastpath, dhcpv6=v6,
                                  check_roundtrip=(scale <= 0.2))
         budget = check_budget(tracer, (
-            BudgetLine("admit", limit_us=200.0, per=chunk),
+            # 500us/frame: the flash-crowd rationale — the dual-stack
+            # admit mean covers TWO laps, so one full-suite GC pause
+            # inside either lap flakes a tighter envelope
+            BudgetLine("admit", limit_us=500.0, per=chunk),
             BudgetLine("fleet", limit_us=5_000.0, per=chunk),
             BudgetLine("worker", limit_us=5_000.0),
         ))

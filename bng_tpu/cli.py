@@ -225,6 +225,10 @@ class BNGConfig:
     # wire (AF_XDP attach ladder; runtime/xsk.py)
     wire_if: str = ""  # NIC to bind AF_XDP on ("" = in-memory ring only)
     wire_queue: int = 0
+    # wire pump implementation (runtime/xsk.py WirePump): "" resolves
+    # BNG_WIRE_PUMP (default scalar); "vector" runs the batch-native
+    # pump over the native batch verbs (ISSUE 15)
+    wire_pump: str = ""
     synthetic_subs: int = 0  # >0: generate DISCOVER/data traffic (smoke)
     # logging (main.go:1398-1418 zap production config role)
     log_level: str = "info"
@@ -1368,11 +1372,28 @@ class BNGApp:
                 frame_size=2048,
                 prefer_native=bool(cfg.wire_if) or "scheduler" not in c)
             att = xsk_mod.open_wire(ring, ifname=cfg.wire_if,
-                                    queue=cfg.wire_queue)
+                                    queue=cfg.wire_queue,
+                                    pump_path=cfg.wire_pump or None)
             c["wire_attachment"] = att
             self.log.info("wire attach", mode=att.mode,
                           interface=cfg.wire_if or "(none)",
                           detail=att.detail)
+            if cfg.wire_if and att.mode == xsk_mod.MODE_MEMORY:
+                # a REQUESTED NIC landed on the memory rung: the ring
+                # keeps serving, so every counter looks healthy while
+                # zero packets touch the wire — dump the flight ring
+                # (TRIG_WIRE_FALLBACK) and say it loudly; the
+                # bng_wire_rung gauge pins it for dashboards
+                from bng_tpu.telemetry import recorder as rec_mod
+                from bng_tpu.telemetry import spans as tele_sp
+
+                self.log.warning(
+                    "wire attach FELL BACK to the memory rung — this is "
+                    "NOT wire serving", interface=cfg.wire_if,
+                    detail=att.detail)
+                tele_sp.trigger(rec_mod.TRIG_WIRE_FALLBACK,
+                                f"requested {cfg.wire_if!r} landed on the "
+                                f"memory rung: {att.detail}")
             if att.xsk is not None:
                 # an AF_XDP socket only RECEIVES via an xskmap redirect
                 # program; load ours through the kernel verifier. TX works
@@ -1457,6 +1478,12 @@ class BNGApp:
                 fleet_c = c["fleet"]
                 collector.add_source(
                     lambda: metrics.collect_fleet(fleet_c))
+            if "wire_attachment" in c:
+                # rung identity + pump accounting; reads c[...] at
+                # scrape time so a re-attach follows the flip
+                collector.add_source(
+                    lambda: metrics.collect_wire(
+                        c.get("wire_attachment")))
             if "telemetry" in c:
                 tele_tr = c["telemetry"]
                 # bng_stage_latency_us renders live from the tracer's
@@ -2181,6 +2208,98 @@ def run_loadtest(args) -> int:
         target = TieredScheduler(engine, SchedulerConfig(
             bulk_batch=args.batch_size))
 
+    # --wire: drive the batches through the full wire loop (inject at
+    # the far end -> kernel rings -> WirePump -> UMEM ring -> engine ->
+    # WirePump -> far end) instead of the engine's batch interface
+    # (ISSUE 15). `--wire` alone runs the memory-rung SimKernelRings
+    # loopback (no privileges needed); `--wire IFNAME` walks the real
+    # attach ladder and needs --wire-peer to see replies.
+    wire = getattr(args, "wire", None)
+    wire_cleanup: list = []
+    wire_pump = None
+    wire_mode = ""
+    if wire is not None:
+        if getattr(args, "scheduler", False):
+            print("loadtest: --wire and --scheduler are incompatible "
+                  "(the native ring's batch assemble..complete contract "
+                  "has no rx_pop)", file=sys.stderr)
+            return 2
+        from bng_tpu.loadtest import WireLoopTarget
+        from bng_tpu.runtime import xsk as xsk_mod
+        from bng_tpu.runtime.ring import NativeRing
+
+        nframes = 1 << max(12, (4 * args.batch_size - 1).bit_length())
+        depth = 1 << max(10, (2 * args.batch_size - 1).bit_length())
+        try:
+            wire_ring = NativeRing(nframes=nframes, frame_size=2048,
+                                   depth=depth)
+        except RuntimeError as e:
+            print(f"loadtest: --wire needs the native ring: {e}",
+                  file=sys.stderr)
+            return 2
+        wire_cleanup.append(wire_ring.close)
+        pump_path = getattr(args, "wire_pump", "") or None
+        att = (xsk_mod.open_wire(wire_ring, ifname=wire,
+                                 pump_path=pump_path)
+               if wire != "mem" else None)
+        if att is not None and att.xsk is not None:
+            peer = getattr(args, "wire_peer", "")
+            if not peer:
+                print("loadtest: --wire on a live rung needs --wire-peer "
+                      "IFNAME (the far end to inject/collect on)",
+                      file=sys.stderr)
+                return 2
+            import socket as so
+
+            from bng_tpu.runtime import xdp_redirect
+
+            wire_cleanup.append(att.xsk.close)
+            try:
+                redir = xdp_redirect.XdpRedirect(wire, {0: att.xsk.fd})
+                wire_cleanup.append(redir.close)
+            except OSError as e:
+                print(f"loadtest: xdp redirect failed (CAP_BPF): {e}",
+                      file=sys.stderr)
+                return 2
+            txs = so.socket(so.AF_PACKET, so.SOCK_RAW)
+            txs.bind((peer, 0))
+            rxs = so.socket(so.AF_PACKET, so.SOCK_RAW, so.htons(0x0003))
+            rxs.bind((peer, 0))
+            rxs.setblocking(False)
+            wire_cleanup.extend((txs.close, rxs.close))
+
+            def _inject(frames, _s=txs):
+                for f in frames:
+                    _s.send(f)
+
+            def _collect(_s=rxs):
+                out = []
+                while True:
+                    try:
+                        out.append(_s.recv(4096))
+                    except (BlockingIOError, OSError):
+                        break
+                return out
+
+            wire_pump = att.xsk.wire_pump
+            wire_mode = att.mode
+            target = WireLoopTarget(engine, wire_ring, wire_pump,
+                                    _inject, _collect)
+        else:
+            if att is not None:
+                # a REQUESTED NIC fell back: say it loudly, then serve
+                # the memory rung anyway (the loadtest still measures
+                # the pump loop; bng_wire_rung would pin it in `run`)
+                print(f"loadtest: wire attach fell back to the memory "
+                      f"rung: {att.detail}", file=sys.stderr)
+            kern = xsk_mod.SimKernelRings(wire_ring, headroom=256,
+                                          ring_size=depth)
+            wire_pump = xsk_mod.WirePump(wire_ring, kern, path=pump_path)
+            wire_mode = "memory"
+            target = WireLoopTarget(engine, wire_ring, wire_pump,
+                                    kern.inject_many, kern.drain_egress,
+                                    tick=kern.deliver)
+
     cfg = BenchmarkConfig(
         batch_size=args.batch_size, duration_s=args.duration,
         warmup_s=args.warmup, unique_macs=args.macs,
@@ -2212,6 +2331,11 @@ def run_loadtest(args) -> int:
         if fleet is not None:
             fleet_snap = fleet.stats_snapshot()
             fleet.close()
+        for fn in reversed(wire_cleanup):
+            try:
+                fn()
+            except Exception:  # noqa: BLE001 — teardown best-effort
+                pass
 
     stage_breakdown = tracer.breakdown() if tracer is not None else {}
     if tracer is not None:
@@ -2256,9 +2380,19 @@ def run_loadtest(args) -> int:
             out["fleet"] = fleet_snap
         if tracer is not None:
             out["stage_breakdown"] = stage_breakdown
+        if wire_pump is not None:
+            out["wire"] = {"mode": wire_mode, "pump_path": wire_pump.path,
+                           "pump_stats": dict(wire_pump.pump_stats),
+                           "unmatched": target.unmatched}
         print(json.dumps(out, indent=2))
     else:
         print(res.summary())
+        if wire_pump is not None:
+            st = wire_pump.pump_stats
+            print(f"Wire:              rung={wire_mode} "
+                  f"pump={wire_pump.path} rx={st['rx']} tx={st['tx']} "
+                  f"submit_fail={st['rx_submit_fail']} "
+                  f"tx_overflow={st['tx_overflow']}")
         if fleet is not None:
             adm = fleet_snap["admission"]
             print(f"Fleet:             {fleet_snap['workers']} workers, "
@@ -2756,6 +2890,21 @@ def main(argv: list[str] | None = None) -> int:
                             "breakdown + SLO verdict + env fingerprint) "
                             "to this jsonl file — gate with `bng perf "
                             "gate --ledger FILE`")
+    loadp.add_argument("--wire", nargs="?", const="mem", default=None,
+                       metavar="IFNAME",
+                       help="drive batches through the full wire loop "
+                            "(kernel rings -> WirePump -> UMEM ring -> "
+                            "engine -> wire) instead of the engine batch "
+                            "interface; bare --wire runs the memory-rung "
+                            "SimKernel loopback, --wire IFNAME walks the "
+                            "real AF_XDP attach ladder")
+    loadp.add_argument("--wire-pump", default="",
+                       choices=("", "scalar", "vector"),
+                       help="wire pump implementation (default: "
+                            "BNG_WIRE_PUMP, scalar)")
+    loadp.add_argument("--wire-peer", default="",
+                       help="far-end interface for a live --wire rung "
+                            "(veth peer to inject/collect on)")
 
     # telemetry subsystem (bng_tpu/telemetry)
     tracep = sub.add_parser("trace", help="telemetry: flight-recorder "

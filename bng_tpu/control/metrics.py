@@ -331,6 +331,39 @@ class BNGMetrics:
             "bng_express_aot_miss_total",
             "Express dispatches that missed the AOT program cache and "
             "fell back to the jit full-program path")
+        # AF_XDP wire path (ISSUE 15): which attach rung actually serves
+        # (a requested NIC landing on `memory` is a silent fallback that
+        # must never masquerade as wire serving) + the wire pump's frame
+        # accounting — pump_stats exported so fill-pool leaks, submit
+        # failures and TX-stall overflow drops are dashboard facts.
+        self.wire_rung = r.gauge(
+            "bng_wire_rung",
+            "1 for the attach-ladder rung serving the wire (zerocopy | "
+            "copy | memory), 0 for the others", ("mode",))
+        self.wire_pump_path = r.gauge(
+            "bng_wire_pump_path",
+            "1 for the wire-pump implementation in use (scalar | "
+            "vector, BNG_WIRE_PUMP)", ("path",))
+        self.wire_frames = r.counter(
+            "bng_wire_frames_total",
+            "Frames moved by the wire pump per direction", ("dir",))
+        self.wire_filled = r.counter(
+            "bng_wire_filled_total",
+            "Free frames fed to the kernel fill ring")
+        self.wire_completed = r.counter(
+            "bng_wire_completed_total",
+            "TX completions reaped back to the frame pool")
+        self.wire_rx_submit_fail = r.counter(
+            "bng_wire_rx_submit_fail_total",
+            "Kernel RX frames the ring refused (rx-full or a length "
+            "that cannot fit the chunk room); every one is recycled")
+        self.wire_tx_overflow = r.counter(
+            "bng_wire_tx_overflow_total",
+            "Pending-TX frames dropped at the explicit bound while the "
+            "kernel TX ring stalled")
+        self.wire_tx_pending = r.gauge(
+            "bng_wire_tx_pending",
+            "Verdict descriptors awaiting kernel TX slots")
         # slow-path fleet (control/fleet.py + control/admission.py). The
         # reference's concurrency is invisible goroutines; here worker
         # sharding, admission shedding and lease-slice refill are
@@ -552,6 +585,38 @@ class BNGMetrics:
             self.slo_burning.set(n, stage=stage)
         for stage, p99 in snap["window_p99_us"].items():
             self.slo_window_p99.set(p99, stage=stage)
+
+    def collect_wire(self, attachment, pump=None) -> None:
+        """AF_XDP wire identity + pump accounting (runtime/xsk.py) ->
+        bng_wire_* families. `attachment` is the WireAttachment the
+        attach ladder returned (None = wire never requested); `pump`
+        defaults to the attached socket's WirePump and may be passed
+        explicitly for memory-rung loops (SimKernelRings)."""
+        if attachment is None and pump is None:
+            return
+        if attachment is not None:
+            from bng_tpu.runtime.xsk import (MODE_COPY, MODE_MEMORY,
+                                             MODE_ZEROCOPY)
+
+            for mode in (MODE_ZEROCOPY, MODE_COPY, MODE_MEMORY):
+                self.wire_rung.set(1.0 if attachment.mode == mode else 0.0,
+                                   mode=mode)
+            if pump is None and attachment.xsk is not None:
+                pump = attachment.xsk.wire_pump
+        if pump is None:
+            return
+        from bng_tpu.runtime.xsk import WIRE_PUMPS
+
+        for p in WIRE_PUMPS:
+            self.wire_pump_path.set(1.0 if pump.path == p else 0.0, path=p)
+        st = pump.pump_stats
+        self.wire_frames.set_total(st["rx"], dir="rx")
+        self.wire_frames.set_total(st["tx"], dir="tx")
+        self.wire_filled.set_total(st["filled"])
+        self.wire_completed.set_total(st["completed"])
+        self.wire_rx_submit_fail.set_total(st["rx_submit_fail"])
+        self.wire_tx_overflow.set_total(st["tx_overflow"])
+        self.wire_tx_pending.set(pump.tx_pending())
 
     def collect_sharded(self, cluster) -> None:
         """Sharded-path telemetry (parallel/sharded.py ShardTelemetry)

@@ -2,5 +2,6 @@ from bng_tpu.loadtest.harness import (  # noqa: F401
     BenchmarkConfig,
     BenchmarkResult,
     DHCPBenchmark,
+    WireLoopTarget,
     result_json,
 )

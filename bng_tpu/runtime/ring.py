@@ -187,6 +187,23 @@ def _configure(lib: C.CDLL) -> None:
     lib.bng_ring_rx_submit.restype = C.c_int
     lib.bng_ring_rx_submit.argtypes = [C.c_void_p, C.c_uint64, C.c_uint32,
                                        C.c_uint32]
+    # batch wire verbs (vector wire pump, ISSUE 15)
+    lib.bng_ring_rx_reserve_batch.restype = C.c_uint32
+    lib.bng_ring_rx_reserve_batch.argtypes = [C.c_void_p,
+                                              C.POINTER(C.c_uint64),
+                                              C.c_uint32]
+    lib.bng_ring_rx_submit_batch.restype = C.c_uint32
+    lib.bng_ring_rx_submit_batch.argtypes = [
+        C.c_void_p, C.POINTER(C.c_uint64), C.POINTER(C.c_uint32),
+        C.c_uint32, C.POINTER(C.c_uint8), C.c_uint32]
+    lib.bng_ring_frame_free_batch.restype = C.c_uint32
+    lib.bng_ring_frame_free_batch.argtypes = [C.c_void_p,
+                                              C.POINTER(C.c_uint64),
+                                              C.c_uint32]
+    lib.bng_ring_out_pop_desc_batch.restype = C.c_uint32
+    lib.bng_ring_out_pop_desc_batch.argtypes = [
+        C.c_void_p, C.POINTER(C.c_uint64), C.POINTER(C.c_uint32),
+        C.c_uint32]
     for name in ("tx_pop_desc", "fwd_pop_desc"):
         fn = getattr(lib, f"bng_ring_{name}")
         fn.restype = C.c_int
@@ -232,6 +249,10 @@ def _u8p(arr: np.ndarray):
 
 def _u32p(arr: np.ndarray):
     return arr.ctypes.data_as(C.POINTER(C.c_uint32))
+
+
+def _u64p(arr: np.ndarray):
+    return arr.ctypes.data_as(C.POINTER(C.c_uint64))
 
 
 class NativeRing:
@@ -296,6 +317,43 @@ class NativeRing:
         buf = np.frombuffer(frame, dtype=np.uint8)
         fl = FLAG_FROM_ACCESS if from_access else 0
         return self._lib.bng_ring_tx_inject(self._h, _u8p(buf), len(frame), fl) == 0
+
+    # -- batch wire verbs (the vector wire pump, runtime/xsk.py) --------
+    def umem_view(self) -> np.ndarray:
+        """Zero-copy uint8 view over the whole UMEM (the vector pump's
+        and the sim kernel's frame access — no per-frame ctypes)."""
+        if self._umem_view is None:
+            self._umem_view = np.ctypeslib.as_array(
+                self.umem_ptr, shape=(self.umem_size,))
+        return self._umem_view
+
+    _umem_view = None
+
+    def rx_reserve_batch(self, out_addrs: np.ndarray) -> int:
+        """Pop up to len(out_addrs) free frames into out_addrs (uint64).
+        Returns the count reserved (one fill_empty stat on a dry pool)."""
+        return int(self._lib.bng_ring_rx_reserve_batch(
+            self._h, _u64p(out_addrs), len(out_addrs)))
+
+    def rx_submit_batch(self, addrs: np.ndarray, lens: np.ndarray,
+                        flags: int, out_ok: np.ndarray, n: int) -> int:
+        """Headroom-aware batch submit (see bngring.h): every failed
+        frame is already recycled to the fill pool. Returns count
+        submitted; out_ok[:n] marks per-frame outcomes."""
+        return int(self._lib.bng_ring_rx_submit_batch(
+            self._h, _u64p(addrs), _u32p(lens), flags, _u8p(out_ok), n))
+
+    def frame_free_batch(self, addrs: np.ndarray, n: int) -> int:
+        """Return n frames to the fill pool (chunk-base normalized)."""
+        return int(self._lib.bng_ring_frame_free_batch(
+            self._h, _u64p(addrs), n))
+
+    def out_pop_desc_batch(self, addrs: np.ndarray, lens: np.ndarray,
+                           cap: int) -> int:
+        """Drain up to cap TX-then-FWD descriptors (frames stay in
+        UMEM). Returns count popped."""
+        return int(self._lib.bng_ring_out_pop_desc_batch(
+            self._h, _u64p(addrs), _u32p(lens), cap))
 
     # -- steering --
     def steer_pub_ip(self, ip: int, shard: int) -> bool:

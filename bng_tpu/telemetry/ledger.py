@@ -120,6 +120,15 @@ def environment_fingerprint() -> dict:
             env["host_path"] = hp.current_host_path_label()
         except Exception:  # noqa: BLE001 — fingerprint is best-effort
             pass
+    # wire pump (scalar | vector, ISSUE 15): the kernel<->UMEM mover's
+    # identity — a batch-pump run must never trend against per-frame
+    # pump history
+    wp = sys.modules.get("bng_tpu.runtime.xsk")
+    if wp is not None:
+        try:
+            env["wire_pump"] = wp.current_wire_pump_label()
+        except Exception:  # noqa: BLE001 — fingerprint is best-effort
+            pass
     return env
 
 
@@ -225,6 +234,23 @@ def host_path(line: dict) -> str:
     return str(env.get("host_path") or "scalar")
 
 
+def wire_pump(line: dict) -> str:
+    """Which wire-pump implementation moved the run's frames (ISSUE
+    15): `scalar` (the per-frame ctypes loop) vs `vector` (the batch
+    verbs behind BNG_WIRE_PUMP). The top-level stamp wins (`bench.py
+    --wire-ab` records it per cohort), then the env fingerprint.
+    Unstamped lines predate the vector pump (or never touched a wire
+    loop) and ran — if anything — the per-frame pump: defaulting to
+    `scalar` keeps existing history one cohort. A wire-stage trend
+    across the two pumps is an architecture comparison, not a
+    regression signal (rc=3 refusal, the host_path discipline)."""
+    v = line.get("wire_pump")
+    if v:
+        return str(v)
+    env = line.get("env") or {}
+    return str(env.get("wire_pump") or "scalar")
+
+
 def n_shards(line: dict) -> int:
     """How many dataplane shards served the run (ISSUE 12): the
     top-level stamp wins (`bench.py --shards` records it on every
@@ -248,7 +274,7 @@ def n_shards(line: dict) -> int:
 def cohort_key(line: dict) -> tuple:
     return (line.get("metric"), backend_class(line), device_kind(line),
             table_impl(line), n_shards(line), express_path(line),
-            host_path(line), geometry(line))
+            host_path(line), wire_pump(line), geometry(line))
 
 
 def _gateable(line: dict) -> bool:
@@ -496,27 +522,30 @@ def gate(lines: list[dict], last_k: int = 8, min_cohort: int = 3,
                         or table_impl(ln) != table_impl(cand)
                         or n_shards(ln) != n_shards(cand)
                         or express_path(ln) != express_path(cand)
-                        or host_path(ln) != host_path(cand))]
+                        or host_path(ln) != host_path(cand)
+                        or wire_pump(ln) != wire_pump(cand))]
         if not cohort and len(relaxed) >= min_cohort:
             others = sorted({
                 f"{backend_class(ln)}/{table_impl(ln)}"
                 f"/shards={n_shards(ln)}/express={express_path(ln)}"
-                f"/host={host_path(ln)}"
+                f"/host={host_path(ln)}/wire={wire_pump(ln)}"
                 for ln in relaxed})
             rep.rc = GATE_INCOMPARABLE
             rep.notes.append(
                 f"candidate ran as {backend_class(cand)!r}/"
                 f"{table_impl(cand)!r}/shards={n_shards(cand)}"
                 f"/express={express_path(cand)!r}"
-                f"/host={host_path(cand)!r} (device "
+                f"/host={host_path(cand)!r}"
+                f"/wire={wire_pump(cand)!r} (device "
                 f"{device_kind(cand) or 'none'!r}) with no same-identity "
                 f"history for this metric+geometry — the existing history "
                 f"is on {others}: refusing the cross-identity comparison "
                 f"(an aggregate sharded number never trends against a "
                 f"different shard count's cohort, the AOT express "
                 f"architecture never trends against the jit full-program "
-                f"path, and the vectorized host path never trends against "
-                f"the scalar per-frame path)")
+                f"path, the vectorized host path never trends against "
+                f"the scalar per-frame path, and the vector wire pump "
+                f"never trends against the scalar pump)")
             return rep
         rep.notes.append(
             f"cohort too small (n={len(cohort)} < {min_cohort}): trend "

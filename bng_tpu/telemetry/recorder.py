@@ -50,6 +50,12 @@ TRIG_SLO = "slo_breach"
 # gray-failure class where a fallback storm serves every OFFER through
 # the slow architecture while the aggregate counters look healthy
 TRIG_EXPRESS_AOT_MISS = "express_aot_miss"
+# a requested NIC attach (bng run --wire-if) landed on the memory rung
+# (ISSUE 15): the in-memory ring keeps serving, so every aggregate
+# counter looks healthy while zero packets touch the wire — the silent
+# fallback must dump the flight ring and flip bng_wire_rung, never
+# masquerade as wire serving
+TRIG_WIRE_FALLBACK = "wire_rung_fallback"
 
 
 def default_trace_dir() -> str:

@@ -112,6 +112,12 @@ DEFAULT_SLOS: tuple[SLOSpec, ...] = (
     SLOSpec("reply", 20_000.0, description="verdict demux + reply encode"),
     SLOSpec("ops", 2_000_000.0,
             description="zero-downtime transition phases"),
+    SLOSpec("wire_rx", 5_000.0,
+            description="wire pump ingress: kernel fill+RX -> ring "
+                        "submit, per pump round"),
+    SLOSpec("wire_tx", 5_000.0,
+            description="wire pump egress: ring verdicts -> kernel TX "
+                        "+ completion reap, per pump round"),
     SLOSpec("total", 500_000.0, description="batch begin -> end"),
 )
 

@@ -27,6 +27,12 @@ order:
                    processes' own histograms)
        slow_path   slow-path drain total (engine._handle_slow_lanes)
        reply       verdict demux + reply encode/inject
+       wire_rx     wire pump ingress: kernel fill-ring feed + kernel RX
+                   drain -> ring submit (runtime/xsk.py WirePump; the
+                   kernel<->UMEM hop Dapper-named so wire cost is never
+                   invisible to the SLO gate)
+       wire_tx     wire pump egress: ring verdict descriptors -> kernel
+                   TX ring + completion reap -> fill pool
        total       batch begin -> end (the client-visible wall time)
 
 3. **Tracing is observation.** A span never mutates subsystem state;
@@ -61,10 +67,10 @@ from bng_tpu.telemetry.hist import LatencyHist
 # each transition phase records one lap, so the histogram answers "how
 # long do operational state moves stall the dataplane".
 (RING, ADMIT, LANE_WAIT, DISPATCH, DEVICE, DEVICE_WAIT, FLEET, WORKER,
- SLOW, REPLY, OPS, TOTAL) = range(12)
+ SLOW, REPLY, OPS, WIRE_RX, WIRE_TX, TOTAL) = range(14)
 STAGE_NAMES = ("ring", "admit", "lane_wait", "dispatch", "device",
                "device_wait", "fleet", "worker", "slow_path", "reply",
-               "ops", "total")
+               "ops", "wire_rx", "wire_tx", "total")
 NSTAGES = len(STAGE_NAMES)
 
 # lane ids for batch records

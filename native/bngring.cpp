@@ -268,6 +268,14 @@ static bool valid_addr(bng_ring *r, uint64_t addr) {
   return addr < r->umem_size && addr % r->frame_size == 0;
 }
 
+/* Return a frame to the fill pool, normalized to its chunk base: wire
+ * descriptors may carry a copy-mode headroom offset (rx_submit_batch),
+ * and the pool hands out whole chunks. */
+static void recycle(bng_ring *r, uint64_t addr) {
+  bng_desc d{addr - addr % r->frame_size, 0, 0};
+  r->fill.push(d);
+}
+
 uint64_t bng_ring_rx_reserve(bng_ring *r) {
   bng_desc d;
   if (!r->fill.pop(&d)) {
@@ -420,10 +428,68 @@ int bng_ring_rx_submit(bng_ring *r, uint64_t addr, uint32_t len,
   bng_desc d{addr, len, flags};
   if (!r->rxq[shard].push(d)) {
     r->stats.rx_full++;
-    r->fill.push(d); /* recycle */
+    recycle(r, addr);
     return -1;
   }
   return 0;
+}
+
+uint32_t bng_ring_rx_reserve_batch(bng_ring *r, uint64_t *out_addrs,
+                                   uint32_t n) {
+  uint32_t got = 0;
+  bng_desc d;
+  while (got < n && r->fill.pop(&d)) out_addrs[got++] = d.addr;
+  if (got < n) r->stats.fill_empty++; /* one per dry pump round (scalar) */
+  return got;
+}
+
+uint32_t bng_ring_rx_submit_batch(bng_ring *r, const uint64_t *addrs,
+                                  const uint32_t *lens, uint32_t flags,
+                                  uint8_t *out_ok, uint32_t n) {
+  uint32_t ok_n = 0;
+  const uint32_t fsz = r->frame_size;
+  for (uint32_t i = 0; i < n; i++) {
+    uint64_t addr = addrs[i];
+    out_ok[i] = 0;
+    if (addr >= r->umem_size) { /* garbage addr: nothing to recycle */
+      r->stats.bad_desc++;
+      continue;
+    }
+    uint32_t off = static_cast<uint32_t>(addr % fsz);
+    if (lens[i] > fsz - off) { /* does not fit the chunk room: drop.
+         The scalar pump pre-validates identically (no ring stat), so
+         pump_stats stay bit-equal across paths. */
+      recycle(r, addr);
+      continue;
+    }
+    uint32_t fl = flags & ~BNG_DESC_F_DHCP_CTRL; /* rx_submit gate */
+    if (fl & BNG_DESC_F_FROM_ACCESS)
+      fl |= classify_dhcp(r->umem + addr, lens[i]);
+    uint32_t shard = bng_ring_shard_of(r, r->umem + addr, lens[i], fl);
+    bng_desc d{addr, lens[i], fl};
+    if (!r->rxq[shard].push(d)) {
+      r->stats.rx_full++;
+      recycle(r, addr);
+      continue;
+    }
+    out_ok[i] = 1;
+    ok_n++;
+  }
+  return ok_n;
+}
+
+uint32_t bng_ring_frame_free_batch(bng_ring *r, const uint64_t *addrs,
+                                   uint32_t n) {
+  uint32_t freed = 0;
+  for (uint32_t i = 0; i < n; i++) {
+    if (addrs[i] >= r->umem_size) {
+      r->stats.bad_desc++;
+      continue;
+    }
+    recycle(r, addrs[i]);
+    freed++;
+  }
+  return freed;
 }
 
 int bng_ring_rx_push(bng_ring *r, const uint8_t *data, uint32_t len,
@@ -526,9 +592,13 @@ int bng_batch_complete(bng_ring *r, const uint8_t *verdict,
     if (d.addr == UINT64_MAX) continue; /* sharded-assemble padding lane */
     uint8_t v = verdict[i];
     if (v == BNG_VERDICT_TX || v == BNG_VERDICT_FWD) {
-      /* device rewrote the packet: copy staged bytes back over the frame */
+      /* device rewrote the packet: copy staged bytes back over the frame.
+       * Clamp to the chunk ROOM — a headroom-offset descriptor
+       * (rx_submit_batch) owns only frame_size - off bytes of its chunk */
+      uint32_t room =
+          r->frame_size - static_cast<uint32_t>(d.addr % r->frame_size);
       uint32_t len = out_len[i];
-      if (len > r->frame_size) len = r->frame_size;
+      if (len > room) len = room;
       if (out) {
         memcpy(r->umem + d.addr, out + static_cast<size_t>(i) * slot,
                len < slot ? len : slot);
@@ -540,17 +610,17 @@ int bng_batch_complete(bng_ring *r, const uint8_t *verdict,
         else r->stats.fwd++;
       } else {
         r->stats.tx_full++;
-        r->fill.push(d);
+        recycle(r, d.addr);
       }
     } else if (v == BNG_VERDICT_PASS) {
       if (r->slow.push(d)) r->stats.slow++;
       else {
         r->stats.tx_full++;
-        r->fill.push(d);
+        recycle(r, d.addr);
       }
     } else { /* DROP (and any unknown verdict fails closed) */
       r->stats.drop++;
-      r->fill.push(d);
+      recycle(r, d.addr);
     }
   }
   r->inflight_n[head] = 0;
@@ -607,6 +677,24 @@ int bng_ring_fwd_pop_desc(bng_ring *r, uint64_t *addr, uint32_t *len,
   return pop_desc_from(r, r->fwd, addr, len, flags);
 }
 
+uint32_t bng_ring_out_pop_desc_batch(bng_ring *r, uint64_t *addrs,
+                                     uint32_t *lens, uint32_t cap) {
+  uint32_t n = 0;
+  bng_desc d;
+  /* tx drains first, then fwd — the scalar pump's per-frame pop order */
+  while (n < cap && r->tx.pop(&d)) {
+    addrs[n] = d.addr;
+    lens[n] = d.len;
+    n++;
+  }
+  while (n < cap && r->fwd.pop(&d)) {
+    addrs[n] = d.addr;
+    lens[n] = d.len;
+    n++;
+  }
+  return n;
+}
+
 int bng_ring_frame_free(bng_ring *r, uint64_t addr) {
   if (!valid_addr(r, addr)) {
     r->stats.bad_desc++;
@@ -629,7 +717,7 @@ static int pop_from(bng_ring *r, Ring &ring, uint8_t *buf, uint32_t cap,
     rc = -1;
   }
   if (flags) *flags = d.flags;
-  r->fill.push(d); /* recycle */
+  recycle(r, d.addr);
   return rc;
 }
 
@@ -679,7 +767,7 @@ static uint32_t pump_dir(bng_ring *src, bng_ring *dst, uint32_t budget) {
      * submitted frame. */
     uint32_t fl = d.flags ^ BNG_DESC_F_FROM_ACCESS;
     bng_ring_rx_push(dst, src->umem + d.addr, d.len, fl);
-    src->fill.push(d);
+    recycle(src, d.addr);
     moved++;
   }
   return moved;
@@ -696,6 +784,6 @@ uint32_t bng_abi_desc_addr_off(void) { return offsetof(bng_desc, addr); }
 uint32_t bng_abi_desc_len_off(void) { return offsetof(bng_desc, len); }
 uint32_t bng_abi_desc_flags_off(void) { return offsetof(bng_desc, flags); }
 uint32_t bng_abi_stats_size(void) { return sizeof(bng_ring_stats); }
-uint32_t bng_abi_version(void) { return 2; }
+uint32_t bng_abi_version(void) { return 3; }
 
 } /* extern "C" */

@@ -153,6 +153,47 @@ uint64_t bng_ring_rx_reserve(bng_ring *r);
 int bng_ring_rx_submit(bng_ring *r, uint64_t addr, uint32_t len,
                        uint32_t flags);
 
+/* ---- batch wire verbs (the vector wire pump, ISSUE 15) ----
+ *
+ * The AF_XDP pump moves frames in batches; these verbs make one ctypes
+ * call cover what the scalar pump did per frame. Descriptors on this
+ * path are HEADROOM-AWARE: the kernel reports chunk_base + headroom for
+ * copy-mode RX, and rx_submit_batch accepts that address as-is (no
+ * normalizing memmove) — the descriptor carries the offset address all
+ * the way through assemble/complete/TX, and every fill-pool recycle
+ * normalizes back to the chunk base. */
+
+/* Pop up to n free frames into out_addrs. Counts ONE fill_empty when
+ * the pool runs dry mid-batch (the scalar reserve loop's break counts
+ * one per pump round). Returns frames reserved. */
+uint32_t bng_ring_rx_reserve_batch(bng_ring *r, uint64_t *out_addrs,
+                                   uint32_t n);
+
+/* Submit n received frames (addr may carry a headroom offset inside its
+ * chunk). Per frame: classify (access side), steer, enqueue. EVERY
+ * failed frame returns to the fill pool (normalized to its chunk base):
+ * rx-full counts stats.rx_full; a length that does not fit the chunk
+ * room (frame_size - headroom) is dropped without a ring stat — the
+ * scalar pump pre-validates the same way, so the two paths' pump_stats
+ * agree. out_ok[i] = 1 submitted / 0 dropped. Returns count submitted.
+ * An addr outside the UMEM counts bad_desc and cannot be recycled. */
+uint32_t bng_ring_rx_submit_batch(bng_ring *r, const uint64_t *addrs,
+                                  const uint32_t *lens, uint32_t flags,
+                                  uint8_t *out_ok, uint32_t n);
+
+/* Return n UMEM frames to the fill pool, each normalized to its chunk
+ * base (kernel TX completions report the headroom-offset address that
+ * was queued). Returns count freed; invalid addrs count bad_desc. */
+uint32_t bng_ring_frame_free_batch(bng_ring *r, const uint64_t *addrs,
+                                   uint32_t n);
+
+/* Drain up to cap output descriptors — the tx ring first, then fwd
+ * (the scalar pump's per-frame pop order) — into addrs/lens. Frames
+ * stay in UMEM (zero-copy TX); recycle via frame_free_batch after the
+ * kernel completion ring reports them. Returns count popped. */
+uint32_t bng_ring_out_pop_desc_batch(bng_ring *r, uint64_t *addrs,
+                                     uint32_t *lens, uint32_t cap);
+
 /* ---- consumer side (TPU engine) ---- */
 
 /* Pop up to max_batch RX frames into out[b*slot .. b*slot+len) and

@@ -52,7 +52,7 @@ class TestABI:
     def test_stats_layout_and_version(self):
         lib = load_native()
         assert lib.bng_abi_stats_size() == C.sizeof(RingStats)
-        assert lib.bng_abi_version() == 2
+        assert lib.bng_abi_version() == 3
 
 
 class TestRingBasics:

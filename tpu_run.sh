@@ -74,6 +74,14 @@ step "express-ab-pallas" 1200 "BNG_TABLE_IMPL=pallas python bench.py --express-a
 # host_mpps_ceiling is the number every future on-chip headline is
 # bounded by (the device can't outrun the host that feeds it).
 step "host-ab"       1200 "python bench.py --host-ab"
+
+# Wire pump A/B (ISSUE 15): scalar per-frame vs batch-native vector
+# pump over the full wire loop (memory rung — a TPU VM has no spare
+# NIC queue, but the pump cost is pure host work and transfers to any
+# rung). Both summed-wire-stage cohorts land under distinct wire_pump
+# identities; the recorded wire_mpps_ceiling bounds what any AF_XDP
+# deployment in front of these chips can move per pump core.
+step "wire-ab"       900  "python bench.py --wire-ab"
 step "autotune"      1800 "BNG_TABLE_IMPL=auto python bench.py --autotune"
 step "headline-1M"   2400 "BNG_BENCH_SUBS=1000000 BNG_BENCH_FLOWS=1000000 BNG_TABLE_IMPL=auto python bench.py"
 step "headline-1M-xla" 2400 "BNG_BENCH_SUBS=1000000 BNG_BENCH_FLOWS=1000000 BNG_TABLE_IMPL=xla python bench.py"
