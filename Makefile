@@ -147,14 +147,15 @@ PYTEST_FLAGS = -q --continue-on-collection-errors -p no:cacheprovider \
 .PHONY: verify verify-slow verify-all verify-load verify-chaos \
         verify-telemetry verify-static verify-sanitize verify-ops \
         verify-storm verify-perf verify-kernels verify-sharded \
-        verify-express verify-hostpath verify-wire
+        verify-express verify-hostpath verify-wire verify-cluster
 
 verify: verify-static verify-storm verify-perf verify-kernels \
-        verify-sharded verify-express verify-hostpath verify-wire
+        verify-sharded verify-express verify-hostpath verify-wire \
+        verify-cluster
 	set -o pipefail; rm -f /tmp/_t1.log; \
 	timeout -k 10 $(TIER1_TIMEOUT) env JAX_PLATFORMS=cpu \
 	$(PY) -m pytest tests/ $(PYTEST_FLAGS) \
-	-m 'not slow and not storm and not perf and not kernels and not sharded and not express and not hostpath and not wire' \
+	-m 'not slow and not storm and not perf and not kernels and not sharded and not express and not hostpath and not wire and not cluster' \
 	2>&1 | tee /tmp/_t1.log
 
 verify-sharded:
@@ -197,6 +198,13 @@ verify-wire:
 	% (r['value'], r['scalar_wire_mpps_ceiling'], \
 	r['vector_wire_mpps_ceiling']))" \
 	&& echo "verify-wire OK"
+
+verify-cluster:
+	set -o pipefail; \
+	timeout -k 10 60 env JAX_PLATFORMS=cpu \
+	$(PY) -m pytest tests/test_cluster.py $(PYTEST_FLAGS) \
+	  -m 'cluster and not slow' \
+	&& echo "verify-cluster OK"
 
 verify-kernels:
 	set -o pipefail; \

@@ -61,6 +61,10 @@ ALLOWED_WRITERS = {
                                "standalone)",
     "bng_tpu/chaos/invariants.py": "auditor drains pending deltas",
     "bng_tpu/loadtest/harness.py": "loadtest provisioning",
+    "bng_tpu/cluster/instance.py": "cluster member composition root: "
+                                   "builds its own instance's pools + "
+                                   "fastpath from the carved spec "
+                                   "(same role as cli.py, per member)",
     "bench.py": "bench provisioning",
 }
 

@@ -878,6 +878,105 @@ def sharded_swap_crash_rollback(seed: int) -> dict:
     return out_rep
 
 
+# ---------------------------------------------------------------------------
+# 10. cluster failover: flash-crowd re-DORA lands on the promoted standby
+# ---------------------------------------------------------------------------
+
+def cluster_failover_redora(seed: int) -> dict:
+    """Cluster-of-BNGs failover (bng_tpu/cluster): DORA a town through
+    the cluster front door, kill one member mid-service, let the
+    health-monitor/failover machinery promote its standby, and land the
+    flash-crowd re-DORA on the promoted instance. Renewals must ACK
+    with the ORIGINAL addresses (the replicated session books make
+    stickiness through failover real), fresh subscribers must keep
+    leasing cluster-wide, and `_audit_cluster` must stay clean — plus
+    the carve's never-half-allocate discipline: removing a member with
+    live leases is refused, and a joiner with no free blocks waits."""
+    from bng_tpu.cluster import ClusterCoordinator, instance_for_mac
+
+    n_macs = 48
+    clock = SimClock()
+    coord = ClusterCoordinator(
+        clock=clock, sub_nbuckets=512, slice_size=64,
+        space_network=ip_to_u32("10.64.0.0"), space_prefix_len=16)
+    coord.add_instances(["bng-a", "bng-b", "bng-c"])
+    macs = [_mac((seed % 89) * 100 + i) for i in range(n_macs)]
+    leased = dora_with_retries(coord, macs, clock)
+    audit_before = audit_invariants(bng_cluster=coord)
+
+    ids = coord.member_ids()
+    victim = ids[seed % len(ids)]
+    victim_macs = [m for m in macs if instance_for_mac(m, ids) == victim]
+    coord.kill_instance(victim)
+    # outage window: the dead member's subscribers shed (clients
+    # retransmit), everyone else keeps serving
+    out = coord.handle_batch(
+        [(k, _renew(m, leased[m], 0x30000 + k))
+         for k, m in enumerate(victim_macs)], now=clock())
+    outage_shed = sum(1 for _l, rep in out if rep is None)
+    ticks = 0
+    while coord.members[victim].role != "promoted" and ticks < 64:
+        clock.advance(1.0)
+        coord.tick()
+        ticks += 1
+    promoted = coord.members[victim].role == "promoted"
+
+    # the flash crowd reconnects: renewals land on the promoted standby
+    # and must come back with the addresses the dead active handed out
+    out = coord.handle_batch(
+        [(k, _renew(m, leased[m], 0x40000 + k))
+         for k, m in enumerate(victim_macs)], now=clock())
+    sticky = sum(
+        1 for (_l, rep), m in zip(out, victim_macs)
+        if rep is not None and _reply(rep).msg_type == dhcp_codec.ACK
+        and _reply(rep).yiaddr == leased[m])
+
+    fresh = [_mac((seed % 89) * 100 + 10_000 + i) for i in range(24)]
+    fresh_leased = dora_with_retries(coord, fresh, clock)
+
+    # never-half-allocate, exercised live: a member holding leases may
+    # not leave (its blocks would move half-drained), and a joiner with
+    # nothing on the free list stays pending instead of stealing
+    survivor = next(i for i in ids if i != victim)
+    refused = not coord.remove_instance(survivor)
+    coord.add_instance("bng-x")
+    joiner_pending = coord.members["bng-x"].pending
+    coord.remove_instance("bng-x")  # empty member: clean leave
+
+    audit_after = audit_invariants(bng_cluster=coord)
+    out_rep = {
+        "name": "cluster_failover_redora", "seed": seed,
+        "instances": len(ids),
+        "victim": victim,
+        "leased": len(leased),
+        "victim_subs": len(victim_macs),
+        "outage_shed": outage_shed,
+        "promoted": promoted,
+        "failovers": coord.failovers,
+        "sticky_acks": sticky,
+        "fresh_leased": len(fresh_leased),
+        "fresh_unique": len(set(fresh_leased.values())),
+        "remove_refused": refused,
+        "joiner_pending": joiner_pending,
+        "recarves": coord.recarves,
+        "audit_before_ok": audit_before.ok,
+        "audit_ok": audit_after.ok,
+        "violations": audit_after.violations_by_kind(),
+    }
+    coord.close()
+    out_rep["ok"] = (
+        out_rep["leased"] == n_macs
+        and out_rep["victim_subs"] > 0
+        and out_rep["outage_shed"] == out_rep["victim_subs"]
+        and promoted and coord.failovers == 1
+        and sticky == out_rep["victim_subs"]
+        and out_rep["fresh_leased"] == len(fresh)
+        and out_rep["fresh_unique"] == len(fresh)
+        and refused and joiner_pending
+        and audit_before.ok and audit_after.ok)
+    return out_rep
+
+
 SCENARIOS = {
     "dora_worker_crash": dora_worker_crash,
     "corrupt_restore_cold_start": corrupt_restore_cold_start,
@@ -888,4 +987,5 @@ SCENARIOS = {
     "rolling_restart_under_kill": rolling_restart_under_kill,
     "engine_swap_crash_rollback": engine_swap_crash_rollback,
     "sharded_swap_crash_rollback": sharded_swap_crash_rollback,
+    "cluster_failover_redora": cluster_failover_redora,
 }

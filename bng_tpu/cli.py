@@ -1044,14 +1044,17 @@ class BNGApp:
         # back through the single-writer drain; non-DHCPv4 slow frames
         # (v6/SLAAC/PPPoE) stay on the parent demux via the fallback.
         # Integrations that live on the parent's per-lease state (RADIUS
-        # auth, HA replication, Nexus allocation, CoA lease lookups)
-        # are not yet fleet-aware: with any of them configured the
-        # fleet is skipped so no integration silently degrades.
+        # auth, Nexus allocation, CoA lease lookups) are not yet
+        # fleet-aware: with any of them configured the fleet is skipped
+        # so no integration silently degrades. HA is fleet-aware: the
+        # fleet's lease_hook relays worker lease events through the
+        # active's syncer push (same single-writer replay discipline as
+        # the worker TableEventLog), so `ha` left the blocker list.
         self.fleet_blockers: list[str] = []
         if cfg.slowpath_workers > 1:
             blockers = [name for flag, name in (
                 (cfg.radius_server, "radius"), (cfg.nexus_url, "nexus"),
-                (cfg.ha_role, "ha"), (cfg.pppoe_enabled, "pppoe"),
+                (cfg.pppoe_enabled, "pppoe"),
                 (cfg.shards > 1, "sharded"),
                 (cfg.peer_pool_cidr, "peer-pool")) if flag]
             if blockers:
@@ -1068,6 +1071,29 @@ class BNGApp:
             else:
                 from bng_tpu.control.admission import AdmissionConfig
                 from bng_tpu.control.fleet import FleetSpec, SlowPathFleet
+                from bng_tpu.control.ha import SessionState as _HAState
+
+                def _fleet_ha_lease(event, lease, sid, _c=c):
+                    # late-bound: HA (step 11) builds AFTER the fleet,
+                    # so the hook reads c["ha"] at event time. Worker
+                    # lease events ride the drained TableEventLog into
+                    # this single-writer seam — push_change here is the
+                    # fleet-side twin of the parent _ha_lease closure.
+                    ha_sync = _c.get("ha")
+                    if ha_sync is None or not hasattr(ha_sync,
+                                                      "push_change"):
+                        return
+                    if event == "stop":
+                        ha_sync.push_change(None, session_id=sid)
+                    else:  # start / renew both RE-push (expiry tracks)
+                        ha_sync.push_change(_HAState(
+                            session_id=sid, mac=lease["mac"],
+                            ip=lease["ip"], pool_id=lease["pool_id"],
+                            username=lease.get("username") or "",
+                            lease_expiry=float(lease["expiry"]),
+                            qos_policy=lease.get("qos_policy") or "",
+                            session_kind="ipoe",
+                            updated_at=self.clock()))
 
                 fallback = c.get("slowpath") or dhcp.handle_frame
                 fleet = c["fleet"] = SlowPathFleet(
@@ -1081,8 +1107,8 @@ class BNGApp:
                         inbox_capacity=cfg.slowpath_inbox,
                         deadline_ms=cfg.slowpath_deadline_ms),
                     table_sink=fastpath, qos_hook=qos_hook,
-                    nat_hook=nat_hook, fallback=fallback,
-                    clock=self.clock)
+                    nat_hook=nat_hook, lease_hook=_fleet_ha_lease,
+                    fallback=fallback, clock=self.clock)
                 c["engine"].slow_path_batch = fleet.handle_batch
                 self._on_close(fleet.close)
                 self.log.info("slowpath fleet up",
@@ -2742,6 +2768,177 @@ def run_chaos(args) -> int:
     return 0 if report["ok"] else 1
 
 
+def _cluster_wave(coord, n_subs: int, chunk: int = 512) -> dict:
+    """Drive a synthetic DORA wave through the cluster front door —
+    the `bng cluster run --subscribers N` smoke traffic. Returns the
+    wave verdict (leased / unique / shed) for the status output."""
+    from bng_tpu.control import dhcp_codec, packets
+    from bng_tpu.loadtest.harness import StormFrameFactory
+
+    fac = StormFrameFactory(coord.server_ip)
+    macs = [(0x02D6 << 32 | i).to_bytes(6, "big") for i in range(n_subs)]
+    leased: dict[bytes, int] = {}
+    now = coord.clock()
+    for ci in range(0, n_subs, chunk):
+        cmacs = macs[ci:ci + chunk]
+        out = coord.handle_batch(
+            [(i, fac.discover(m, ci + i + 1)) for i, m in enumerate(cmacs)],
+            now=now)
+        offers: dict[bytes, int] = {}
+        for (_l, rep), m in zip(out, cmacs):
+            if rep is not None:
+                p = dhcp_codec.decode(packets.decode(rep).payload)
+                if p.msg_type == dhcp_codec.OFFER:
+                    offers[m] = p.yiaddr
+        req = [m for m in cmacs if m in offers]
+        out = coord.handle_batch(
+            [(i, fac.request(m, offers[m], 0x100000 + ci + i))
+             for i, m in enumerate(req)], now=now)
+        for (_l, rep), m in zip(out, req):
+            if rep is not None:
+                p = dhcp_codec.decode(packets.decode(rep).payload)
+                if p.msg_type == dhcp_codec.ACK:
+                    leased[m] = p.yiaddr
+    return {"subscribers": n_subs, "leased": len(leased),
+            "unique_ips": len(set(leased.values())),
+            "shed": coord.shed_frames,
+            "ok": (len(leased) == n_subs
+                   and len(set(leased.values())) == n_subs)}
+
+
+def _plan_summary(plan) -> dict:
+    from bng_tpu.utils.net import u32_to_ip
+
+    return {
+        "space": f"{u32_to_ip(plan.space_network)}/{plan.space_prefix_len}",
+        "block_prefix_len": plan.block_prefix_len,
+        "blocks": plan.n_blocks,
+        "epoch": plan.epoch,
+        "addresses": plan.total_addresses(),
+        "members": {
+            iid: {"blocks": [f"{u32_to_ip(b.network)}/{b.prefix_len}"
+                             for b in p.blocks],
+                  "addresses": p.addresses(),
+                  "nat": [list(plan.nat_range(b)) for b in p.blocks]}
+            for iid, p in sorted(plan.members.items())},
+        "free_blocks": [f"{u32_to_ip(b.network)}/{b.prefix_len}"
+                        for b in plan.free],
+    }
+
+
+def run_cluster(args) -> int:
+    """`bng cluster run|status` — the cluster-of-BNGs front door
+    (bng_tpu/cluster). `run` composes N instances behind one FNV-1a32
+    steering door (inline in this process, or one child process per
+    instance), optionally drives a synthetic DORA wave, and prints or
+    serves the coordinator status + bng_cluster_* metrics; `status`
+    reads the carve plan back out of a checkpoint (or a status file a
+    `run` wrote) without building anything."""
+    from bng_tpu.utils.net import ip_to_u32
+
+    if args.cluster_cmd == "status":
+        if args.from_checkpoint:
+            from bng_tpu.cluster import ClusterPlan
+            from bng_tpu.runtime.checkpoint import (CheckpointError,
+                                                    decode_checkpoint)
+
+            try:
+                with open(args.from_checkpoint, "rb") as f:
+                    ckpt = decode_checkpoint(f.read())
+            except (OSError, CheckpointError) as e:
+                print(f"cluster status: {e}", file=sys.stderr)
+                return 2
+            comp = ckpt.meta.get("components", {}).get("cluster_plan")
+            if not comp:
+                print("cluster status: checkpoint carries no "
+                      "cluster_plan component", file=sys.stderr)
+                return 1
+            try:
+                plan = ClusterPlan.from_dict(comp)
+            except (KeyError, TypeError, ValueError) as e:
+                print(f"cluster status: corrupt carve plan: {e!r}",
+                      file=sys.stderr)
+                return 2
+            print(json.dumps(_plan_summary(plan), indent=2,
+                             sort_keys=True))
+            return 0
+        if args.status_file:
+            try:
+                with open(args.status_file) as f:
+                    print(f.read().rstrip())
+            except OSError as e:
+                print(f"cluster status: {e}", file=sys.stderr)
+                return 2
+            return 0
+        print("cluster status: --from-checkpoint or --status-file "
+              "required (a live `cluster run` writes the latter)",
+              file=sys.stderr)
+        return 2
+
+    # -- cluster run -------------------------------------------------
+    from bng_tpu.cluster import ClusterCoordinator
+    from bng_tpu.control.metrics import BNGMetrics
+
+    net_s, _, plen_s = args.space.partition("/")
+    try:
+        space_net, space_plen = ip_to_u32(net_s), int(plen_s or "10")
+    except (OSError, ValueError) as e:
+        print(f"cluster run: bad --space {args.space!r}: {e}",
+              file=sys.stderr)
+        return 2
+    coord = ClusterCoordinator(
+        mode=args.mode, space_network=space_net,
+        space_prefix_len=space_plen,
+        nat_base=ip_to_u32(args.nat_base) if args.nat_base else 0,
+        nat_total=args.nat_total, n_workers=args.workers,
+        sub_nbuckets=args.sub_nbuckets)
+    metrics = BNGMetrics()
+    try:
+        coord.add_instances([f"bng-{i:02d}" for i in range(args.instances)])
+        out: dict = {}
+        if args.subscribers:
+            out["wave"] = _cluster_wave(coord, args.subscribers)
+        status = coord.status()
+        metrics.record_cluster(status)
+        out["status"] = status
+        if args.checkpoint_out:
+            from bng_tpu.runtime.checkpoint import (build_checkpoint,
+                                                    encode_checkpoint)
+
+            ckpt = build_checkpoint(1, time.time(), cluster_plan=coord)
+            with open(args.checkpoint_out, "wb") as f:
+                f.write(encode_checkpoint(ckpt))
+            out["checkpoint"] = args.checkpoint_out
+        text = json.dumps(out, indent=2, sort_keys=True, default=str)
+        if args.status_file:
+            with open(args.status_file, "w") as f:
+                f.write(text + "\n")
+        print(text)
+        if args.once:
+            wave = out.get("wave")
+            return 0 if (wave is None or wave["ok"]) else 1
+        # serve: the HA/membership machinery ticks at 1 Hz (the same
+        # cadence App.tick gives a single instance) until interrupted
+        print(f"cluster serving: {args.instances} instances "
+              f"({args.mode}); ^C to stop", file=sys.stderr)
+        try:
+            while True:
+                time.sleep(1.0)
+                coord.tick()
+                status = coord.status()
+                metrics.record_cluster(status)
+                if args.status_file:
+                    with open(args.status_file, "w") as f:
+                        f.write(json.dumps(status, indent=2,
+                                           sort_keys=True, default=str)
+                                + "\n")
+        except KeyboardInterrupt:
+            pass
+        return 0
+    finally:
+        coord.close()
+
+
 def run_perf(args) -> int:
     """`bng perf gate|import` — the perf-regression ledger verbs
     (telemetry/ledger.py; no jax import, runs cold in milliseconds).
@@ -2993,6 +3190,57 @@ def main(argv: list[str] | None = None) -> int:
                       "authorities; rc=2 on any violation")
     _add_run_flags(caud)
 
+    # cluster-of-BNGs front door (bng_tpu/cluster)
+    clup = sub.add_parser(
+        "cluster", help="compose N BNG instances into one cluster: "
+                        "disjoint pool carve, HA standbys, FNV-1a32 "
+                        "MAC steering (bng_tpu/cluster)")
+    clu_sub = clup.add_subparsers(dest="cluster_cmd", required=True)
+    clrun = clu_sub.add_parser(
+        "run", help="carve the space, build the instances and serve "
+                    "(or --once: print status and exit)")
+    clrun.add_argument("--instances", type=int, default=4,
+                       help="founding member count (default 4)")
+    clrun.add_argument("--mode", choices=("inline", "process"),
+                       default="inline",
+                       help="inline = all instances in this process "
+                            "(deterministic); process = one child per "
+                            "instance")
+    clrun.add_argument("--space", default="10.0.0.0/10",
+                       help="cluster address space CIDR to carve "
+                            "(default 10.0.0.0/10)")
+    clrun.add_argument("--nat-base", default="",
+                       help="first NAT public IP (block index maps to "
+                            "NAT slice; default: no NAT ranges)")
+    clrun.add_argument("--nat-total", type=int, default=0,
+                       help="NAT public IP count across the space")
+    clrun.add_argument("--workers", type=int, default=1,
+                       help="slow-path workers per instance")
+    clrun.add_argument("--sub-nbuckets", type=int, default=0,
+                       help="per-instance fast-path subscriber buckets "
+                            "(0 = slow-path only)")
+    clrun.add_argument("--subscribers", type=int, default=0,
+                       help="drive a synthetic DORA wave of N "
+                            "subscribers through the front door")
+    clrun.add_argument("--once", action="store_true",
+                       help="print status (+ wave verdict) and exit "
+                            "instead of serving")
+    clrun.add_argument("--status-file", default="",
+                       help="write status JSON here (refreshed each "
+                            "tick while serving)")
+    clrun.add_argument("--checkpoint-out", default="",
+                       help="write a checkpoint carrying the carve "
+                            "plan to this file")
+    clstat = clu_sub.add_parser(
+        "status", help="print cluster status: the carve plan from a "
+                       "checkpoint, or a status file a run wrote")
+    clstat.add_argument("--from-checkpoint", default="",
+                        help="read the carve plan out of this "
+                             "checkpoint file")
+    clstat.add_argument("--status-file", default="",
+                        help="print the status JSON a `cluster run "
+                             "--status-file` wrote")
+
     # runtime ops control (control/opsctl.py wire)
     ctlp = sub.add_parser(
         "ctl", help="zero-downtime ops on a LIVE `bng run` process "
@@ -3080,6 +3328,8 @@ def main(argv: list[str] | None = None) -> int:
         return run_checkpoint(args)
     if args.command == "chaos":
         return run_chaos(args)
+    if args.command == "cluster":
+        return run_cluster(args)
     if args.command == "ctl":
         return run_ctl(args)
     if args.command == "trace":

@@ -1,0 +1,15 @@
+"""Cluster-of-BNGs: compose N instances into one audited, failover-
+capable system (membership + carve plan + HA pairing + one front door).
+"""
+
+from .coordinator import ClusterCoordinator, InstanceEntity
+from .instance import InlineInstance, InstanceSpec, ProcessInstance
+from .plan import (CarvedBlock, ClusterPlan, InstancePlan, elect_carver,
+                   initial_plan, instance_for_mac, replan, steer_macs_u48)
+
+__all__ = [
+    "CarvedBlock", "ClusterCoordinator", "ClusterPlan", "InlineInstance",
+    "InstanceEntity", "InstancePlan", "InstanceSpec", "ProcessInstance",
+    "elect_carver", "initial_plan", "instance_for_mac", "replan",
+    "steer_macs_u48",
+]

@@ -1,0 +1,441 @@
+"""ClusterCoordinator: N BNG instances composed into one system.
+
+The composition root above the single-process boundary:
+
+- **Membership** lives in a shared Nexus store (`MemoryStore` embedded,
+  or any Store-shaped remote). Instances register under
+  `cluster/instances/`; every membership change elects a carver
+  (`elect_carver`: lowest sorted id) which writes the carve plan to
+  `cluster/plan`. All members — carver included — apply the plan via
+  the store watch, so the plan document is the only authority.
+- **Carving** follows `plan.replan`'s never-half-allocate discipline:
+  whole blocks only, survivors never disturbed, a leaver's blocks
+  return to the free list only after its leases drained
+  (`remove_instance` refuses a live book without `force=True`).
+- **HA pairing**: each member gets an `ActiveSyncer` fed by its fleet's
+  lease events (the TableEventLog replay discipline, relayed by the
+  coordinator after every batch) and a `StandbySyncer` mirroring it.
+  A `HealthMonitor`/`FailoverController` pair watches liveness; on
+  promote, a fresh instance hydrates its lease books from the
+  replicated sessions (`InlineInstance.hydrate_sessions`) and takes
+  over the same member slot — steering is untouched, so the flash
+  crowd's re-DORA lands on the promoted standby with sticky addresses.
+- **Steering**: `instance_for_mac` over the sorted plan membership —
+  the same FNV-1a32 family as worker and device sharding.
+
+Checkpoint interop: the carve plan rides `runtime/checkpoint.py` as the
+`cluster_plan` component (`checkpoint_plan`/`parse_plan`/`restore_plan`)
+so a restarted coordinator resumes the exact carve epoch.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass
+from typing import Callable
+
+from bng_tpu.control.ha import (ActiveSyncer, FailoverController,
+                                HealthMonitor, InMemorySessionStore, Role,
+                                StandbySyncer)
+from bng_tpu.control.nexus import MemoryStore, TypedStore
+from bng_tpu.utils.net import ip_to_u32
+
+from .instance import InlineInstance, InstanceSpec, ProcessInstance
+from .plan import (ClusterPlan, InstancePlan, elect_carver, initial_plan,
+                   instance_for_mac, replan)
+
+_MEMBERS_PREFIX = "cluster/instances/"
+_PLAN_KEY = "cluster/plan"
+
+DEFAULT_SERVER_MAC = bytes.fromhex("02aabbccdd01")
+DEFAULT_SERVER_IP = ip_to_u32("10.0.0.1")
+
+
+@dataclass
+class InstanceEntity:
+    """Membership record in the Nexus store."""
+
+    id: str
+    joined_at: float = 0.0
+    state: str = "up"
+
+
+class _Member:
+    """Coordinator-side slot for one instance: the serving stack plus
+    its HA pair and failover machinery."""
+
+    def __init__(self, instance_id: str):
+        self.id = instance_id
+        self.spec: InstanceSpec | None = None
+        self.instance = None  # InlineInstance | ProcessInstance | None
+        self.alive = True
+        self.role = "active"  # active | promoted
+        self.store: InMemorySessionStore | None = None
+        self.syncer: ActiveSyncer | None = None
+        self.standby_store: InMemorySessionStore | None = None
+        self.standby: StandbySyncer | None = None
+        self.monitor: HealthMonitor | None = None
+        self.failover: FailoverController | None = None
+
+    @property
+    def pending(self) -> bool:
+        return self.instance is None
+
+
+class ClusterCoordinator:
+    """Compose N instances behind one front door (inline mode for
+    deterministic tests, process mode for real serving)."""
+
+    def __init__(self, *, mode: str = "inline",
+                 clock: Callable[[], float] | None = None,
+                 store=None,
+                 space_network: int = ip_to_u32("10.0.0.0"),
+                 space_prefix_len: int = 10,
+                 block_prefix_len: int | None = None,
+                 nat_base: int = 0, nat_total: int = 0,
+                 server_mac: bytes = DEFAULT_SERVER_MAC,
+                 server_ip: int = DEFAULT_SERVER_IP,
+                 ha: bool = True, n_workers: int = 1,
+                 slice_size: int = 256, inbox_capacity: int = 4096,
+                 sub_nbuckets: int = 0, lease_time: int = 3600,
+                 ha_failover_delay_s: float = 2.0,
+                 ha_probe_interval_s: float = 0.5,
+                 ha_failure_threshold: int = 3):
+        if mode not in ("inline", "process"):
+            raise ValueError(f"cluster mode {mode!r}: expected "
+                             f"'inline' or 'process'")
+        import time
+
+        self.mode = mode
+        self.clock = clock or time.time
+        self.store = store if store is not None else MemoryStore()
+        self.space_network = space_network
+        self.space_prefix_len = space_prefix_len
+        self.block_prefix_len = block_prefix_len
+        self.nat_base = nat_base
+        self.nat_total = nat_total
+        self.server_mac = server_mac
+        self.server_ip = server_ip
+        self.ha = ha
+        self.n_workers = n_workers
+        self.slice_size = slice_size
+        self.inbox_capacity = inbox_capacity
+        self.sub_nbuckets = sub_nbuckets
+        self.lease_time = lease_time
+        self.ha_failover_delay_s = ha_failover_delay_s
+        self.ha_probe_interval_s = ha_probe_interval_s
+        self.ha_failure_threshold = ha_failure_threshold
+
+        self.members: dict[str, _Member] = {}
+        self.plan: ClusterPlan | None = None
+        self.recarves = 0
+        self.failovers = 0
+        self.refused_removes = 0
+        self.shed_frames = 0
+        self.steered: dict[str, int] = {}
+
+        self._hold_recarve = False
+        self.registry = TypedStore(self.store, _MEMBERS_PREFIX.rstrip("/"),
+                                   InstanceEntity)
+        self._cancel_members = self.store.watch(_MEMBERS_PREFIX,
+                                                self._on_membership)
+        self._cancel_plan = self.store.watch(_PLAN_KEY, self._on_plan)
+
+    # -- membership -------------------------------------------------------
+    def add_instances(self, instance_ids: list) -> None:
+        """Register a founding (or joining) batch in one carve: blocks
+        deal across the whole batch instead of the first registrant
+        swallowing the space."""
+        for iid in instance_ids:
+            if iid in self.members:
+                raise ValueError(f"instance {iid!r} already registered")
+            self.members[iid] = _Member(iid)
+        # hold the carve until the whole batch registered: the founding
+        # set must carve TOGETHER, or the first registrant's initial
+        # plan swallows every block and the rest join empty-handed
+        self._hold_recarve = True
+        try:
+            for iid in instance_ids:
+                self.registry.put(iid, InstanceEntity(id=iid,
+                                                      joined_at=self.clock()))
+        finally:
+            self._hold_recarve = False
+        self._recarve()
+        if self.plan is not None:
+            # a restored plan may already cover this membership (carve
+            # unchanged -> no new epoch): build the instances anyway
+            self._apply_plan()
+
+    def add_instance(self, instance_id: str) -> None:
+        self.add_instances([instance_id])
+
+    def remove_instance(self, instance_id: str, force: bool = False) -> bool:
+        """Leave. Refused while the instance still holds leases — a
+        block must drain before its addresses transfer (`force=True`
+        drops the sessions, the operator's explicit loss)."""
+        m = self.members.get(instance_id)
+        if m is None:
+            raise KeyError(f"unknown instance {instance_id!r}")
+        if m.instance is not None and not force and m.instance.lease_count():
+            self.refused_removes += 1
+            return False
+        if m.instance is not None:
+            m.instance.close()
+        del self.members[instance_id]
+        self.registry.delete(instance_id)
+        return True
+
+    def _on_membership(self, _key: str, _value) -> None:
+        if not self._hold_recarve:
+            self._recarve()
+
+    def _recarve(self) -> None:
+        ids = sorted(self.registry.list())
+        carver = elect_carver(ids)
+        if carver is None or carver not in self.members:
+            return  # carver hosted elsewhere (or empty cluster)
+        if self.plan is None:
+            new = initial_plan(self.space_network, self.space_prefix_len,
+                               ids, block_prefix_len=self.block_prefix_len,
+                               nat_base=self.nat_base,
+                               nat_total=self.nat_total)
+        else:
+            new = replan(self.plan, ids)
+            if new is self.plan:
+                return
+        self.recarves += 1
+        self.store.put(_PLAN_KEY, json.dumps(new.to_dict(),
+                                             sort_keys=True).encode())
+
+    # -- plan application -------------------------------------------------
+    def _on_plan(self, _key: str, value: bytes | None) -> None:
+        if value is None:
+            return
+        incoming = ClusterPlan.from_dict(json.loads(value))
+        if self.plan is not None and incoming.epoch <= self.plan.epoch:
+            return
+        self.plan = incoming
+        self._apply_plan()
+
+    def _apply_plan(self) -> None:
+        for iid, iplan in self.plan.members.items():
+            m = self.members.get(iid)
+            if m is None or not iplan.blocks:
+                continue
+            if m.instance is None:
+                m.spec = self._spec_for(iplan)
+                m.instance = self._build_instance(m.spec)
+                if self.ha:
+                    self._wire_ha(m)
+            elif hasattr(m.instance, "apply_plan"):
+                # inline members adopt carve changes live; a process
+                # member restarts on its next roll to pick them up
+                m.spec = self._spec_for(iplan)
+                m.instance.apply_plan(iplan)
+
+    def _spec_for(self, iplan: InstancePlan) -> InstanceSpec:
+        return InstanceSpec.from_plan(
+            iplan, self.plan, server_mac=self.server_mac,
+            server_ip=self.server_ip, n_workers=self.n_workers,
+            slice_size=self.slice_size, inbox_capacity=self.inbox_capacity,
+            lease_time=self.lease_time, sub_nbuckets=self.sub_nbuckets)
+
+    def _build_instance(self, spec: InstanceSpec):
+        if self.mode == "process":
+            return ProcessInstance(spec)
+        return InlineInstance(spec, clock=self.clock)
+
+    # -- HA pairing -------------------------------------------------------
+    def _wire_ha(self, m: _Member, checkpoint: dict | None = None) -> None:
+        m.store = InMemorySessionStore()
+        m.syncer = ActiveSyncer(m.store)
+        if checkpoint is not None:
+            m.syncer.restore_state(checkpoint)
+
+        def transport(mm=m):
+            if not mm.alive:
+                raise ConnectionError(f"active {mm.id} down")
+            return mm.syncer
+
+        m.standby_store = InMemorySessionStore()
+        m.standby = StandbySyncer(m.standby_store, transport)
+        if checkpoint is not None:
+            m.standby.bootstrap_state(checkpoint)
+        m.failover = FailoverController(
+            role=Role.STANDBY, failover_delay_s=self.ha_failover_delay_s,
+            auto_failback=False,
+            on_role_change=lambda role, iid=m.id: self._on_role_change(
+                iid, role))
+        m.monitor = HealthMonitor(
+            probe=lambda mm=m: mm.alive,
+            interval_s=self.ha_probe_interval_s,
+            failure_threshold=self.ha_failure_threshold,
+            on_event=m.failover.handle_health_event)
+        m.standby.tick(self.clock())
+
+    def _relay_sessions(self, m: _Member, now: float) -> None:
+        """Worker lease events -> SessionStates -> ActiveSyncer push:
+        the parent-side single-writer replay, same discipline as the
+        fleet's table-event relay."""
+        if m.syncer is None or m.instance is None:
+            return
+        events = m.instance.drain_session_events()
+        for op, payload in m.instance.session_states(events, now):
+            if op == "put":
+                m.syncer.push_change(payload)
+            else:
+                m.syncer.push_change(None, session_id=payload)
+
+    def _on_role_change(self, instance_id: str, role: Role) -> None:
+        if role == Role.ACTIVE:
+            self._promote(instance_id)
+
+    def _promote(self, instance_id: str) -> None:
+        """Standby takes over the member slot: fresh stack on the same
+        carve, lease books hydrated from the replicated sessions, HA
+        pair re-wired with the promoted side as the new active."""
+        m = self.members[instance_id]
+        if m.standby is None or m.spec is None:
+            return
+        m.standby.disconnect()
+        ckpt = m.standby.checkpoint_state()
+        sessions = m.standby_store.all()
+        promoted = self._build_instance(m.spec)
+        if isinstance(promoted, InlineInstance):
+            promoted.hydrate_sessions(sessions, now=self.clock())
+        if m.instance is not None:
+            m.instance.close()
+        m.instance = promoted
+        m.alive = True
+        m.role = "promoted"
+        self.failovers += 1
+        self._wire_ha(m, checkpoint=ckpt)
+
+    def kill_instance(self, instance_id: str) -> None:
+        """Chaos verb: the instance stops answering (books frozen, the
+        real crash shape). Health probes see it; failover owns
+        recovery."""
+        self.members[instance_id].alive = False
+
+    def tick(self, now: float | None = None) -> None:
+        """Drive standby reconnects, health probes and failover state
+        machines (all tick(now)-based, SimClock-compatible)."""
+        now = now if now is not None else self.clock()
+        for _iid, m in sorted(self.members.items()):
+            if m.standby is not None:
+                m.standby.tick(now)
+            if m.monitor is not None:
+                m.monitor.tick(now)
+            if m.failover is not None:
+                m.failover.tick(now)
+
+    # -- the front door ---------------------------------------------------
+    def member_ids(self) -> tuple:
+        if self.plan is not None:
+            return self.plan.serving_ids()
+        return tuple(sorted(self.members))
+
+    def handle_batch(self, items: list, now: float | None = None) -> list:
+        """[(lane, frame)] -> [(lane, reply)] in lane order: steer each
+        frame to its member by source MAC, serve per member, relay
+        session events, re-merge."""
+        now = now if now is not None else self.clock()
+        ids = self.member_ids()
+        groups: dict[str, list] = {}
+        results: list = []
+        for item in items:
+            lane, frame = item[0], item[1]
+            if len(frame) < 12 or not ids:
+                self.shed_frames += 1
+                results.append((lane, None))
+                continue
+            iid = instance_for_mac(frame[6:12], ids)
+            groups.setdefault(iid, []).append((lane, frame))
+        for iid in sorted(groups):
+            m = self.members.get(iid)
+            if m is None or m.instance is None or not m.alive:
+                self.shed_frames += len(groups[iid])
+                results.extend((lane, None) for lane, _f in groups[iid])
+                continue
+            self.steered[iid] = self.steered.get(iid, 0) + len(groups[iid])
+            results.extend(m.instance.handle_batch(groups[iid], now))
+            self._relay_sessions(m, now)
+        results.sort(key=lambda r: r[0])
+        return results
+
+    def expire(self, now: int, max_reaps: int | None = None) -> int:
+        total = 0
+        for _iid, m in sorted(self.members.items()):
+            if m.instance is not None and m.alive:
+                total += m.instance.expire(now, max_reaps)
+                self._relay_sessions(m, float(now))
+        return total
+
+    # -- checkpoint interop (runtime/checkpoint.py 'cluster_plan') --------
+    def checkpoint_plan(self) -> dict:
+        if self.plan is None:
+            return {}
+        return self.plan.to_dict()
+
+    @staticmethod
+    def parse_plan(state: dict) -> int:
+        """Dry-parse (restore pre-check): raises on a corrupt plan,
+        touches nothing. Returns the member count."""
+        if not state:
+            return 0
+        return len(ClusterPlan.from_dict(state).members)
+
+    def restore_plan(self, state: dict) -> int:
+        """Resume a checkpointed carve: the plan document goes back
+        through the store so every watcher applies it — restore is just
+        a replayed carve."""
+        if not state:
+            return 0
+        incoming = ClusterPlan.from_dict(state)
+        self.store.put(_PLAN_KEY, json.dumps(incoming.to_dict(),
+                                             sort_keys=True).encode())
+        return len(incoming.members)
+
+    # -- introspection ----------------------------------------------------
+    def status(self) -> dict:
+        members = {}
+        for iid, m in sorted(self.members.items()):
+            entry: dict = {"alive": m.alive, "role": m.role,
+                           "pending": m.pending,
+                           "steered": self.steered.get(iid, 0)}
+            if m.instance is not None:
+                entry.update(m.instance.status())
+            if m.syncer is not None:
+                entry["ha"] = {
+                    "active_sessions": len(m.store),
+                    "standby_sessions": len(m.standby_store),
+                    "standby_connected": bool(m.standby.connected),
+                    "failover_state": m.failover.state.value,
+                }
+            members[iid] = entry
+        out = {
+            "mode": self.mode,
+            "instances": len(self.members),
+            "members": members,
+            "recarves": self.recarves,
+            "failovers": self.failovers,
+            "refused_removes": self.refused_removes,
+            "shed_frames": self.shed_frames,
+        }
+        if self.plan is not None:
+            out["plan"] = {
+                "epoch": self.plan.epoch,
+                "blocks": self.plan.n_blocks,
+                "free_blocks": len(self.plan.free),
+                "addresses": self.plan.total_addresses(),
+                "members": {iid: p.addresses()
+                            for iid, p in sorted(self.plan.members.items())},
+            }
+        return out
+
+    def close(self) -> None:
+        self._cancel_members()
+        self._cancel_plan()
+        for m in self.members.values():
+            if m.instance is not None:
+                m.instance.close()

@@ -50,6 +50,21 @@ class _Metric:
                              f"got {tuple(labels)}")
         return tuple(labels[n] for n in self.label_names)
 
+    def labeled(self) -> list[dict]:
+        """Label dicts currently carrying a child value — lets callers
+        reconcile a labeled family against fresh state and `remove`
+        labels that no longer exist."""
+        with self._lock:
+            return [dict(zip(self.label_names, k)) for k in self._children]
+
+    def remove(self, **labels) -> bool:
+        """Drop one labeled child (True if it existed). A label whose
+        subject disappeared must leave the scrape — a frozen last value
+        reads as live state."""
+        key = self._key(labels)
+        with self._lock:
+            return self._children.pop(key, None) is not None
+
     def collect(self) -> list[str]:
         out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} {self.kind}"]
         with self._lock:
@@ -408,6 +423,42 @@ class BNGMetrics:
             "1 per integration blocking the configured slow-path fleet "
             "(process runs single-worker until these are fleet-aware)",
             ("blocker",))
+        # cluster-of-BNGs (bng_tpu/cluster): the front-door
+        # coordinator's view — membership, carve-plan ownership and the
+        # failover counters. Per-instance gauges reconcile against the
+        # live membership (a departed member's labels drop).
+        self.cluster_instances = r.gauge(
+            "bng_cluster_instances",
+            "Cluster members by state (up / dead / pending)", ("state",))
+        self.cluster_plan_epoch = r.gauge(
+            "bng_cluster_plan_epoch",
+            "Carve-plan epoch the coordinator is serving")
+        self.cluster_free_blocks = r.gauge(
+            "bng_cluster_free_blocks",
+            "Unassigned carve blocks (headroom for joiners)")
+        self.cluster_addresses = r.gauge(
+            "bng_cluster_addresses",
+            "Addresses carved to an instance", ("instance",))
+        self.cluster_leases = r.gauge(
+            "bng_cluster_leases",
+            "Live leases held by an instance", ("instance",))
+        self.cluster_steered = r.counter(
+            "bng_cluster_steered_frames_total",
+            "Front-door frames steered to an instance", ("instance",))
+        self.cluster_recarves = r.counter(
+            "bng_cluster_recarves_total",
+            "Carve-plan changes applied (joins, leaves)")
+        self.cluster_failovers = r.counter(
+            "bng_cluster_failovers_total",
+            "Standby promotions after a member death")
+        self.cluster_shed = r.counter(
+            "bng_cluster_shed_frames_total",
+            "Front-door frames shed (steered at a dead member before "
+            "its standby promoted)")
+        self.cluster_refused_removes = r.counter(
+            "bng_cluster_refused_removes_total",
+            "Member removals refused for holding live leases "
+            "(never-half-allocate)")
         # checkpoint/warm-restart subsystem (runtime/checkpoint.py +
         # control/statestore.py). The reference needs none of this — its
         # state survives in kernel-pinned maps; here snapshot health IS
@@ -803,9 +854,57 @@ class BNGMetrics:
 
     def record_fleet_blocked(self, blockers: list[str]) -> None:
         """The configured-but-degraded fleet gauge: one labeled 1 per
-        blocking integration (empty list = nothing blocked)."""
-        for b in blockers:
-            self.slowpath_fleet_blocked.set(1, blocker=str(b))
+        blocking integration (empty list = nothing blocked). A blocker
+        that disappears across a config reload must DROP its label —
+        a stale 1 reads as still-degraded forever on the dashboard."""
+        want = {str(b) for b in blockers}
+        for labels in self.slowpath_fleet_blocked.labeled():
+            if labels["blocker"] not in want:
+                self.slowpath_fleet_blocked.remove(**labels)
+        for b in want:
+            self.slowpath_fleet_blocked.set(1, blocker=b)
+
+    def record_cluster(self, status: dict) -> None:
+        """ClusterCoordinator.status() -> bng_cluster_* families.
+        Instance-labeled gauges reconcile against the live membership:
+        a member that left drops its labels (same staleness rule as
+        record_fleet_blocked)."""
+        states = {"up": 0, "dead": 0, "pending": 0}
+        leases: dict[str, float] = {}
+        steered: dict[str, float] = {}
+        addrs: dict[str, float] = {}
+        for iid, m in status.get("members", {}).items():
+            if m.get("pending"):
+                states["pending"] += 1
+            elif not m.get("alive", True):
+                states["dead"] += 1
+            else:
+                states["up"] += 1
+            if "leases" in m:
+                leases[str(iid)] = float(m["leases"])
+            steered[str(iid)] = float(m.get("steered", 0))
+        plan = status.get("plan") or {}
+        if plan:
+            self.cluster_plan_epoch.set(plan.get("epoch", 0))
+            self.cluster_free_blocks.set(plan.get("free_blocks", 0))
+            addrs = {str(i): float(a)
+                     for i, a in plan.get("members", {}).items()}
+        for state, n in states.items():
+            self.cluster_instances.set(n, state=state)
+        for gauge, want in ((self.cluster_addresses, addrs),
+                            (self.cluster_leases, leases)):
+            for labels in gauge.labeled():
+                if labels["instance"] not in want:
+                    gauge.remove(**labels)
+            for iid, v in want.items():
+                gauge.set(v, instance=iid)
+        for iid, v in steered.items():
+            self.cluster_steered.set_total(v, instance=iid)
+        self.cluster_recarves.set_total(status.get("recarves", 0))
+        self.cluster_failovers.set_total(status.get("failovers", 0))
+        self.cluster_shed.set_total(status.get("shed_frames", 0))
+        self.cluster_refused_removes.set_total(
+            status.get("refused_removes", 0))
 
     def record_restore(self, rows: dict, outcome: str = "ok") -> None:
         """Startup-restore result -> bng_ckpt_restore_rows / restores."""

@@ -52,11 +52,21 @@ class MemoryStore:
     def list(self, prefix: str) -> dict[str, bytes]:
         return {k: v for k, v in self._data.items() if k.startswith(prefix)}
 
-    def watch(self, prefix: str, cb: Callable[[str, bytes | None], None]) -> None:
-        self._watchers.append((prefix, cb))
+    def watch(self, prefix: str,
+              cb: Callable[[str, bytes | None], None]) -> Callable[[], None]:
+        """Subscribe to changes under `prefix`. Returns a cancel
+        callable (idempotent); watchers fire in registration order."""
+        entry = (prefix, cb)
+        self._watchers.append(entry)
+
+        def cancel():
+            if entry in self._watchers:
+                self._watchers.remove(entry)
+
+        return cancel
 
     def _notify(self, key: str, value: bytes | None) -> None:
-        for prefix, cb in self._watchers:
+        for prefix, cb in list(self._watchers):
             if key.startswith(prefix):
                 cb(key, value)
 
@@ -91,12 +101,12 @@ class TypedStore(Generic[T]):
             for k, v in self.store.list(self.prefix).items()
         }
 
-    def watch(self, cb: Callable[[str, T | None], None]) -> None:
+    def watch(self, cb: Callable[[str, T | None], None]) -> Callable[[], None]:
         def wrapped(key: str, value: bytes | None):
             id_ = key[len(self.prefix):]
             cb(id_, self.cls(**json.loads(value)) if value else None)
 
-        self.store.watch(self.prefix, wrapped)
+        return self.store.watch(self.prefix, wrapped)
 
 
 # ---------------------------------------------------------------------------

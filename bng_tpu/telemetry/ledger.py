@@ -271,10 +271,27 @@ def n_shards(line: dict) -> int:
         return 1
 
 
+def n_instances(line: dict) -> int:
+    """How many cluster instances served the run (ISSUE 16): the
+    top-level stamp wins (`bng cluster` benches record it per line),
+    then the env fingerprint. Unstamped lines are single-instance by
+    construction — defaulting to 1 keeps existing history one cohort.
+    An aggregate 4-instance cluster number must never trend against
+    single-process history: the cohort keys on this."""
+    v = line.get("n_instances")
+    if v is None:
+        v = (line.get("env") or {}).get("n_instances")
+    try:
+        return int(v) if v is not None else 1
+    except (TypeError, ValueError):
+        return 1
+
+
 def cohort_key(line: dict) -> tuple:
     return (line.get("metric"), backend_class(line), device_kind(line),
-            table_impl(line), n_shards(line), express_path(line),
-            host_path(line), wire_pump(line), geometry(line))
+            table_impl(line), n_shards(line), n_instances(line),
+            express_path(line), host_path(line), wire_pump(line),
+            geometry(line))
 
 
 def _gateable(line: dict) -> bool:
@@ -521,19 +538,23 @@ def gate(lines: list[dict], last_k: int = 8, min_cohort: int = 3,
                    and (backend_class(ln) != backend_class(cand)
                         or table_impl(ln) != table_impl(cand)
                         or n_shards(ln) != n_shards(cand)
+                        or n_instances(ln) != n_instances(cand)
                         or express_path(ln) != express_path(cand)
                         or host_path(ln) != host_path(cand)
                         or wire_pump(ln) != wire_pump(cand))]
         if not cohort and len(relaxed) >= min_cohort:
             others = sorted({
                 f"{backend_class(ln)}/{table_impl(ln)}"
-                f"/shards={n_shards(ln)}/express={express_path(ln)}"
+                f"/shards={n_shards(ln)}"
+                f"/instances={n_instances(ln)}"
+                f"/express={express_path(ln)}"
                 f"/host={host_path(ln)}/wire={wire_pump(ln)}"
                 for ln in relaxed})
             rep.rc = GATE_INCOMPARABLE
             rep.notes.append(
                 f"candidate ran as {backend_class(cand)!r}/"
                 f"{table_impl(cand)!r}/shards={n_shards(cand)}"
+                f"/instances={n_instances(cand)}"
                 f"/express={express_path(cand)!r}"
                 f"/host={host_path(cand)!r}"
                 f"/wire={wire_pump(cand)!r} (device "

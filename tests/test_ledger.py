@@ -152,6 +152,36 @@ class TestCohorts:
         rep = ledger.gate_file(path)
         assert rep.rc == ledger.GATE_REGRESSION, rep.to_dict()
 
+    def test_cluster_width_is_cohort_identity(self, tmp_path):
+        """ISSUE 16: instance count joins the cohort key. A 4-instance
+        cluster headline against single-instance history (legacy lines
+        default to 1) is the rc=3 refusal naming both widths — a
+        cluster aggregate is a different machine, not a 4x win."""
+        path = str(tmp_path / "ledger.jsonl")
+        for line in _cohort():
+            ledger.append(path, line)  # no n_instances stamp -> 1
+        wide = _tpu_line(9, scale=4.0)
+        wide["n_instances"] = 4
+        ledger.append(path, wide)
+        rep = ledger.gate_file(path)
+        assert rep.rc == ledger.GATE_INCOMPARABLE
+        assert "instances=4" in rep.notes[0]
+        assert "instances=1" in rep.notes[0]
+
+    def test_cluster_width_gates_within_itself(self, tmp_path):
+        """Once 4-instance history exists, a regressed 4-instance run is
+        caught against ITS cohort."""
+        path = str(tmp_path / "ledger.jsonl")
+        for i in range(4):
+            ln = _tpu_line(40 + i, scale=4.0)
+            ln["n_instances"] = 4
+            ledger.append(path, ln)
+        bad = _tpu_line(50, scale=2.0)  # half the cluster trend
+        bad["n_instances"] = 4
+        ledger.append(path, bad)
+        rep = ledger.gate_file(path)
+        assert rep.rc == ledger.GATE_REGRESSION, rep.to_dict()
+
     def test_autotune_depth_is_cohort_identity(self, tmp_path):
         """Sweep points differing only in pipeline depth are different
         operating points: a depth-2 point must not be trend-gated

@@ -467,17 +467,61 @@ class TestOpsControl:
     def test_app_rejects_resize_without_fleet(self):
         from bng_tpu.cli import BNGApp, BNGConfig
 
-        app = BNGApp(BNGConfig(slowpath_workers=4, ha_role="active",
+        app = BNGApp(BNGConfig(slowpath_workers=4, pppoe_enabled=True,
                                dhcpv6_enabled=False, slaac_enabled=False,
                                metrics_enabled=True))
         try:
-            assert app.fleet_blockers == ["ha"]
+            assert app.fleet_blockers == ["pppoe"]
             assert "slowpath_fleet_blocked" in app.stats()
             rep = app.fleet_resize(8)
-            assert rep["outcome"] == "rejected" and "ha" in rep["error"]
+            assert rep["outcome"] == "rejected" and "pppoe" in rep["error"]
             # the degradation is a labeled gauge, not just a log line
             m = app.components["metrics"]
-            assert m.slowpath_fleet_blocked.value(blocker="ha") == 1
+            assert m.slowpath_fleet_blocked.value(blocker="pppoe") == 1
+        finally:
+            app.close()
+
+    def test_ha_active_composes_with_fleet(self):
+        """`ha` left the blocker list: an active-role app with a
+        configured fleet builds BOTH, and worker lease events reach the
+        ActiveSyncer store through the fleet's lease_hook relay."""
+        from bng_tpu.cli import BNGApp, BNGConfig
+        from bng_tpu.control import dhcp_codec, packets
+
+        app = BNGApp(BNGConfig(slowpath_workers=2, ha_role="active",
+                               dhcpv6_enabled=False, slaac_enabled=False,
+                               metrics_enabled=True))
+        try:
+            assert app.fleet_blockers == []
+            fleet = app.components["fleet"]
+            assert fleet.n == 2
+            ha_store = app.components["ha_store"]
+            assert len(ha_store) == 0
+
+            mac = bytes.fromhex("02aa00000042")
+            disc = dhcp_codec.build_request(mac, dhcp_codec.DISCOVER,
+                                            xid=1)
+            frame = packets.udp_packet(
+                mac, b"\xff" * 6, 0, 0xFFFFFFFF, 68, 67,
+                disc.encode().ljust(300, b"\x00"))
+            (_l, rep), = fleet.handle_batch([(0, frame)], now=1.0)
+            off = dhcp_codec.decode(packets.decode(rep).payload)
+            assert off.msg_type == dhcp_codec.OFFER
+            req = dhcp_codec.build_request(
+                mac, dhcp_codec.REQUEST, xid=2, requested_ip=off.yiaddr,
+                server_id=off.server_id)
+            frame = packets.udp_packet(
+                mac, b"\xff" * 6, 0, 0xFFFFFFFF, 68, 67,
+                req.encode().ljust(300, b"\x00"))
+            (_l, rep), = fleet.handle_batch([(0, frame)], now=1.0)
+            ack = dhcp_codec.decode(packets.decode(rep).payload)
+            assert ack.msg_type == dhcp_codec.ACK
+
+            # the worker's lease event crossed the single-writer drain
+            # into the active's replicated session store
+            assert len(ha_store) == 1
+            (sess,) = ha_store.all()
+            assert sess.mac == mac.hex() and sess.ip == ack.yiaddr
         finally:
             app.close()
 
