@@ -147,15 +147,16 @@ PYTEST_FLAGS = -q --continue-on-collection-errors -p no:cacheprovider \
 .PHONY: verify verify-slow verify-all verify-load verify-chaos \
         verify-telemetry verify-static verify-sanitize verify-ops \
         verify-storm verify-perf verify-kernels verify-sharded \
-        verify-express verify-hostpath verify-wire verify-cluster
+        verify-express verify-hostpath verify-wire verify-cluster \
+        verify-edge
 
 verify: verify-static verify-storm verify-perf verify-kernels \
         verify-sharded verify-express verify-hostpath verify-wire \
-        verify-cluster
+        verify-cluster verify-edge
 	set -o pipefail; rm -f /tmp/_t1.log; \
 	timeout -k 10 $(TIER1_TIMEOUT) env JAX_PLATFORMS=cpu \
 	$(PY) -m pytest tests/ $(PYTEST_FLAGS) \
-	-m 'not slow and not storm and not perf and not kernels and not sharded and not express and not hostpath and not wire and not cluster' \
+	-m 'not slow and not storm and not perf and not kernels and not sharded and not express and not hostpath and not wire and not cluster and not edge' \
 	2>&1 | tee /tmp/_t1.log
 
 verify-sharded:
@@ -205,6 +206,13 @@ verify-cluster:
 	$(PY) -m pytest tests/test_cluster.py $(PYTEST_FLAGS) \
 	  -m 'cluster and not slow' \
 	&& echo "verify-cluster OK"
+
+verify-edge:
+	set -o pipefail; \
+	timeout -k 10 60 env JAX_PLATFORMS=cpu \
+	$(PY) -m pytest tests/test_edge.py tests/test_qinq_ztp.py \
+	  $(PYTEST_FLAGS) -m 'edge and not slow' \
+	&& echo "verify-edge OK"
 
 verify-kernels:
 	set -o pipefail; \

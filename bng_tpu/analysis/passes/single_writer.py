@@ -36,6 +36,8 @@ MUTATORS = {
     "set_subscriber", "bulk_set_subscribers",
     "add_binding", "add_binding_v6", "remove_binding",
     "resync_tables", "restore_arrays",
+    "arm_tap", "disarm_tap", "set_tap_filters",
+    "set_route", "clear_route",
 }
 
 # writer modules (path suffix -> why it is allowed to write)
@@ -66,6 +68,9 @@ ALLOWED_WRITERS = {
                                    "fastpath from the carved spec "
                                    "(same role as cli.py, per member)",
     "bench.py": "bench provisioning",
+    "bng_tpu/edge/tables.py": "edge host authority (tap/route mirrors)",
+    "bng_tpu/edge/compile.py": "warrant/route compilers are the edge "
+                               "tables' owning managers",
 }
 
 # receiver names that mark the call as a fast-path table mutation
@@ -73,6 +78,7 @@ ALLOWED_WRITERS = {
 TABLE_RECEIVERS = {
     "fastpath", "tables", "sub", "vlan", "cid", "bindings", "subscribers",
     "qos", "up", "down", "antispoof", "garden", "pppoe", "by_sid", "by_ip",
+    "edge", "tap", "route",
 }
 
 

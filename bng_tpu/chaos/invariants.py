@@ -350,6 +350,20 @@ def _audit_device_mirror(report: AuditReport, engine,
         report.add("mirror-mismatch", "fastpath.server",
                    "device server config differs from host")
     _audit_qos_mirror(report, engine)
+    edge = getattr(engine, "edge", None)
+    if edge is not None and engine.tables.tap is not None:
+        _table_mirror_findings(report, edge.tap, engine.tables.tap,
+                               "edge.tap")
+        _table_mirror_findings(report, edge.route, engine.tables.route,
+                               "edge.route")
+        if not np.array_equal(edge.tap_filters,
+                              np.asarray(engine.tables.tap_filters)):
+            report.add("mirror-mismatch", "edge.tap_filters",
+                       "device filter rows differ from host")
+        if not np.array_equal(edge.tap_config,
+                              np.asarray(engine.tables.tap_config)):
+            report.add("mirror-mismatch", "edge.tap_config",
+                       "device armed predicate differs from host")
 
 
 def _audit_qos_mirror(report: AuditReport, engine) -> None:
@@ -380,6 +394,99 @@ def _audit_qos_mirror(report: AuditReport, engine) -> None:
         if len(bad) > 4:
             report.add("qos-mirror-mismatch", label,
                        f"{len(bad)} slots diverge in total")
+
+
+# ---------------------------------------------------------------------------
+# edge protection: tap rows vs warrants, route rows vs the routing program
+# ---------------------------------------------------------------------------
+
+def _audit_edge(report: AuditReport, edge, tap_program=None,
+                route_program=None) -> None:
+    """Edge-protection cross-authority clauses (ISSUE 17). The tap table
+    and the warrant store are separate writers (device rows via
+    EdgeTables, warrant lifecycle via control/intercept.py), so the
+    auditor proves both directions:
+
+    - every device tap row is backed by an ACTIVE in-window warrant — a
+      row without one mirrors subscriber traffic with no legal basis,
+      the worst finding this auditor can make;
+    - every target the compiler armed is resident on the device — a
+      missing row silently under-collects a live intercept;
+    - every route row equals what the routing program would compile
+      RIGHT NOW from the ISP tables + link health — a divergent row
+      forwards to a next hop the tables no longer name;
+    - each EdgeTables' armed predicate equals its live tap row count —
+      a stale zero disables matching with warrants armed, a stale
+      nonzero pays the tap probe with none.
+
+    `edge` is anything with tap_rows()/route_rows(): an EdgeTables or a
+    ShardedCluster's merged owner-routed surface.
+    """
+    if edge is None:
+        return
+    from bng_tpu.edge.compile import _active_in_window
+    from bng_tpu.edge.ops import (RW_CLASS, RW_MAC_HI, RW_MAC_LO,
+                                  RW_TABLE, TC_ARMED, TW_WID)
+
+    taps = edge.tap_rows()
+    routes = edge.route_rows()
+    report.checks["edge_tap_rows"] = len(taps)
+    report.checks["edge_route_rows"] = len(routes)
+
+    if tap_program is not None:
+        now = tap_program._clock()
+        resident = {}
+        for ip, row in taps:
+            wid = int(row[TW_WID])
+            resident[ip] = wid
+            wid_id = tap_program.warrant_for(wid)
+            try:
+                w = (tap_program.manager.get_warrant(wid_id)
+                     if wid_id is not None else None)
+            except KeyError:  # warrant deleted out from under the row
+                w = None
+            if w is None:
+                report.add("edge-tap-orphan", _ip(ip),
+                           f"tap row carries wid {wid} with no known "
+                           f"warrant — mirroring without legal basis")
+            elif not _active_in_window(w, now):
+                report.add("edge-tap-orphan", _ip(ip),
+                           f"tap row for warrant {w.id} outside its "
+                           f"ACTIVE validity window — must be reaped")
+        for wid, ips in sorted(tap_program._ips_by_wid.items()):
+            for ip in sorted(ips):
+                if resident.get(ip) != wid:
+                    report.add("edge-tap-missing", _ip(ip),
+                               f"warrant wid {wid} armed this target but "
+                               f"no device row carries it — the intercept "
+                               f"silently under-collects")
+
+    if route_program is not None:
+        for ip, row in routes:
+            want = route_program.expected_row(ip)
+            got = (int(row[RW_MAC_HI]), int(row[RW_MAC_LO]),
+                   int(row[RW_TABLE]), int(row[RW_CLASS]))
+            if want is None:
+                report.add("edge-route-orphan", _ip(ip),
+                           "route row for a subscriber the routing "
+                           "program would not route (unbound, or no "
+                           "eligible upstream for its class)")
+            elif got != tuple(int(x) for x in want):
+                report.add("edge-route-divergence", _ip(ip),
+                           f"device row {got} != compiled {want} — "
+                           f"forwarding to a next hop the ISP tables "
+                           f"no longer select")
+
+    # armed predicate == live tap row count, per EdgeTables instance
+    # (a ShardedCluster exposes its per-shard authorities as .edge)
+    tables = ([edge] if hasattr(edge, "tap_config")
+              else list(getattr(edge, "edge", None) or ()))
+    for j, e in enumerate(tables):
+        n_rows = len(e.tap_rows())
+        cfg = int(e.tap_config[TC_ARMED])
+        if cfg != n_rows:
+            report.add("edge-armed-count", f"edge{j}",
+                       f"armed predicate {cfg} != {n_rows} live tap rows")
 
 
 # ---------------------------------------------------------------------------
@@ -801,6 +908,16 @@ def _audit_sharded(report: AuditReport, cluster, dhcp=None,
                            f"nat/shard{i}",
                            f"port block for {_ip(int(priv))} belongs on "
                            f"shard {o}")
+        if cluster.edge is not None:
+            for t in ("tap", "route"):
+                for ip, _row in getattr(cluster.edge[i], f"{t}_rows")():
+                    o = cluster.affinity_shard_ip(int(ip))
+                    if o != i:
+                        report.add("shard-misplaced-affinity",
+                                   f"edge.{t}/shard{i}",
+                                   f"{t} row for {_ip(int(ip))} belongs "
+                                   f"on shard {o}; the ring never "
+                                   f"steers its traffic here")
 
     # -- NAT public-IP exclusivity (downstream steering is by-IP)
     try:
@@ -856,11 +973,30 @@ def _audit_sharded(report: AuditReport, cluster, dhcp=None,
                               np.asarray(dev.dhcp.pools)[i]):
             report.add("mirror-mismatch", f"shard{i}.fastpath.pools",
                        "device pool config differs from host")
+        if cluster.edge is not None and dev.tap is not None:
+            for t, dt in (("tap", dev.tap), ("route", dev.route)):
+                _table_mirror_findings(
+                    report, getattr(cluster.edge[i], t),
+                    TableState(krows=np.asarray(dt.krows)[i],
+                               stash_rows=np.asarray(dt.stash_rows)[i],
+                               vals=np.asarray(dt.vals)[i]),
+                    f"shard{i}.edge.{t}")
+            if not np.array_equal(cluster.edge[i].tap_filters,
+                                  np.asarray(dev.tap_filters)[i]):
+                report.add("mirror-mismatch",
+                           f"shard{i}.edge.tap_filters",
+                           "device filter rows differ from host")
+            if not np.array_equal(cluster.edge[i].tap_config,
+                                  np.asarray(dev.tap_config)[i]):
+                report.add("mirror-mismatch",
+                           f"shard{i}.edge.tap_config",
+                           "device armed predicate differs from host")
 
 
 def audit_invariants(*, engine=None, scheduler=None, fastpath=None,
                      pools=None, dhcp=None, fleet=None, nat=None,
-                     dhcpv6=None, pppoe=None, cluster=None,
+                     dhcpv6=None, pppoe=None, edge=None, tap_program=None,
+                     route_program=None, cluster=None,
                      bng_cluster=None,
                      ha_pair=None, quiesce=True, check_roundtrip=True,
                      metrics=None, epoch=None) -> AuditReport:
@@ -903,6 +1039,13 @@ def audit_invariants(*, engine=None, scheduler=None, fastpath=None,
     _audit_nat(report, nat)
     _audit_dhcpv6(report, dhcpv6)
     _audit_pppoe(report, pppoe, pools)
+    if edge is None and engine is not None:
+        edge = getattr(engine, "edge", None)
+    if edge is None and cluster is not None \
+            and getattr(cluster, "edge", None) is not None:
+        # the merged owner-routed surface IS the cluster audit surface
+        edge = cluster
+    _audit_edge(report, edge, tap_program, route_program)
     if check_roundtrip:
         active = None
         if ha_pair is not None:

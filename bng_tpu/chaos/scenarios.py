@@ -40,6 +40,12 @@ Scenario list:
                               standby; crash-mid-swap and snapshot
                               io_error roll back with the active
                               untouched
+    intercept_tap_live        warrant-compiled taps mirror on the live
+                              sharded serving path, filter at the
+                              device, and provably reap on expiry
+    route_flap_rewrite        next-hop rewrite rides a link flap as
+                              bounded dirty-slot deltas; traffic
+                              re-forwards via the survivor
 """
 
 from __future__ import annotations
@@ -879,7 +885,260 @@ def sharded_swap_crash_rollback(seed: int) -> dict:
 
 
 # ---------------------------------------------------------------------------
-# 10. cluster failover: flash-crowd re-DORA lands on the promoted standby
+# 10. edge protection on the sharded serving path (ISSUE 17)
+# ---------------------------------------------------------------------------
+
+def _build_edge_cluster(clock):
+    """2-shard edge-enabled cluster + steered ring + host DHCP server —
+    the shared stack for the two edge scenarios (identical geometry so
+    one jit compile serves both)."""
+    from bng_tpu.control.dhcp_server import DHCPServer
+    from bng_tpu.parallel.sharded import ShardedCluster, ShardedFastPathSink
+
+    cl = ShardedCluster(2, batch_per_shard=8, sub_nbuckets=64,
+                        vlan_nbuckets=64, cid_nbuckets=64,
+                        nat_sessions_nbuckets=64, qos_nbuckets=64,
+                        spoof_nbuckets=64, garden_enabled=False,
+                        edge_enabled=True, edge_nbuckets=64)
+    sink = ShardedFastPathSink(lambda: cl)
+    sink.set_server_config(SERVER_MAC, SERVER_IP)
+    pools = _make_pools(sink)
+    server = DHCPServer(SERVER_MAC, SERVER_IP, pools,
+                        fastpath_tables=sink, clock=clock)
+    ring = cl.make_ring(nframes=256, frame_size=2048, depth=64)
+
+    def drive(frame: bytes, from_access: bool = True) -> bytes | None:
+        assert ring.rx_push(frame, from_access=from_access)
+        cl.process_ring(ring, int(clock()), 0, pkt_slot=2048,
+                        slow_path=server.handle_frame)
+        got = ring.tx_pop()
+        return got[0] if got is not None else None
+
+    def dora(macs) -> dict:
+        leased = {}
+        for i, m in enumerate(macs):
+            offer = drive(_discover(m, 0x800 + i))
+            assert offer is not None, "DORA discover went unanswered"
+            ip = _reply(offer).yiaddr
+            ack = drive(_request(m, ip, 0x900 + i))
+            assert ack is not None \
+                and _reply(ack).msg_type == dhcp_codec.ACK
+            leased[m] = ip
+        return leased
+
+    return cl, pools, server, ring, drive, dora
+
+
+def _data(mac: bytes, src_ip: int, dst_ip: int, sport: int,
+          dport: int) -> bytes:
+    return packets.udp_packet(mac, SERVER_MAC, src_ip, dst_ip, sport,
+                              dport, b"edge-scenario-payload")
+
+
+def intercept_tap_live(seed: int) -> dict:
+    """Warrant-compiled taps mirror on the live sharded serving path,
+    filter at the device, and reap on expiry. A warrant with a port
+    filter arms mid-service against a leased subscriber: matching
+    upstream frames MIRROR to RecordCC through the ring retire,
+    non-matching and non-target frames do not, the warrant's expiry
+    (bounded `expire_warrants(max_reaps=)` sweep + `sync()`) provably
+    removes the device row, and the `_audit_edge` warrant<->row clause
+    plus the missteer counter close the loop."""
+    from bng_tpu.control.intercept import InterceptManager, Warrant
+    from bng_tpu.edge import InterceptTapProgram, MirrorPump
+    from bng_tpu.utils.net import u32_to_ip
+
+    clock = SimClock()
+    cl, pools, server, ring, drive, dora = _build_edge_cluster(clock)
+    macs = [_mac((seed % 53) * 100 + i) for i in range(6)]
+    leased = dora(macs)
+
+    target_mac = macs[seed % len(macs)]
+    bystander = macs[(seed + 1) % len(macs)]
+    target_ip = leased[target_mac]
+
+    im = InterceptManager(clock=clock)
+    im.add_warrant(Warrant(
+        id="W-STORM-1", liid="LIID-17", target_ipv4=u32_to_ip(target_ip),
+        valid_from=clock() - 1.0, valid_until=clock() + 600.0,
+        filter_dest_ports=[443]))
+    program = InterceptTapProgram(cl, im, clock=clock)
+    pump = MirrorPump(program)
+    cl.mirror_sink = pump
+    sync0 = program.sync()
+
+    peer = ip_to_u32("198.51.100.7")
+    # matching flow (dst port 443) from the target: must mirror
+    drive(_data(target_mac, target_ip, peer, 40001, 443))
+    mirrored_match = pump.stats["mirrored"]
+    # non-matching port from the target: device filter rejects the lane
+    drive(_data(target_mac, target_ip, peer, 40002, 9999))
+    # a bystander's matching flow: no tap row, never mirrored
+    drive(_data(bystander, leased[bystander], peer, 40003, 443))
+    mirrored_total = pump.stats["mirrored"]
+    edge_stats = np.asarray(cl.stats.get("edge", np.zeros(4)))
+
+    audit_live = audit_invariants(cluster=cl, pools=pools, dhcp=server,
+                                  tap_program=program,
+                                  check_roundtrip=False)
+
+    # expiry: bounded sweep flips the warrant, sync reaps the row, and
+    # the audit would have flagged the stale row had it survived
+    clock.advance(700.0)
+    expired = im.expire_warrants(max_reaps=4)
+    sync1 = program.sync()
+    drive(_data(target_mac, target_ip, peer, 40004, 443))
+    mirrored_after = pump.stats["mirrored"]
+
+    audit = audit_invariants(cluster=cl, pools=pools, dhcp=server,
+                             tap_program=program, check_roundtrip=False)
+    snap = cl.telemetry.snapshot()
+    out_rep = {
+        "name": "intercept_tap_live", "seed": seed,
+        "leased": len(leased),
+        "armed": sync0["armed"],
+        "mirrored_match": mirrored_match,
+        "mirrored_total": mirrored_total,
+        "tap_filtered": int(edge_stats[1]),
+        "cc_records": im.stats()["cc_records"],
+        "expired": expired,
+        "reaped": sync1["reaped"],
+        "rows_after_reap": len(cl.tap_rows()),
+        "mirrored_after_expiry": mirrored_after - mirrored_total,
+        "missteers": int(snap["missteer_total"]),
+        "audit_live_ok": audit_live.ok,
+        "audit_ok": audit.ok,
+        "violations": audit.violations_by_kind(),
+    }
+    out_rep["ok"] = (out_rep["armed"] == 1
+                     and out_rep["mirrored_match"] == 1
+                     and out_rep["mirrored_total"] == 1
+                     and out_rep["tap_filtered"] >= 1
+                     and out_rep["cc_records"] == 1
+                     and out_rep["expired"] == 1
+                     and out_rep["reaped"] == 1
+                     and out_rep["rows_after_reap"] == 0
+                     and out_rep["mirrored_after_expiry"] == 0
+                     and out_rep["missteers"] == 0
+                     and out_rep["audit_live_ok"]
+                     and out_rep["audit_ok"])
+    return out_rep
+
+
+def route_flap_rewrite(seed: int) -> dict:
+    """Next-hop rewrite rides a link flap on the live sharded serving
+    path as bounded dirty-slot deltas — never a resync. Subscribers
+    bind to per-class ECMP next hops compiled into chip-local device
+    rows; data frames forward (verdict FWD) with the gateway MAC
+    stamped; killing an upstream's health target recompiles ONLY the
+    rows whose selection changed (dirty slots bounded by the bound
+    count), traffic re-forwards via the survivor, recovery flaps back,
+    and `_audit_edge` proves every row equals the routing program's
+    compiled expectation."""
+    from bng_tpu.control.routing import (RoutingManager, StubPlatform,
+                                         Upstream)
+    from bng_tpu.edge import RouteProgram
+    from bng_tpu.edge.ops import RW_MAC_HI, RW_MAC_LO
+
+    clock = SimClock()
+    cl, pools, server, ring, drive, dora = _build_edge_cluster(clock)
+    macs = [_mac((seed % 47) * 100 + i) for i in range(8)]
+    leased = dora(macs)
+
+    platform = StubPlatform()
+    manager = RoutingManager(None, platform)
+    mac_a, mac_b = bytes.fromhex("02dd0000000a"), bytes.fromhex(
+        "02dd0000000b")
+    manager.add_upstream(Upstream(name="ispA", interface="eth1",
+                                  gateway="192.0.2.1", table=100,
+                                  health_target="192.0.2.1"))
+    manager.add_upstream(Upstream(name="ispB", interface="eth2",
+                                  gateway="192.0.2.2", table=101,
+                                  health_target="192.0.2.2"))
+    platform.reachable["192.0.2.1"] = 0.001
+    platform.reachable["192.0.2.2"] = 0.001
+    manager.check_health()
+
+    program = RouteProgram(cl, manager)
+    program.attach()
+    program.set_neighbor("192.0.2.1", mac_a)
+    program.set_neighbor("192.0.2.2", mac_b)
+    classes = ("residential", "business")
+    for i, m in enumerate(macs):
+        assert program.bind_subscriber(leased[m], classes[i % 2])
+
+    def _forward_all(xid: int) -> int:
+        fwd0 = int(cl.telemetry.verdicts[:, 3].sum())
+        for i, m in enumerate(macs):
+            drive(_data(m, leased[m], ip_to_u32("203.0.113.9"),
+                        41000 + xid + i, 443))
+        return int(cl.telemetry.verdicts[:, 3].sum()) - fwd0
+
+    fwd_before = _forward_all(0)
+    rewrites_before = int(np.asarray(cl.stats["edge"])[2])
+    audit_live = audit_invariants(cluster=cl, pools=pools, dhcp=server,
+                                  route_program=program,
+                                  check_roundtrip=False)
+
+    # flap: ispA's health target dies; threshold failures mark it DOWN
+    # and the manager hook recompiles ONLY the rows that moved
+    deltas_before = program.stats["deltas"]
+    del platform.reachable["192.0.2.1"]
+    for _ in range(manager.config.failure_threshold):
+        manager.check_health()
+    dirty_after_flap = cl.pending_dirty()
+    moved = program.stats["deltas"] - deltas_before
+    on_b = sum(1 for m in macs
+               if (r := cl.get_route(leased[m])) is not None
+               and (int(r[RW_MAC_HI]), int(r[RW_MAC_LO]))
+               == (int.from_bytes(mac_b[:2], "big"),
+                   int.from_bytes(mac_b[2:6], "big")))
+    fwd_during = _forward_all(100)
+
+    # recovery: the target answers again, selection heals (bounded)
+    platform.reachable["192.0.2.1"] = 0.001
+    manager.check_health()
+    fwd_after = _forward_all(200)
+
+    audit = audit_invariants(cluster=cl, pools=pools, dhcp=server,
+                             route_program=program, check_roundtrip=False)
+    snap = cl.telemetry.snapshot()
+    out_rep = {
+        "name": "route_flap_rewrite", "seed": seed,
+        "leased": len(leased),
+        "bound": len(macs),
+        "fwd_before": fwd_before,
+        "rewrites_before": rewrites_before,
+        "flaps": program.stats["flaps"],
+        "moved_rows": moved,
+        "dirty_after_flap": dirty_after_flap,
+        "on_survivor": on_b,
+        "fwd_during_flap": fwd_during,
+        "fwd_after_recovery": fwd_after,
+        "unroutable": program.stats["unroutable"],
+        "missteers": int(snap["missteer_total"]),
+        "audit_live_ok": audit_live.ok,
+        "audit_ok": audit.ok,
+        "violations": audit.violations_by_kind(),
+    }
+    n = len(macs)
+    out_rep["ok"] = (out_rep["fwd_before"] == n
+                     and out_rep["rewrites_before"] >= n
+                     and out_rep["flaps"] == 2
+                     and 0 < out_rep["moved_rows"] <= n
+                     and 0 < out_rep["dirty_after_flap"] <= 2 * n
+                     and out_rep["on_survivor"] == n
+                     and out_rep["fwd_during_flap"] == n
+                     and out_rep["fwd_after_recovery"] == n
+                     and out_rep["unroutable"] == 0
+                     and out_rep["missteers"] == 0
+                     and out_rep["audit_live_ok"]
+                     and out_rep["audit_ok"])
+    return out_rep
+
+
+# ---------------------------------------------------------------------------
+# 11. cluster failover: flash-crowd re-DORA lands on the promoted standby
 # ---------------------------------------------------------------------------
 
 def cluster_failover_redora(seed: int) -> dict:
@@ -987,5 +1246,7 @@ SCENARIOS = {
     "rolling_restart_under_kill": rolling_restart_under_kill,
     "engine_swap_crash_rollback": engine_swap_crash_rollback,
     "sharded_swap_crash_rollback": sharded_swap_crash_rollback,
+    "intercept_tap_live": intercept_tap_live,
+    "route_flap_rewrite": route_flap_rewrite,
     "cluster_failover_redora": cluster_failover_redora,
 }

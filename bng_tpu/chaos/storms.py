@@ -28,6 +28,13 @@ injected at all:
     dual_stack_bringup      interleaved DORA + SOLICIT/REQUEST + RS/RA
                             per subscriber; the v4 and v6 lease books
                             must both agree with their pool bitmaps
+    production_day          one compressed production day on a single
+                            engine: diurnal IPoE/PPPoE/dual-stack/CGNAT
+                            churn with CoA waves, intercept taps armed
+                            mid-storm, an ISP uplink flap re-steered as
+                            bounded route deltas, and a spoofed-source
+                            DDoS burst the antispoof stage counts; the
+                            edge audit closes the day
 
 The Jepsen split (PAPERS.md): the GENERATORS here are dumb — they build
 frames (loadtest.harness.StormFrameFactory) and retry like clients do.
@@ -1040,6 +1047,391 @@ def cluster_scale_storm(seed: int, scale: float = 1.0) -> dict:
 
 
 # ---------------------------------------------------------------------------
+# 7. production day: the composite edge-protection storm
+# ---------------------------------------------------------------------------
+
+def production_day(seed: int, scale: float = 1.0) -> dict:
+    """One compressed production day on a single engine proves the edge
+    subsystem under composite churn. Morning: IPoE DORA plus dual-stack
+    SOLICIT/REQUEST and PPPoE discovery share one slow queue while every
+    lease carves a CGNAT block and binds a next-hop route (ECMP by
+    subscriber class). Midday: CoA policy waves rewrite QoS rows with
+    renewals riding the device path. Afternoon: two intercept warrants
+    arm mid-storm — matching flows mirror to RecordCC, non-matching
+    flows are filtered ON DEVICE. Evening: an ISP uplink dies and the
+    route table re-steers as bounded dirty-slot deltas (never a
+    resync); a spoofed-source DDoS burst is dropped and counted by the
+    antispoof stage. Night: the short warrant expires, the bounded reap
+    removes its tap rows, and the edge audit plus per-stage SLO budget
+    close the day."""
+    from bng_tpu.control import packets
+    from bng_tpu.control.dhcpv6.server import (AddressPool6, DHCPv6Server,
+                                               DHCPv6ServerConfig,
+                                               PrefixPool6)
+    from bng_tpu.control.intercept import InterceptManager, Warrant
+    from bng_tpu.control.pppoe import codec as pcodec
+    from bng_tpu.control.pppoe.auth import LocalVerifier
+    from bng_tpu.control.pppoe.server import PPPoEServer, PPPoEServerConfig
+    from bng_tpu.control.radius import packet as rp
+    from bng_tpu.control.radius.coa import CoAProcessor, CoAServer
+    from bng_tpu.control.radius.packet import RadiusPacket
+    from bng_tpu.control.radius.policy import PolicyManager, QoSPolicy
+    from bng_tpu.control.routing import (RoutingManager, StubPlatform,
+                                         Upstream)
+    from bng_tpu.control.slaac import PrefixConfig, SLAACConfig, SLAACServer
+    from bng_tpu.control.slowpath import SlowPathDemux
+    from bng_tpu.edge import (EdgeTables, InterceptTapProgram, MirrorPump,
+                              RouteProgram)
+    from bng_tpu.edge.ops import EST_ROUTE_REWRITES, EST_TAP_FILTERED
+    from bng_tpu.ops.antispoof import (AST_DROPPED, AST_V4_VIOL,
+                                       MODE_DISABLED, MODE_STRICT)
+    from bng_tpu.runtime.engine import (AntispoofTables, Engine, QoSTables)
+    from bng_tpu.utils.net import u32_to_ip
+
+    import numpy as np
+
+    n_subs = max(6, int(round(12 * scale)))
+    n_v6 = max(2, int(round(4 * scale)))
+    n_ppp = max(2, int(round(4 * scale)))
+    coa_waves = max(2, int(round(6 * scale)))
+    ddos = max(8, int(round(24 * scale)))
+    secret = b"day-secret"
+
+    # ---- build the whole stack UNtraced: the first process() pays the
+    # fused-pipeline compile and must not land in a budget stage -------
+    clock = SimClock()
+    server, pools, fastpath, nat = _build_server_stack(clock)
+    qos = QoSTables()
+    spoof = AntispoofTables(nbuckets=256)
+    # per-binding STRICT, default DISABLED: control planes (v6 SOLICIT,
+    # PPPoE discovery) come from not-yet-bound MACs and must reach the
+    # slow path; only a BOUND subscriber spoofing a foreign source is a
+    # violation — exactly the reference's per-subscriber mode column
+    spoof.set_config(MODE_DISABLED, True)
+    edge = EdgeTables(nbuckets=256)
+    policies = PolicyManager([
+        QoSPolicy("gold", download_bps=400_000_000,
+                  upload_bps=200_000_000),
+        QoSPolicy("bronze", download_bps=50_000_000,
+                  upload_bps=10_000_000),
+    ])
+
+    def qos_hook(ip, policy_name):
+        p = policies.get(policy_name or "bronze")
+        if p is not None:
+            qos.set_subscriber(ip, p.download_bps, p.upload_bps)
+        return True
+
+    server.qos_hook = qos_hook
+
+    im = InterceptManager(clock=clock)
+    platform = StubPlatform()
+    rman = RoutingManager(None, platform)
+    rman.add_upstream(Upstream(name="ispA", interface="eth1",
+                               gateway="192.0.2.1", table=100,
+                               health_target="192.0.2.1", weight=1))
+    rman.add_upstream(Upstream(name="ispB", interface="eth2",
+                               gateway="192.0.2.2", table=101,
+                               health_target="192.0.2.2", weight=1))
+    platform.reachable["192.0.2.1"] = 0.001
+    platform.reachable["192.0.2.2"] = 0.001
+    for _ in range(3):
+        rman.check_health()
+    mac_a = bytes.fromhex("02dd0000000a")
+    mac_b = bytes.fromhex("02dd0000000b")
+    tap_prog = InterceptTapProgram(edge, im, clock=clock)
+    route_prog = RouteProgram(edge, rman)
+    route_prog.attach()
+    route_prog.set_neighbor("192.0.2.1", mac_a)
+    route_prog.set_neighbor("192.0.2.2", mac_b)
+    pump = MirrorPump(tap_prog, manager=im)
+
+    v6 = DHCPv6Server(
+        DHCPv6ServerConfig(server_mac=SERVER_MAC, rapid_commit=False),
+        address_pool=AddressPool6("2001:db8:100::/64"),
+        prefix_pool=PrefixPool6("2001:db8:f000::/40", delegated_len=56),
+        clock=clock)
+    slaac = SLAACServer(SLAACConfig(
+        server_mac=SERVER_MAC,
+        prefixes=[PrefixConfig(
+            prefix=bytes.fromhex("20010db8010000000000000000000000"))],
+        managed=True))
+    ppp = PPPoEServer(
+        PPPoEServerConfig(our_ip=ip_to_u32("10.64.0.1"),
+                          dns_primary=ip_to_u32("1.1.1.1"),
+                          echo_interval_s=30.0),
+        LocalVerifier({"alice": b"secret123"}),
+        lambda username, mac: ip_to_u32("10.64.0.100"),
+        magic_source=lambda: 0xDEADBEEF,
+        challenge_source=lambda: b"C" * 16)
+    demux = SlowPathDemux(dhcp=server, dhcpv6=v6, slaac=slaac, pppoe=ppp,
+                          clock=clock)
+    eng = Engine(fastpath, nat, qos=qos, antispoof=spoof, edge=edge,
+                 mirror_sink=pump, batch_size=32, slow_path=demux,
+                 clock=clock)
+
+    fac = StormFrameFactory(SERVER_IP)
+    base = (seed % 61) * 1_000_000
+    macs = [_mac(base + i) for i in range(n_subs)]
+    ppp_macs = [_mac(base + 0x10000 + i) for i in range(n_ppp)]
+    v6_macs = [_mac(base + 0x20000 + i) for i in range(n_v6)]
+    ext_ip = ip_to_u32("198.51.100.9")
+
+    def data(mac, src_ip, dport, sport=40000):
+        return packets.udp_packet(mac, SERVER_MAC, src_ip, ext_ip,
+                                  sport, dport, b"production-day")
+
+    # warm-up: ONE lease pays the jit compile outside the tracer
+    leased: dict[bytes, int] = {}
+
+    def dora(m, i):
+        res = eng.process([fac.discover(m, 0x800 + i)])
+        off = (res["slow"] or res["tx"])[0][1]
+        ip = _reply(off).yiaddr
+        eng.process([fac.request(m, ip, 0x900 + i)])
+        leased[m] = ip
+
+    dora(macs[0], 0)
+
+    with _traced() as tracer:
+        # ---- morning: bring-up wave — IPoE + dual-stack + PPPoE ------
+        for i, m in enumerate(macs[1:], start=1):
+            dora(m, i)
+        for m in macs:
+            spoof.add_binding(m, leased[m], MODE_STRICT)
+            route_prog.bind_subscriber(
+                leased[m], "business" if leased[m] % 2 else "residential")
+
+        from bng_tpu.control.dhcpv6.protocol import (DHCPv6Message,
+                                                     generate_duid_ll)
+        from bng_tpu.control.dhcpv6 import protocol as p6
+
+        server_duid = v6.duid.encode()
+        v6_leased = 0
+        ra_seen = 0
+        for i, m in enumerate(v6_macs):
+            duid = generate_duid_ll(m).encode()
+            res = eng.process([_solicit6(m, 0x600 + i, duid),
+                               _rs_frame(m)])
+            replies = [f for _l, f in res["slow"] if f is not None]
+            ra_seen += sum(1 for f in replies if f[12:14] == b"\x86\xdd"
+                           and f[20] != 17)
+            res = eng.process([_request6(m, 0x700 + i, duid,
+                                         server_duid, None)])
+            for _l, f in res["slow"]:
+                if f is None or f[20] != 17:
+                    continue
+                msg = DHCPv6Message.decode(f[62:])
+                if msg.msg_type == p6.REPLY:
+                    ias = msg.ia_nas()
+                    if ias and ias[0].addresses:
+                        v6_leased += 1
+
+        ppp_sessions = 0
+        for i, m in enumerate(ppp_macs):
+            padi = pcodec.PPPoEPacket(pcodec.CODE_PADI, 0,
+                                      pcodec.serialize_tags(
+                [pcodec.Tag(pcodec.TAG_SERVICE_NAME, b""),
+                 pcodec.Tag(pcodec.TAG_HOST_UNIQ, b"HU%02d" % i)]))
+            res = eng.process([pcodec.eth_frame(
+                b"\xff" * 6, m, pcodec.ETH_PPPOE_DISCOVERY, padi.encode())])
+            pado = next((f for _l, f in res["slow"] if f is not None), None)
+            if pado is None:
+                continue
+            _d, src, _e, payload = pcodec.parse_eth(pado)
+            tags = pcodec.parse_tags(pcodec.PPPoEPacket.decode(payload).payload)
+            cookie = pcodec.find_tag(tags, pcodec.TAG_AC_COOKIE)
+            out_tags = [pcodec.Tag(pcodec.TAG_SERVICE_NAME, b"")]
+            if cookie is not None:
+                out_tags.append(cookie)
+            padr = pcodec.PPPoEPacket(pcodec.CODE_PADR, 0,
+                                      pcodec.serialize_tags(out_tags))
+            res = eng.process([pcodec.eth_frame(
+                src, m, pcodec.ETH_PPPOE_DISCOVERY, padr.encode())])
+            for _l, f in res["slow"]:
+                if f is None:
+                    continue
+                pads = pcodec.PPPoEPacket.decode(pcodec.parse_eth(f)[3])
+                if pads.code == pcodec.CODE_PADS and pads.session_id:
+                    ppp_sessions += 1
+            demux.drain_pending()  # LCP conf-reqs beyond the ring contract
+
+        # every lease carved a CGNAT block at DORA time (nat_hook); a
+        # first flow per subscriber proves the blocks actually translate
+        nat_flows = sum(
+            1 for i, m in enumerate(macs)
+            if nat.handle_new_flow(leased[m], ext_ip, 40000 + i, 80, 17,
+                                   100, int(clock())) is not None)
+
+        def forward_wave(dport, sport=41000):
+            """One upstream data frame per subscriber; returns (fwd
+            count, dst MACs of the forwarded frames)."""
+            res = eng.process([data(m, leased[m], dport,
+                                    sport=sport + i)
+                               for i, m in enumerate(macs)],
+                              now=clock.advance(1.0))
+            out_macs = [bytes(f[:6]) for _l, f in res["fwd"]]
+            return len(res["fwd"]), out_macs
+
+        fwd_morning, wave_macs = forward_wave(8080)
+        on_isps = sum(1 for mm in wave_macs if mm in (mac_a, mac_b))
+        classes_split = len(set(wave_macs)) == 2  # ECMP split by class
+
+        # ---- midday: CoA policy waves with renewals on the device ----
+        def find_by_ip(ip):
+            for _mk, lease in server.leases.items():
+                if lease.ip == ip:
+                    return lease
+            return None
+
+        proc = CoAProcessor(find_by_ip=find_by_ip, qos_update=qos_hook,
+                            policy_manager=policies)
+        coa = CoAServer(secret, proc)
+        renew_ok = renew_total = 0
+        for rnd in range(coa_waves):
+            policy = ("gold", "bronze")[rnd % 2]
+            for i, m in enumerate(macs):
+                if (i + rnd) % 3 == 0:
+                    req = RadiusPacket(rp.COA_REQUEST,
+                                       (leased[m] + rnd) & 0xFF)
+                    req.add(rp.FRAMED_IP_ADDRESS, leased[m])
+                    req.add(rp.FILTER_ID, policy)
+                    coa.handle_raw(req.encode(secret))
+            batch = [fac.renew(m, leased[m], 0xA000 + rnd * 64 + i)
+                     for i, m in enumerate(macs)]
+            res = eng.process(batch, now=clock.advance(30.0))
+            renew_total += len(batch)
+            renew_ok += sum(1 for _l, f in res["tx"]
+                            if f is not None
+                            and _reply(f).msg_type == dhcp_codec.ACK)
+
+        # ---- afternoon: taps armed MID-storm -------------------------
+        now = clock()
+        im.add_warrant(Warrant(id="W-DAY-1", liid="LIID-D1",
+                               target_ipv4=u32_to_ip(leased[macs[0]]),
+                               valid_from=now - 1.0,
+                               valid_until=now + 100_000.0,
+                               filter_dest_ports=[443]))
+        im.add_warrant(Warrant(id="W-DAY-2", liid="LIID-D2",
+                               target_ipv4=u32_to_ip(leased[macs[1]]),
+                               valid_from=now - 1.0,
+                               valid_until=now + 600.0))
+        sync_rep = tap_prog.sync()
+        filtered_before = int(np.asarray(eng.stats.edge)[EST_TAP_FILTERED])
+        # matching flow mirrors; non-matching is filtered ON DEVICE
+        eng.process([data(macs[0], leased[macs[0]], 443, sport=42000),
+                     data(macs[0], leased[macs[0]], 9999, sport=42001),
+                     data(macs[1], leased[macs[1]], 8080, sport=42002),
+                     data(macs[2], leased[macs[2]], 443, sport=42003)],
+                    now=clock.advance(1.0))
+        filtered_on_device = (int(np.asarray(eng.stats.edge)[EST_TAP_FILTERED])
+                              - filtered_before)
+        mirrored_day = pump.stats["mirrored"]
+        cc_records = im.stats()["cc_records"]
+
+        # ---- evening rush: uplink dies + DDoS burst ------------------
+        del platform.reachable["192.0.2.1"]
+        for _ in range(rman.config.failure_threshold):
+            rman.check_health()
+        dirty_after_flap = edge.dirty_count()
+        deltas = route_prog.stats["deltas"]
+        fwd_evening, wave_macs = forward_wave(8081, sport=43000)
+        on_survivor = sum(1 for mm in wave_macs if mm == mac_b)
+
+        viol_before = np.asarray(eng.stats.spoof)[
+            [AST_DROPPED, AST_V4_VIOL]].astype(np.int64)
+        burst = [data(macs[i % n_subs],
+                      ip_to_u32("172.16.9.9") + i,  # NOT the binding
+                      53, sport=44000 + i)
+                 for i in range(ddos)]
+        eng.process(burst, now=clock.advance(1.0))
+        viol_delta = (np.asarray(eng.stats.spoof)[
+            [AST_DROPPED, AST_V4_VIOL]].astype(np.int64) - viol_before)
+
+        # ---- night: the short warrant expires; bounded reap ----------
+        clock.advance(700.0)
+        expired = im.expire_warrants(max_reaps=4)
+        reap_rep = tap_prog.sync()
+        mirrored_before_night = pump.stats["mirrored"]
+        eng.process([data(macs[1], leased[macs[1]], 8080, sport=45000)],
+                    now=clock())
+        mirrored_at_night = pump.stats["mirrored"] - mirrored_before_night
+
+        audit = audit_invariants(engine=eng, pools=pools, dhcp=server,
+                                 nat=nat, dhcpv6=v6,
+                                 tap_program=tap_prog,
+                                 route_program=route_prog)
+        budget = check_budget(tracer, (
+            # the coa_policy_flap envelopes: same engine, same stages
+            BudgetLine("dispatch", limit_us=500_000.0),
+            BudgetLine("device_wait", limit_us=2_000_000.0),
+            BudgetLine("reply", limit_us=200_000.0),
+            BudgetLine("total", limit_us=5_000_000.0),
+        ))
+
+    out = {
+        "name": "production_day", "seed": seed,
+        "subscribers": n_subs,
+        "leased": len(leased),
+        "v6_leased": v6_leased,
+        "ra_seen": ra_seen,
+        "ppp_sessions": ppp_sessions,
+        "nat_flows": nat_flows,
+        "routes_bound": route_prog.stats["bound"],
+        "fwd_morning": fwd_morning,
+        "ecmp_on_isps": on_isps,
+        "ecmp_split": classes_split,
+        "coa_ack": proc.stats["coa_ack"],
+        "renew_ok": renew_ok, "renew_total": renew_total,
+        "taps_armed": sync_rep["armed"],
+        "mirrored": mirrored_day,
+        "cc_records": cc_records,
+        "filtered_on_device": filtered_on_device,
+        "route_flaps": route_prog.stats["flaps"],
+        "route_deltas": deltas,
+        "dirty_after_flap": dirty_after_flap,
+        "fwd_evening": fwd_evening,
+        "on_survivor": on_survivor,
+        "spoof_dropped": int(viol_delta[0]),
+        "spoof_v4_viol": int(viol_delta[1]),
+        "warrants_expired": expired,
+        "taps_reaped": reap_rep["reaped"],
+        "tap_rows_after_reap": reap_rep["rows"],
+        "mirrored_after_expiry": mirrored_at_night,
+        "edge_rewrites": int(np.asarray(eng.stats.edge)[EST_ROUTE_REWRITES]),
+        "demux": dict(sorted(demux.stats.items())),
+        "audit_ok": audit.ok,
+        "violations": audit.violations_by_kind(),
+        "budget": budget,
+    }
+    out["ok"] = (
+        len(leased) == n_subs
+        and v6_leased == n_v6 and ra_seen == n_v6
+        and ppp_sessions == n_ppp
+        and nat_flows == n_subs
+        and out["routes_bound"] == n_subs
+        and fwd_morning == n_subs and on_isps == n_subs
+        and classes_split
+        and renew_ok == renew_total
+        and out["taps_armed"] == 2
+        # W-DAY-1 matched once (443), W-DAY-2 has no filters (any flow);
+        # the 9999 flow died on the DEVICE filter predicate, and the
+        # untargeted macs[2] flow never mirrors
+        and mirrored_day == 2 and cc_records == 2
+        and filtered_on_device >= 1
+        and out["route_flaps"] == 1 and deltas >= 1
+        and 0 < dirty_after_flap <= 2 * n_subs
+        and fwd_evening == n_subs and on_survivor == n_subs
+        and out["spoof_dropped"] == ddos
+        and out["spoof_v4_viol"] == ddos
+        and expired == 1
+        and out["taps_reaped"] == 1 and out["tap_rows_after_reap"] == 1
+        and mirrored_at_night == 0
+        and audit.ok and budget["ok"])
+    return out
+
+
+# ---------------------------------------------------------------------------
 # registry (merged into the runner's catalog next to SCENARIOS)
 # ---------------------------------------------------------------------------
 
@@ -1050,4 +1442,5 @@ STORMS = {
     "coa_policy_flap": coa_policy_flap,
     "dual_stack_bringup": dual_stack_bringup,
     "cluster_scale_storm": cluster_scale_storm,
+    "production_day": production_day,
 }

@@ -348,11 +348,21 @@ class InterceptManager:
 
     # -- maintenance ----------------------------------------------------
 
-    def expire_warrants(self) -> int:
+    def expire_warrants(self, max_reaps: int | None = None) -> int:
+        """Sweep ACTIVE warrants past their validity window to EXPIRED.
+
+        `max_reaps` bounds one sweep (the `cleanup_expired` mold): a
+        maintenance tick over a large warrant store expires at most
+        that many per call, the remainder reaped by later ticks —
+        iteration order is insertion order, so repeated bounded sweeps
+        converge without starvation.
+        """
         now = self._clock()
         n = 0
         with self._lock:
             for w in self._warrants.values():
+                if max_reaps is not None and n >= max_reaps:
+                    break
                 if w.status == WarrantStatus.ACTIVE and now >= w.valid_until:
                     w.status = WarrantStatus.EXPIRED
                     n += 1

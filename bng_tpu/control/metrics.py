@@ -600,6 +600,66 @@ class BNGMetrics:
             "bng_shard_stage_p99_us",
             "Per-shard stage p99 from the sharded-path histograms",
             ("shard", "stage"))
+        # antispoof stage (ops/antispoof.py AST_* words). The reference
+        # streams violations over a perf-event buffer; here the device
+        # counts and the host logs rate-limited, so the counters are the
+        # durable record a DDoS post-mortem reads.
+        self.antispoof_allowed = r.counter(
+            "bng_antispoof_allowed_total",
+            "Access-side frames the source-validation stage passed")
+        self.antispoof_dropped = r.counter(
+            "bng_antispoof_dropped_total",
+            "Frames dropped for a spoofed source address")
+        self.antispoof_logged = r.counter(
+            "bng_antispoof_logged_total",
+            "Violations recorded by log-only mode (frame still passed)")
+        self.antispoof_violations = r.counter(
+            "bng_antispoof_violations_total",
+            "Source-validation violations by address family",
+            ("family",))
+        # edge protection (bng_tpu/edge): device tap-match + next-hop
+        # rewrite. Armed-tap and route-row gauges reconcile against the
+        # control plane (the _audit_edge clauses); the counters are the
+        # fast-path truth a lawful-intercept export is reconciled to.
+        self.edge_taps_armed = r.gauge(
+            "bng_edge_taps_armed", "Tap rows armed on the device")
+        self.edge_routes_active = r.gauge(
+            "bng_edge_routes_active", "Next-hop route rows on the device")
+        self.edge_dirty_slots = r.gauge(
+            "bng_edge_dirty_slots",
+            "Edge table rows changed host-side awaiting the next drain")
+        self.edge_mirrored = r.counter(
+            "bng_edge_mirrored_total",
+            "Frames flagged MIRROR by the device tap-match stage")
+        self.edge_tap_filtered = r.counter(
+            "bng_edge_tap_filtered_total",
+            "Tapped-subscriber frames the DEVICE filter predicate "
+            "excluded (never reached the host mirror path)")
+        self.edge_route_rewrites = r.counter(
+            "bng_edge_route_rewrites_total",
+            "Upstream frames steered by the device next-hop rewrite")
+        self.edge_route_misses = r.counter(
+            "bng_edge_route_misses_total",
+            "Upstream data frames with no route row (default path)")
+        # lawful intercept (control/intercept.py): warrant book + export
+        # stream health. export_errors nonzero is an evidentiary gap.
+        self.intercept_warrants = r.gauge(
+            "bng_intercept_warrants", "Warrants in the book")
+        self.intercept_sessions = r.gauge(
+            "bng_intercept_sessions_active",
+            "Sessions currently matched to a warrant")
+        self.intercept_iri = r.counter(
+            "bng_intercept_iri_records_total",
+            "IRI (intercept-related information) records exported")
+        self.intercept_cc = r.counter(
+            "bng_intercept_cc_records_total",
+            "CC (content) records exported from mirrored frames")
+        self.intercept_filtered = r.counter(
+            "bng_intercept_filtered_total",
+            "Mirrored frames excluded by host-side warrant filters")
+        self.intercept_export_errors = r.counter(
+            "bng_intercept_export_errors_total",
+            "Delivery failures while exporting intercept records")
 
     # -- telemetry (bng_tpu/telemetry) ----------------------------------
 
@@ -755,6 +815,48 @@ class BNGMetrics:
             return
         self.garden_gated_drops.set_total(int(g[0]))
         self.garden_allowed_hits.set_total(int(g[1]))
+
+    def collect_antispoof(self, engine_stats) -> None:
+        """Antispoof stage counters (EngineStats.spoof, AST_* order)."""
+        s = getattr(engine_stats, "spoof", None)
+        if s is None or len(s) < 6:
+            return
+        self.antispoof_allowed.set_total(int(s[0]))
+        self.antispoof_dropped.set_total(int(s[1]))
+        self.antispoof_logged.set_total(int(s[2]))
+        self.antispoof_violations.set_total(int(s[3]), family="v4")
+        self.antispoof_violations.set_total(int(s[4]), family="v6")
+
+    def collect_edge(self, engine_stats, tables=None) -> None:
+        """Edge-protection counters (EngineStats.edge, EST_* order) +
+        table-occupancy gauges from the host surface (Engine.edge or a
+        ShardedCluster, both expose tap_rows/route_rows)."""
+        e = getattr(engine_stats, "edge", None)
+        if e is None and isinstance(engine_stats, dict):
+            e = engine_stats.get("edge")
+        if e is not None and len(e) >= 4:
+            self.edge_mirrored.set_total(int(e[0]))
+            self.edge_tap_filtered.set_total(int(e[1]))
+            self.edge_route_rewrites.set_total(int(e[2]))
+            self.edge_route_misses.set_total(int(e[3]))
+        if tables is not None:
+            self.edge_taps_armed.set(len(tables.tap_rows()))
+            self.edge_routes_active.set(len(tables.route_rows()))
+            dirty = getattr(tables, "dirty_count", None)
+            if dirty is not None:
+                self.edge_dirty_slots.set(dirty())
+
+    def collect_intercept(self, manager) -> None:
+        """Warrant-book + export-stream health (InterceptManager.stats()
+        or an equivalent dict)."""
+        st = manager.stats() if callable(getattr(manager, "stats", None)) \
+            else dict(manager)
+        self.intercept_warrants.set(st.get("warrants", 0))
+        self.intercept_sessions.set(st.get("active_sessions", 0))
+        self.intercept_iri.set_total(st.get("iri_records", 0))
+        self.intercept_cc.set_total(st.get("cc_records", 0))
+        self.intercept_filtered.set_total(st.get("filtered", 0))
+        self.intercept_export_errors.set_total(st.get("export_errors", 0))
 
     def collect_scheduler(self, scheduler) -> None:
         """TieredScheduler.stats_snapshot() -> bng_sched_* gauges/counters

@@ -75,6 +75,14 @@ class PipelineTables(NamedTuple):
     pppoe_by_sid: TableState | None = None
     pppoe_by_ip: TableState | None = None
     pppoe_server_mac: jax.Array | None = None  # [2] uint32 (hi16, lo32)
+    # edge protection (bng_tpu/edge): intercept tap-match rows + dense
+    # filter/armed arrays, and the next-hop route table. None = no edge
+    # stage compiled in; an armed-but-warrantless tap table costs one
+    # predicate (the lax.cond in edge.ops.tap_match).
+    tap: TableState | None = None
+    tap_filters: jax.Array | None = None  # [F, 4] uint32
+    tap_config: jax.Array | None = None  # [2] uint32
+    route: TableState | None = None
 
 
 class PipelineGeom(NamedTuple):
@@ -84,6 +92,8 @@ class PipelineGeom(NamedTuple):
     spoof: AntispoofGeom
     garden: TableGeom | None = None
     pppoe: TableGeom | None = None
+    tap: TableGeom | None = None
+    route: TableGeom | None = None
 
 
 class PipelineResult(NamedTuple):
@@ -100,6 +110,12 @@ class PipelineResult(NamedTuple):
     spoof_violation: jax.Array  # [B] bool — host audit log
     garden_stats: jax.Array | None = None  # [GARDEN_NSTATS] when gated
     pppoe_stats: jax.Array | None = None  # [PPPOE_NSTATS] when PPPoE on
+    # [B] uint32: warrant id the lane mirrors for (0 = not mirrored).
+    # Deliberately a side array, NOT a verdict bit: verdict histograms
+    # and == VERDICT_* comparisons stay exact. The host retire path
+    # (engine mirror_sink) extracts wid != 0 lanes for RecordCC/HI3.
+    mirror: jax.Array | None = None
+    edge_stats: jax.Array | None = None  # [EDGE_NSTATS] when edge on
 
 
 def pipeline_step(
@@ -179,6 +195,34 @@ def pipeline_step(
                       tables.qos_down, geom.qos, now_us)
     qos_drop = (up.dropped & from_access) | (down.dropped & ~from_access)
 
+    # --- edge protection (bng_tpu/edge): intercept tap-match + next-hop
+    # route rewrite. The tap keys on the SUBSCRIBER address of the lane
+    # (src upstream, post-DNAT dst downstream) so one row taps both
+    # directions of a session; the route table steers upstream lanes to
+    # their ISP next-hop (per-class ECMP compiled host-side). Mirror is
+    # a side array (see PipelineResult); the route rewrite patches the
+    # L2 dst MAC in place on nat.out_pkt — upstream-only, disjoint from
+    # pppoe_encap's downstream MAC stamp.
+    mirror = None
+    edge_stats = None
+    data_pkt = nat.out_pkt
+    route_fwd = jnp.zeros_like(from_access)
+    if tables.tap is not None:
+        from bng_tpu.edge.ops import route_rewrite, tap_match
+
+        sub_ip = jnp.where(from_access, parsed.src_ip, dnat_dst)
+        peer_ip = jnp.where(from_access, parsed.dst_ip, parsed.src_ip)
+        data_lane = parsed.is_ipv4 & ~dhcp.is_dhcp
+        tap = tap_match(sub_ip, parsed.src_port, parsed.dst_port,
+                        parsed.proto, peer_ip, data_lane, tables.tap,
+                        tables.tap_filters, tables.tap_config, geom.tap)
+        mirror = tap.mirror
+        rt = route_rewrite(data_pkt, sub_ip, data_lane & from_access,
+                           tables.route, geom.route)
+        data_pkt = rt.out_pkt
+        route_fwd = rt.hit
+        edge_stats = jnp.concatenate([tap.stats, rt.stats])
+
     # --- PPPoE encap post-stage: downstream data whose post-DNAT dst is
     # an OPEN PPPoE session gets its AC framing here (the reference builds
     # these frames host-side per packet, pkg/pppoe/server.go; batched
@@ -195,8 +239,10 @@ def pipeline_step(
 
     # --- verdict combination (precedence: TX > DROP > FWD > PASS) ---
     drop = (spoof_drop | qos_drop | garden_drop) & ~dhcp_tx
-    fwd = nat_fwd
-    out_pkt = jnp.where(dhcp_tx[:, None], dhcp.out_pkt, nat.out_pkt)
+    # a routed (next-hop-rewritten) lane forwards even when NAT left it
+    # untouched — the non-CGNAT routed-subscriber case
+    fwd = nat_fwd | (route_fwd & ~drop & ~dhcp_tx)
+    out_pkt = jnp.where(dhcp_tx[:, None], dhcp.out_pkt, data_pkt)
     out_len = jnp.where(dhcp_tx, dhcp.out_len, length)
     if pppoe_enc is not None:
         enc_done = pppoe_enc.done & ~drop & ~dhcp_tx
@@ -237,4 +283,6 @@ def pipeline_step(
         pppoe_stats=(None if pppoe_dec is None else
                      pppoe_dec.stats + (0 if pppoe_enc is None
                                         else pppoe_enc.stats)),
+        mirror=mirror,
+        edge_stats=edge_stats,
     )

@@ -40,6 +40,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from bng_tpu.control.nat import NATManager
+from bng_tpu.edge.tables import EdgeTables
 from bng_tpu.ops.pipeline import PipelineGeom, PipelineTables, pipeline_step
 from bng_tpu.ops import table as table_mod
 from bng_tpu.ops.table import TableGeom, shard_owner
@@ -111,6 +112,7 @@ def _sharded_step_jit(mesh: Mesh, geom: PipelineGeom, n: int,
 
     has_garden = geom.garden is not None
     has_pppoe = geom.pppoe is not None
+    has_edge = geom.tap is not None
 
     def local_step(tables1, upd1, pkt, length, fa, now_s, now_us):
         # shard_map hands each chip a leading dim of 1: drop it
@@ -135,6 +137,10 @@ def _sharded_step_jit(mesh: Mesh, geom: PipelineGeom, n: int,
             out += (jax.lax.psum(res.garden_stats, AXIS),)
         if has_pppoe:
             out += (jax.lax.psum(res.pppoe_stats, AXIS),)
+        if has_edge:
+            # mirror wids stay per-lane (the host retire extracts flagged
+            # frames from its own shard region); stats psum like the rest
+            out += (res.mirror, jax.lax.psum(res.edge_stats, AXIS))
         return out
 
     out_specs = (P(AXIS), P(AXIS), P(AXIS), P(AXIS), P(), P(), P(), P(),
@@ -143,6 +149,8 @@ def _sharded_step_jit(mesh: Mesh, geom: PipelineGeom, n: int,
         out_specs += (P(),)
     if has_pppoe:
         out_specs += (P(),)
+    if has_edge:
+        out_specs += (P(AXIS), P())
     sharded = _shard_map(
         local_step,
         mesh=mesh,
@@ -362,6 +370,8 @@ class ShardedCluster:
         pppoe_enabled: bool = False,
         pppoe_nbuckets: int = 256,
         server_mac: bytes = b"\x02\xbb\x00\x00\x00\x01",
+        edge_enabled: bool = False,
+        edge_nbuckets: int = 256,
     ):
         self.n = n_shards
         self.mesh = mesh if mesh is not None else make_mesh(n_shards)
@@ -379,7 +389,8 @@ class ShardedCluster:
             qos_nbuckets=qos_nbuckets, spoof_nbuckets=spoof_nbuckets,
             public_ips=list(public_ips) if public_ips else None,
             garden_enabled=garden_enabled, pppoe_enabled=pppoe_enabled,
-            pppoe_nbuckets=pppoe_nbuckets, server_mac=server_mac)
+            pppoe_nbuckets=pppoe_nbuckets, server_mac=server_mac,
+            edge_enabled=edge_enabled, edge_nbuckets=edge_nbuckets)
         self.fastpath = [
             FastPathTables(sub_nbuckets=sub_nbuckets, vlan_nbuckets=vlan_nbuckets,
                            cid_nbuckets=cid_nbuckets, max_pools=max_pools)
@@ -417,6 +428,16 @@ class ShardedCluster:
         self.pppoe = ([PPPoEFastPathTables(nbuckets=pppoe_nbuckets,
                                            server_mac=server_mac)
                        for _ in range(n_shards)] if pppoe_enabled else None)
+        # edge protection tables (tap mirror + route rewrite), chip-local
+        # like NAT/QoS: both key on the subscriber private IP = the
+        # affinity key, so the ring already steers the matching lanes to
+        # the shard holding the row. Optional: a cluster without warrants
+        # or route policy compiles the stage out entirely.
+        self.edge = ([EdgeTables(nbuckets=edge_nbuckets)
+                      for _ in range(n_shards)] if edge_enabled else None)
+        # host retire hook for MIRROR-flagged lanes (lane, frame, wid) —
+        # the Engine.mirror_sink analog; wire a MirrorPump here
+        self.mirror_sink = None
         self.geom = PipelineGeom(
             dhcp=self.fastpath[0].geom,
             nat=self.nat[0].geom,
@@ -424,6 +445,8 @@ class ShardedCluster:
             spoof=self.spoof[0].geom,
             garden=self.garden[0].geom if garden_enabled else None,
             pppoe=self.pppoe[0].geom if pppoe_enabled else None,
+            tap=self.edge[0].geom if edge_enabled else None,
+            route=self.edge[0].geom if edge_enabled else None,
         )
         # table-probe impl resolved once at cluster construction (the
         # Engine discipline); dryrun_multichip stamps it into the
@@ -545,6 +568,68 @@ class ShardedCluster:
         o = self.affinity_shard_ip(sess.assigned_ip)
         self.pppoe[o].session_down(event)
         return o
+
+    # ---- edge protection (rows live on the subscriber's affinity shard) --
+    # The same duck-typed surface EdgeTables exposes, with owner routing
+    # in front, so InterceptTapProgram/RouteProgram target a cluster
+    # exactly as they target a single engine's tables.
+    def _edge_or_raise(self) -> list[EdgeTables]:
+        if self.edge is None:
+            raise RuntimeError("edge protection disabled for this cluster")
+        return self.edge
+
+    def arm_tap(self, private_ip: int, wid: int, filters=()) -> int:
+        edge = self._edge_or_raise()
+        o = self.affinity_shard_ip(private_ip)
+        edge[o].arm_tap(private_ip, wid, filters)
+        # filter rows are warrant-global: replicate to every shard so
+        # any shard's dense copy (and shard 0's at checkpoint time) is
+        # authoritative for the whole cluster
+        for i, e in enumerate(edge):
+            if i != o:
+                e.set_tap_filters(wid, filters)
+        return o
+
+    def disarm_tap(self, private_ip: int) -> bool:
+        edge = self._edge_or_raise()
+        return edge[self.affinity_shard_ip(private_ip)].disarm_tap(private_ip)
+
+    def get_tap(self, private_ip: int):
+        edge = self._edge_or_raise()
+        return edge[self.affinity_shard_ip(private_ip)].get_tap(private_ip)
+
+    def set_tap_filters(self, wid: int, filters) -> int:
+        """Filter rows replicate cluster-wide (one warrant may arm IPs on
+        several shards); returns the smallest per-shard write count so a
+        truncation anywhere reads as dropped."""
+        edge = self._edge_or_raise()
+        return min(e.set_tap_filters(wid, filters) for e in edge)
+
+    def set_route(self, private_ip: int, nh_mac: bytes, table_id: int,
+                  klass: int = 0) -> int:
+        edge = self._edge_or_raise()
+        o = self.affinity_shard_ip(private_ip)
+        edge[o].set_route(private_ip, nh_mac, table_id, klass)
+        return o
+
+    def clear_route(self, private_ip: int) -> bool:
+        edge = self._edge_or_raise()
+        return edge[self.affinity_shard_ip(private_ip)].clear_route(private_ip)
+
+    def get_route(self, private_ip: int):
+        edge = self._edge_or_raise()
+        return edge[self.affinity_shard_ip(private_ip)].get_route(private_ip)
+
+    def tap_rows(self):
+        """Cluster-wide tap rows, sorted by IP (the audit surface)."""
+        edge = self._edge_or_raise()
+        return sorted((kv for e in edge for kv in e.tap_rows()),
+                      key=lambda kv: kv[0])
+
+    def route_rows(self):
+        edge = self._edge_or_raise()
+        return sorted((kv for e in edge for kv in e.route_rows()),
+                      key=lambda kv: kv[0])
 
     def pub_ip_map(self) -> dict[int, int]:
         """NAT public IP -> owner shard (downstream ring steering).
@@ -700,6 +785,8 @@ class ShardedCluster:
                   if self.garden is not None else ()),
                 *(self.pppoe[i].make_updates()
                   if self.pppoe is not None else ()),
+                *(self.edge[i].make_updates()
+                  if self.edge is not None else ()),
             )
             for i in range(self.n)
         ]))
@@ -739,6 +826,14 @@ class ShardedCluster:
                              if self.pppoe is not None else None),
                 pppoe_server_mac=(jnp.asarray(self.pppoe[i].server_mac)
                                   if self.pppoe is not None else None),
+                tap=(self.edge[i].tap.device_state()
+                     if self.edge is not None else None),
+                tap_filters=(jnp.asarray(self.edge[i].tap_filters)
+                             if self.edge is not None else None),
+                tap_config=(jnp.asarray(self.edge[i].tap_config)
+                            if self.edge is not None else None),
+                route=(self.edge[i].route.device_state()
+                       if self.edge is not None else None),
             )
             per_shard.append(t)
         self.tables = self._stack_per_shard(per_shard)
@@ -944,6 +1039,7 @@ class ShardedCluster:
                                np.uint8(VERDICT_PASS))
             punt = np.zeros((B,), dtype=bool)
             viol = np.zeros((B,), dtype=bool)
+            mir = None
             stats_h = np.asarray(stats)
             self._fold_stats(dhcp=stats_h)
             out_pkt_h = np.asarray(out_pkt)
@@ -958,6 +1054,8 @@ class ShardedCluster:
             tails = list(tails)
             g_stats = tails.pop(0) if self.garden is not None else None
             p_stats = tails.pop(0) if self.pppoe is not None else None
+            mir = tails.pop(0) if self.edge is not None else None
+            e_stats = tails.pop(0) if self.edge is not None else None
             verdict = np.asarray(verdict_d).astype(np.uint8)
             punt = np.asarray(nat_punt)
             viol = np.asarray(viol_d)
@@ -969,7 +1067,9 @@ class ShardedCluster:
                              garden=(np.asarray(g_stats)
                                      if g_stats is not None else None),
                              pppoe=(np.asarray(p_stats)
-                                    if p_stats is not None else None))
+                                    if p_stats is not None else None),
+                             edge=(np.asarray(e_stats)
+                                   if e_stats is not None else None))
             out_pkt_h = np.asarray(out_pkt)
             out_len_h = np.asarray(out_len).astype(np.uint32)
             wait_us = (time.perf_counter() - t0) * 1e6
@@ -995,6 +1095,14 @@ class ShardedCluster:
             for lane in np.nonzero(viol)[0]:
                 violation_sink(int(lane),
                                bytes(pkt[lane, : int(length[lane])]))
+        if mir is not None and self.mirror_sink is not None:
+            mirw = np.asarray(mir)
+            for lane in np.nonzero((mirw != 0) & real)[0]:
+                # interception observes the ORIGINAL ring bytes even on
+                # lanes the verdict demux above dropped (Engine parity)
+                self.mirror_sink(int(lane),
+                                 bytes(pkt[lane, : int(length[lane])]),
+                                 int(mirw[lane]))
         # slow drain, lane-aligned with the PASS lanes complete() queued
         for lane in np.nonzero((verdict == VERDICT_PASS) & real)[0]:
             got_f = ring.slow_pop()
@@ -1101,6 +1209,7 @@ class ShardedCluster:
         tails = list(tails)
         garden_stats = [tails.pop(0)] if self.garden is not None else []
         pppoe_stats = [tails.pop(0)] if self.pppoe is not None else []
+        edge_out = list(tails[:2]) if self.edge is not None else []
         res = {
             "verdict": np.asarray(verdict),
             "out_pkt": out_pkt,
@@ -1115,6 +1224,9 @@ class ShardedCluster:
                if garden_stats else {}),
             **({"pppoe_stats": np.asarray(pppoe_stats[0])}
                if pppoe_stats else {}),
+            **({"mirror": np.asarray(edge_out[0]),
+                "edge_stats": np.asarray(edge_out[1])}
+               if edge_out else {}),
         }
         t2 = time.perf_counter()
         self.telemetry.record_fused(
@@ -1203,6 +1315,8 @@ class ShardedCluster:
             if self.pppoe is not None:
                 total += self.pppoe[i].by_sid.dirty_count()
                 total += self.pppoe[i].by_ip.dirty_count()
+            if self.edge is not None:
+                total += self.edge[i].dirty_count()
         return total
 
     def shard_components(self, i: int) -> dict:
@@ -1215,6 +1329,8 @@ class ShardedCluster:
             out["garden"] = self.garden[i]
         if self.pppoe is not None:
             out["pppoe"] = self.pppoe[i]
+        if self.edge is not None:
+            out["edge"] = self.edge[i]
         return out
 
     def clone_empty(self, n_shards: int | None = None) -> "ShardedCluster":

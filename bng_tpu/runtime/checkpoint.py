@@ -224,7 +224,7 @@ def _denamespace(prefix: str, arrays: dict) -> dict:
 
 def build_checkpoint(seq: int, now: float, *, engine=None, scheduler=None,
                      fastpath=None, nat=None, qos=None, antispoof=None,
-                     garden=None, pppoe=None, dhcp=None, ha=None,
+                     garden=None, pppoe=None, edge=None, dhcp=None, ha=None,
                      fleet=None, cluster_plan=None,
                      node_id: str = "") -> Checkpoint:
     """Collect a consistent snapshot of the authoritative state.
@@ -243,6 +243,7 @@ def build_checkpoint(seq: int, now: float, *, engine=None, scheduler=None,
         antispoof = antispoof if antispoof is not None else engine.antispoof
         garden = garden if garden is not None else engine.garden
         pppoe = pppoe if pppoe is not None else engine.pppoe
+        edge = edge if edge is not None else getattr(engine, "edge", None)
         if scheduler is not None:
             scheduler.quiesce()
         else:
@@ -285,6 +286,10 @@ def build_checkpoint(seq: int, now: float, *, engine=None, scheduler=None,
         m, a = pppoe.checkpoint_state()
         meta["components"]["pppoe"] = m
         arrays.update(_ns("pppoe", a))
+    if edge is not None:
+        m, a = edge.checkpoint_state()
+        meta["components"]["edge"] = m
+        arrays.update(_ns("edge", a))
     if dhcp is not None:
         meta["components"]["dhcp"] = dhcp.export_leases()
     if ha is not None:
@@ -408,6 +413,15 @@ def _verify_components(ckpt: Checkpoint, comps: dict, targets: dict) -> None:
                           for k in ("keys", "vals", "used")},
                          comps["pppoe"]["geom"][t], f"pppoe.{t}")
         _check_dense(a, "server_mac", pe.server_mac, "pppoe")
+    if "edge" in comps:
+        ed, a = targets["edge"], _denamespace("edge", ckpt.arrays)
+        for t in ("tap", "route"):
+            _check_table(getattr(ed, t),
+                         {k: a.get(f"{t}.{k}")
+                          for k in ("keys", "vals", "used")},
+                         comps["edge"]["geom"][t], f"edge.{t}")
+        _check_dense(a, "tap_filters", ed.tap_filters, "edge")
+        _check_dense(a, "tap_config", ed.tap_config, "edge")
     # dry-parse the dict-driven components: their meta is consumed
     # during mutation, so a parse fault there must be caught HERE or the
     # reject would leave the process half-hydrated
@@ -451,7 +465,7 @@ def _verify_components(ckpt: Checkpoint, comps: dict, targets: dict) -> None:
 
 def restore_checkpoint(ckpt: Checkpoint, *, engine=None, fastpath=None,
                        nat=None, qos=None, antispoof=None, garden=None,
-                       pppoe=None, dhcp=None, ha=None,
+                       pppoe=None, edge=None, dhcp=None, ha=None,
                        fleet=None, cluster_coord=None) -> dict[str, int]:
     """Hydrate the host mirrors from a decoded checkpoint and re-upload.
 
@@ -476,13 +490,14 @@ def restore_checkpoint(ckpt: Checkpoint, *, engine=None, fastpath=None,
         antispoof = antispoof if antispoof is not None else engine.antispoof
         garden = garden if garden is not None else engine.garden
         pppoe = pppoe if pppoe is not None else engine.pppoe
+        edge = edge if edge is not None else getattr(engine, "edge", None)
     comps = dict(ckpt.meta.get("components", {}))
     for name in _PAYLOAD_JSON_COMPONENTS:
         if name in comps:
             comps[name] = _resolve_component_meta(ckpt, comps, name)
     targets = {"fastpath": fastpath, "nat": nat, "qos": qos,
                "antispoof": antispoof, "garden": garden, "pppoe": pppoe,
-               "dhcp": dhcp, "ha": ha, "fleet": fleet,
+               "edge": edge, "dhcp": dhcp, "ha": ha, "fleet": fleet,
                "cluster_plan": cluster_coord}
     missing = []
     for name in comps:
@@ -539,6 +554,10 @@ def restore_checkpoint(ckpt: Checkpoint, *, engine=None, fastpath=None,
             got = pppoe.restore_state(comps["pppoe"],
                                       _denamespace("pppoe", ckpt.arrays))
             rows.update({f"pppoe.{k}": v for k, v in got.items()})
+        if "edge" in comps:
+            got = edge.restore_state(comps["edge"],
+                                     _denamespace("edge", ckpt.arrays))
+            rows.update({f"edge.{k}": v for k, v in got.items()})
         if "dhcp" in comps or "fleet" in comps:
             worker_books = (list(comps["fleet"]["workers"])
                             if "fleet" in comps else [])
@@ -681,6 +700,7 @@ def _reshard_walk(ckpt: Checkpoint, shards_meta: list[dict], src_n: int,
     state. Raises CheckpointError on structural problems; an insert
     overflow (target shards too small for the re-balanced load) also
     rejects — the caller's throwaway target makes that safe."""
+    from bng_tpu.edge.ops import TC_ARMED
     from bng_tpu.ops.antispoof import AB_IPV4
     from bng_tpu.ops.pppoe import PS_IP
     from bng_tpu.ops.qtable import (QW_BURST, QW_FLAGS, QW_KEY,
@@ -688,7 +708,8 @@ def _reshard_walk(ckpt: Checkpoint, shards_meta: list[dict], src_n: int,
     from bng_tpu.ops.table import shard_owner
 
     rows = {"dhcp_rows": 0, "qos_rows": 0, "spoof_rows": 0,
-            "garden_rows": 0, "pppoe_rows": 0, "nat_blocks": 0}
+            "garden_rows": 0, "pppoe_rows": 0, "nat_blocks": 0,
+            "edge_taps": 0, "edge_routes": 0}
     try:
         for i in range(src_n):
             comps = dict(shards_meta[i])
@@ -795,6 +816,35 @@ def _reshard_walk(ckpt: Checkpoint, shards_meta: list[dict], src_n: int,
                     for pe in target.pppoe:
                         pe.server_mac[:] = pa["server_mac"]
 
+            if "edge" in comps and getattr(target, "edge", None) is None:
+                raise CheckpointError(
+                    f"{label} carries edge state but the target cluster "
+                    f"has edge protection disabled: refusing a partial "
+                    f"restore")
+            if "edge" in comps and getattr(target, "edge", None) is not None:
+                ea = _denamespace("edge", a)
+                keys, vals = _used_rows(ea, "tap", f"{label}.edge")
+                for r in range(len(keys)):
+                    # chip-local by subscriber affinity, like the ring
+                    o = target.affinity_shard_ip(int(keys[r][0]))
+                    target.edge[o].tap.insert(keys[r], vals[r])
+                    target.edge[o]._armed += 1
+                    target.edge[o].tap_config[TC_ARMED] = \
+                        target.edge[o]._armed
+                    rows["edge_taps"] += 1
+                keys, vals = _used_rows(ea, "route", f"{label}.edge")
+                for r in range(len(keys)):
+                    o = target.affinity_shard_ip(int(keys[r][0]))
+                    target.edge[o].route.insert(keys[r], vals[r])
+                    rows["edge_routes"] += 1
+                if i == 0:
+                    # filter rows are warrant-global: replicated to
+                    # every shard, shard 0's copy authoritative
+                    for ed in target.edge:
+                        _check_dense(ea, "tap_filters", ed.tap_filters,
+                                     f"{label}.edge")
+                        ed.tap_filters[:] = ea["tap_filters"]
+
             if "nat" in comps:
                 from bng_tpu.control.nat import NATManager
 
@@ -900,6 +950,7 @@ def restore_sharded_checkpoint(ckpt: Checkpoint, cluster, *, dhcp=None,
     cluster.spoof = tmp.spoof
     cluster.garden = tmp.garden
     cluster.pppoe = tmp.pppoe
+    cluster.edge = tmp.edge
     cluster._pub_owner_cache = None
     cluster.resync_tables()
     return rows

@@ -58,7 +58,7 @@ from bng_tpu.runtime import hostpath
 from bng_tpu.runtime.ring import FLAG_DHCP_CTRL
 from bng_tpu.runtime.tables import (FastPathTables, PPPoEFastPathTables,
                                     apply_fastpath_updates)
-from bng_tpu.utils.structlog import SlowPathErrorLog
+from bng_tpu.utils.structlog import ErrorLog, SlowPathErrorLog
 
 # default per-lane packet slot: a full MTU frame (1500) + headroom for
 # QinQ/PPPoE encap, like the reference's XDP frame slot. Engines that only
@@ -67,9 +67,10 @@ PKT_SLOT = 1536
 
 
 def _apply_all_updates(tables: PipelineTables, upd) -> PipelineTables:
-    """upd layout: 7 mandatory entries + optional named tails —
-    garden (garden_upd, allowed_rows) then pppoe (sid_upd, ip_upd) — each
-    present exactly when the corresponding device stage is compiled in."""
+    """upd layout: 7 mandatory entries + optional named tails — garden
+    (garden_upd, allowed_rows), then pppoe (sid_upd, ip_upd), then edge
+    (tap_upd, tap_filters, tap_config, route_upd) — each present exactly
+    when the corresponding device stage is compiled in."""
     fp_upd, nat_upd, qup, qdown, sp_upd, sp_ranges, sp_config, *tails = upd
     tails = list(tails)
     g_state, g_allowed = tables.garden, tables.garden_allowed
@@ -80,6 +81,13 @@ def _apply_all_updates(tables: PipelineTables, upd) -> PipelineTables:
     if p_sid is not None:
         p_sid = apply_update(p_sid, tails.pop(0))
         p_ip = apply_update(p_ip, tails.pop(0))
+    e_tap, e_filters, e_config, e_route = (tables.tap, tables.tap_filters,
+                                           tables.tap_config, tables.route)
+    if e_tap is not None:
+        e_tap = apply_update(e_tap, tails.pop(0))
+        e_filters = tails.pop(0)
+        e_config = tails.pop(0)
+        e_route = apply_update(e_route, tails.pop(0))
     return PipelineTables(
         dhcp=apply_fastpath_updates(tables.dhcp, fp_upd),
         nat=apply_nat_updates(tables.nat, nat_upd),
@@ -93,6 +101,10 @@ def _apply_all_updates(tables: PipelineTables, upd) -> PipelineTables:
         pppoe_by_sid=p_sid,
         pppoe_by_ip=p_ip,
         pppoe_server_mac=tables.pppoe_server_mac,
+        tap=e_tap,
+        tap_filters=e_filters,
+        tap_config=e_config,
+        route=e_route,
     )
 
 
@@ -113,7 +125,8 @@ def _pipeline_jit(geom: PipelineGeom, table_impl: str = "xla"):
 
 
 @functools.lru_cache(maxsize=8)
-def _apply_updates_jit(geom: PipelineGeom, has_garden: bool, has_pppoe: bool):
+def _apply_updates_jit(geom: PipelineGeom, has_garden: bool, has_pppoe: bool,
+                       has_edge: bool = False):
     """Packet-free update application — the scheduler's safety net for a
     PREFETCHED bulk drain that no later batch consumed (overlap-drain
     mode builds the scatter for step N+1 while step N executes; at
@@ -125,7 +138,7 @@ def _apply_updates_jit(geom: PipelineGeom, has_garden: bool, has_pppoe: bool):
     the authoritative chain may live on the express lane's own device —
     including it would force a cross-device program. geom rides in the
     key only to separate engines whose update pytrees differ."""
-    del geom, has_garden, has_pppoe
+    del geom, has_garden, has_pppoe, has_edge
 
     def apply_only(tables, upd):
         fp_upd, nat_upd, qup, qdown, sp_upd, sp_ranges, sp_config, *tails = upd
@@ -139,6 +152,13 @@ def _apply_updates_jit(geom: PipelineGeom, has_garden: bool, has_pppoe: bool):
         if p_sid is not None:
             p_sid = apply_update(p_sid, tails.pop(0))
             p_ip = apply_update(p_ip, tails.pop(0))
+        e_tap, e_filters, e_config, e_route = (tables.tap, tables.tap_filters,
+                                               tables.tap_config, tables.route)
+        if e_tap is not None:
+            e_tap = apply_update(e_tap, tails.pop(0))
+            e_filters = tails.pop(0)
+            e_config = tails.pop(0)
+            e_route = apply_update(e_route, tails.pop(0))
         from bng_tpu.control.nat import apply_nat_updates
 
         return tables._replace(
@@ -148,7 +168,9 @@ def _apply_updates_jit(geom: PipelineGeom, has_garden: bool, has_pppoe: bool):
             spoof=apply_update(tables.spoof, sp_upd),
             spoof_ranges=sp_ranges, spoof_config=sp_config,
             garden=g_state, garden_allowed=g_allowed,
-            pppoe_by_sid=p_sid, pppoe_by_ip=p_ip)
+            pppoe_by_sid=p_sid, pppoe_by_ip=p_ip,
+            tap=e_tap, tap_filters=e_filters, tap_config=e_config,
+            route=e_route)
 
     return jax.jit(apply_only, donate_argnums=(0,))
 
@@ -263,6 +285,8 @@ class EngineStats:
     garden: np.ndarray = field(default_factory=lambda: np.zeros(2, dtype=np.uint64))
     # device PPPoE decap/encap (ops/pppoe.py)
     pppoe: np.ndarray = field(default_factory=lambda: np.zeros(PPPOE_NSTATS, dtype=np.uint64))
+    # device edge protection: tap mirror + route rewrite (edge/ops.py EST_*)
+    edge: np.ndarray = field(default_factory=lambda: np.zeros(4, dtype=np.uint64))
     batches: int = 0
     tx: int = 0
     fwd: int = 0
@@ -419,6 +443,8 @@ class Engine:
         violation_sink: Callable[[int, bytes], None] | None = None,
         clock: Callable[[], float] = time.time,
         device_tables: "PipelineTables | None" = None,
+        edge: "EdgeTables | None" = None,
+        mirror_sink: Callable[[int, bytes, int], None] | None = None,
     ):
         self.fastpath = fastpath
         self.nat = nat
@@ -433,6 +459,13 @@ class Engine:
         # deployments pay nothing); the composition root passes
         # PPPoEFastPathTables when the PPPoE server is constructed
         self.pppoe = pppoe
+        # None = no edge-protection stage (tap mirror + route rewrite) in
+        # the compiled pipeline; the composition root passes EdgeTables
+        # when intercept/routing programs are wired (edge/compile.py)
+        self.edge = edge
+        # host retire hook for MIRROR-flagged lanes: (lane, frame, wid).
+        # The MirrorPump (edge/compile.py) feeds RecordCC/HI3 export here.
+        self.mirror_sink = mirror_sink
         self.B = batch_size
         self.L = pkt_slot
         self.slow_path = slow_path
@@ -451,6 +484,11 @@ class Engine:
         # slow-path failures are counted AND logged (rate-limited): the
         # counter alone dropped the traceback (server.go:330 logs each)
         self._slow_err_log = SlowPathErrorLog("engine")
+        # antispoof violation lanes are logged rate-limited (ISSUE 17
+        # satellite): counters alone hid WHO is spoofing; an unbounded
+        # log would melt under a DDoS burst storm
+        self._viol_log = ErrorLog("antispoof", "antispoof violation",
+                                  rate=5.0, burst=10)
         # bumped by resync_tables(); the scheduler watches it to know its
         # bulk-lane DHCP replica / express placement went stale
         self.resync_count = 0
@@ -460,6 +498,8 @@ class Engine:
             spoof=self.antispoof.geom,
             garden=self.garden.geom if self.garden else None,
             pppoe=self.pppoe.geom if self.pppoe else None,
+            tap=self.edge.geom if self.edge else None,
+            route=self.edge.geom if self.edge else None,
         )
         # `device_tables` adopts a prebuilt geometry-identical device
         # pytree (the blue/green standby's snapshot-hydrated chain,
@@ -505,6 +545,12 @@ class Engine:
                          if self.pppoe else None),
             pppoe_server_mac=(jnp.asarray(self.pppoe.server_mac)
                               if self.pppoe else None),
+            tap=(self.edge.tap.device_state() if self.edge else None),
+            tap_filters=(jnp.asarray(self.edge.tap_filters)
+                         if self.edge else None),
+            tap_config=(jnp.asarray(self.edge.tap_config)
+                        if self.edge else None),
+            route=(self.edge.route.device_state() if self.edge else None),
         )
 
     def resync_tables(self) -> None:
@@ -554,6 +600,7 @@ class Engine:
             *((self.pppoe.by_sid.make_update(self.pppoe.update_slots),
                self.pppoe.by_ip.make_update(self.pppoe.update_slots))
               if self.pppoe else ()),
+            *(self.edge.make_updates() if self.edge else ()),
         ))
 
     # -- latency-tiered scheduler support (runtime/scheduler.py) ----------
@@ -583,6 +630,7 @@ class Engine:
             *((self.pppoe.by_sid.make_update(self.pppoe.update_slots),
                self.pppoe.by_ip.make_update(self.pppoe.update_slots))
               if self.pppoe else ()),
+            *(self.edge.make_updates() if self.edge else ()),
         )
 
     def _empty_updates(self):
@@ -607,6 +655,7 @@ class Engine:
             *((self.pppoe.by_sid.empty_update(self.pppoe.update_slots),
                self.pppoe.by_ip.empty_update(self.pppoe.update_slots))
               if self.pppoe else ()),
+            *(self.edge.empty_updates() if self.edge else ()),
         )
 
     def prefetch_bulk_updates(self):
@@ -630,7 +679,8 @@ class Engine:
         the step; the authoritative dhcp chain (possibly express-lane
         device-resident) never enters the program."""
         step = _apply_updates_jit(self.geom, self.garden is not None,
-                                  self.pppoe is not None)
+                                  self.pppoe is not None,
+                                  self.edge is not None)
         rest = step(self.tables._replace(dhcp=None), upd)
         self.tables = rest._replace(dhcp=self.tables.dhcp)
 
@@ -782,6 +832,8 @@ class Engine:
         out_pkt = res.out_pkt  # fetch rows lazily
         punt = np.asarray(res.nat_punt)[: len(frames)]
         viol = np.asarray(res.spoof_violation)[: len(frames)]
+        mir = (np.asarray(res.mirror)[: len(frames)]
+               if getattr(res, "mirror", None) is not None else None)
 
         out = {"tx": [], "fwd": [], "dropped": [], "slow": []}
         out_rows = None
@@ -813,8 +865,15 @@ class Engine:
                     punt_lanes.append(i)
                 else:
                     slow_items.append((i, frames[i]))
-            if viol[i] and self.violation_sink is not None:
-                self.violation_sink(i, frames[i])
+            if viol[i]:
+                self._viol_log.report(ValueError("spoofed source address"),
+                                      path="process", lane=i)
+                if self.violation_sink is not None:
+                    self.violation_sink(i, frames[i])
+            if mir is not None and mir[i] and self.mirror_sink is not None:
+                # interception observes the ORIGINAL frame even on lanes
+                # the verdict later drops (garden/QoS/antispoof)
+                self.mirror_sink(i, frames[i], int(mir[i]))
         tele.lap(tele.REPLY, t0, tok)
         out["slow"] = sorted(
             [(i, None) for i in punt_lanes]
@@ -1105,6 +1164,9 @@ class Engine:
         ps = getattr(res, "pppoe_stats", None)
         if ps is not None:
             self.stats.pppoe += np.asarray(ps, dtype=np.uint64)
+        es = getattr(res, "edge_stats", None)
+        if es is not None:
+            self.stats.edge += np.asarray(es, dtype=np.uint64)
 
     def _run_step(self, pkt, length, fa, now_s, now_us) -> PipelineResult:
         """Dispatch + fold (the synchronous step both process paths use)."""
@@ -1175,10 +1237,21 @@ class Engine:
         self.stats.dropped += int((vv == VERDICT_DROP).sum())
         self.stats.passed += int((vv == VERDICT_PASS).sum())
 
-        if self.violation_sink is not None:
-            viol = np.asarray(res.spoof_violation)[:n]
-            for lane in np.nonzero(viol)[0]:
+        viol = np.asarray(res.spoof_violation)[:n]
+        for lane in np.nonzero(viol)[0]:
+            self._viol_log.report(ValueError("spoofed source address"),
+                                  path="ring", lane=int(lane))
+            if self.violation_sink is not None:
                 self.violation_sink(int(lane), bytes(pkt[lane, : int(length[lane])]))
+        mir = getattr(res, "mirror", None)  # DHCP-only batches have none
+        if mir is not None and self.mirror_sink is not None:
+            mirw = np.asarray(mir)[:n]
+            for lane in np.nonzero(mirw)[0]:
+                # original ring bytes: interception sees the frame as it
+                # arrived, regardless of the verdict demux above
+                self.mirror_sink(int(lane),
+                                 bytes(pkt[lane, : int(length[lane])]),
+                                 int(mirw[lane]))
 
         # Drain the slow ring: the slow ring preserves lane order (PASS
         # frames are queued in lane order by complete()), so align pops
@@ -1392,6 +1465,9 @@ class Engine:
         if self.pppoe is not None:
             out["pppoe/by_sid"] = self.pppoe.by_sid
             out["pppoe/by_ip"] = self.pppoe.by_ip
+        if self.edge is not None:
+            out["edge/tap"] = self.edge.tap
+            out["edge/route"] = self.edge.route
         return out
 
     def pending_dirty(self) -> int:

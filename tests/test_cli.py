@@ -290,6 +290,9 @@ class TestAppOnLiveWire:
 
     IF_A, IF_B = "bngct0", "bngct1"
 
+    # compile-heavy veth e2e (~38s); tier-1 keeps the memory-rung wire
+    # twin (test_wire_pump) and TestWireDrive — slow tier runs this one
+    @pytest.mark.slow
     def test_dora_over_kernel_wire(self):
         import socket as so
         import subprocess
@@ -491,6 +494,10 @@ class TestMaintenanceHeartbeat:
     production run — an expired lease stops fast-pathing and an idle NAT
     session leaves the device table without a restart."""
 
+    # compile-heavy (~25s: garden-off app is its own trace) + long tick
+    # body; lease/NAT aging stays proven by test_e2e expiry + the storm
+    # suite's expire_batch drives — slow tier runs the app-level twin
+    @pytest.mark.slow
     def test_expired_lease_and_idle_nat_age_out(self):
         from bng_tpu.control import dhcp_codec, packets
         from bng_tpu.utils.net import ip_to_u32

@@ -353,6 +353,10 @@ class TestEnginePipelinePPPoE:
         skey = nat._key(CLIENT_IP, self.WAN_IP, 40000, 53, 17)
         assert nat.sessions.lookup(skey) is not None
 
+    # compile-heavy (~25s: from_access=False is its own pipeline trace);
+    # downstream DNAT+encap stays proven sharded by TestClusterPPPoE —
+    # slow tier runs the single-engine twin
+    @pytest.mark.slow
     def test_downstream_dnat_then_encap(self):
         engine, nat, pp = self._engine()
         up = self._upstream()

@@ -1,0 +1,264 @@
+"""Dormant-module coverage (ISSUE 17 satellite): QinQ double-tag parity
+and ZTP bootstrap retry/backoff.
+
+control/qinq.py and control/ztp.py shipped as parity ports with no
+tests of their own. QinQ matters to the edge subsystem because the
+tap/route tables key subscribers the same way the classifier does — a
+drift between `VLANPair.key()` and the ring parser's {s_tag,c_tag}
+packing would silently steer double-tagged subscribers to the wrong
+shard. ZTP matters because a BNG that can't bootstrap never gets
+warrants or routes pushed at all; the backoff loop is the part that
+hides bugs (it swallows transport errors by design).
+"""
+
+import jax.numpy as jnp
+import pytest
+
+from bng_tpu.control.deviceauth import DeviceIdentity
+from bng_tpu.control.qinq import (QinQConfig, QinQMapper, VLANPair,
+                                  VLANRange)
+from bng_tpu.control.ztp import (BootstrapClient, BootstrapConfig,
+                                 BootstrapPending, build_vendor_option,
+                                 discover_from_lease, extract_nexus_url,
+                                 parse_vendor_options)
+from bng_tpu.ops.parse import parse_batch
+
+pytestmark = pytest.mark.edge
+
+
+# ---------------------------------------------------------------------------
+# QinQ: pair model + registry
+# ---------------------------------------------------------------------------
+
+class TestVLANPair:
+    def test_tag_states(self):
+        assert VLANPair(100, 200).is_double_tagged
+        assert VLANPair(0, 200).is_single_tagged
+        assert VLANPair().is_untagged
+        assert str(VLANPair(100, 200)) == "100.200"
+        assert str(VLANPair(0, 200)) == "200"
+        assert str(VLANPair()) == "untagged"
+
+    def test_out_of_range_rejected(self):
+        with pytest.raises(ValueError):
+            VLANPair(4096, 1)
+        with pytest.raises(ValueError):
+            VLANPair(1, -1)
+
+    def test_key_packs_s_high_c_low(self):
+        assert VLANPair(0x123, 0x456).key() == (0x123 << 16) | 0x456
+        assert VLANPair(0, 7).key() == 7
+
+    def test_range(self):
+        r = VLANRange(10, 20)
+        assert r.contains(10) and r.contains(20) and not r.contains(21)
+        assert r.size() == 11
+        assert VLANRange(5, 4).size() == 0
+
+
+class TestQinQMapper:
+    def test_register_and_bidirectional_lookup(self):
+        m = QinQMapper()
+        m.register(VLANPair(100, 200), "sub-1")
+        assert m.get_subscriber(VLANPair(100, 200)) == "sub-1"
+        assert m.get_vlan("sub-1") == VLANPair(100, 200)
+
+    def test_conflicting_registration_rejected(self):
+        m = QinQMapper()
+        m.register(VLANPair(100, 200), "sub-1")
+        with pytest.raises(ValueError, match="already registered"):
+            m.register(VLANPair(100, 200), "sub-2")
+
+    def test_move_subscriber_releases_old_pair(self):
+        m = QinQMapper()
+        m.register(VLANPair(100, 200), "sub-1")
+        m.register(VLANPair(100, 201), "sub-1")
+        assert m.get_subscriber(VLANPair(100, 200)) is None
+        assert m.get_vlan("sub-1") == VLANPair(100, 201)
+
+    def test_s_tag_only_invalid(self):
+        with pytest.raises(ValueError, match="outer without inner"):
+            QinQMapper().register(VLANPair(100, 0), "sub-1")
+
+    def test_config_gates(self):
+        cfg = QinQConfig(s_tag_range=VLANRange(100, 110),
+                         allow_single_tagged=False)
+        m = QinQMapper(cfg)
+        with pytest.raises(ValueError, match="single-tagged"):
+            m.register(VLANPair(0, 200), "sub-1")
+        with pytest.raises(ValueError, match="s_tag 99"):
+            m.register(VLANPair(99, 200), "sub-1")
+        with pytest.raises(ValueError, match="untagged"):
+            m.register(VLANPair(), "sub-1")
+        m.register(VLANPair(105, 200), "sub-1")
+
+    def test_unregister_both_directions(self):
+        m = QinQMapper()
+        m.register(VLANPair(100, 200), "sub-1")
+        m.register(VLANPair(100, 201), "sub-2")
+        m.unregister(VLANPair(100, 200))
+        assert m.get_vlan("sub-1") is None
+        m.unregister_subscriber("sub-2")
+        assert m.get_subscriber(VLANPair(100, 201)) is None
+        assert m.stats()["total_mappings"] == 0
+
+    def test_stats_split_by_tagging(self):
+        m = QinQMapper()
+        m.register(VLANPair(100, 200), "a")
+        m.register(VLANPair(0, 300), "b")
+        assert m.stats() == {"total_mappings": 2, "double_tagged": 1,
+                             "single_tagged": 1}
+
+
+class TestDoubleTagParity:
+    """The load-bearing invariant: VLANPair.key() == the u32 the device
+    parser derives from the wire == the fast-path vlan-table key."""
+
+    @staticmethod
+    def _qinq_frame(s_tag, c_tag):
+        return (b"\x02" * 6 + b"\x04" * 6
+                + b"\x88\xa8" + s_tag.to_bytes(2, "big")
+                + b"\x81\x00" + c_tag.to_bytes(2, "big")
+                + b"\x08\x00" + b"\x00" * 40)
+
+    def test_parser_and_registry_agree_on_key(self):
+        pair = VLANPair(0x123, 0x456)
+        frame = self._qinq_frame(pair.s_tag, pair.c_tag)
+        pkt = jnp.zeros((1, 128), jnp.uint8)
+        pkt = pkt.at[0, : len(frame)].set(
+            jnp.frombuffer(frame, jnp.uint8))
+        p = parse_batch(pkt, jnp.asarray([len(frame)], jnp.int32))
+        assert bool(p.is_qinq[0])
+        wire_key = (int(p.s_tag[0]) << 16) | int(p.c_tag[0])
+        assert wire_key == pair.key()
+
+    def test_registry_key_reaches_fastpath_table(self):
+        from bng_tpu.runtime.tables import FastPathTables
+
+        fp = FastPathTables(sub_nbuckets=64, vlan_nbuckets=64,
+                            cid_nbuckets=64, max_pools=4)
+        pair = VLANPair(100, 200)
+        m = QinQMapper()
+        m.register(pair, "sub-1")
+        fp.add_vlan_subscriber(pair.s_tag, pair.c_tag, 1, 0x0A000005,
+                               1000)
+        assert fp.vlan.lookup([pair.key()]) is not None
+        assert fp.remove_vlan_subscriber(pair.s_tag, pair.c_tag)
+
+
+# ---------------------------------------------------------------------------
+# ZTP: discovery options + bootstrap retry/backoff
+# ---------------------------------------------------------------------------
+
+class TestZTPDiscovery:
+    def test_option_224_wins_over_vendor(self):
+        opts = {224: b"https://a", 43: build_vendor_option("https://b")}
+        assert extract_nexus_url(opts) == "https://a"
+
+    def test_vendor_tlv_roundtrip(self):
+        raw = build_vendor_option("https://nexus.example")
+        assert parse_vendor_options(raw) == "https://nexus.example"
+        # unknown sub-types are skipped, truncated TLVs stop the walk
+        padded = bytes([9, 2, 0, 0]) + raw
+        assert parse_vendor_options(padded) == "https://nexus.example"
+        assert parse_vendor_options(bytes([1, 200, 65])) == ""
+
+    def test_discover_from_lease(self):
+        r = discover_from_lease(ip="10.0.0.9", gateway="10.0.0.1",
+                                options={224: b"https://n"})
+        assert r.nexus_url == "https://n" and r.ip == "10.0.0.9"
+        assert discover_from_lease().nexus_url == ""
+
+
+def _client(transport, **cfg):
+    sleeps = []
+    clk = [0.0]
+
+    def sleep(dt):
+        sleeps.append(dt)
+        clk[0] += dt
+
+    c = BootstrapClient(
+        BootstrapConfig(nexus_url="https://n", **cfg), transport,
+        identity=DeviceIdentity(serial="SN1", mac="02:00:00:00:00:01",
+                                model="bng-1"),
+        clock=lambda: clk[0], sleep=sleep)
+    return c, sleeps, clk
+
+
+class TestZTPBootstrap:
+    def test_transport_errors_back_off_exponentially_capped(self):
+        calls = []
+
+        def transport(req):
+            calls.append(req.serial)
+            if len(calls) < 6:
+                raise ConnectionError("nexus unreachable")
+            return {"status": "configured", "node_id": "n1"}
+
+        c, sleeps, _clk = _client(transport, initial_backoff=1.0,
+                                  max_backoff=4.0)
+        cfg = c.bootstrap()
+        assert cfg.node_id == "n1"
+        # 1, 2, 4, then capped at max_backoff
+        assert sleeps == [1.0, 2.0, 4.0, 4.0, 4.0]
+        assert calls == ["SN1"] * 6
+
+    def test_pending_honors_retry_after_and_resets_backoff(self):
+        responses = iter([
+            ConnectionError("down"),  # backoff 1 -> 2
+            {"status": "pending", "retry_after": 7},  # contact: reset
+            ConnectionError("down"),  # back to initial 1
+            {"status": "configured", "node_id": "n1"},
+        ])
+
+        def transport(req):
+            r = next(responses)
+            if isinstance(r, Exception):
+                raise r
+            return r
+
+        c, sleeps, _clk = _client(transport, initial_backoff=1.0,
+                                  max_backoff=60.0)
+        assert c.bootstrap().node_id == "n1"
+        assert sleeps == [1.0, 7.0, 1.0]
+
+    def test_pending_without_retry_after_uses_backoff(self):
+        responses = iter([{"status": "pending"},
+                          {"status": "configured"}])
+        c, sleeps, _clk = _client(lambda req: next(responses))
+        c.bootstrap()
+        assert sleeps == [1.0]
+
+    def test_max_retries_exceeded(self):
+        c, _sleeps, _clk = _client(lambda req: {"status": "pending"},
+                                   max_retries=3)
+        with pytest.raises(TimeoutError, match="max retries"):
+            c.bootstrap()
+        assert c.attempts == 3
+
+    def test_deadline_exceeded(self):
+        c, _sleeps, _clk = _client(
+            lambda req: (_ for _ in ()).throw(ConnectionError("down")),
+            initial_backoff=10.0)
+        with pytest.raises(TimeoutError, match="deadline"):
+            c.bootstrap(deadline=25.0)
+
+    def test_register_once_surfaces_pending(self):
+        c, _sleeps, _clk = _client(
+            lambda req: {"status": "pending", "retry_after": 3,
+                         "message": "awaiting approval"})
+        with pytest.raises(BootstrapPending) as exc:
+            c.register_once()
+        assert exc.value.retry_after == 3.0
+
+    def test_configured_payload_mapped(self):
+        c, _sleeps, clk = _client(
+            lambda req: {"status": "configured", "node_id": "n1",
+                         "site_id": "s1", "role": "active",
+                         "pools": [{"id": 1}]})
+        clk[0] = 99.0
+        cfg = c.register_once()
+        assert (cfg.node_id, cfg.site_id, cfg.role) == ("n1", "s1",
+                                                        "active")
+        assert cfg.pools == [{"id": 1}] and cfg.timestamp == 99.0
