@@ -148,15 +148,15 @@ PYTEST_FLAGS = -q --continue-on-collection-errors -p no:cacheprovider \
         verify-telemetry verify-static verify-sanitize verify-ops \
         verify-storm verify-perf verify-kernels verify-sharded \
         verify-express verify-hostpath verify-wire verify-cluster \
-        verify-edge
+        verify-edge verify-devloop
 
 verify: verify-static verify-storm verify-perf verify-kernels \
         verify-sharded verify-express verify-hostpath verify-wire \
-        verify-cluster verify-edge
+        verify-cluster verify-edge verify-devloop
 	set -o pipefail; rm -f /tmp/_t1.log; \
 	timeout -k 10 $(TIER1_TIMEOUT) env JAX_PLATFORMS=cpu \
 	$(PY) -m pytest tests/ $(PYTEST_FLAGS) \
-	-m 'not slow and not storm and not perf and not kernels and not sharded and not express and not hostpath and not wire and not cluster and not edge' \
+	-m 'not slow and not storm and not perf and not kernels and not sharded and not express and not hostpath and not wire and not cluster and not edge and not devloop' \
 	2>&1 | tee /tmp/_t1.log
 
 verify-sharded:
@@ -213,6 +213,13 @@ verify-edge:
 	$(PY) -m pytest tests/test_edge.py tests/test_qinq_ztp.py \
 	  $(PYTEST_FLAGS) -m 'edge and not slow' \
 	&& echo "verify-edge OK"
+
+verify-devloop:
+	set -o pipefail; \
+	timeout -k 10 60 env JAX_PLATFORMS=cpu \
+	$(PY) -m pytest tests/test_devloop.py $(PYTEST_FLAGS) \
+	  -m 'devloop' \
+	&& echo "verify-devloop OK"
 
 verify-kernels:
 	set -o pipefail; \

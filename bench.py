@@ -1576,30 +1576,39 @@ def scheduler_bench(on_tpu: bool, checkpoint_interval_s: float = 0.0) -> None:
 
 
 def express_ab_bench(on_tpu: bool) -> None:
-    """`--express-ab`: one-flag A/B of the two express-lane architectures
-    (ISSUE 13) — the jit full-program path (`_dhcp_jit`: on-device parse
-    + reply compose) vs the AOT minimal-program path (ops/express.py:
+    """`--express-ab`: one-flag A/B/C of the express-lane architectures
+    — the jit full-program path (`_dhcp_jit`: on-device parse + reply
+    compose), the AOT minimal-program path (ISSUE 13: ops/express.py
     admission-extracted descriptors, table probe + verdict block on
-    device, host template patch-in).
+    device, host template patch-in), and the devloop ring (ISSUE 18:
+    the same AOT architecture served through the k-slot descriptor-ring
+    megakernel — one device touch per k admission batches).
 
-    Emits ONE ledger line per cohort, both under the scheduler OFFER
-    metric, with `express_path` joining the cohort identity — the trend
-    gate can therefore gate each architecture against its own history
-    and REFUSES (rc=3, naming both identities) to trend one against the
-    other. Each cohort carries:
+    Emits ONE ledger line per cohort, all under the scheduler OFFER
+    metric, with `express_path` + `express_loop` joining the cohort
+    identity — the trend gate can therefore gate each architecture
+    against its own history and REFUSES (rc=3, naming the identities)
+    to trend one against another. Each cohort carries:
       - `offer_device_only_p99_us`: profiler-fenced per-execution device
-        time of that cohort's express program (the 50us target quantity);
+        time of that cohort's express program (the 50us target
+        quantity; per-slot amortized for the devloop megakernel);
       - the host-side submit-to-dispatch overhead split the AOT path
-        exists to shrink: `submit_us_per_batch` (admission incl.
-        descriptor extraction) and the `dispatch` stage breakdown
-        (batch close -> device enqueue: staging + update drain + the
-        jit-cache lookup the AOT path eliminates);
+        exists to shrink and the devloop ring amortizes k-fold:
+        `submit_us_per_batch` (admission incl. descriptor extraction)
+        and the `dispatch` stage breakdown (batch close -> device
+        enqueue; the devloop pump records it per batch as ring-dispatch
+        time / slots, so the histograms stay per-batch comparable);
       - blocked end-to-end OFFER latency through the scheduler.
+
+    Each measured round submits BNG_DEVLOOP_K (default 8) batches per
+    cohort before flushing, so the devloop cohort runs FULL rings (its
+    steady state) while the per-batch cohorts dispatch k times — the
+    per-batch quantities divide by the same k everywhere.
     """
     import jax
     import jax.numpy as jnp
 
-    from bng_tpu.ops.dhcp import dhcp_fastpath
+    from bng_tpu.ops.dhcp import NSTATS, dhcp_fastpath
     from bng_tpu.ops.express import XD_WORDS, express_verdicts, parse_express
     from bng_tpu.ops.parse import parse_batch
     from bng_tpu.runtime.engine import Engine
@@ -1633,21 +1642,25 @@ def express_ab_bench(on_tpu: bool) -> None:
     if os.environ.pop("BNG_EXPRESS_AOT", None) == "0":
         _mark("express A/B: ignoring BNG_EXPRESS_AOT=0 (the A/B measures "
               "both architectures by definition)")
+    K_LOOP = max(1, int(os.environ.get("BNG_DEVLOOP_K", 8)))
     now = int(time.time())
     rng = np.random.default_rng(42)
     _mark(f"express A/B: {N_SUBS} subscribers, express B={B_EXPR}, "
-          f"{LAT_STEPS} batches per cohort...")
+          f"devloop k={K_LOOP}, {LAT_STEPS} rounds x {K_LOOP} batches "
+          f"per cohort...")
 
-    # build BOTH stacks up front and INTERLEAVE the measured batches:
-    # the two cohorts see the same box noise (GC, sibling load, cache
-    # state), so the host-overhead delta is an architecture fact, not a
+    # build ALL stacks up front and INTERLEAVE the measured rounds: the
+    # cohorts see the same box noise (GC, sibling load, cache state),
+    # so the host-overhead delta is an architecture fact, not a
     # phase-of-run artifact. Each cohort keeps its OWN tracer — the
-    # per-stage breakdowns must never mix the two architectures'
-    # samples (that mixing is exactly the comparison the ledger's
-    # express_path identity forbids).
+    # per-stage breakdowns must never mix architectures' samples (that
+    # mixing is exactly the comparison the ledger's express_path /
+    # express_loop identity forbids).
     stacks: dict[str, dict] = {}
     macs = None
-    for path_name, aot in (("jit-full", False), ("aot-express", True)):
+    for path_name, aot, loop in (("jit-full", False, "aot"),
+                                 ("aot-express", True, "aot"),
+                                 ("devloop", True, "devloop")):
         recorder = FlightRecorder(RecorderConfig())
         recorder.set_backend(jax.default_backend())
         tracer = tele.Tracer(recorder=recorder)
@@ -1658,7 +1671,8 @@ def express_ab_bench(on_tpu: bool) -> None:
                                        sub_nat_nbuckets=sub_nb)
         engine = Engine(fp, nat, batch_size=256, pkt_slot=512)
         sched = TieredScheduler(engine, SchedulerConfig(
-            express_batch=B_EXPR, bulk_batch=256, express_aot=aot))
+            express_batch=B_EXPR, bulk_batch=256, express_aot=aot,
+            express_loop=loop, devloop_k=K_LOOP))
         setup_s = time.time() - t_setup
         _mark(f"[{path_name}] compiling + warming...")
         t_c = time.time()
@@ -1666,27 +1680,36 @@ def express_ab_bench(on_tpu: bool) -> None:
             [_discover_row(macs[int(rng.integers(N_SUBS))], 0x8000 + k)
              for k in range(B_EXPR)])
         stacks[path_name] = {
-            "aot": aot, "engine": engine, "sched": sched, "fp": fp,
-            "tracer": tracer, "setup_s": setup_s,
+            "aot": aot, "loop": loop, "engine": engine, "sched": sched,
+            "fp": fp, "tracer": tracer, "setup_s": setup_s,
             "compile_s": time.time() - t_c,
             "offer_hits": len(warm["tx"]),
             "llat": [], "submit_us": [],
         }
         tele.disarm()
         if aot:
-            # identity gate: the aot-express cohort must actually have
-            # been SERVED by the AOT program — a compile failure here
-            # would file jit-full measurements under the aot identity
+            # identity gate: an aot-identity cohort must actually have
+            # been SERVED by its program — a compile failure here would
+            # file lower-rung measurements under the wrong identity
             ex_snap = sched.stats_snapshot()["express"]
-            if not ex_snap["aot_dispatches"] or ex_snap["aot_misses"]:
+            refused = (not ex_snap["aot_dispatches"]
+                       or ex_snap["aot_misses"])
+            if loop == "devloop":
+                refused = (refused or ex_snap["loop"] != "devloop"
+                           or ex_snap.get("fallbacks")
+                           or not ex_snap.get("devloop", {}).get(
+                               "dispatches"))
+            if refused:
                 print(json.dumps({
                     "metric": "OFFER p99 device-isolated (scheduler)",
                     "value": 0.0, "unit": "us", "vs_baseline": 0.0,
-                    "error": "express A/B refused: the aot-express stack "
-                             "did not serve via the AOT program "
+                    "error": f"express A/B refused: the {path_name} "
+                             "stack did not serve via its own program "
                              f"(dispatches={ex_snap['aot_dispatches']}, "
-                             f"misses={ex_snap['aot_misses']}) — "
-                             "publishing it would mislabel the cohort",
+                             f"misses={ex_snap['aot_misses']}, "
+                             f"loop={ex_snap['loop']}, fallbacks="
+                             f"{ex_snap.get('fallbacks')}) — publishing "
+                             "it would mislabel the cohort",
                     **_DIAG}))
                 sys.exit(2)
 
@@ -1694,22 +1717,28 @@ def express_ab_bench(on_tpu: bool) -> None:
         return [_discover_row(macs[int(rng.integers(N_SUBS))],
                               base_xid + k) for k in range(B_EXPR)]
 
-    _mark(f"interleaved measurement: {LAT_STEPS} batches per cohort...")
+    _mark(f"interleaved measurement: {LAT_STEPS} rounds x {K_LOOP} "
+          f"batches per cohort...")
     for k in range(LAT_STEPS):
-        frames = discover_batch(0x9000 + k * B_EXPR)
+        # K_LOOP closed batches per round: the devloop cohort runs one
+        # FULL ring per round, the per-batch cohorts dispatch K_LOOP
+        # times — per-batch figures divide by the same K_LOOP everywhere
+        rounds = [discover_batch(0x9000 + (k * K_LOOP + j) * B_EXPR)
+                  for j in range(K_LOOP)]
         for path_name, st in stacks.items():
             sched = st["sched"]
             tele.arm(st["tracer"])
             t1 = time.perf_counter()
-            for f in frames:
-                sched.submit(f, from_access=True)
+            for frames in rounds:
+                for f in frames:
+                    sched.submit(f, from_access=True)
             t2 = time.perf_counter()
             sched.flush()
             t3 = time.perf_counter()
             sched.drain_completions()
             tele.disarm()
-            st["submit_us"].append((t2 - t1) * 1e6)
-            st["llat"].append((t3 - t1) * 1e6)
+            st["submit_us"].append((t2 - t1) * 1e6 / K_LOOP)
+            st["llat"].append((t3 - t1) * 1e6 / K_LOOP)
 
     cohorts: dict[str, dict] = {}
     for path_name, st in stacks.items():
@@ -1729,9 +1758,35 @@ def express_ab_bench(on_tpu: bool) -> None:
         frames = discover_batch(0xA000)
         dtables = engine.tables.dhcp
         dev_p50 = dev_p99 = 0.0
+        dev_scale = 1.0  # devloop: per-ring events amortize to per-slot
         device_source = "none"
         try:
-            if aot:
+            if st["loop"] == "devloop":
+                # the megakernel twin: the k-slot scan over a FULL ring
+                # (non-donating, so the profiled arrays survive the
+                # repeated executions) — per-execution events carry one
+                # RING's device time; amortize to per-slot for the
+                # 50us-per-batch target quantity
+                desc = np.zeros((B_EXPR, XD_WORDS), dtype=np.uint32)
+                for i, f in enumerate(frames):
+                    d = parse_express(f)
+                    if d is not None:
+                        desc[i] = d.words
+                ring = np.broadcast_to(
+                    desc, (K_LOOP, B_EXPR, XD_WORDS)).copy()
+                desc_d = place(jnp.asarray(ring))
+                geom = fp.geom
+                dev_scale = float(K_LOOP)
+
+                @jax.jit
+                def prof_step(dt, dd):
+                    def slot(stats, d):
+                        res = express_verdicts(dt, d, geom,
+                                               jnp.uint32(now))
+                        return stats + res.stats, res.block
+                    return jax.lax.scan(
+                        slot, jnp.zeros((NSTATS,), jnp.uint32), dd)
+            elif aot:
                 desc = np.zeros((B_EXPR, XD_WORDS), dtype=np.uint32)
                 for i, f in enumerate(frames):
                     d = parse_express(f)
@@ -1771,9 +1826,12 @@ def express_ab_bench(on_tpu: bool) -> None:
                 lambda: prof_step(dtables, desc_d),
                 iters=max(20, min(LAT_STEPS, 200)))
             if sd.us:
-                dev_p50, dev_p99 = sd.percentile(50), sd.percentile(99)
+                dev_p50 = sd.percentile(50) / dev_scale
+                dev_p99 = sd.percentile(99) / dev_scale
                 device_source = sd.source
-                tele.tracer().observe_many(tele.DEVICE, sd.us)
+                tele.tracer().observe_many(
+                    tele.DEVICE, [u / dev_scale for u in sd.us]
+                    if dev_scale != 1.0 else sd.us)
             else:
                 _DIAG[f"ab_{path_name}_profile_error"] = "no events in trace"
         except Exception as e:  # profiling must never sink the benchmark
@@ -1787,8 +1845,14 @@ def express_ab_bench(on_tpu: bool) -> None:
             "unit": "us",
             "vs_baseline": round(50.0 / dev_p99, 3) if dev_p99 else 0.0,
             # the cohort identity the ledger keys on: the gate refuses
-            # to trend the two architectures against each other (rc=3)
-            "express_path": path_name,
+            # to trend architectures/loops against each other (rc=3).
+            # The devloop cohort IS the aot-express architecture served
+            # through the ring loop — path stays aot-express, the loop
+            # axis separates it
+            "express_path": ("aot-express" if st["loop"] == "devloop"
+                             else path_name),
+            "express_loop": ("devloop" if st["loop"] == "devloop"
+                             else "per-batch"),
             "offer_device_only_p50_us": round(dev_p50, 1),
             "offer_device_only_p99_us": round(dev_p99, 1),
             "device_time_source": device_source,
@@ -1801,6 +1865,10 @@ def express_ab_bench(on_tpu: bool) -> None:
             "offer_hits_warm": st["offer_hits"],
             "express_batch": B_EXPR,
             "express_aot_misses": snap["express"]["aot_misses"],
+            "express_fallbacks": snap["express"]["fallbacks"],
+            **({"devloop_k": K_LOOP,
+                "devloop": snap["express"].get("devloop")}
+               if st["loop"] == "devloop" else {}),
             "subscribers": N_SUBS,
             "sched": snap,
             "device": str(dev),
@@ -1823,11 +1891,18 @@ def express_ab_bench(on_tpu: bool) -> None:
               f"p50 {dispatch_bd.get('p50_us', 0.0)}us, submit "
               f"{line['submit_us_per_batch']}us/batch")
 
-    # one summary line (its own metric: never a trend point for either
-    # cohort) with the host-overhead delta the AB exists to measure
+    # one summary line (its own metric: never a trend point for any
+    # cohort) with the host-overhead deltas the AB exists to measure.
+    # `devloop_dispatch_reduction_x` is the ISSUE-18 acceptance number:
+    # the per-batch host-dispatch stage p50 of the AOT lane over the
+    # devloop pump's (ring dispatch / k) — >=4x at k=8 on CPU.
     jit_l, aot_l = cohorts["jit-full"], cohorts["aot-express"]
+    dl_l = cohorts["devloop"]
     jit_host = jit_l["submit_us_per_batch"] + jit_l["dispatch_host_p50_us"]
     aot_host = aot_l["submit_us_per_batch"] + aot_l["dispatch_host_p50_us"]
+    dl_host = dl_l["submit_us_per_batch"] + dl_l["dispatch_host_p50_us"]
+    aot_disp = aot_l["dispatch_host_p50_us"]
+    dl_disp = dl_l["dispatch_host_p50_us"]
     summary = _order_line({
         "metric": "express A/B host dispatch overhead delta",
         "value": round(jit_host - aot_host, 1),
@@ -1835,8 +1910,15 @@ def express_ab_bench(on_tpu: bool) -> None:
         "vs_baseline": round(jit_host / aot_host, 3) if aot_host else 0.0,
         "jit_full_host_us": round(jit_host, 1),
         "aot_express_host_us": round(aot_host, 1),
+        "devloop_host_us": round(dl_host, 1),
         "jit_full_device_p99_us": jit_l["offer_device_only_p99_us"],
         "aot_express_device_p99_us": aot_l["offer_device_only_p99_us"],
+        "devloop_device_p99_us": dl_l["offer_device_only_p99_us"],
+        "devloop_k": K_LOOP,
+        "aot_dispatch_p50_us": aot_disp,
+        "devloop_dispatch_p50_us": dl_disp,
+        "devloop_dispatch_reduction_x": (round(aot_disp / dl_disp, 2)
+                                         if dl_disp else 0.0),
         "express_batch": B_EXPR,
         "subscribers": N_SUBS,
         "device": str(dev),
@@ -1844,6 +1926,9 @@ def express_ab_bench(on_tpu: bool) -> None:
     })
     print(json.dumps(summary))
     _persist(summary)
+    _mark(f"devloop dispatch p50 {dl_disp}us/batch vs aot {aot_disp}us "
+          f"({summary['devloop_dispatch_reduction_x']}x reduction at "
+          f"k={K_LOOP})")
 
 
 def host_ab_bench(on_tpu: bool) -> None:

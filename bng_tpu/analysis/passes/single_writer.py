@@ -38,6 +38,7 @@ MUTATORS = {
     "resync_tables", "restore_arrays",
     "arm_tap", "disarm_tap", "set_tap_filters",
     "set_route", "clear_route",
+    "fill_slot", "adopt_cursors",
 }
 
 # writer modules (path suffix -> why it is allowed to write)
@@ -71,6 +72,13 @@ ALLOWED_WRITERS = {
     "bng_tpu/edge/tables.py": "edge host authority (tap/route mirrors)",
     "bng_tpu/edge/compile.py": "warrant/route compilers are the edge "
                                "tables' owning managers",
+    "bng_tpu/devloop/ring.py": "descriptor-ring host authority: "
+                               "fill_slot/adopt_cursors ARE the ring "
+                               "cursor mutators (ISSUE 18)",
+    "bng_tpu/devloop/host.py": "the devloop pump owns its ring: slot "
+                               "fills at admission, cursor adoption at "
+                               "retire — a writer outside the pump "
+                               "bypasses the quiesce/audit story",
 }
 
 # receiver names that mark the call as a fast-path table mutation
@@ -78,7 +86,7 @@ ALLOWED_WRITERS = {
 TABLE_RECEIVERS = {
     "fastpath", "tables", "sub", "vlan", "cid", "bindings", "subscribers",
     "qos", "up", "down", "antispoof", "garden", "pppoe", "by_sid", "by_ip",
-    "edge", "tap", "route",
+    "edge", "tap", "route", "ring", "devloop", "cursors",
 }
 
 

@@ -346,6 +346,17 @@ class BNGMetrics:
             "bng_express_aot_miss_total",
             "Express dispatches that missed the AOT program cache and "
             "fell back to the jit full-program path")
+        # express rung-fallback family (ISSUE 18 gray-failure
+        # hardening): every event where the express lane served below
+        # its configured rung, by reason — compile_failed (AOT refused
+        # to lower at setup), geometry_miss (per-dispatch cache miss),
+        # devloop_compile_failed / devloop_unavailable / devloop_miss
+        # (the ring megakernel degrading to per-batch). Any nonzero
+        # rate here under a supposedly-healthy config is a gray failure.
+        self.express_fallback = r.counter(
+            "bng_express_fallback_total",
+            "Express serving-rung fallback events by reason",
+            ("reason",))
         # AF_XDP wire path (ISSUE 15): which attach rung actually serves
         # (a requested NIC landing on `memory` is a silent fallback that
         # must never masquerade as wire serving) + the wire pump's frame
@@ -879,6 +890,12 @@ class BNGMetrics:
         self.express_program_dispatches.set_total(
             ex.get("jit_dispatches", 0), program="jit-full")
         self.express_aot_miss.set_total(ex.get("aot_misses", 0))
+        for reason, n in (ex.get("fallbacks") or {}).items():
+            self.express_fallback.set_total(n, reason=reason)
+        dl = ex.get("devloop")
+        if dl:
+            self.express_program_dispatches.set_total(
+                dl.get("dispatches", 0), program="devloop")
 
     def collect_fleet(self, fleet) -> None:
         """SlowPathFleet.stats_snapshot() -> bng_slowpath_* families."""

@@ -1,0 +1,34 @@
+"""Device-resident express serving loop (ISSUE 18).
+
+The AOT express lane (ISSUE 13) made the device program minimal, but
+the host still touches the device once per admission batch — ~1.1 ms of
+dispatch ceremony (update drain, staging upload, executable call) per
+batch on CPU, 20x the 50us OFFER budget before a single device cycle
+runs. This package stops dispatching per batch:
+
+- ``ring``   — the descriptor ring: fixed-geometry [k, B, XD_WORDS]
+  uint32 express rows staged host-side in cycling double buffers, with
+  device-resident head/tail/seq cursors threaded dispatch-to-dispatch.
+- ``kernel`` — the persistent express megakernel: ONE AOT-compiled
+  program that drains up to k ring slots per invocation, running the
+  probe-only OFFER cascade (ops/express.express_verdicts — the PR-13
+  program is the bit-identity oracle) per slot and streaming verdict
+  rows back over the donated ring (the completion ring aliases the
+  descriptor ring).
+- ``host``   — the pump: fills slots from closed express batches,
+  dispatches once per k batches (or deadline/flush with a partial
+  fill), retires completions asynchronously through the PR-13 wire
+  template patch-in, and falls back LOUDLY to the per-batch AOT lane
+  on any geometry miss or injected fault.
+
+Selected per scheduler via ``BNG_EXPRESS_LOOP=aot|devloop|auto``
+(SchedulerConfig.express_loop); the default stays ``aot`` until the
+devloop cohort has baselined in the perf ledger — the BNG_HOST_PATH /
+BNG_TABLE_IMPL flip-after-measurement discipline.
+"""
+
+from bng_tpu.devloop.ring import (CUR_EPOCH, CUR_SEQ, CUR_TAIL, CUR_WORDS,
+                                  DescriptorRing)
+
+__all__ = ["CUR_EPOCH", "CUR_SEQ", "CUR_TAIL", "CUR_WORDS",
+           "DescriptorRing"]

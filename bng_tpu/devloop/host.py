@@ -1,0 +1,387 @@
+"""The devloop host pump (ISSUE 18): k admission batches, one dispatch.
+
+The pump is the express lane's serving strategy when
+``BNG_EXPRESS_LOOP`` resolves to ``devloop``: the scheduler hands it
+every closed express batch, and instead of dispatching per batch it
+stages the batch's descriptor rows into the next slot of a
+`DescriptorRing` (devloop/ring.py). The device is touched once per ring
+— when the ring fills, on the ring deadline, or at flush/quiesce with a
+partial fill — through the persistent megakernel (devloop/kernel.py),
+and completions retire asynchronously per slot through the SAME wire
+template patch-in path the per-batch AOT lane uses
+(`TieredScheduler._retire_express_aot`), so reply bytes are identical
+by construction, not by parallel implementation.
+
+Telemetry attribution (the Dapper discipline — every us has a stage):
+
+- ``lane_wait``   batch enqueue -> close (unchanged, per batch)
+- ``loop_fill``   descriptor rows -> ring slot (per batch, measured)
+- ``loop_wait``   slot staged -> ring dispatch (per batch, measured —
+                  the latency the k-amortization trades away; the
+                  ring deadline bounds it)
+- ``dispatch``    the ONE megakernel dispatch, amortized per batch
+                  (dur / slots): per-batch histograms stay comparable
+                  with the per-batch lane, and sums are conserved
+- ``loop_retire`` ring force + per-slot demux bookkeeping, amortized
+                  per batch the same way
+
+Fallbacks are Gray-Failure-loud (PAPERS.md): a megakernel geometry
+miss, a compile failure at setup, or an injected
+``devloop.dispatch`` fault re-dispatches every staged slot through the
+per-batch AOT path AND counts `bng_express_fallback_total{reason}` +
+fires the `express_fallback` flight-recorder trigger — serving never
+stops, but a degraded loop can never masquerade as a healthy one.
+
+Quiesce/drain contract: `flush()` dispatches any partial ring and
+retires every in-flight ring, so after the scheduler's flush the ring
+is empty, the cursor handle is live (nothing donated ahead of it) and
+`audit()` can prove the device cursors agree with the host's slot
+accounting — a snapshot/checkpoint never observes a half-retired ring.
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+from concurrent.futures import ThreadPoolExecutor
+from typing import NamedTuple
+
+import numpy as np
+
+from bng_tpu.chaos.faults import fault_point
+from bng_tpu.devloop.ring import CUR_SEQ, DescriptorRing
+from bng_tpu.ops.dhcp import NSTATS
+from bng_tpu.runtime.engine import Engine, _ExpressAotResult
+from bng_tpu.runtime.lanes import (CLOSE_DEADLINE, CLOSE_FLUSH,
+                                   CompletionRing, InflightEntry,
+                                   LANE_EXPRESS)
+from bng_tpu.telemetry import spans as tele
+
+
+class _RingInflight(NamedTuple):
+    """One megakernel dispatch in flight: the dispatch-worker future
+    (resolving to a kernel.DevloopResult) plus the per-slot host retire
+    metadata the device never sees."""
+
+    fut: object            # Future[kernel.DevloopResult]
+    slots: list            # [n_slots] lists of PendingFrame
+    tokens: list           # [n_slots] telemetry batch tokens
+    reasons: list          # [n_slots] batch close reasons
+    dispatch_t: float
+    meta: tuple            # dispatch-epoch (pools, server) snapshot
+
+
+class DevloopPump:
+    """Owns one DescriptorRing + its in-flight completion ring on
+    behalf of a TieredScheduler's express lane."""
+
+    def __init__(self, sched, k: int, depth: int = 2,
+                 max_wait_us: float | None = None):
+        self.sched = sched
+        self.ring = DescriptorRing(k, sched.express.cfg.batch, depth)
+        self._inflight = CompletionRing(depth)
+        # ring deadline: a partial ring may wait at most this long after
+        # its OLDEST slot was staged (defaults to the express lane's own
+        # close deadline — the loop at most doubles the worst-case wait)
+        self.max_wait_us = (max_wait_us if max_wait_us is not None
+                            else sched.cfg.express_max_wait_us)
+        self.dispatches = 0
+        self.batches = 0
+        self.fallback_slots = 0
+        # The dispatch worker: ONE thread that only ever runs the pure
+        # executable call (Engine.call_devloop_aot). On a real TPU the
+        # runtime dispatches asynchronously and the worker merely waits;
+        # on CPU XLA may run the computation inline in whichever thread
+        # calls the executable, and whether the caller blocks is an OS
+        # scheduling lottery (observed flipping per process on 1-core
+        # hosts). Routing the call through the worker makes the serving
+        # thread's dispatch cost deterministic — prepare + submit — on
+        # every backend. Single worker => FIFO => the chain/cursor
+        # threading below needs no locks.
+        self._pool = None
+        self._last_fut = None
+        # Worker-local double buffers: the dhcp chain and cursor handle
+        # the NEXT ring call consumes. The engine's published
+        # tables.dhcp stays live and readable (nothing donated out from
+        # under it) while rings are in flight; retires publish each
+        # ring's output chain back monotonically (engine lags by at
+        # most the in-flight depth, the bulk lane's read-replica
+        # staleness class). None = seed from engine at next dispatch.
+        self._dev_chain = None
+        self._dev_cur = self.ring.cursors
+
+    # -- dispatch worker --------------------------------------------------
+
+    def _submit(self, fn, *args):
+        if self._pool is None:
+            self._pool = ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="bng-devloop")
+        fut = self._pool.submit(fn, *args)
+        self._last_fut = fut
+        return fut
+
+    def _join(self) -> None:
+        """Wait for the dispatch worker to go idle (errors surface at
+        the owning ring's retire, not here)."""
+        if self._last_fut is not None:
+            concurrent.futures.wait([self._last_fut])
+
+    def close(self) -> None:
+        """Release the dispatch worker thread (engine adopt replaces the
+        pump; the old one must not leak its thread)."""
+        if self._pool is not None:
+            self._pool.shutdown(wait=True)
+            self._pool = None
+
+    # -- fill (one closed express batch -> one ring slot) ----------------
+
+    def add_batch(self, pend: list, now: float, reason: str) -> int:
+        """Stage one closed express batch into the ring; dispatches the
+        megakernel when the ring fills. Returns frames retired as a
+        side effect of the in-flight ring overflowing its depth."""
+        tok = tele.begin_batch(tele.LANE_EXPRESS_L, len(pend))
+        if tok is not None:
+            tele.observe(tele.LANE_WAIT, (now - pend[0].enq_t) * 1e6, tok)
+        t0 = tele.t()
+        rows = [p.desc.words for p in pend if p.desc is not None]
+        idxs = ([i for i, p in enumerate(pend) if p.desc is not None]
+                if rows else [])
+        self.ring.fill_slot(rows, idxs, pend, tok, now)
+        tele.lap(tele.LOOP_FILL, t0, tok)
+        self.batches += 1
+        if self.ring.head >= self.ring.k:
+            return self._dispatch(now, reason)
+        return 0
+
+    # -- the beat ---------------------------------------------------------
+
+    def poll(self, now: float) -> int:
+        """Opportunistic retire of finished rings + the ring deadline
+        close (a partial ring must not strand its slots past the loop
+        deadline)."""
+        retired = 0
+        for entry in self._inflight.pop_ready(self._ready):
+            retired += self._retire(entry)
+        oldest = self.ring.oldest_fill_t
+        if (oldest is not None
+                and (now - oldest) * 1e6 >= self.max_wait_us):
+            retired += self._dispatch(now, CLOSE_DEADLINE)
+        return retired
+
+    def flush(self, now: float) -> int:
+        """Ship the partial ring and retire EVERYTHING in flight — the
+        scheduler's flush/quiesce barrier. Afterwards the ring is empty
+        and the cursor handle is live (audit() is legal)."""
+        retired = 0
+        if self.ring.head:
+            retired += self._dispatch(now, CLOSE_FLUSH)
+        while True:
+            entry = self._inflight.pop_oldest()
+            if entry is None:
+                break
+            retired += self._retire(entry)
+        return retired
+
+    @staticmethod
+    def _ready(entry: _RingInflight) -> bool:
+        if not entry.fut.done():
+            return False
+        if entry.fut.exception() is not None:
+            return True  # retire now; the error surfaces there
+        is_ready = getattr(entry.fut.result().blocks, "is_ready", None)
+        return bool(is_ready()) if is_ready is not None else True
+
+    # -- dispatch ---------------------------------------------------------
+
+    def _run_ring(self, exe, prepared, device):
+        """DISPATCH WORKER thread: the pure executable call plus the
+        worker-local chain/cursor threading. Touches no engine or
+        scheduler state — the main thread owns every drain, fault point,
+        telemetry write and `engine.tables` publish."""
+        res = Engine.call_devloop_aot(
+            exe, self._dev_chain, self._dev_cur, prepared, device)
+        self._dev_chain = res.dhcp_tables
+        self._dev_cur = res.cursors
+        return res
+
+    def _barrier(self) -> int:
+        """Retire every in-flight ring and idle the worker — the point
+        past which the engine's published chain is the newest and no
+        stale publish can follow. Required before any OTHER writer of
+        the authoritative chain runs (per-batch fallback dispatch,
+        update-slot-overflow resync)."""
+        retired = 0
+        while True:
+            entry = self._inflight.pop_oldest()
+            if entry is None:
+                break
+            retired += self._retire(entry)
+        self._join()
+        return retired
+
+    def _dispatch(self, now: float, reason: str) -> int:
+        sched = self.sched
+        eng = sched.engine
+        buf, n_slots, slots, tokens, fill_ts = self.ring.take()
+        if n_slots == 0:
+            return 0
+        exe = (eng.devloop_aot(self.ring.k, self.ring.batch,
+                               sched._express_dev)
+               if sched._aot_ready else None)
+        fp = fault_point("devloop.dispatch")
+        if fp is not None and fp.kind == "fail":
+            exe = None  # chaos: injected mid-storm loop fallback
+        if exe is None:
+            # LOUD fallback: every staged slot re-dispatches through the
+            # per-batch AOT/jit path — service degrades to PR-13
+            # latency, consistency and reply bytes are unchanged. The
+            # direct path writes the authoritative chain itself, so the
+            # loop drains first: in-flight rings publish and the worker
+            # idles before the per-batch dispatches run.
+            retired = self._barrier()
+            self._dev_chain = None  # re-seed from engine next dispatch
+            sched._note_fallback(
+                "devloop_miss",
+                f"no compiled megakernel for k={self.ring.k} "
+                f"batch={self.ring.batch} impl={eng.table_impl}"
+                + (" (injected)" if fp is not None else "")
+                + f": {n_slots} slot(s) served per-batch")
+            self.fallback_slots += n_slots
+            for tok in tokens:
+                tele.cancel_batch(tok)  # the direct path opens its own
+            for pend, slot_reason in zip(slots, [reason] * n_slots):
+                retired += sched._dispatch_express_direct(
+                    pend, now, slot_reason)
+            return retired
+        retired = 0
+        # resync barrier: a drain that would overflow the delta slots
+        # rebuilds the device chain from full host state; everything in
+        # flight must publish BEFORE that chain seeds the worker, or a
+        # stale pre-resync chain could publish over it at retire.
+        if (self._dev_chain is not None
+                and eng.fastpath.dirty_count() > eng.fastpath.update_slots):
+            retired += self._barrier()
+            self._dev_chain = None
+        t0 = tele.t()
+        for tok, ft in zip(tokens, fill_ts):
+            tele.observe(tele.LOOP_WAIT, (now - ft) * 1e6, tok)
+        try:
+            prepared, resynced = eng.prepare_devloop_dispatch(
+                buf, n_slots, now, device=sched._express_dev)
+            if resynced and self._dev_chain is not None:
+                # the pre-check raced (a resync it did not predict):
+                # drain everything in flight, then re-publish the
+                # resync'd chain over whatever stale chain the last
+                # retire just published
+                fresh = eng.tables.dhcp
+                retired += self._barrier()
+                eng.adopt_devloop_chain(fresh, count=False)
+                self._dev_chain = None
+            if self._dev_chain is None:
+                self._join()
+                self._dev_chain = eng.tables.dhcp
+            fut = self._submit(self._run_ring, exe, prepared,
+                               sched._express_dev)
+        except BaseException:
+            for tok in tokens:
+                tele.cancel_batch(tok)
+            raise
+        if t0 is not None:
+            # DISPATCH in loop mode = what the serving thread actually
+            # spent: update drain + ring upload + worker submit. The
+            # device compute lands in LOOP_RETIRE where the force waits.
+            dur_us = (tele.t() - t0) / 1000.0 / n_slots
+            for tok in tokens:
+                tele.observe(tele.DISPATCH, dur_us, tok)
+        # dispatch-epoch config snapshot: the retire renders from the
+        # rows the device verdicts saw (the _dispatch_express_direct
+        # discipline, per ring instead of per batch)
+        cfg_epoch = (eng.fastpath.pools.copy(), eng.fastpath.server.copy())
+        self.dispatches += 1
+        sched.express_aot_dispatches += n_slots
+        tele.set_meta("express_program", "devloop")
+        tele.set_meta("devloop_ring", {
+            "k": self.ring.k, "slots": int(n_slots),
+            "inflight": len(self._inflight) + 1,
+            "occupancy_avg": round(self.ring.occupancy_avg(), 4)})
+        for pend in slots:
+            sched._observe_dispatch(LANE_EXPRESS, len(pend), reason)
+        over = self._inflight.push(_RingInflight(
+            fut, slots, tokens, [reason] * n_slots, now, cfg_epoch))
+        if over is not None:
+            retired += self._retire(over)
+        return retired
+
+    # -- retire -----------------------------------------------------------
+
+    def _retire(self, entry: _RingInflight) -> int:
+        """Force one ring's verdict blocks, publish its output chain and
+        cursor handle, and retire each slot through the scheduler's
+        per-batch AOT retire (wire template patch-in, slow-path fan-out,
+        telemetry close) — the reply path is shared, not cloned."""
+        t0 = tele.t()
+        try:
+            res = entry.fut.result()
+            blocks = np.asarray(res.blocks)
+        except BaseException:
+            for tok in entry.tokens:
+                tele.cancel_batch(tok)
+            raise
+        self.ring.adopt_cursors(res.cursors)
+        self.sched.engine.adopt_devloop_chain(res.dhcp_tables)
+        n_slots = len(entry.slots)
+        if t0 is not None and n_slots:
+            wait_us = (tele.t() - t0) / 1000.0 / n_slots
+            for tok in entry.tokens:
+                tele.observe(tele.LOOP_RETIRE, wait_us, tok)
+        zero_stats = np.zeros((NSTATS,), dtype=np.uint32)
+        retired = 0
+        folded = False
+        for s, pend in enumerate(entry.slots):
+            if not pend:
+                continue
+            res_s = _ExpressAotResult(
+                block=blocks[s],
+                # the megakernel sums stats across slots: fold once
+                dhcp_stats=(res.dhcp_stats if not folded
+                            else zero_stats),
+                nat_stats=res.nat_stats if not folded
+                else np.zeros_like(res.nat_stats),
+                qos_stats=res.qos_stats if not folded
+                else np.zeros_like(res.qos_stats),
+                spoof_stats=res.spoof_stats if not folded
+                else np.zeros_like(res.spoof_stats))
+            folded = True
+            retired += self.sched._retire_express_aot(InflightEntry(
+                res_s, pend, entry.dispatch_t, entry.reasons[s],
+                trace=entry.tokens[s], meta=entry.meta))
+        return retired
+
+    # -- quiesce audit / observability ------------------------------------
+
+    def audit(self) -> dict:
+        """Cursor-vs-host agreement — legal only after flush() (nothing
+        in flight). The quiesce pin: `seq` on device equals the host's
+        total dispatched slot count, head is 0, the in-flight ring is
+        empty."""
+        cur = self.ring.read_cursors()
+        return {
+            "seq": int(cur[CUR_SEQ]),
+            "slots_taken": self.ring.slots_taken - self.fallback_slots,
+            "staged": self.ring.head,
+            "inflight": len(self._inflight),
+            "consistent": (int(cur[CUR_SEQ]) == (self.ring.slots_taken
+                                                 - self.fallback_slots)
+                           and self.ring.head == 0
+                           and len(self._inflight) == 0),
+        }
+
+    def stats(self) -> dict:
+        return {
+            "k": self.ring.k,
+            "dispatches": self.dispatches,
+            "batches": self.batches,
+            "fallback_slots": self.fallback_slots,
+            "staged": self.ring.head,
+            "inflight": len(self._inflight),
+            "occupancy_avg": round(self.ring.occupancy_avg(), 4),
+        }

@@ -1,0 +1,142 @@
+"""The persistent express megakernel (ISSUE 18).
+
+ONE AOT-compiled program drains up to k descriptor-ring slots per
+invocation: for each slot it runs the probe-only OFFER cascade
+(ops/express.express_verdicts — the PR-13 AOT program, which stays the
+bit-identity oracle AND the loud fallback) and streams the verdict rows
+back over the donated ring, so the device-side completion ring IS the
+descriptor ring. The host touches the device once per k admission
+batches instead of once per batch; the per-dispatch ceremony (update
+drain, executable call, placement) amortizes k-fold.
+
+The slot axis is a `jax.lax.scan`, not a vmap: the compiled program
+stays O(1) in k (one probe cascade body, k iterations), matching the
+persistent-kernel shape the ROADMAP `[latency]` item names — on TPU the
+same scan becomes the on-chip serving loop, with slots arriving via
+device DMA instead of a host upload.
+
+Table impl dispatch follows the PR-13 discipline exactly: the probe
+cascade routes through ops/table.device_lookup under
+``forced_impl(table_impl)``, so ``BNG_TABLE_IMPL=pallas`` serves the
+ring through the fused Pallas probe kernel (interpret-mode on CPU in
+tier-1) and ``xla`` through the reference lowering — the identity tests
+pin both against the per-batch oracle.
+
+Empty lanes and unfilled slots need no explicit mask: the host zeroes
+them at staging, a zero descriptor row has no XF_VALID flag, and the
+cascade's validity mask produces verdict 0 and no stats for it — the
+same contract the per-batch AOT lane relies on for short batches.
+
+Compiled executables are cached process-wide like `_EXPRESS_AOT`
+(engine.py): (geometry, pools, update slots, k, batch, impl, device) ->
+Compiled. A lookup miss at dispatch time is the LOUD fallback class the
+pump counts and flight-records; it never compiles on the serving path.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from bng_tpu.devloop.ring import CUR_EPOCH, CUR_SEQ, CUR_TAIL, CUR_WORDS
+from bng_tpu.ops import table as table_mod
+from bng_tpu.ops.dhcp import NSTATS
+from bng_tpu.ops.express import XD_WORDS, express_verdicts
+from bng_tpu.runtime.tables import apply_fastpath_updates
+
+
+class DevloopResult(NamedTuple):
+    """One megakernel dispatch (futures until the pump retires it).
+    Shaped for Engine._fold_stats like _ExpressAotResult; `blocks`
+    carries the per-slot verdict blocks, `cursors` the advanced
+    device-resident cursor handle the ring adopts, `dhcp_tables` the
+    output chain the retire publishes back to the engine."""
+
+    dhcp_tables: object   # DHCPFastPathTables pytree (post-ring chain)
+    blocks: "jax.Array"   # [k, B, XD_WORDS] uint32 (VB_* verdict cols)
+    cursors: "jax.Array"  # [CUR_WORDS] uint32 (tail/seq/epoch advanced)
+    dhcp_stats: "jax.Array"  # [NSTATS] summed across slots
+    nat_stats: np.ndarray    # zeros (no NAT on this program)
+    qos_stats: np.ndarray    # zeros
+    spoof_stats: np.ndarray  # zeros
+
+
+@functools.lru_cache(maxsize=8)
+def _devloop_jit(geom, k: int, table_impl: str = "xla"):
+    """The megakernel jit factory. Donates ONLY the descriptor ring
+    (argnum 2): the per-slot verdict blocks are shaped exactly like it,
+    so XLA aliases the completion ring onto the uploaded descriptor
+    ring. The dhcp chain is deliberately NOT donated — the chain is
+    double-buffered across ring boundaries so the engine's published
+    `tables.dhcp` handle stays live and readable while a ring is in
+    flight on the pump's dispatch worker; donation would poison every
+    engine-side reader between dispatch and retire. (On-chip the
+    double buffer is the classic persistent-kernel A/B table swap; the
+    extra copy is one chain, not one per slot.) Cursors are 16 bytes —
+    donating them would only make the retired handle unreadable."""
+
+    def step(dhcp_tables, upd, ring, n_slots, cursors, now_s):
+        dhcp_tables = apply_fastpath_updates(dhcp_tables, upd)
+        with table_mod.forced_impl(table_impl):
+            def slot(stats, desc):
+                res = express_verdicts(dhcp_tables, desc, geom, now_s)
+                return stats + res.stats, res.block
+
+            stats, blocks = jax.lax.scan(
+                slot, jnp.zeros((NSTATS,), dtype=jnp.uint32), ring)
+        cursors = (cursors
+                   .at[CUR_TAIL].set(n_slots)
+                   .at[CUR_SEQ].add(n_slots)
+                   .at[CUR_EPOCH].add(jnp.uint32(1)))
+        return dhcp_tables, blocks, cursors, stats
+
+    return jax.jit(step, donate_argnums=(2,))
+
+
+# AOT-compiled megakernel executables, shared across engines of one
+# geometry (the _EXPRESS_AOT discipline): key -> Compiled.
+_DEVLOOP_AOT: dict = {}
+
+
+def devloop_key(engine, k: int, batch: int, device) -> tuple:
+    """Everything the compiled program's avals bake in — two engines
+    differing in any of these must not share an executable (the
+    _express_aot_key rationale, plus the ring's k axis)."""
+    return (engine.fastpath.geom, len(engine.fastpath.pools),
+            engine.fastpath.update_slots, k, batch, engine.table_impl,
+            None if device is None else str(device))
+
+
+def get_compiled(engine, k: int, batch: int, device=None):
+    """The compiled megakernel for this ring geometry, or None — a None
+    here is the GEOMETRY MISS the pump must fall back (loudly) from;
+    it never compiles."""
+    return _DEVLOOP_AOT.get(devloop_key(engine, k, batch, device))
+
+
+def compile_devloop(engine, k: int, batch: int, device=None):
+    """`jax.jit(...).lower(...).compile()` the megakernel for one fixed
+    ring geometry — scheduler init / engine-adopt time, NEVER the
+    dispatch path. Lowering uses the live chain's avals plus an EMPTY
+    update batch (the compile_express_aot discipline: a real
+    make_updates() here would consume dirty state the next dispatch
+    needs)."""
+    key = devloop_key(engine, k, batch, device)
+    exe = _DEVLOOP_AOT.get(key)
+    if exe is not None:
+        return exe
+    dev = device if device is not None else jax.devices()[0]
+    upd = jax.device_put(engine.fastpath.empty_updates(), dev)
+    ring = jax.device_put(
+        jnp.zeros((k, batch, XD_WORDS), jnp.uint32), dev)
+    cursors = jax.device_put(jnp.zeros((CUR_WORDS,), jnp.uint32), dev)
+    n_d = jax.device_put(jnp.uint32(0), dev)
+    now_d = jax.device_put(jnp.uint32(0), dev)
+    exe = _devloop_jit(engine.fastpath.geom, k, engine.table_impl).lower(
+        engine.tables.dhcp, upd, ring, n_d, cursors, now_d).compile()
+    _DEVLOOP_AOT[key] = exe
+    return exe

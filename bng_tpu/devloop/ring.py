@@ -1,0 +1,143 @@
+"""Descriptor ring for the device-resident express loop (ISSUE 18).
+
+One ring = k slots x B lanes x XD_WORDS uint32 — k closed express
+batches staged as one [k, B, XD_WORDS] block that crosses the host->HBM
+boundary ONCE. The megakernel (devloop/kernel.py) donates the block and
+writes each slot's verdict columns over its descriptor rows, so the
+completion ring aliases the descriptor ring on device: no second
+allocation, no second transfer.
+
+Host staging is double-buffered the way the AOT lane's `_desc_bufs`
+are: `depth + 2` cycling [k, B, XD_WORDS] buffers, so slot fills for
+ring i+1 write a different buffer than the (up to `depth`) rings still
+in flight — batch i+1 uploads while batch i executes, and a buffer is
+only rewritten after every dispatch that could still be reading it has
+retired.
+
+Cursors are DEVICE-resident: a [CUR_WORDS] uint32 array threaded
+through every megakernel invocation (not donated — 16 bytes; donating
+would only make the retired handle unreadable at audit). The host
+never writes it after creation — the kernel advances tail/seq/epoch;
+the host's only cursor mutators are `fill_slot` (the host-side head
+advance) and `adopt_cursors` (swapping in the kernel's returned
+handle at retire), both allowlisted in
+analysis/passes/single_writer.py: a module outside the devloop pump
+mutating ring cursors bypasses the quiesce/audit story the same way
+an un-allowlisted table writer bypasses the event log. Reading the
+cursors back (`read_cursors`) is only legal when nothing is in flight
+— a newer handle may still be a future on the dispatch worker — which
+is exactly the quiesce barrier's state.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from bng_tpu.ops.express import XD_WORDS
+
+# device cursor layout ([CUR_WORDS] uint32, padded for alignment)
+CUR_TAIL = 0   # slots drained by the LAST invocation (kernel-written)
+CUR_SEQ = 1    # total slots drained since ring creation (kernel-written)
+CUR_EPOCH = 2  # megakernel invocations since ring creation
+CUR_WORDS = 4
+
+
+class DescriptorRing:
+    """Host half of one device ring: staging buffers, slot occupancy,
+    the cursor handle, and the per-slot retire metadata (pending-frame
+    lists + telemetry tokens) that never touches the device."""
+
+    def __init__(self, k: int, batch: int, depth: int = 2):
+        if k < 1:
+            raise ValueError(f"devloop ring needs k >= 1 slots, got {k}")
+        self.k = k
+        self.batch = batch
+        self.depth = max(1, depth)
+        self._bufs = [np.zeros((k, batch, XD_WORDS), dtype=np.uint32)
+                      for _ in range(self.depth + 2)]
+        self._buf_i = 0
+        self.head = 0  # filled slots in the CURRENT (staging) ring
+        # per-slot retire metadata for the staging ring (host-only)
+        self._slot_pend: list[list] = [[] for _ in range(k)]
+        self._slot_tok: list = [None] * k
+        self._slot_fill_t: list[float] = [0.0] * k
+        # device cursor handle — numpy until the first dispatch converts
+        # it to a device array; each retire adopts the kernel-returned
+        # handle (the device-resident thread)
+        self.cursors = np.zeros((CUR_WORDS,), dtype=np.uint32)
+        # occupancy accounting for flight-record / bench fields
+        self.rings_taken = 0
+        self.slots_taken = 0
+        self.batches_filled = 0
+
+    # -- host-side mutators (single-writer allowlisted) -------------------
+
+    def fill_slot(self, rows: list, idxs: list, pend: list, tok,
+                  now: float) -> int:
+        """Advance the host head: stage one closed express batch's
+        descriptor rows into the next free slot of the staging ring
+        (ONE stacked assignment, the AOT lane's fill discipline; unused
+        lanes stay zero so the kernel's validity mask skips them).
+        Returns the slot index."""
+        if self.head >= self.k:
+            raise IndexError("devloop ring overfilled: dispatch before "
+                             f"filling slot {self.head} of {self.k}")
+        s = self.head
+        desc = self._bufs[self._buf_i][s]
+        desc[:] = 0
+        if rows:
+            desc[idxs] = rows
+        self._slot_pend[s] = pend
+        self._slot_tok[s] = tok
+        self._slot_fill_t[s] = now
+        self.head = s + 1
+        self.batches_filled += 1
+        return s
+
+    def take(self) -> tuple:
+        """Close the staging ring for dispatch: returns (ring_buf,
+        n_slots, slots, tokens, fill_ts) and rotates to the next
+        staging buffer with head reset. Slots beyond n_slots stay
+        zeroed in the returned buffer — the kernel's validity mask
+        drains them as empty."""
+        n = self.head
+        buf = self._bufs[self._buf_i]
+        if n < self.k:
+            buf[n:] = 0  # a prior occupancy of this buffer must not
+            # resurrect stale descriptors in the unfilled tail
+        slots = self._slot_pend[:n]
+        tokens = self._slot_tok[:n]
+        fill_ts = self._slot_fill_t[:n]
+        self._buf_i = (self._buf_i + 1) % len(self._bufs)
+        self.head = 0
+        self._slot_pend = [[] for _ in range(self.k)]
+        self._slot_tok = [None] * self.k
+        self._slot_fill_t = [0.0] * self.k
+        self.rings_taken += 1
+        self.slots_taken += n
+        return buf, n, slots, tokens, fill_ts
+
+    def adopt_cursors(self, handle) -> None:
+        """Swap in the kernel-returned cursor handle (retire time: the
+        newest retired ring's view of tail/seq/epoch)."""
+        self.cursors = handle
+
+    # -- queries ----------------------------------------------------------
+
+    @property
+    def oldest_fill_t(self) -> float | None:
+        """Enqueue time of the oldest staged slot (deadline close)."""
+        return self._slot_fill_t[0] if self.head else None
+
+    def occupancy_avg(self) -> float:
+        """Mean slots-per-dispatched-ring (1.0 == every ring full)."""
+        if not self.rings_taken:
+            return 0.0
+        return self.slots_taken / (self.rings_taken * self.k)
+
+    def read_cursors(self) -> np.ndarray:
+        """Force + read the live cursor words. ONLY legal with nothing
+        in flight (the quiesce/audit barrier): a newer handle may still
+        be in flight on the dispatch worker until the last ring
+        retires."""
+        return np.asarray(self.cursors)

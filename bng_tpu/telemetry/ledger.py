@@ -216,6 +216,22 @@ def express_path(line: dict) -> str:
     return str(v) if v else "jit-full"
 
 
+def express_loop(line: dict) -> str:
+    """Which express SERVING LOOP drove the dispatches (ISSUE 18):
+    `per-batch` (one device touch per admission batch — both the
+    jit-full and aot-express architectures) vs `devloop` (the k-slot
+    descriptor-ring megakernel, one device touch per k batches).
+    Unstamped lines predate the ring and dispatched per batch —
+    defaulting to `per-batch` keeps ALL existing express history
+    (jit-full and aot-express cohorts alike) one loop cohort. The loop
+    changes what a "dispatch" stage lap even measures (one batch vs an
+    amortized ring share): a trend across loops is an architecture
+    comparison, not a regression signal (rc=3 refusal, the express_path
+    discipline)."""
+    v = line.get("express_loop")
+    return str(v) if v else "per-batch"
+
+
 def host_path(line: dict) -> str:
     """Which HOST serving path staged the run (ISSUE 14): `scalar` (the
     original per-frame ring/admission/pack loops) vs `vector` (the
@@ -290,8 +306,8 @@ def n_instances(line: dict) -> int:
 def cohort_key(line: dict) -> tuple:
     return (line.get("metric"), backend_class(line), device_kind(line),
             table_impl(line), n_shards(line), n_instances(line),
-            express_path(line), host_path(line), wire_pump(line),
-            geometry(line))
+            express_path(line), express_loop(line), host_path(line),
+            wire_pump(line), geometry(line))
 
 
 def _gateable(line: dict) -> bool:
@@ -540,6 +556,7 @@ def gate(lines: list[dict], last_k: int = 8, min_cohort: int = 3,
                         or n_shards(ln) != n_shards(cand)
                         or n_instances(ln) != n_instances(cand)
                         or express_path(ln) != express_path(cand)
+                        or express_loop(ln) != express_loop(cand)
                         or host_path(ln) != host_path(cand)
                         or wire_pump(ln) != wire_pump(cand))]
         if not cohort and len(relaxed) >= min_cohort:
@@ -548,6 +565,7 @@ def gate(lines: list[dict], last_k: int = 8, min_cohort: int = 3,
                 f"/shards={n_shards(ln)}"
                 f"/instances={n_instances(ln)}"
                 f"/express={express_path(ln)}"
+                f"/loop={express_loop(ln)}"
                 f"/host={host_path(ln)}/wire={wire_pump(ln)}"
                 for ln in relaxed})
             rep.rc = GATE_INCOMPARABLE
@@ -556,6 +574,7 @@ def gate(lines: list[dict], last_k: int = 8, min_cohort: int = 3,
                 f"{table_impl(cand)!r}/shards={n_shards(cand)}"
                 f"/instances={n_instances(cand)}"
                 f"/express={express_path(cand)!r}"
+                f"/loop={express_loop(cand)!r}"
                 f"/host={host_path(cand)!r}"
                 f"/wire={wire_pump(cand)!r} (device "
                 f"{device_kind(cand) or 'none'!r}) with no same-identity "
@@ -564,7 +583,8 @@ def gate(lines: list[dict], last_k: int = 8, min_cohort: int = 3,
                 f"(an aggregate sharded number never trends against a "
                 f"different shard count's cohort, the AOT express "
                 f"architecture never trends against the jit full-program "
-                f"path, the vectorized host path never trends against "
+                f"path, the devloop ring never trends against per-batch "
+                f"dispatch, the vectorized host path never trends against "
                 f"the scalar per-frame path, and the vector wire pump "
                 f"never trends against the scalar pump)")
             return rep

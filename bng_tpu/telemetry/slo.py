@@ -102,6 +102,14 @@ DEFAULT_SLOS: tuple[SLOSpec, ...] = (
     SLOSpec("lane_wait", 50_000.0,
             description="scheduler enqueue -> dispatch (oldest frame)"),
     SLOSpec("dispatch", 50_000.0, description="host-side jitted dispatch"),
+    SLOSpec("loop_fill", 2_000.0,
+            description="devloop: descriptor rows -> ring slot, per batch"),
+    SLOSpec("loop_wait", 100_000.0,
+            description="devloop: slot staged -> ring dispatch (bounded "
+                        "by the ring deadline)"),
+    SLOSpec("loop_retire", 50_000.0,
+            description="devloop: ring force + per-slot demux, amortized "
+                        "per batch"),
     SLOSpec("device", HEADLINE_TARGETS["offer_device_only_p99_us"],
             description="profiler-fenced device execution (paper target)"),
     SLOSpec("device_wait", 200_000.0,

@@ -61,16 +61,22 @@ import numpy as np
 
 from bng_tpu.telemetry.hist import LatencyHist
 
-# stage ids — array indexes; keep STAGE_NAMES in lockstep. `ops` is the
+# stage ids — array indexes; keep STAGE_NAMES in lockstep (and TOTAL
+# LAST — the recorder indexes it as NSTAGES-1). `ops` is the
 # zero-downtime-transition stage (fleet resize / rolling restart /
 # blue/green engine swap phases — runtime/ops.py, control/fleet.py):
 # each transition phase records one lap, so the histogram answers "how
-# long do operational state moves stall the dataplane".
-(RING, ADMIT, LANE_WAIT, DISPATCH, DEVICE, DEVICE_WAIT, FLEET, WORKER,
- SLOW, REPLY, OPS, WIRE_RX, WIRE_TX, TOTAL) = range(14)
-STAGE_NAMES = ("ring", "admit", "lane_wait", "dispatch", "device",
-               "device_wait", "fleet", "worker", "slow_path", "reply",
-               "ops", "wire_rx", "wire_tx", "total")
+# long do operational state moves stall the dataplane". The loop_*
+# stages attribute the devloop ring pump (devloop/host.py): fill = rows
+# into the ring slot, wait = slot staged -> ring dispatch (the latency
+# the k-amortization trades away), retire = ring force + per-slot demux.
+(RING, ADMIT, LANE_WAIT, DISPATCH, LOOP_FILL, LOOP_WAIT, LOOP_RETIRE,
+ DEVICE, DEVICE_WAIT, FLEET, WORKER, SLOW, REPLY, OPS, WIRE_RX, WIRE_TX,
+ TOTAL) = range(17)
+STAGE_NAMES = ("ring", "admit", "lane_wait", "dispatch", "loop_fill",
+               "loop_wait", "loop_retire", "device", "device_wait",
+               "fleet", "worker", "slow_path", "reply", "ops", "wire_rx",
+               "wire_tx", "total")
 NSTAGES = len(STAGE_NAMES)
 
 # lane ids for batch records

@@ -1,0 +1,286 @@
+"""Tests for control/deviceauth.py (dormant-module coverage, ISSUE 18).
+
+The device-auth stack the control-plane transport wires in when a BNG
+instance enrolls with its controller: identity detection from a (fake)
+sysfs tree, the NONE/PSK/MTLS authenticators, the minimal X.509 DER
+helpers, and the header-injecting transport wrapper. All jax-free;
+MTLS paths use a hand-built synthetic DER certificate so no openssl
+invocation (and no real key material) is needed.
+"""
+
+from __future__ import annotations
+
+import base64
+import os
+
+import pytest
+
+from bng_tpu.control.deviceauth import (
+    MAX_TIMESTAMP_SKEW, PSK_SIGNATURE_HEADER, PSK_TIMESTAMP_HEADER,
+    AuthenticatedTransport, AuthMode, DeviceIdentity, MTLSAuthenticator,
+    NoneAuthenticator, PSKAuthenticator, _pem_to_der, cert_fingerprint,
+    cert_not_after, generate_device_id, new_authenticator,
+    read_device_identity, sanitize_id,
+)
+
+NOW = 1_700_000_000.0
+
+
+class FakeClock:
+    def __init__(self, t=NOW):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+# ---------------------------------------------------------------------------
+# identity detection
+# ---------------------------------------------------------------------------
+
+class TestIdentity:
+    def test_sanitize_id(self):
+        assert sanitize_id("AB c/1:2") == "ab-c-1-2"
+        assert sanitize_id("ok_id-9") == "ok_id-9"
+
+    def test_generate_device_id_precedence(self):
+        assert generate_device_id("SN 01", "02:aa") == "dev-sn-01"
+        assert generate_device_id("", "02:AA:bb") == "dev-02aabb"
+        anon = generate_device_id("", "")
+        assert anon.startswith("dev-") and len(anon) == 4 + 12
+
+    def test_read_identity_from_fake_sys_tree(self, tmp_path):
+        dmi = tmp_path / "sys/class/dmi/id"
+        dmi.mkdir(parents=True)
+        (dmi / "product_serial").write_text("BNG-42 \n")
+        (dmi / "product_name").write_text("tpu-bng-host\n")
+        for iface, addr in (("lo", "00:00:00:00:00:00"),
+                            ("eth0", "02:aa:bb:cc:dd:ee")):
+            d = tmp_path / "sys/class/net" / iface
+            d.mkdir(parents=True)
+            (d / "address").write_text(addr + "\n")
+        ident = read_device_identity(str(tmp_path))
+        assert ident.serial == "BNG-42"
+        assert ident.model == "tpu-bng-host"
+        assert ident.mac == "02:aa:bb:cc:dd:ee"  # lo skipped
+        assert ident.device_id == "dev-bng-42"
+
+    def test_read_identity_mac_fallback(self, tmp_path):
+        d = tmp_path / "sys/class/net/eth0"
+        d.mkdir(parents=True)
+        (d / "address").write_text("02:aa:bb:cc:dd:ee\n")
+        ident = read_device_identity(str(tmp_path))
+        assert ident.serial == ""
+        assert ident.device_id == "dev-02aabbccddee"
+
+    def test_read_identity_empty_tree(self, tmp_path):
+        ident = read_device_identity(str(tmp_path))
+        assert ident.device_id.startswith("dev-")
+
+
+# ---------------------------------------------------------------------------
+# NONE + PSK authenticators
+# ---------------------------------------------------------------------------
+
+class TestNoneAuth:
+    def test_headers_and_result(self):
+        a = NoneAuthenticator(DeviceIdentity(device_id="dev-x",
+                                             serial="SN9"))
+        res = a.authenticate()
+        assert res.success and res.mode == AuthMode.NONE
+        h = a.http_headers()
+        assert h == {"X-Device-ID": "dev-x", "X-Device-Serial": "SN9"}
+        assert a.tls_config() is None
+
+
+class TestPSK:
+    KEY = "correct-horse-battery-staple"
+
+    def _auth(self, clock=None):
+        return PSKAuthenticator(psk=self.KEY, clock=clock or FakeClock(),
+                                identity=DeviceIdentity(device_id="dev-p"))
+
+    def test_short_psk_rejected(self):
+        with pytest.raises(ValueError):
+            PSKAuthenticator(psk="too-short")
+
+    def test_psk_file_source(self, tmp_path):
+        f = tmp_path / "psk"
+        f.write_text(self.KEY + "\n")
+        a = PSKAuthenticator(psk_file=str(f), clock=FakeClock())
+        assert a.sign_message("m") == self._auth().sign_message("m")
+
+    def test_sign_verify_roundtrip(self):
+        a = self._auth()
+        h = a.http_headers()
+        assert h["X-Device-ID"] == "dev-p"
+        # the server side accepts its own client's headers
+        a.verify_signature("dev-p", h[PSK_TIMESTAMP_HEADER],
+                           h[PSK_SIGNATURE_HEADER])
+
+    def test_tampered_signature_rejected(self):
+        a = self._auth()
+        h = a.http_headers()
+        bad = "0" * len(h[PSK_SIGNATURE_HEADER])
+        with pytest.raises(ValueError, match="signature mismatch"):
+            a.verify_signature("dev-p", h[PSK_TIMESTAMP_HEADER], bad)
+        # a different device_id re-signs to a different digest
+        with pytest.raises(ValueError, match="signature mismatch"):
+            a.verify_signature("dev-q", h[PSK_TIMESTAMP_HEADER],
+                               h[PSK_SIGNATURE_HEADER])
+
+    def test_timestamp_skew_window(self):
+        clock = FakeClock()
+        a = self._auth(clock)
+        h = a.http_headers()
+        clock.t = NOW + MAX_TIMESTAMP_SKEW - 1  # inside the window
+        a.verify_signature("dev-p", h[PSK_TIMESTAMP_HEADER],
+                           h[PSK_SIGNATURE_HEADER])
+        clock.t = NOW + MAX_TIMESTAMP_SKEW + 1  # replayed too late
+        with pytest.raises(ValueError, match="skew"):
+            a.verify_signature("dev-p", h[PSK_TIMESTAMP_HEADER],
+                               h[PSK_SIGNATURE_HEADER])
+
+    def test_bad_timestamp_format(self):
+        a = self._auth()
+        with pytest.raises(ValueError, match="invalid timestamp"):
+            a.verify_signature("dev-p", "yesterday-ish", "00")
+
+    def test_rotation_invalidates_old_signatures(self):
+        a = self._auth()
+        h = a.http_headers()
+        with pytest.raises(ValueError):
+            a.rotate_psk("short")
+        a.rotate_psk("a-brand-new-shared-key")
+        with pytest.raises(ValueError, match="signature mismatch"):
+            a.verify_signature("dev-p", h[PSK_TIMESTAMP_HEADER],
+                               h[PSK_SIGNATURE_HEADER])
+        h2 = a.http_headers()  # signed under the new key
+        a.verify_signature("dev-p", h2[PSK_TIMESTAMP_HEADER],
+                           h2[PSK_SIGNATURE_HEADER])
+
+    def test_close_zeroes_key_material(self):
+        a = self._auth()
+        n = len(self.KEY)
+        a.close()
+        assert a._psk == b"\x00" * n
+
+
+# ---------------------------------------------------------------------------
+# X.509 helpers + MTLS (synthetic DER certificate, no openssl)
+# ---------------------------------------------------------------------------
+
+def _der(tag: int, content: bytes) -> bytes:
+    n = len(content)
+    if n < 0x80:
+        return bytes([tag, n]) + content
+    b = n.to_bytes((n.bit_length() + 7) // 8, "big")
+    return bytes([tag, 0x80 | len(b)]) + b + content
+
+
+def fake_cert_pem(not_after: str, not_before: str = "250101000000Z") -> str:
+    """Minimal syntactically-valid Certificate DER: enough structure
+    for cert_not_after's TBS walk ([0] version, serial, sigAlg, issuer,
+    validity{UTCTime,UTCTime}, subject)."""
+    validity = _der(0x30, _der(0x17, not_before.encode())
+                    + _der(0x17, not_after.encode()))
+    tbs = _der(0x30, _der(0xA0, _der(0x02, b"\x02"))  # [0] v3
+               + _der(0x02, b"\x01")                   # serial
+               + _der(0x30, b"")                       # sigAlg
+               + _der(0x30, b"")                       # issuer
+               + validity
+               + _der(0x30, b""))                      # subject
+    cert = _der(0x30, tbs + _der(0x30, b"") + _der(0x03, b"\x00"))
+    b64 = base64.encodebytes(cert).decode()
+    return ("-----BEGIN CERTIFICATE-----\n" + b64
+            + "-----END CERTIFICATE-----\n")
+
+
+class TestCertHelpers:
+    def test_pem_without_cert_rejected(self):
+        with pytest.raises(ValueError, match="no certificate"):
+            _pem_to_der("-----BEGIN KEY-----\nAAAA\n-----END KEY-----")
+
+    def test_utctime_century_rule(self):
+        # YY<50 -> 20YY, YY>=50 -> 19YY: 2049 lands after 1950
+        assert (cert_not_after(fake_cert_pem("490101000000Z"))
+                > cert_not_after(fake_cert_pem("500101000000Z")))
+
+    def test_fingerprint_tracks_der_bytes(self):
+        a, b = fake_cert_pem("270101000000Z"), fake_cert_pem("280101000000Z")
+        assert cert_fingerprint(a) != cert_fingerprint(b)
+        assert cert_fingerprint(a) == cert_fingerprint(a)
+
+
+class TestMTLS:
+    def _write_pair(self, tmp_path, not_after="270101000000Z"):
+        cert = tmp_path / "device.crt"
+        key = tmp_path / "device.key"
+        cert.write_text(fake_cert_pem(not_after))
+        key.write_text("not-a-real-key")
+        return str(cert), str(key)
+
+    def test_accepts_before_expiry_rejects_after(self, tmp_path):
+        cert, key = self._write_pair(tmp_path)
+        clock = FakeClock()
+        a = MTLSAuthenticator(cert, key, clock=clock,
+                              identity=DeviceIdentity(device_id="dev-m"))
+        na = cert_not_after(fake_cert_pem("270101000000Z"))
+        clock.t = na - 1000.0
+        res = a.authenticate()
+        assert res.success and res.mode == AuthMode.MTLS
+        assert a.expires_within(2000.0) and not a.expires_within(500.0)
+        clock.t = na + 1.0
+        res = a.authenticate()
+        assert not res.success and res.error == "certificate expired"
+
+    def test_rotation_reload_on_file_change(self, tmp_path):
+        cert, key = self._write_pair(tmp_path)
+        a = MTLSAuthenticator(cert, key, clock=FakeClock(),
+                              identity=DeviceIdentity(device_id="dev-m"))
+        fp0 = a.fingerprint
+        assert not a.maybe_rotate()  # unchanged file -> no reload
+        with open(cert, "w") as f:
+            f.write(fake_cert_pem("280101000000Z"))
+        os.utime(cert, (1, 1))  # force a visible mtime change
+        assert a.maybe_rotate()
+        assert a.fingerprint != fp0
+        assert a.http_headers()["X-Device-Cert-Fingerprint"] == a.fingerprint
+
+
+# ---------------------------------------------------------------------------
+# dispatch + transport wrapper
+# ---------------------------------------------------------------------------
+
+class TestWiring:
+    def test_new_authenticator_dispatch(self, tmp_path):
+        assert isinstance(new_authenticator("none"), NoneAuthenticator)
+        assert isinstance(
+            new_authenticator(AuthMode.PSK, psk="0123456789abcdef",
+                              clock=FakeClock()), PSKAuthenticator)
+        cert = tmp_path / "c.crt"
+        cert.write_text(fake_cert_pem("270101000000Z"))
+        assert isinstance(
+            new_authenticator("mtls", cert_file=str(cert), key_file="",
+                              clock=FakeClock(),
+                              identity=DeviceIdentity(device_id="d")),
+            MTLSAuthenticator)
+        with pytest.raises(ValueError):
+            new_authenticator("bogus")
+
+    def test_transport_injects_auth_headers(self):
+        calls = []
+
+        def base(method, url, headers, body):
+            calls.append((method, url, headers, body))
+            return 200
+
+        auth = NoneAuthenticator(DeviceIdentity(device_id="dev-t"))
+        tr = AuthenticatedTransport(base, auth)
+        assert tr("POST", "http://c/v1/enroll",
+                  {"Content-Type": "application/json",
+                   "X-Device-ID": "spoofed"}, b"{}") == 200
+        method, url, headers, body = calls[0]
+        assert headers["Content-Type"] == "application/json"
+        assert headers["X-Device-ID"] == "dev-t"  # auth wins over caller
+        assert body == b"{}"

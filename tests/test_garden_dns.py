@@ -10,6 +10,7 @@ from bng_tpu.control.dns import (
     RCODE_REFUSED, RCODE_SUCCESS, RCODE_SERVER_FAILURE, Record, Resolver,
     Response, TYPE_A, TYPE_AAAA, TYPE_CNAME, cache_key, dns64_synthesize,
 )
+from bng_tpu.control import packets
 from bng_tpu.control.qinq import QinQConfig, QinQMapper, VLANPair, VLANRange
 from bng_tpu.control.walledgarden import (
     SubscriberState, WalledGardenConfig, WalledGardenManager,
@@ -18,6 +19,7 @@ from bng_tpu.control.wifi import (
     OperatingMode, WiFiGatewayManager, WiFiSessionState,
     default_olt_bng_config, default_wifi_config,
 )
+from bng_tpu.utils.net import ip_to_u32, u32_to_ip
 
 
 class FakeClock:
@@ -772,3 +774,85 @@ class TestForwarderDeadline:
                 fwd(Query(name="real.test"))  # poisoned answer never accepted
         finally:
             srv.close()
+
+
+# ------------------------------------------------- walled garden wire view
+
+def _wire_view(frame: bytes):
+    """What the ring parser sees for garden classification: the DECODED
+    frame's (src mac, dst ip, dst L4 port, ip proto) — no host-side
+    session hints."""
+    d = packets.decode(frame)
+    return d.src_mac, u32_to_ip(d.dst_ip), d.dst_port, d.proto
+
+
+class TestGardenWireView:
+    """ISSUE 18 dormant-module pass: the host redirect decision and the
+    wire view agree. Every flow below is built as real frame bytes
+    (packets.udp_packet/tcp_packet), decoded, and classified from the
+    decoded fields only — so the manager's (ip, port, proto) matching
+    is pinned to exactly what the dataplane parser extracts."""
+
+    SUB = bytes.fromhex("020000000001")   # gardened subscriber
+    PROV = bytes.fromhex("020000000002")  # provisioned subscriber
+    GW = bytes.fromhex("0200000000fe")
+
+    def _frames(self, m):
+        cfg = m.config
+        web = ip_to_u32("93.184.216.34")
+        dns = ip_to_u32(cfg.allowed_dns[0])
+        portal = ip_to_u32(cfg.portal_ip)
+        src = ip_to_u32("10.0.0.50")
+        mk_udp = lambda mac, dst, dport: packets.udp_packet(
+            mac, self.GW, src, dst, 40000, dport, b"x")
+        mk_tcp = lambda mac, dst, dport: packets.tcp_packet(
+            mac, self.GW, src, dst, 40000, dport)
+        return [
+            # (frame, should_redirect?)
+            (mk_tcp(self.SUB, web, 80), True),          # gardened HTTP
+            (mk_tcp(self.SUB, web, 443), True),         # gardened HTTPS
+            (mk_udp(self.SUB, dns, 53), False),         # DNS/UDP bypass
+            (mk_tcp(self.SUB, dns, 53), False),         # DNS/TCP bypass
+            (mk_udp(self.SUB, dns, 5353), True),        # wrong port
+            (mk_tcp(self.SUB, portal, cfg.portal_port), False),  # portal
+            (mk_udp(self.SUB, portal, cfg.portal_port), True),   # portal
+            # is allowed for TCP only: a UDP flow to it still diverts
+            (mk_tcp(self.PROV, web, 80), False),        # provisioned
+        ]
+
+    def test_wire_decoded_flows_classify_like_host(self):
+        m = WalledGardenManager()
+        m.add_to_walled_garden(self.SUB)
+        m.release_from_walled_garden(self.PROV)
+        for i, (frame, want) in enumerate(self._frames(m)):
+            mac, ip, port, proto = _wire_view(frame)
+            assert m.should_redirect(mac, ip, port, proto) == want, \
+                f"flow {i}: wire view ({ip}:{port}/{proto}) misclassified"
+
+    def test_state_flip_reclassifies_same_bytes(self):
+        """The SAME frame bytes flip classification when only the
+        subscriber state moves — destination matching never caches."""
+        m = WalledGardenManager()
+        m.add_to_walled_garden(self.SUB)
+        frame = packets.tcp_packet(self.SUB, self.GW,
+                                   ip_to_u32("10.0.0.50"),
+                                   ip_to_u32("93.184.216.34"), 40000, 80)
+        mac, ip, port, proto = _wire_view(frame)
+        assert m.should_redirect(mac, ip, port, proto)
+        m.release_from_walled_garden(mac)
+        assert not m.should_redirect(mac, ip, port, proto)
+        m.add_to_walled_garden(mac)
+        assert m.should_redirect(mac, ip, port, proto)
+
+    def test_decoded_proto_distinguishes_udp_tcp(self):
+        dns = "8.8.8.8"
+        m = WalledGardenManager()
+        m.add_to_walled_garden(self.SUB)
+        udp = packets.udp_packet(self.SUB, self.GW, ip_to_u32("10.0.0.50"),
+                                 ip_to_u32(dns), 40000, 53, b"q")
+        tcp = packets.tcp_packet(self.SUB, self.GW, ip_to_u32("10.0.0.50"),
+                                 ip_to_u32(dns), 40000, 53)
+        assert packets.decode(udp).proto == 17
+        assert packets.decode(tcp).proto == 6
+        assert not m.should_redirect(*_wire_view(udp))
+        assert not m.should_redirect(*_wire_view(tcp))

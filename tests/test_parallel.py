@@ -768,6 +768,10 @@ class TestClusterPPPoE:
                               payload=codec.ppp_frame(P.PPP_IPV4,
                                                       inner)).encode())
 
+    @pytest.mark.slow  # the pppoe_enabled sharded fused step is its
+    # own ~20s compile used by this test alone; decap/SNAT device
+    # semantics stay in tier-1 via test_pppoe_ops and the PPPoE
+    # steering law via test_native_and_python_steering_agree_on_pppoe
     def test_steering_and_device_data_path(self):
         from bng_tpu.control.pppoe import codec
 

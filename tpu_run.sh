@@ -68,6 +68,15 @@ step "config6-ab-pallas" 900 "BNG_TABLE_IMPL=pallas python bench.py --config 6"
 step "express-ab"    1200 "python bench.py --express-ab"
 step "express-ab-pallas" 1200 "BNG_TABLE_IMPL=pallas python bench.py --express-ab"
 
+# Device-resident serving loop (ISSUE 18): --express-ab is three-way
+# (aot / devloop / jit) with the ring at the default k=8 above; sweep
+# the remaining k points so PERF_NOTES §20's CPU k-curve gets its
+# on-chip twin. Every line lands in its own express_loop=devloop
+# ledger cohort (the gate refuses cross-loop trends with rc=3).
+step "devloop-k1"    900  "BNG_DEVLOOP_K=1 python bench.py --express-ab"
+step "devloop-k4"    900  "BNG_DEVLOOP_K=4 python bench.py --express-ab"
+step "devloop-k16"   900  "BNG_DEVLOOP_K=16 python bench.py --express-ab"
+
 # Host serving-loop A/B (ISSUE 14): scalar per-frame vs vectorized
 # batch-native host path feeding real chips — both summed-host-stage
 # cohorts land under distinct host_path identities, and the recorded

@@ -1,21 +1,45 @@
 #!/bin/bash
-# Capture-on-return watcher (VERDICT r3 item 1): probe the axon tunnel on a
-# long backoff for the whole unattended window; the moment it answers, run
-# the full tpu_run.sh validation sequence.  Exits after a completed window
-# (/tmp/tpu_run.done) or when $TPU_WATCH_MAX_S elapses.
+# Capture-on-return supervisor (VERDICT r3 item 1, ISSUE 18): probe the
+# axon tunnel on a long backoff for the whole unattended window; the
+# moment it answers, run the queued tpu_run.sh campaign (table A/B,
+# autotune sweep, sharded headline, express-ab, host-ab, wire-ab,
+# devloop k-sweep).  Exits after a completed window (/tmp/tpu_run.done)
+# or when $TPU_WATCH_MAX_S elapses.
 #
-# Probes are `timeout`-bounded subprocesses: a dead tunnel costs one child
-# per attempt and can never wedge the watcher (PERF_NOTES §3.5 — a stuck
-# client can wedge the relay; always kill, never block).
+# Probes are `timeout`-bounded subprocesses: a dead tunnel costs one
+# child per attempt and can never wedge the watcher (PERF_NOTES §3.5 —
+# a stuck client can wedge the relay; always kill, never block).
+#
+# Artifacts are archived after EVERY campaign attempt and again on any
+# watcher exit (trap), so a window that closes mid-campaign still
+# leaves its partial ledger lines, bench JSON, flight-record dumps and
+# transcripts in a timestamped directory — partial hardware numbers
+# beat none, but only if they survive the tunnel.
 set -u
 cd "$(dirname "$0")"
 LOG=${TPU_WATCH_LOG:-/tmp/tpu_watch.log}
+RUN_LOG=${TPU_RUN_LOG:-/tmp/tpu_validation.log}
 MAX_S=${TPU_WATCH_MAX_S:-39600}   # default: an 11 h round window
 SLEEP_S=${TPU_WATCH_SLEEP_S:-150}
+ARCHIVE_ROOT=${TPU_WATCH_ARCHIVE:-/tmp/tpu_artifacts}
+DEST="$ARCHIVE_ROOT/$(date -u +%Y%m%dT%H%M%SZ)"
 START=$(date +%s)
+
+archive() {
+  mkdir -p "$DEST"
+  cp -f "$LOG" "$RUN_LOG" "$DEST/" 2>/dev/null
+  cp -f bench_runs.jsonl "$DEST/" 2>/dev/null
+  cp -f BENCH_*.json "$DEST/" 2>/dev/null
+  FLIGHT_DIR=${BNG_TRACE_DIR:-${TMPDIR:-/tmp}/bng-flightrec}
+  [ -d "$FLIGHT_DIR" ] && cp -rf "$FLIGHT_DIR" "$DEST/flightrec" 2>/dev/null
+  [ -f /tmp/tpu_run.done ] && cp -f /tmp/tpu_run.done "$DEST/" 2>/dev/null
+  echo "artifacts -> $DEST ($(date -u +%H:%M:%S))" | tee -a "$LOG"
+}
+trap archive EXIT
+
 # a done-marker from a PREVIOUS round must not satisfy this watch
 rm -f /tmp/tpu_run.done
-echo "watch start $(date -u +%H:%M:%S) max=${MAX_S}s" | tee -a "$LOG"
+echo "watch start $(date -u +%H:%M:%S) max=${MAX_S}s archive=$DEST" | tee -a "$LOG"
 while true; do
   if [ -f /tmp/tpu_run.done ]; then
     echo "tpu_run.done present; watcher exiting $(date -u +%H:%M:%S)" | tee -a "$LOG"
@@ -30,8 +54,11 @@ while true; do
     bash tpu_run.sh >>"$LOG" 2>&1
     rc=$?
     echo "tpu_run.sh rc=$rc $(date -u +%H:%M:%S)" | tee -a "$LOG"
-    # rc=0: full window captured.  Non-zero: tunnel died mid-run — keep
-    # watching; a later window can still finish the remaining configs.
+    # archive THIS attempt's artifacts now: rc!=0 means the tunnel died
+    # mid-campaign, and the next window may never open
+    archive
+    # rc=0: full window captured.  Non-zero: keep watching; a later
+    # window can still finish the remaining configs.
     [ $rc -eq 0 ] && exit 0
   fi
   sleep "$SLEEP_S"
