@@ -148,15 +148,15 @@ PYTEST_FLAGS = -q --continue-on-collection-errors -p no:cacheprovider \
         verify-telemetry verify-static verify-sanitize verify-ops \
         verify-storm verify-perf verify-kernels verify-sharded \
         verify-express verify-hostpath verify-wire verify-cluster \
-        verify-edge verify-devloop
+        verify-edge verify-devloop verify-fabric
 
 verify: verify-static verify-storm verify-perf verify-kernels \
         verify-sharded verify-express verify-hostpath verify-wire \
-        verify-cluster verify-edge verify-devloop
+        verify-cluster verify-edge verify-devloop verify-fabric
 	set -o pipefail; rm -f /tmp/_t1.log; \
 	timeout -k 10 $(TIER1_TIMEOUT) env JAX_PLATFORMS=cpu \
 	$(PY) -m pytest tests/ $(PYTEST_FLAGS) \
-	-m 'not slow and not storm and not perf and not kernels and not sharded and not express and not hostpath and not wire and not cluster and not edge and not devloop' \
+	-m 'not slow and not storm and not perf and not kernels and not sharded and not express and not hostpath and not wire and not cluster and not edge and not devloop and not fabric' \
 	2>&1 | tee /tmp/_t1.log
 
 verify-sharded:
@@ -221,6 +221,13 @@ verify-devloop:
 	  -m 'devloop' \
 	&& echo "verify-devloop OK"
 
+verify-fabric:
+	set -o pipefail; \
+	timeout -k 10 60 env JAX_PLATFORMS=cpu \
+	$(PY) -m pytest tests/test_fabric.py $(PYTEST_FLAGS) \
+	  -m 'fabric and not slow' \
+	&& echo "verify-fabric OK"
+
 verify-kernels:
 	set -o pipefail; \
 	timeout -k 10 240 env JAX_PLATFORMS=cpu \
@@ -244,7 +251,7 @@ verify-all: verify verify-slow
 
 verify-chaos:
 	set -o pipefail; \
-	timeout -k 10 180 env JAX_PLATFORMS=cpu \
+	timeout -k 10 300 env JAX_PLATFORMS=cpu \
 	$(PY) -m pytest tests/test_chaos.py $(PYTEST_FLAGS) -m 'chaos and not slow'
 	set -o pipefail; \
 	timeout -k 10 360 env JAX_PLATFORMS=cpu \
@@ -254,7 +261,7 @@ verify-chaos:
 	&& test -s /tmp/_chaos_a.json \
 	&& cmp /tmp/_chaos_a.json /tmp/_chaos_b.json \
 	&& echo "verify-chaos OK: report bit-deterministic (incl. the 4 \
-	transition scenarios + 5 full-scale storms)" \
+	transition scenarios, 2 fabric scenarios + 5 full-scale storms)" \
 	|| { echo "verify-chaos FAILED: scenario failure or same-seed \
 	reports differ"; exit 1; }
 

@@ -39,6 +39,7 @@ MUTATORS = {
     "arm_tap", "disarm_tap", "set_tap_filters",
     "set_route", "clear_route",
     "fill_slot", "adopt_cursors",
+    "watch", "reset", "reset_peer",
 }
 
 # writer modules (path suffix -> why it is allowed to write)
@@ -79,6 +80,13 @@ ALLOWED_WRITERS = {
                                "fills at admission, cursor adoption at "
                                "retire — a writer outside the pump "
                                "bypasses the quiesce/audit story",
+    "bng_tpu/cluster/coordinator.py": "fabric membership authority "
+                                      "(ISSUE 19): watches slots on "
+                                      "plan apply, resets the view + "
+                                      "transport replay floor on "
+                                      "promote — a second writer "
+                                      "desyncs verdicts from the HA "
+                                      "ladder",
 }
 
 # receiver names that mark the call as a fast-path table mutation
@@ -87,6 +95,7 @@ TABLE_RECEIVERS = {
     "fastpath", "tables", "sub", "vlan", "cid", "bindings", "subscribers",
     "qos", "up", "down", "antispoof", "garden", "pppoe", "by_sid", "by_ip",
     "edge", "tap", "route", "ring", "devloop", "cursors",
+    "fabric_detector", "fabric_transport",
 }
 
 

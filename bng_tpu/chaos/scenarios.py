@@ -52,6 +52,14 @@ Scenario list:
                               megakernel dispatch failure; reply bytes
                               must match a fault-free control sweep and
                               the ring cursor audit must close clean
+    cluster_partial_partition sever exactly the a<->b fabric link while
+                              both still reach c (NEAT): mutual
+                              suspicion but no accusation quorum, so no
+                              demotion, no failover, no double-carve
+    cluster_gray_member       a member beats perfectly but its serving
+                              word stalls: GRAY verdict off its own
+                              signed beats, standby promotes, the
+                              flash crowd re-DORAs sticky
 """
 
 from __future__ import annotations
@@ -1386,6 +1394,264 @@ def devloop_storm(seed: int) -> dict:
     return out_rep
 
 
+# ---------------------------------------------------------------------------
+# 13. cluster partial partition: no quorum, no demotion, no double-carve
+# ---------------------------------------------------------------------------
+
+def cluster_partial_partition(seed: int) -> dict:
+    """The NEAT shape (Alquraan OSDI'18) on the cluster control fabric:
+    three members beat over a SimTransport mesh, then the a<->b link is
+    severed while BOTH still reach c. a and b accuse each other, but c
+    accuses neither — no quorum forms on either side, so nobody is
+    demoted to down, the coordinator fails nothing over, and the carve
+    plan keeps one owner per block (no double-carve). Service continues
+    through the split (renewals ACK cluster-wide), and when the link
+    heals both suspicion episodes close as observed partitions."""
+    from bng_tpu.cluster import ClusterCoordinator
+    from bng_tpu.cluster.fabric import FailureDetector, SimTransport
+
+    n_macs = 36
+    clock = SimClock()
+    ids = ["bng-a", "bng-b", "bng-c"]
+    hub = SimTransport(clock, seed=seed)
+    dets: dict = {}
+    for nid in ids:
+        ep = hub.endpoint(nid)
+        for peer in ids:
+            if peer != nid:
+                ep.add_peer(peer)
+        # mesh quorum: observers of X are the 2 others -> majority 2
+        dets[nid] = FailureDetector(nid, ep, clock=clock,
+                                    beat_interval_s=0.5,
+                                    suspicion_threshold=3,
+                                    startup_grace_s=0.0)
+    for nid in ids:
+        for peer in ids:
+            if peer != nid:
+                dets[nid].watch(peer, now=clock())
+
+    # the data plane the fabric protects: an inline cluster serving
+    # leases under the same member names
+    coord = ClusterCoordinator(
+        clock=clock, sub_nbuckets=512, slice_size=64,
+        space_network=ip_to_u32("10.80.0.0"), space_prefix_len=16)
+    coord.add_instances(ids)
+    macs = [_mac((seed % 89) * 100 + i) for i in range(n_macs)]
+    leased = dora_with_retries(coord, macs, clock)
+    epoch_before = coord.plan.epoch
+
+    counters = {nid: 0 for nid in ids}
+
+    def fabric_round(rounds: int) -> None:
+        for _ in range(rounds):
+            for nid in ids:
+                counters[nid] += 1
+                dets[nid].beat(served=counters[nid], work=counters[nid])
+            for nid in ids:
+                dets[nid].tick(clock())
+            clock.advance(0.5)
+
+    fabric_round(4)  # warm: everyone sees everyone up
+    warm_ok = all(v.state == "up"
+                  for d in dets.values() for v in d.views.values())
+
+    hub.partition("bng-a", "bng-b")
+    fabric_round(8)  # 4s of split: 3-beat suspicion windows expire
+
+    # the quorum ledger mid-split, per observer
+    states_during = {nid: {p: v.state
+                           for p, v in sorted(dets[nid].views.items())}
+                     for nid in ids}
+    accusers_at_c = {p: sorted(v.accused_by)
+                     for p, v in sorted(dets["bng-c"].views.items())}
+    down_verdicts = sum(d.verdicts["down"] for d in dets.values())
+    # a coordinator acting on the fabric would only carve out members
+    # the detector demoted to DOWN; none were, so nothing is killed
+    for nid in ids:
+        for peer, v in dets[nid].views.items():
+            if v.state == "down":
+                coord.kill_instance(peer)
+    for _ in range(4):
+        clock.advance(1.0)
+        coord.tick()
+
+    # service through the split: every subscriber renews, cluster-wide
+    out = coord.handle_batch(
+        [(k, _renew(m, leased[m], 0x50000 + k))
+         for k, m in enumerate(macs)], now=clock())
+    renew_acks = sum(
+        1 for (_l, rep), m in zip(out, macs)
+        if rep is not None and _reply(rep).msg_type == dhcp_codec.ACK
+        and _reply(rep).yiaddr == leased[m])
+
+    hub.heal_all()
+    fabric_round(6)
+    healed_ok = all(v.state == "up"
+                    for d in dets.values() for v in d.views.values())
+    partitions_observed = sum(
+        v.partitions_observed
+        for d in dets.values() for v in d.views.values())
+
+    audit = audit_invariants(bng_cluster=coord)
+    out_rep = {
+        "name": "cluster_partial_partition", "seed": seed,
+        "instances": len(ids),
+        "leased": len(leased),
+        "warm_ok": warm_ok,
+        "states_during": states_during,
+        "accusers_at_c": accusers_at_c,
+        "down_verdicts": down_verdicts,
+        "failovers": coord.failovers,
+        "epoch_before": epoch_before,
+        "epoch_after": coord.plan.epoch,
+        "renew_acks": renew_acks,
+        "healed_ok": healed_ok,
+        "partitions_observed": partitions_observed,
+        "link_cut_datagrams": hub.stats["cut"],
+        "audit_ok": audit.ok,
+        "violations": audit.violations_by_kind(),
+    }
+    coord.close()
+    out_rep["ok"] = (
+        out_rep["leased"] == n_macs and warm_ok
+        # each split side suspects the other; the common neighbour
+        # keeps both up — the quorum evidence that blocks demotion
+        and states_during["bng-a"]["bng-b"] == "suspect"
+        and states_during["bng-b"]["bng-a"] == "suspect"
+        and states_during["bng-c"] == {"bng-a": "up", "bng-b": "up"}
+        and accusers_at_c == {"bng-a": ["bng-b"], "bng-b": ["bng-a"]}
+        and down_verdicts == 0
+        and out_rep["failovers"] == 0
+        and out_rep["epoch_after"] == epoch_before
+        and renew_acks == n_macs
+        and healed_ok and partitions_observed >= 2
+        and out_rep["link_cut_datagrams"] > 0
+        and audit.ok)
+    return out_rep
+
+
+# ---------------------------------------------------------------------------
+# 14. cluster gray member: beating but not serving -> demoted, sticky re-DORA
+# ---------------------------------------------------------------------------
+
+def cluster_gray_member(seed: int) -> dict:
+    """Gray failure (Huang HotOS'17) through the fabric detector: a
+    member keeps beating — its heartbeats are perfect — but its
+    serving-health word stalls (work accepted keeps climbing, replies
+    produced does not). The detector reads the stall off the member's
+    own signed beats, issues a GRAY verdict with no quorum needed, the
+    HA probe goes false, and the standby promotes exactly as if the
+    member had died. The wedged member's subscribers re-DORA sticky
+    onto the promoted standby (original addresses), and the healthy
+    member never flaps."""
+    from bng_tpu.cluster import ClusterCoordinator, instance_for_mac
+    from bng_tpu.cluster.fabric import SimTransport
+
+    n_macs = 32
+    clock = SimClock()
+    hub = SimTransport(clock, seed=seed)
+    ids = ["bng-a", "bng-b"]
+    coord = ClusterCoordinator(
+        clock=clock, sub_nbuckets=512, slice_size=64,
+        space_network=ip_to_u32("10.96.0.0"), space_prefix_len=16,
+        fabric_endpoint=hub.endpoint("coordinator"),
+        fabric_beat_interval_s=0.5, fabric_gray_beats=4,
+        fabric_startup_grace_s=2.0,
+        ha_probe_interval_s=0.5, ha_failure_threshold=2,
+        ha_failover_delay_s=1.0)
+    coord.add_instances(ids)
+    # inline members do not beat on their own (the flag oracle serves
+    # them); this scenario IS the fabric lane, so watch them and speak
+    # their beats from the sim endpoints
+    eps = {}
+    for iid in ids:
+        coord.fabric_detector.watch(iid, now=clock())
+        eps[iid] = hub.endpoint(iid)
+        eps[iid].add_peer("coordinator")
+
+    macs = [_mac((seed % 89) * 100 + i) for i in range(n_macs)]
+    leased = dora_with_retries(coord, macs, clock)
+    victim = ids[seed % len(ids)]
+    healthy = next(i for i in ids if i != victim)
+    victim_macs = [m for m in macs if instance_for_mac(m, ids) == victim]
+
+    served = {iid: 0 for iid in ids}
+    work = {iid: 0 for iid in ids}
+
+    def beat_round(wedged: str = "") -> None:
+        for iid in ids:
+            work[iid] += 8  # batches keep arriving either way
+            if iid != wedged:
+                served[iid] += 8  # ...but only healthy members reply
+            eps[iid].send("coordinator", "beat",
+                          {"served": served[iid], "work": work[iid],
+                           "accuse": []})
+        coord.tick(clock())
+        clock.advance(0.5)
+
+    for _ in range(4):
+        beat_round()
+    warm_states = {p: v["state"] for p, v in
+                   coord.fabric_detector.status()["peers"].items()}
+
+    # wedge: the victim's replies stop while its intake keeps climbing
+    rounds = 0
+    while coord.members[victim].role != "promoted" and rounds < 40:
+        beat_round(wedged=victim)
+        rounds += 1
+    promoted = coord.members[victim].role == "promoted"
+    gray_events = [e for e in coord.fabric_events if e == (victim, "gray")]
+
+    # the promoted slot is fresh (detector view was reset): beats
+    # resume with a healthy serving word and it must read up again
+    for _ in range(4):
+        beat_round()
+    post_state = coord.fabric_detector.views[victim].state
+
+    # the wedged member's flash crowd lands on the promoted standby
+    # and must keep its addresses (replicated books = sticky re-DORA)
+    out = coord.handle_batch(
+        [(k, _renew(m, leased[m], 0x60000 + k))
+         for k, m in enumerate(victim_macs)], now=clock())
+    sticky = sum(
+        1 for (_l, rep), m in zip(out, victim_macs)
+        if rep is not None and _reply(rep).msg_type == dhcp_codec.ACK
+        and _reply(rep).yiaddr == leased[m])
+
+    audit = audit_invariants(bng_cluster=coord)
+    out_rep = {
+        "name": "cluster_gray_member", "seed": seed,
+        "victim": victim,
+        "leased": len(leased),
+        "victim_subs": len(victim_macs),
+        "warm_states": warm_states,
+        "promoted": promoted,
+        "gray_verdicts": coord.fabric_detector.verdicts["gray"],
+        "gray_events": [list(e) for e in gray_events],
+        "failovers": coord.failovers,
+        "healthy_role": coord.members[healthy].role,
+        "healthy_state": coord.fabric_detector.views[healthy].state,
+        "post_promote_state": post_state,
+        "sticky_acks": sticky,
+        "audit_ok": audit.ok,
+        "violations": audit.violations_by_kind(),
+    }
+    coord.close()
+    out_rep["ok"] = (
+        out_rep["leased"] == n_macs
+        and out_rep["victim_subs"] > 0
+        and warm_states == {"bng-a": "up", "bng-b": "up"}
+        and promoted and out_rep["failovers"] == 1
+        and out_rep["gray_verdicts"] >= 1
+        and len(gray_events) >= 1
+        and out_rep["healthy_role"] == "active"
+        and out_rep["healthy_state"] == "up"
+        and post_state == "up"
+        and sticky == out_rep["victim_subs"]
+        and audit.ok)
+    return out_rep
+
+
 SCENARIOS = {
     "dora_worker_crash": dora_worker_crash,
     "corrupt_restore_cold_start": corrupt_restore_cold_start,
@@ -1400,4 +1666,6 @@ SCENARIOS = {
     "route_flap_rewrite": route_flap_rewrite,
     "cluster_failover_redora": cluster_failover_redora,
     "devloop_storm": devloop_storm,
+    "cluster_partial_partition": cluster_partial_partition,
+    "cluster_gray_member": cluster_gray_member,
 }

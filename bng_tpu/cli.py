@@ -595,15 +595,16 @@ class BNGApp:
 
         # 5. RADIUS (main.go:946-973)
         authenticator = None
+        radius_server_cfgs: list = []  # picklable, reused by the fleet
         if cfg.radius_server:
             from bng_tpu.control.radius.client import (RadiusClient,
                                                        RadiusServerConfig)
             secret = resolve_secret(cfg.radius_secret, cfg.radius_secret_file)
             host, _, port = cfg.radius_server.partition(":")
-            radius = c["radius"] = RadiusClient(
-                servers=[RadiusServerConfig(host=host,
-                                            auth_port=int(port or 1812),
-                                            secret=secret.encode())])
+            radius_server_cfgs = [RadiusServerConfig(
+                host=host, auth_port=int(port or 1812),
+                secret=secret.encode())]
+            radius = c["radius"] = RadiusClient(servers=radius_server_cfgs)
 
             def authenticator(username="", password="", mac=b"",
                               circuit_id=b"", **kw):
@@ -1043,20 +1044,22 @@ class BNGApp:
         # slices carved from the parent pools and relay table writes
         # back through the single-writer drain; non-DHCPv4 slow frames
         # (v6/SLAAC/PPPoE) stay on the parent demux via the fallback.
-        # Integrations that live on the parent's per-lease state (RADIUS
-        # auth, Nexus allocation, CoA lease lookups) are not yet
-        # fleet-aware: with any of them configured the fleet is skipped
-        # so no integration silently degrades. HA is fleet-aware: the
-        # fleet's lease_hook relays worker lease events through the
-        # active's syncer push (same single-writer replay discipline as
-        # the worker TableEventLog), so `ha` left the blocker list.
+        # Integrations that live on the parent's per-lease state (Nexus
+        # allocation, PPPoE) are not yet fleet-aware: with any of them
+        # configured the fleet is skipped so no integration silently
+        # degrades. Fleet-aware and OFF the blocker list: `ha` (worker
+        # lease events relay through the active's syncer push), `radius`
+        # (per-worker RadiusClient on the MAC steering hash — ISSUE 19,
+        # accounting start/stop riding the same lease-event relay, CoA
+        # routed to the owning shard), and `peer-pool` (parent-side
+        # only: it mounts on the cluster HTTP server and health-checks
+        # in tick — it never sits in the DHCP allocation path).
         self.fleet_blockers: list[str] = []
         if cfg.slowpath_workers > 1:
             blockers = [name for flag, name in (
-                (cfg.radius_server, "radius"), (cfg.nexus_url, "nexus"),
+                (cfg.nexus_url, "nexus"),
                 (cfg.pppoe_enabled, "pppoe"),
-                (cfg.shards > 1, "sharded"),
-                (cfg.peer_pool_cidr, "peer-pool")) if flag]
+                (cfg.shards > 1, "sharded")) if flag]
             if blockers:
                 # more than a log line: the degradation is exported as
                 # bng_slowpath_fleet_blocked (step 13), surfaced in the
@@ -1073,12 +1076,28 @@ class BNGApp:
                 from bng_tpu.control.fleet import FleetSpec, SlowPathFleet
                 from bng_tpu.control.ha import SessionState as _HAState
 
-                def _fleet_ha_lease(event, lease, sid, _c=c):
+                def _fleet_ha_lease(event, lease, sid, _c=c, _acct=acct):
                     # late-bound: HA (step 11) builds AFTER the fleet,
                     # so the hook reads c["ha"] at event time. Worker
                     # lease events ride the drained TableEventLog into
                     # this single-writer seam — push_change here is the
-                    # fleet-side twin of the parent _ha_lease closure.
+                    # fleet-side twin of the parent _ha_lease closure,
+                    # and accounting start/stop the _acct_lease twin
+                    # (octets stay device-authoritative: the tick bridge
+                    # folds NAT counters by framed_ip, which is disjoint
+                    # per shard, so per-shard folding is exact).
+                    if _acct is not None:
+                        from bng_tpu.utils.net import u32_to_ip as _uip
+                        if event == "start":
+                            _acct.start(
+                                sid, username=lease.get("username")
+                                or _uip(lease["ip"]),
+                                framed_ip=lease["ip"],
+                                mac="-".join(
+                                    f"{b:02X}" for b in
+                                    bytes.fromhex(lease["mac"])))
+                        elif event == "stop":
+                            _acct.stop(sid)
                     ha_sync = _c.get("ha")
                     if ha_sync is None or not hasattr(ha_sync,
                                                       "push_change"):
@@ -1096,11 +1115,18 @@ class BNGApp:
                             updated_at=self.clock()))
 
                 fallback = c.get("slowpath") or dhcp.handle_frame
+                fspec = FleetSpec.from_pool_manager(
+                    parse_mac(cfg.server_mac), ip_to_u32(cfg.server_ip),
+                    pool_mgr, slice_size=cfg.slowpath_slice,
+                    low_watermark=max(1, cfg.slowpath_slice // 4))
+                if radius_server_cfgs:
+                    # per-worker RADIUS sockets on the MAC steering
+                    # hash (ISSUE 19): auth affinity = DHCP affinity
+                    fspec.radius_servers = list(radius_server_cfgs)
+                    fspec.radius_nas_id = cfg.node_id or "bng-tpu"
+                    fspec.radius_nas_ip = ip_to_u32(cfg.server_ip)
                 fleet = c["fleet"] = SlowPathFleet(
-                    FleetSpec.from_pool_manager(
-                        parse_mac(cfg.server_mac), ip_to_u32(cfg.server_ip),
-                        pool_mgr, slice_size=cfg.slowpath_slice,
-                        low_watermark=max(1, cfg.slowpath_slice // 4)),
+                    fspec,
                     n_workers=cfg.slowpath_workers, pools=pool_mgr,
                     mode=cfg.slowpath_worker_mode,
                     admission=AdmissionConfig(
@@ -1127,6 +1153,12 @@ class BNGApp:
             from bng_tpu.utils.net import mac_to_u64
 
             pppoe_srv = c.get("pppoe")
+            # fleet-aware CoA (ISSUE 19): DHCPv4 leases live in the
+            # workers when the fleet serves — the locators probe the
+            # parent books first (PPPoE and non-fleet leases), then
+            # route to the owning shard on the same MAC steering hash
+            # (relay counted by the fleet when missteered)
+            fleet_coa = c.get("fleet")
 
             def _find_by_ip(ip):
                 for lease in dhcp.leases.values():
@@ -1136,6 +1168,10 @@ class BNGApp:
                     for s in pppoe_srv.sessions.all():
                         if s.assigned_ip == ip:
                             return ("pppoe", s)
+                if fleet_coa is not None:
+                    r = fleet_coa.handle_coa("locate", ip=ip)
+                    if r["found"]:
+                        return ("fleet", r)
                 return None
 
             def _find_by_sid(sid):
@@ -1151,6 +1187,10 @@ class BNGApp:
                     s = pppoe_srv.sessions.get(num)
                     if s is not None:
                         return ("pppoe", s)
+                if fleet_coa is not None and not sid.startswith("pppoe-"):
+                    r = fleet_coa.handle_coa("locate", session_id=sid)
+                    if r["found"]:
+                        return ("fleet", r)
                 return None
 
             def _find_by_mac(mac_str):
@@ -1166,6 +1206,10 @@ class BNGApp:
                     for s in pppoe_srv.sessions.all():
                         if s.client_mac == mac:
                             return ("pppoe", s)
+                if fleet_coa is not None:
+                    r = fleet_coa.handle_coa("locate", mac=mac)
+                    if r["found"]:
+                        return ("fleet", r)
                 return None
 
             def _coa_qos(ip, policy_name):
@@ -1183,6 +1227,11 @@ class BNGApp:
                     if dhcp.accounting_hook is not None:
                         dhcp.accounting_hook("renew", lease,
                                              lease.session_id)
+                elif fleet_coa is not None:
+                    # the owning shard mutates its own lease; the renew
+                    # event rides the drained relay into HA/accounting
+                    fleet_coa.handle_coa("qos", ip=ip,
+                                         policy_name=policy_name)
                 return True
 
             def _coa_disconnect(handle):
@@ -1191,6 +1240,9 @@ class BNGApp:
                     obj.expiry = 0
                     dhcp.cleanup_expired(1)  # reaps only the forced lease
                     return True
+                if kind == "fleet":
+                    r = fleet_coa.handle_coa("disconnect", ip=obj["ip"])
+                    return bool(r["found"])
                 from bng_tpu.control.pppoe.session import TerminateCause
 
                 frames = pppoe_srv.terminate(
@@ -1211,7 +1263,12 @@ class BNGApp:
                 kind, obj = found
                 h = _CoASession()
                 h.kind, h.obj = kind, obj
-                h.ip = obj.ip if kind == "dhcp" else obj.assigned_ip
+                if kind == "dhcp":
+                    h.ip = obj.ip
+                elif kind == "fleet":
+                    h.ip = obj["ip"]
+                else:
+                    h.ip = obj.assigned_ip
                 return h
 
             def _locked(fn):
@@ -2875,6 +2932,48 @@ def run_cluster(args) -> int:
               file=sys.stderr)
         return 2
 
+    # -- cluster join ------------------------------------------------
+    # announce this host into a running coordinator's carve over the
+    # fabric (ISSUE 19): one join datagram, then beats — the hub adds
+    # us as a remote member on the plan's host axis
+    if args.join:
+        import socket as _socket
+
+        from bng_tpu.cluster.coordinator import DEFAULT_FABRIC_PSK
+        from bng_tpu.cluster.fabric import UDPTransport
+        from bng_tpu.control.deviceauth import PSKAuthenticator
+
+        host_s, _, port_s = args.join.rpartition(":")
+        try:
+            hub = (host_s or "127.0.0.1", int(port_s))
+        except ValueError:
+            print(f"cluster run: bad --join {args.join!r} "
+                  f"(want HOST:PORT)", file=sys.stderr)
+            return 2
+        hostname = _socket.gethostname()
+        node_id = args.node_id or f"bng-{hostname}"
+        ep = UDPTransport(node_id, PSKAuthenticator(
+            psk=args.fabric_psk or DEFAULT_FABRIC_PSK))
+        try:
+            ep.add_peer("coordinator", hub)
+            ep.send("coordinator", "join",
+                    {"instance_id": node_id, "host": hostname})
+            print(f"cluster join: announced {node_id} (host {hostname}) "
+                  f"to {hub[0]}:{hub[1]}; beating", file=sys.stderr)
+            beats = 0
+            try:
+                while True:
+                    ep.send("coordinator", "beat",
+                            {"served": 0, "work": 0, "accuse": []})
+                    beats += 1
+                    if args.once and beats >= 3:
+                        return 0
+                    time.sleep(0.5)
+            except KeyboardInterrupt:
+                return 0
+        finally:
+            ep.close()
+
     # -- cluster run -------------------------------------------------
     from bng_tpu.cluster import ClusterCoordinator
     from bng_tpu.control.metrics import BNGMetrics
@@ -2886,12 +2985,31 @@ def run_cluster(args) -> int:
         print(f"cluster run: bad --space {args.space!r}: {e}",
               file=sys.stderr)
         return 2
+    fabric_bind: tuple = ("127.0.0.1", 0)
+    if args.listen:
+        lh, _, lp = args.listen.rpartition(":")
+        try:
+            fabric_bind = (lh or "127.0.0.1", int(lp))
+        except ValueError:
+            print(f"cluster run: bad --listen {args.listen!r} "
+                  f"(want HOST:PORT)", file=sys.stderr)
+            return 2
+    # the fabric lane rides --listen or process mode (process members
+    # beat over UDP; inline members stay on the in-process oracle
+    # unless a hub address asks for remote joiners)
+    use_fabric = bool(args.listen) or args.mode == "process"
     coord = ClusterCoordinator(
         mode=args.mode, space_network=space_net,
         space_prefix_len=space_plen,
         nat_base=ip_to_u32(args.nat_base) if args.nat_base else 0,
         nat_total=args.nat_total, n_workers=args.workers,
-        sub_nbuckets=args.sub_nbuckets)
+        sub_nbuckets=args.sub_nbuckets,
+        fabric=use_fabric, fabric_psk=args.fabric_psk,
+        fabric_bind=fabric_bind)
+    if use_fabric and coord.fabric_transport is not None:
+        fa = coord.fabric_transport.addr
+        print(f"cluster fabric: listening on {fa[0]}:{fa[1]}",
+              file=sys.stderr)
     metrics = BNGMetrics()
     try:
         coord.add_instances([f"bng-{i:02d}" for i in range(args.instances)])
@@ -3231,6 +3349,23 @@ def main(argv: list[str] | None = None) -> int:
     clrun.add_argument("--checkpoint-out", default="",
                        help="write a checkpoint carrying the carve "
                             "plan to this file")
+    # ISSUE 19: the cluster control fabric (UDP membership lane)
+    clrun.add_argument("--listen", default="",
+                       help="HOST:PORT for the fabric hub: process "
+                            "members beat here over authenticated UDP "
+                            "and remote `--join`ers announce themselves "
+                            "(process mode; port 0 = ephemeral)")
+    clrun.add_argument("--join", default="",
+                       help="HOST:PORT of a running coordinator's "
+                            "--listen: join its carve as a remote "
+                            "member and beat instead of serving locally")
+    clrun.add_argument("--fabric-psk", default="",
+                       help="pre-shared key authenticating fabric "
+                            "datagrams (>=16 chars; default: the dev "
+                            "PSK — set your own off-box)")
+    clrun.add_argument("--node-id", default="",
+                       help="member id to announce when --join'ing "
+                            "(default bng-<hostname>)")
     clstat = clu_sub.add_parser(
         "status", help="print cluster status: the carve plan from a "
                        "checkpoint, or a status file a run wrote")

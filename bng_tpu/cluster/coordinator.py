@@ -38,8 +38,11 @@ from bng_tpu.control.ha import (ActiveSyncer, FailoverController,
                                 HealthMonitor, InMemorySessionStore, Role,
                                 StandbySyncer)
 from bng_tpu.control.nexus import MemoryStore, TypedStore
+from bng_tpu.telemetry import spans as tele
+from bng_tpu.telemetry.recorder import TRIG_MEMBER_SUSPECT
 from bng_tpu.utils.net import ip_to_u32
 
+from .fabric import FailureDetector
 from .instance import InlineInstance, InstanceSpec, ProcessInstance
 from .plan import (ClusterPlan, InstancePlan, elect_carver, initial_plan,
                    instance_for_mac, replan)
@@ -49,6 +52,10 @@ _PLAN_KEY = "cluster/plan"
 
 DEFAULT_SERVER_MAC = bytes.fromhex("02aabbccdd01")
 DEFAULT_SERVER_IP = ip_to_u32("10.0.0.1")
+
+# dev/test PSK for the fabric when the operator supplies none; a real
+# multi-host deployment passes its own via `bng cluster run --fabric-psk`
+DEFAULT_FABRIC_PSK = "bng-cluster-fabric-dev-psk"
 
 
 @dataclass
@@ -70,6 +77,8 @@ class _Member:
         self.instance = None  # InlineInstance | ProcessInstance | None
         self.alive = True
         self.role = "active"  # active | promoted
+        self.remote = False   # fabric-joined, served on another host
+        self.host = ""
         self.store: InMemorySessionStore | None = None
         self.syncer: ActiveSyncer | None = None
         self.standby_store: InMemorySessionStore | None = None
@@ -100,7 +109,15 @@ class ClusterCoordinator:
                  sub_nbuckets: int = 0, lease_time: int = 3600,
                  ha_failover_delay_s: float = 2.0,
                  ha_probe_interval_s: float = 0.5,
-                 ha_failure_threshold: int = 3):
+                 ha_failure_threshold: int = 3,
+                 fabric: bool = False,
+                 fabric_psk: str = "",
+                 fabric_bind: tuple = ("127.0.0.1", 0),
+                 fabric_endpoint=None,
+                 fabric_beat_interval_s: float = 0.5,
+                 fabric_suspicion_threshold: int = 3,
+                 fabric_gray_beats: int = 4,
+                 fabric_startup_grace_s: float = 30.0):
         if mode not in ("inline", "process"):
             raise ValueError(f"cluster mode {mode!r}: expected "
                              f"'inline' or 'process'")
@@ -133,6 +150,34 @@ class ClusterCoordinator:
         self.refused_removes = 0
         self.shed_frames = 0
         self.steered: dict[str, int] = {}
+        self._hosts: dict[str, str] = {}
+        self.fabric_events: list = []  # last 64 (peer, verdict) pairs
+
+        # -- control fabric: the real-transport membership lane. The
+        # coordinator is the star hub — members beat TO it, so it is
+        # the sole observer and quorum is 1 (pipe-oracle semantics).
+        # `fabric_endpoint` injects a SimTransport endpoint for the
+        # deterministic chaos lane; `fabric=True` builds the UDP lane.
+        self.fabric_beat_interval_s = fabric_beat_interval_s
+        self.fabric_psk = fabric_psk or DEFAULT_FABRIC_PSK
+        self.fabric_transport = fabric_endpoint
+        self.fabric_detector: FailureDetector | None = None
+        if fabric and fabric_endpoint is None:
+            from bng_tpu.control.deviceauth import PSKAuthenticator
+
+            from .fabric import UDPTransport
+            self.fabric_transport = UDPTransport(
+                "coordinator", PSKAuthenticator(psk=self.fabric_psk),
+                bind=fabric_bind, clock=self.clock)
+        if self.fabric_transport is not None:
+            self.fabric_detector = FailureDetector(
+                "coordinator", self.fabric_transport, clock=self.clock,
+                beat_interval_s=fabric_beat_interval_s,
+                suspicion_threshold=fabric_suspicion_threshold,
+                gray_beats=fabric_gray_beats,
+                startup_grace_s=fabric_startup_grace_s, quorum=1,
+                on_verdict=self._on_fabric_verdict,
+                on_message=self._on_fabric_message)
 
         self._hold_recarve = False
         self.registry = TypedStore(self.store, _MEMBERS_PREFIX.rstrip("/"),
@@ -142,14 +187,17 @@ class ClusterCoordinator:
         self._cancel_plan = self.store.watch(_PLAN_KEY, self._on_plan)
 
     # -- membership -------------------------------------------------------
-    def add_instances(self, instance_ids: list) -> None:
+    def add_instances(self, instance_ids: list, host: str = "") -> None:
         """Register a founding (or joining) batch in one carve: blocks
         deal across the whole batch instead of the first registrant
-        swallowing the space."""
+        swallowing the space. `host` tags the batch's placement for the
+        plan's host axis (blocks interleave across hosts)."""
         for iid in instance_ids:
             if iid in self.members:
                 raise ValueError(f"instance {iid!r} already registered")
             self.members[iid] = _Member(iid)
+            self.members[iid].host = host
+            self._hosts[iid] = host
         # hold the carve until the whole batch registered: the founding
         # set must carve TOGETHER, or the first registrant's initial
         # plan swallows every block and the rest join empty-handed
@@ -166,8 +214,31 @@ class ClusterCoordinator:
             # unchanged -> no new epoch): build the instances anyway
             self._apply_plan()
 
-    def add_instance(self, instance_id: str) -> None:
-        self.add_instances([instance_id])
+    def add_instance(self, instance_id: str, host: str = "") -> None:
+        self.add_instances([instance_id], host=host)
+
+    def add_remote_instance(self, instance_id: str, host: str,
+                            addr: tuple | None = None) -> None:
+        """A fabric-joined member served on another host: it takes part
+        in the carve (its blocks interleave on the host axis) and the
+        failure detector watches its beats, but this coordinator builds
+        no local stack for it — frames steered its way are shed and
+        counted, because only the control plane spans hosts today (the
+        data path to a remote member is the ROADMAP's next rung)."""
+        if instance_id in self.members:
+            raise ValueError(f"instance {instance_id!r} already registered")
+        m = _Member(instance_id)
+        m.remote = True
+        m.host = host
+        self.members[instance_id] = m
+        self._hosts[instance_id] = host
+        if addr is not None and self.fabric_transport is not None:
+            self.fabric_transport.add_peer(instance_id, addr)
+        if self.fabric_detector is not None:
+            self.fabric_detector.watch(instance_id, now=self.clock())
+        self.registry.put(instance_id,
+                          InstanceEntity(id=instance_id,
+                                         joined_at=self.clock()))
 
     def remove_instance(self, instance_id: str, force: bool = False) -> bool:
         """Leave. Refused while the instance still holds leases — a
@@ -198,9 +269,9 @@ class ClusterCoordinator:
             new = initial_plan(self.space_network, self.space_prefix_len,
                                ids, block_prefix_len=self.block_prefix_len,
                                nat_base=self.nat_base,
-                               nat_total=self.nat_total)
+                               nat_total=self.nat_total, hosts=self._hosts)
         else:
-            new = replan(self.plan, ids)
+            new = replan(self.plan, ids, hosts=self._hosts)
             if new is self.plan:
                 return
         self.recarves += 1
@@ -220,11 +291,16 @@ class ClusterCoordinator:
     def _apply_plan(self) -> None:
         for iid, iplan in self.plan.members.items():
             m = self.members.get(iid)
-            if m is None or not iplan.blocks:
+            if m is None or m.remote or not iplan.blocks:
                 continue
             if m.instance is None:
                 m.spec = self._spec_for(iplan)
                 m.instance = self._build_instance(m.spec)
+                # only process members beat over the fabric; inline
+                # members stay on the in-process flag oracle (watching
+                # them would read their silence as failure)
+                if self.fabric_detector is not None and self.mode == "process":
+                    self.fabric_detector.watch(iid, now=self.clock())
                 if self.ha:
                     self._wire_ha(m)
             elif hasattr(m.instance, "apply_plan"):
@@ -234,11 +310,17 @@ class ClusterCoordinator:
                 m.instance.apply_plan(iplan)
 
     def _spec_for(self, iplan: InstancePlan) -> InstanceSpec:
-        return InstanceSpec.from_plan(
+        spec = InstanceSpec.from_plan(
             iplan, self.plan, server_mac=self.server_mac,
             server_ip=self.server_ip, n_workers=self.n_workers,
             slice_size=self.slice_size, inbox_capacity=self.inbox_capacity,
             lease_time=self.lease_time, sub_nbuckets=self.sub_nbuckets)
+        if self.mode == "process" and self.fabric_transport is not None:
+            # the child beats back to this address over the UDP fabric
+            spec.fabric_addr = tuple(self.fabric_transport.addr)
+            spec.fabric_psk = self.fabric_psk
+            spec.beat_interval_s = self.fabric_beat_interval_s
+        return spec
 
     def _build_instance(self, spec: InstanceSpec):
         if self.mode == "process":
@@ -266,12 +348,22 @@ class ClusterCoordinator:
             auto_failback=False,
             on_role_change=lambda role, iid=m.id: self._on_role_change(
                 iid, role))
+        # with a fabric, liveness comes from the detector (beats over
+        # the transport), not the parent-side flag alone: a SIGKILL'd
+        # process member stops beating, the detector demotes it, and
+        # THIS probe goes false — no pipe heartbeat on the probe path.
+        # `mm.alive` stays in the conjunction as the chaos kill verb.
         m.monitor = HealthMonitor(
-            probe=lambda mm=m: mm.alive,
+            probe=lambda mm=m: mm.alive and self._fabric_probe(mm.id),
             interval_s=self.ha_probe_interval_s,
             failure_threshold=self.ha_failure_threshold,
             on_event=m.failover.handle_health_event)
         m.standby.tick(self.clock())
+
+    def _fabric_probe(self, instance_id: str) -> bool:
+        if self.fabric_detector is None:
+            return True  # inline pipe-oracle mode: the flag decides
+        return self.fabric_detector.probe(instance_id)
 
     def _relay_sessions(self, m: _Member, now: float) -> None:
         """Worker lease events -> SessionStates -> ActiveSyncer push:
@@ -309,6 +401,14 @@ class ClusterCoordinator:
         m.alive = True
         m.role = "promoted"
         self.failovers += 1
+        if self.fabric_detector is not None:
+            # the slot is a new process with fresh counters: wipe the
+            # old view AND the transport's replay floor, or the new
+            # child's seq=1 beats all read as replays of the dead one
+            self.fabric_detector.reset(m.id, now=self.clock())
+            reset_peer = getattr(self.fabric_transport, "reset_peer", None)
+            if reset_peer is not None:
+                reset_peer(m.id)
         self._wire_ha(m, checkpoint=ckpt)
 
     def kill_instance(self, instance_id: str) -> None:
@@ -317,10 +417,31 @@ class ClusterCoordinator:
         recovery."""
         self.members[instance_id].alive = False
 
+    # -- fabric verdicts --------------------------------------------------
+    def _on_fabric_verdict(self, peer_id: str, state: str) -> None:
+        """Detector transition: record it, flight-record it. Demotion
+        itself flows through the probe path — the HealthMonitor /
+        FailoverController machinery owns failover, same as ever."""
+        self.fabric_events.append((peer_id, state))
+        del self.fabric_events[:-64]
+        tele.trigger(TRIG_MEMBER_SUSPECT, f"{peer_id} -> {state}")
+
+    def _on_fabric_message(self, msg) -> None:
+        """Non-beat fabric traffic. `join`: a member on another host
+        announces itself — it enters the carve as a remote member."""
+        if msg.kind == "join":
+            iid = str(msg.body.get("instance_id", ""))
+            if iid and iid not in self.members:
+                self.add_remote_instance(iid,
+                                         host=str(msg.body.get("host", "")))
+
     def tick(self, now: float | None = None) -> None:
-        """Drive standby reconnects, health probes and failover state
-        machines (all tick(now)-based, SimClock-compatible)."""
+        """Drive the fabric detector, standby reconnects, health probes
+        and failover state machines (all tick(now)-based,
+        SimClock-compatible)."""
         now = now if now is not None else self.clock()
+        if self.fabric_detector is not None:
+            self.fabric_detector.tick(now)
         for _iid, m in sorted(self.members.items()):
             if m.standby is not None:
                 m.standby.tick(now)
@@ -401,7 +522,8 @@ class ClusterCoordinator:
         members = {}
         for iid, m in sorted(self.members.items()):
             entry: dict = {"alive": m.alive, "role": m.role,
-                           "pending": m.pending,
+                           "pending": m.pending, "remote": m.remote,
+                           "host": m.host,
                            "steered": self.steered.get(iid, 0)}
             if m.instance is not None:
                 entry.update(m.instance.status())
@@ -428,14 +550,20 @@ class ClusterCoordinator:
                 "blocks": self.plan.n_blocks,
                 "free_blocks": len(self.plan.free),
                 "addresses": self.plan.total_addresses(),
+                "n_hosts": self.plan.n_hosts,
                 "members": {iid: p.addresses()
                             for iid, p in sorted(self.plan.members.items())},
             }
+        if self.fabric_detector is not None:
+            out["fabric"] = self.fabric_detector.status()
+            out["fabric"]["transport"] = dict(self.fabric_transport.stats)
         return out
 
     def close(self) -> None:
         self._cancel_members()
         self._cancel_plan()
+        if self.fabric_transport is not None:
+            self.fabric_transport.close()
         for m in self.members.values():
             if m.instance is not None:
                 m.instance.close()

@@ -46,6 +46,12 @@ class InstanceSpec:
     lease_time: int = 3600
     dns_primary: int = 0
     sub_nbuckets: int = 0  # >0 builds FastPathTables as the table sink
+    # control fabric (ISSUE 19): when set, a process child beats its
+    # serving-health word to this coordinator address over the UDP
+    # fabric — the probe path the pipe heartbeat used to simulate
+    fabric_addr: tuple = ()
+    fabric_psk: str = ""
+    beat_interval_s: float = 0.5
 
     @classmethod
     def from_plan(cls, iplan: InstancePlan, cluster_plan, *, server_mac: bytes,
@@ -223,13 +229,40 @@ def _books(fleet: SlowPathFleet):
 # process mode: the fleet.py child mold
 # ---------------------------------------------------------------------------
 
+def _beat_loop(spec: InstanceSpec, inst: InlineInstance, stop) -> None:
+    """Child-side heartbeat: one signed UDP datagram per interval to
+    the coordinator, carrying the serving-health word (`work` = batches
+    accepted, `served` = replies produced). A SIGKILL takes this thread
+    with the process — the beats just stop, which IS the failure signal
+    the coordinator's detector consumes."""
+    from bng_tpu.control.deviceauth import PSKAuthenticator
+
+    from .fabric import UDPTransport
+
+    ep = UDPTransport(spec.instance_id,
+                      PSKAuthenticator(psk=spec.fabric_psk))
+    ep.add_peer("coordinator", spec.fabric_addr)
+    try:
+        while not stop.wait(spec.beat_interval_s):
+            ep.send("coordinator", "beat",
+                    {"served": inst.replies, "work": inst.batches,
+                     "accuse": []})
+    finally:
+        ep.close()
+
+
 def _instance_child(spec: InstanceSpec, conn) -> None:
     """Child loop: verbs in, results out. The clock is wall time in the
     child — process mode is the real-serving lane, not the deterministic
     test lane."""
+    import threading
     import time
 
     inst = InlineInstance(spec, clock=time.time)
+    stop_beats = threading.Event()
+    if spec.fabric_addr:
+        threading.Thread(target=_beat_loop, args=(spec, inst, stop_beats),
+                         daemon=True).start()
     try:
         while True:
             msg = conn.recv()
@@ -247,9 +280,11 @@ def _instance_child(spec: InstanceSpec, conn) -> None:
             elif verb == "export":
                 conn.send(("state", inst.export_state()))
             elif verb == "stop":
+                stop_beats.set()
                 conn.send(("bye",))
                 return
     except (EOFError, KeyboardInterrupt):
+        stop_beats.set()
         return
 
 
@@ -269,6 +304,10 @@ class ProcessInstance:
         child.close()
         self._session_events: list = []
         self.batches = 0
+
+    @property
+    def pid(self) -> int | None:
+        return self._proc.pid
 
     def _gather(self, want: str):
         tag, *rest = self._conn.recv()

@@ -102,10 +102,12 @@ class CarvedBlock:
 @dataclass
 class InstancePlan:
     """One instance's carve: whole blocks plus the NAT slices they
-    imply."""
+    imply. `host` is the placement axis the fabric added: which machine
+    the instance runs on ("" = unplaced, the single-host legacy)."""
 
     instance_id: str
     blocks: list = field(default_factory=list)  # list[CarvedBlock]
+    host: str = ""
 
     def addresses(self) -> int:
         return sum(b.size for b in self.blocks)
@@ -115,12 +117,14 @@ class InstancePlan:
 
     def to_dict(self) -> dict:
         return {"instance_id": self.instance_id,
-                "blocks": [b.to_dict() for b in self.blocks]}
+                "blocks": [b.to_dict() for b in self.blocks],
+                "host": self.host}
 
     @classmethod
     def from_dict(cls, d: dict) -> "InstancePlan":
         return cls(instance_id=d["instance_id"],
-                   blocks=[CarvedBlock.from_dict(b) for b in d["blocks"]])
+                   blocks=[CarvedBlock.from_dict(b) for b in d["blocks"]],
+                   host=str(d.get("host", "")))
 
 
 @dataclass
@@ -154,6 +158,15 @@ class ClusterPlan:
         on free blocks is a member but not yet a steering target (it
         has no addresses to answer with)."""
         return tuple(sorted(i for i, p in self.members.items() if p.blocks))
+
+    def hosts(self) -> dict:
+        return {i: p.host for i, p in sorted(self.members.items())}
+
+    @property
+    def n_hosts(self) -> int:
+        """Distinct placement hosts in the carve ("" counts as one host:
+        the unplaced single-machine legacy)."""
+        return max(1, len({p.host for p in self.members.values()}))
 
     def total_addresses(self) -> int:
         return sum(p.addresses() for p in self.members.values())
@@ -228,32 +241,62 @@ def default_block_prefix(space_prefix_len: int, n_members: int) -> int:
     return block_prefix
 
 
+def _deal_order(ids: list, hosts: dict | None) -> list:
+    """Dealing order for round-robin block assignment. Without a host
+    map this is plain sorted-id order (the single-host legacy). With
+    hosts, consecutive deals alternate across sorted host groups, so an
+    N-host cluster spreads each stretch of the space over machines —
+    losing one host takes out interleaved blocks, not a contiguous run.
+    Deterministic: pure function of (ids, hosts)."""
+    if not hosts or not any(hosts.get(i, "") for i in ids):
+        return list(ids)
+    groups: dict[str, list] = {}
+    for i in ids:
+        groups.setdefault(hosts.get(i, ""), []).append(i)
+    hkeys = sorted(groups)
+    order: list = []
+    cursors = {h: 0 for h in hkeys}
+    while len(order) < len(ids):
+        for h in hkeys:
+            g = groups[h]
+            if cursors[h] < len(g):
+                order.append(g[cursors[h]])
+                cursors[h] += 1
+    return order
+
+
 def initial_plan(space_network: int, space_prefix_len: int,
                  member_ids: list, *, block_prefix_len: int | None = None,
-                 nat_base: int = 0, nat_total: int = 0) -> ClusterPlan:
+                 nat_base: int = 0, nat_total: int = 0,
+                 hosts: dict | None = None) -> ClusterPlan:
     """Carve the space for the founding membership: blocks dealt
     round-robin in sorted-id order — deterministic, so every elected
-    carver computes the identical plan."""
+    carver computes the identical plan. With a `hosts` map (instance id
+    -> host name) the deal interleaves across hosts-of-processes."""
     ids = sorted(member_ids)
     if block_prefix_len is None:
         block_prefix_len = default_block_prefix(space_prefix_len,
                                                 max(1, len(ids)))
     blocks = _split_blocks(space_network, space_prefix_len, block_prefix_len)
+    hosts = hosts or {}
     plan = ClusterPlan(space_network=space_network,
                        space_prefix_len=space_prefix_len,
                        block_prefix_len=block_prefix_len,
                        nat_base=nat_base, nat_total=nat_total, epoch=1,
-                       members={i: InstancePlan(i) for i in ids},
+                       members={i: InstancePlan(i, host=hosts.get(i, ""))
+                                for i in ids},
                        free=[])
     if ids:
+        order = _deal_order(ids, hosts)
         for i, b in enumerate(blocks):
-            plan.members[ids[i % len(ids)]].blocks.append(b)
+            plan.members[order[i % len(order)]].blocks.append(b)
     else:
         plan.free = blocks
     return plan
 
 
-def replan(plan: ClusterPlan, member_ids: list) -> ClusterPlan:
+def replan(plan: ClusterPlan, member_ids: list,
+           hosts: dict | None = None) -> ClusterPlan:
     """Re-carve for a new membership. Discipline:
 
     - a surviving member's blocks NEVER move (never-half-allocate);
@@ -261,19 +304,24 @@ def replan(plan: ClusterPlan, member_ids: list) -> ClusterPlan:
       only calls this after that instance drained, so the transfer is
       whole-block and lease-free;
     - free blocks deal round-robin to members that hold NO blocks yet
-      (joiners). Members already serving keep exactly their carve —
-      rebalancing an occupied block would mean moving live leases, the
-      half-allocate this plan exists to forbid. A joiner arriving with
-      nothing free stays pending until a leaver returns blocks.
+      (joiners), interleaved across hosts when a host map is given.
+      Members already serving keep exactly their carve — rebalancing an
+      occupied block would mean moving live leases, the half-allocate
+      this plan exists to forbid. A joiner arriving with nothing free
+      stays pending until a leaver returns blocks.
 
     Returns a NEW plan (epoch+1) when anything changed, else the same
     plan object.
     """
     ids = sorted(member_ids)
     old_ids = plan.member_ids()
+    carried = {i: plan.members[i].host for i in ids if i in plan.members}
+    hosts = {**carried, **(hosts or {})}
 
-    members = {i: InstancePlan(i, list(plan.members[i].blocks))
-               if i in plan.members else InstancePlan(i)
+    members = {i: InstancePlan(i, list(plan.members[i].blocks),
+                               host=hosts.get(i, ""))
+               if i in plan.members else InstancePlan(i,
+                                                      host=hosts.get(i, ""))
                for i in ids}
     free = list(plan.free)
     for iid in old_ids:
@@ -282,7 +330,10 @@ def replan(plan: ClusterPlan, member_ids: list) -> ClusterPlan:
     free.sort(key=lambda b: b.index)
 
     changed = tuple(ids) != old_ids
-    joiners = sorted(i for i in ids if not members[i].blocks)
+    changed = changed or any(plan.members[i].host != members[i].host
+                             for i in ids if i in plan.members)
+    joiners = _deal_order(sorted(i for i in ids if not members[i].blocks),
+                          hosts)
     k = 0
     while free and joiners:
         members[joiners[k % len(joiners)]].blocks.append(free.pop(0))

@@ -119,6 +119,14 @@ class FleetSpec:
     lease_time_cap: int | None = None
     slice_size: int = 1024
     low_watermark: int = 256
+    # RADIUS fan-out (ISSUE 19): each worker builds its OWN RadiusClient
+    # from these picklable RadiusServerConfig entries — auth runs on the
+    # shard that owns the subscriber's MAC (auth affinity = DHCP
+    # affinity, both the same FNV-1a32 hash), so no cross-worker lock
+    # and no parent round-trip on the DORA path
+    radius_servers: list = field(default_factory=list)
+    radius_nas_id: str = "bng-tpu"
+    radius_nas_ip: int = 0
 
     @staticmethod
     def from_pool_manager(server_mac: bytes, server_ip: int,
@@ -334,12 +342,30 @@ class FleetWorker:
         self.refill_now: Callable[[int], None] | None = None
         self.pools = WorkerPools(spec.pools, self._on_slice_exhausted)
         self._events: list = []
+        # per-worker RADIUS lane: own client socket, own degraded-auth
+        # cache. The MAC that steered the frame here is the MAC being
+        # authenticated, so the cache is shard-complete by construction.
+        self.radius = None
+        self._radius_degraded = None
+        self.auth_requests = 0
+        self.auth_degraded = 0
+        if spec.radius_servers:
+            from bng_tpu.control.radius.client import RadiusClient
+            from bng_tpu.control.resilience import DegradedRADIUSHandler
+
+            self.radius = RadiusClient(
+                servers=list(spec.radius_servers),
+                nas_identifier=spec.radius_nas_id,
+                nas_ip=spec.radius_nas_ip, clock=self.clock)
+            self._radius_degraded = DegradedRADIUSHandler()
         self.server = DHCPServer(
             server_mac=spec.server_mac, server_ip=spec.server_ip,
             pool_manager=self.pools, fastpath_tables=self.tables,
             qos_hook=lambda ip, pol: self._events.append(("qos", ip, pol)),
             nat_hook=lambda ip, now: self._events.append(("nat", ip, now)),
             accounting_hook=self._lease_event,
+            authenticator=(self._radius_auth if self.radius is not None
+                           else None),
             lease_time_cap=spec.lease_time_cap, clock=self.clock)
         self.demux = SlowPathDemux(dhcp=self.server, clock=self.clock)
         # mac_u64s whose lease ENDED (release/expiry/replacement) since
@@ -375,6 +401,73 @@ class FleetWorker:
             "mac": lease.mac.hex(), "ip": lease.ip, "pool_id": lease.pool_id,
             "expiry": lease.expiry, "username": lease.username,
             "qos_policy": lease.qos_policy}, sid))
+
+    # -- RADIUS fan-out (worker-local auth + CoA actions) -----------------
+
+    def _radius_auth(self, username="", password="", mac=b"",
+                     circuit_id=b"", **kw):
+        """Worker-shard authenticator (the cli closure's fleet twin):
+        auth over this worker's own RadiusClient, degraded fallback from
+        the worker-local profile cache on full-timeout — an outage must
+        not evict paying subscribers, and a REJECT is never cached."""
+        self.auth_requests += 1
+        res = self.radius.authenticate(username, password, mac=mac,
+                                       circuit_id=circuit_id)
+        key = username or mac.hex()
+        if res is None:
+            cached = self._radius_degraded.degraded_auth(key, self.clock())
+            if cached is not None:
+                self.auth_degraded += 1
+                return {"qos_policy": cached.policy_name,
+                        "framed_ip": cached.framed_ip}
+            return None
+        if not res.success:
+            return None
+        from bng_tpu.control.resilience import CachedProfile
+
+        self._radius_degraded.cache_profile(CachedProfile(
+            username=key, policy_name=res.policy_name,
+            framed_ip=res.framed_ip, cached_at=self.clock()))
+        profile = {"qos_policy": res.policy_name,
+                   "framed_ip": res.framed_ip, **res.attributes}
+        if res.session_timeout:
+            profile["lease_time"] = res.session_timeout
+        return profile
+
+    def handle_coa(self, action: str, mac_u64: int = 0, ip: int = 0,
+                   session_id: str = "", policy_name: str = "") -> dict:
+        """CoA/Disconnect actions against THIS shard's lease book.
+        `locate` finds without mutating (the fleet's steering probe);
+        `qos` re-plans a live lease; `disconnect` force-expires it. The
+        mutations ride the same drained event stream as DHCP handling,
+        so the parent's single-writer replay sees them in order."""
+        lease = None
+        if mac_u64:
+            lease = self.server.leases.get(mac_u64)
+        if lease is None and (ip or session_id):
+            for cand in self.server.leases.values():
+                if (ip and cand.ip == ip) or \
+                        (session_id and cand.session_id == session_id):
+                    lease = cand
+                    break
+        out = {"found": lease is not None, "ip": 0, "events": [],
+               "releases": [], "stats": None}
+        if lease is None:
+            return out
+        out["ip"] = lease.ip
+        if action == "qos":
+            lease.qos_policy = policy_name
+            self._events.append(("qos", lease.ip, policy_name))
+            # re-push through the lease-event seam so HA replication
+            # sees the new plan — else failover restores pre-CoA QoS
+            self._lease_event("renew", lease, lease.session_id)
+        elif action == "disconnect":
+            lease.expiry = 0
+            self.server.cleanup_expired(1)  # reaps only the forced lease
+        out["events"] = self.tables.drain() + self._drain_events()
+        out["releases"] = self._drain_released()
+        out["stats"] = self._stats()
+        return out
 
     # -- batch handling ---------------------------------------------------
 
@@ -462,6 +555,10 @@ class FleetWorker:
             # dry) surfaces through the server's counted degradations
             "pool_exhausted": self.server.stats.pool_exhausted,
         }
+        if self.radius is not None:
+            out["radius"] = dict(self.radius.stats)
+            out["auth_requests"] = self.auth_requests
+            out["auth_degraded"] = self.auth_degraded
         if self._lat_hist is not None and self._lat_hist.n:
             # ship-and-reset: the parent folds each shipped delta into
             # its tracer (merge = addition, so deltas compose exactly)
@@ -547,6 +644,8 @@ def _worker_main(conn, spec: FleetSpec, worker_id: int,
                 conn.send(("state", worker.export_transfer()))
             elif kind == "restore":
                 conn.send(("restored", worker.restore_state(msg[1])))
+            elif kind == "coa":
+                conn.send(("coa", worker.handle_coa(msg[1], **msg[2])))
             elif kind == "stop":
                 break
     finally:
@@ -613,6 +712,12 @@ class SlowPathFleet:
         self._fallback_err_log = SlowPathErrorLog("fleet-fallback")
         self.batches = 0
         self.worker_failures = 0  # dead-worker batch losses (IPC errors)
+        # CoA fan-out (ISSUE 19): found on the steered shard / relayed
+        # to another shard (missteered — no MAC in the request, or the
+        # lease moved) / not found anywhere
+        self.coa_handled = 0
+        self.coa_relayed = 0
+        self.coa_misses = 0
         # workers killed by the chaos harness (fleet.scatter `kill`):
         # process mode terminates the child AND marks it here so the
         # maintenance fan-outs stop talking to a dead pipe; inline mode
@@ -1099,6 +1204,56 @@ class SlowPathFleet:
         else:
             self._pending.extend(frames)
 
+    # -- CoA fan-out ------------------------------------------------------
+
+    def _coa_one(self, w: int, action: str, kw: dict) -> dict | None:
+        """One shard's CoA verdict, with its event stream folded through
+        the parent's single-writer replay (same discipline as batches)."""
+        try:
+            if self.mode == "inline":
+                out = self._inline[w].handle_coa(action, **kw)
+            else:
+                self._conns[w].send(("coa", action, kw))
+                out = self._gather(w, "coa")
+        except (OSError, EOFError):
+            self._note_worker_failure(w)
+            return None
+        apply_table_events(out["events"], self.table_sink,
+                          self.qos_hook, self.nat_hook, self.lease_hook)
+        for mac in out["releases"]:
+            self.admission.note_release(mac)
+        if out["stats"] is not None:
+            self._last_stats[w] = out["stats"]
+        return out
+
+    def handle_coa(self, action: str, mac: bytes = b"", ip: int = 0,
+                   session_id: str = "", policy_name: str = "") -> dict:
+        """Route a CoA/Disconnect action to the owning shard. With a MAC
+        the steering hash names the owner directly (auth affinity = DHCP
+        affinity = CoA affinity); otherwise — or when the steered shard
+        misses — the remaining shards are probed in index order and a
+        hit counts as a relay. Returns {found, ip, worker, relayed}."""
+        kw = {"mac_u64": int.from_bytes(mac[:6].rjust(6, b"\0"), "big")
+              if mac else 0,
+              "ip": ip, "session_id": session_id,
+              "policy_name": policy_name}
+        steered = shard_for_mac(mac, self.n) if mac else 0
+        order = [steered] + [w for w in range(self.n) if w != steered]
+        for w in order:
+            if w in self._dead:
+                continue
+            out = self._coa_one(w, action, kw)
+            if out is None or not out["found"]:
+                continue
+            relayed = bool(mac) and w != steered
+            self.coa_handled += 1
+            if relayed:
+                self.coa_relayed += 1
+            return {"found": True, "ip": out["ip"], "worker": w,
+                    "relayed": relayed}
+        self.coa_misses += 1
+        return {"found": False, "ip": 0, "worker": -1, "relayed": False}
+
     # -- maintenance ------------------------------------------------------
 
     def expire(self, now: int, max_reaps: int | None = None) -> int:
@@ -1498,6 +1653,9 @@ class SlowPathFleet:
             "refill_ips_granted": self.refill_ips_granted,
             "fallback_frames": self.fallback_frames,
             "fallback_errors": self.fallback_errors,
+            "coa_handled": self.coa_handled,
+            "coa_relayed": self.coa_relayed,
+            "coa_misses": self.coa_misses,
             "per_worker": list(self._last_stats),
             "pool_exhausted_total": self.pool_exhausted_total(),
             "admission": self.admission.stats_snapshot(),

@@ -470,6 +470,43 @@ class BNGMetrics:
             "bng_cluster_refused_removes_total",
             "Member removals refused for holding live leases "
             "(never-half-allocate)")
+        # cluster control fabric (cluster/fabric): the membership lane's
+        # own health — beat traffic, per-member suspicion state, verdict
+        # and partition counts, and the transport's rejection reasons.
+        # The RADIUS fan-out counters ride here too (the fabric owns
+        # cross-member steering, and CoA relay is exactly that).
+        self.fabric_beats_tx = r.counter(
+            "bng_fabric_beats_tx_total",
+            "Heartbeats this node sent over the fabric")
+        self.fabric_beats_rx = r.counter(
+            "bng_fabric_beats_rx_total",
+            "Heartbeats this node absorbed from watched peers")
+        self.fabric_member_state = r.gauge(
+            "bng_fabric_member_state",
+            "Detector state per watched member (1 at the current "
+            "state's label, 0 elsewhere)", ("member", "state"))
+        self.fabric_member_suspicion = r.gauge(
+            "bng_fabric_member_suspicion",
+            "Accusers currently voting against a member (quorum "
+            "pressure; 0 = trusted by everyone)", ("member",))
+        self.fabric_verdicts = r.counter(
+            "bng_fabric_verdicts_total",
+            "Detector verdicts issued by kind", ("verdict",))
+        self.fabric_partitions = r.counter(
+            "bng_fabric_partitions_observed_total",
+            "Suspicion episodes that healed (beats resumed before any "
+            "demotion): transient partitions survived")
+        self.fabric_rx_rejected = r.counter(
+            "bng_fabric_rx_rejected_total",
+            "Fabric datagrams rejected on receive", ("reason",))
+        self.fabric_coa_relayed = r.counter(
+            "bng_fabric_coa_relayed_total",
+            "CoA/Disconnect requests relayed off the steered shard "
+            "(the dynamic-authorization missteer corrector)")
+        self.fabric_auth_shard = r.counter(
+            "bng_fabric_auth_shard_total",
+            "RADIUS authentications served per MAC-affine worker "
+            "shard", ("worker",))
         # checkpoint/warm-restart subsystem (runtime/checkpoint.py +
         # control/statestore.py). The reference needs none of this — its
         # state survives in kernel-pinned maps; here snapshot health IS
@@ -917,6 +954,45 @@ class BNGMetrics:
         self.slowpath_admitted.set_total(adm["admitted"])
         for reason, n in adm["shed"].items():
             self.slowpath_shed.set_total(n, reason=reason)
+        # RADIUS fan-out (ISSUE 19): per-shard auth affinity + the CoA
+        # relay counter (requests that arrived missteered and were
+        # routed to the owning shard)
+        if "coa_relayed" in snap:
+            self.fabric_coa_relayed.set_total(snap["coa_relayed"])
+        for i, w in enumerate(snap["per_worker"]):
+            if w and "auth_requests" in w:
+                self.fabric_auth_shard.set_total(w["auth_requests"],
+                                                 worker=str(i))
+
+    def collect_fabric(self, fabric: dict) -> None:
+        """ClusterCoordinator.status()['fabric'] -> bng_fabric_*.
+        Member-labeled gauges reconcile against the current watch set
+        (a forgotten peer drops its labels, same staleness rule as
+        record_cluster)."""
+        self.fabric_beats_tx.set_total(fabric.get("beats_tx", 0))
+        self.fabric_beats_rx.set_total(fabric.get("beats_rx", 0))
+        for verdict, n in (fabric.get("verdicts") or {}).items():
+            self.fabric_verdicts.set_total(n, verdict=str(verdict))
+        self.fabric_partitions.set_total(
+            fabric.get("partitions_observed", 0))
+        peers = fabric.get("peers") or {}
+        for labels in self.fabric_member_suspicion.labeled():
+            if labels["member"] not in peers:
+                self.fabric_member_suspicion.remove(**labels)
+        for labels in self.fabric_member_state.labeled():
+            if labels["member"] not in peers:
+                self.fabric_member_state.remove(**labels)
+        for member, view in sorted(peers.items()):
+            self.fabric_member_suspicion.set(
+                len(view.get("accused_by", ())), member=str(member))
+            for state in ("up", "suspect", "gray", "down"):
+                self.fabric_member_state.set(
+                    1 if view.get("state") == state else 0,
+                    member=str(member), state=state)
+        for reason in ("bad_sig", "replay", "skew", "malformed"):
+            n = (fabric.get("transport") or {}).get(f"rx_{reason}")
+            if n is not None:
+                self.fabric_rx_rejected.set_total(n, reason=reason)
 
     def collect_checkpoint(self, checkpointer, now: float | None = None) -> None:
         """PeriodicCheckpointer.stats -> bng_ckpt_* gauges/counters (the
@@ -1024,6 +1100,8 @@ class BNGMetrics:
         self.cluster_shed.set_total(status.get("shed_frames", 0))
         self.cluster_refused_removes.set_total(
             status.get("refused_removes", 0))
+        if "fabric" in status:
+            self.collect_fabric(status["fabric"])
 
     def record_restore(self, rows: dict, outcome: str = "ok") -> None:
         """Startup-restore result -> bng_ckpt_restore_rows / restores."""

@@ -266,6 +266,7 @@ class ResilienceManager:
         central_lookup: Callable[[int], tuple[str, float] | None] | None = None,
         renumber: Callable[[str], bool] | None = None,
         on_state_change: Callable[[PartitionState], None] | None = None,
+        probe_clock: Callable[[], float] | None = None,
     ):
         self.nexus_healthy = nexus_healthy
         self.radius_healthy = radius_healthy
@@ -274,6 +275,11 @@ class ResilienceManager:
         self.central_lookup = central_lookup
         self.renumber = renumber
         self.on_state_change = on_state_change
+        if probe_clock is None:
+            import time
+
+            probe_clock = time.monotonic
+        self.probe_clock = probe_clock
 
         self.state = PartitionState.NORMAL
         self.conflicts = ConflictDetector()
@@ -326,10 +332,12 @@ class ResilienceManager:
         # RADIUS-only outage: degraded auth without a Nexus partition
         if self.radius_healthy is not None:
             r_ok = False
+            probe_t0 = self.probe_clock()
             try:
                 r_ok = bool(self.radius_healthy())
             except Exception as e:
                 self._probe_err_log.report(e, probe="radius")
+            probe_wall_s = max(0.0, self.probe_clock() - probe_t0)
             if r_ok:
                 self._radius_fails = 0
                 if self.radius_down:
@@ -338,7 +346,15 @@ class ResilienceManager:
                     if acct_send is not None:
                         self.radius_handler.replay(acct_send)
             else:
-                self._radius_fails += 1
+                # a probe that STALLED (socket timeout against a
+                # black-holed server) already burned the wall-time of
+                # that many check intervals — credit them all, or
+                # detection takes threshold * stall instead of
+                # threshold * interval and degraded auth arrives long
+                # after subscribers started timing out
+                self._radius_fails += min(
+                    self.failure_threshold,
+                    1 + int(probe_wall_s // self.check_interval_s))
                 if self._radius_fails >= self.failure_threshold:
                     self.radius_down = True
 

@@ -303,11 +303,27 @@ def n_instances(line: dict) -> int:
         return 1
 
 
+def n_hosts(line: dict) -> int:
+    """How many HOSTS the cluster's carve spanned (ISSUE 19): the plan
+    host axis interleaves blocks across hosts, and a multi-host run's
+    numbers carry cross-host fabric overhead a single-host run never
+    pays. Same accessor discipline as n_instances — top-level stamp,
+    then env fingerprint, legacy default 1 (every pre-fabric line ran
+    on one host by construction)."""
+    v = line.get("n_hosts")
+    if v is None:
+        v = (line.get("env") or {}).get("n_hosts")
+    try:
+        return int(v) if v is not None else 1
+    except (TypeError, ValueError):
+        return 1
+
+
 def cohort_key(line: dict) -> tuple:
     return (line.get("metric"), backend_class(line), device_kind(line),
             table_impl(line), n_shards(line), n_instances(line),
-            express_path(line), express_loop(line), host_path(line),
-            wire_pump(line), geometry(line))
+            n_hosts(line), express_path(line), express_loop(line),
+            host_path(line), wire_pump(line), geometry(line))
 
 
 def _gateable(line: dict) -> bool:
@@ -555,6 +571,7 @@ def gate(lines: list[dict], last_k: int = 8, min_cohort: int = 3,
                         or table_impl(ln) != table_impl(cand)
                         or n_shards(ln) != n_shards(cand)
                         or n_instances(ln) != n_instances(cand)
+                        or n_hosts(ln) != n_hosts(cand)
                         or express_path(ln) != express_path(cand)
                         or express_loop(ln) != express_loop(cand)
                         or host_path(ln) != host_path(cand)
@@ -564,6 +581,7 @@ def gate(lines: list[dict], last_k: int = 8, min_cohort: int = 3,
                 f"{backend_class(ln)}/{table_impl(ln)}"
                 f"/shards={n_shards(ln)}"
                 f"/instances={n_instances(ln)}"
+                f"/hosts={n_hosts(ln)}"
                 f"/express={express_path(ln)}"
                 f"/loop={express_loop(ln)}"
                 f"/host={host_path(ln)}/wire={wire_pump(ln)}"
@@ -573,6 +591,7 @@ def gate(lines: list[dict], last_k: int = 8, min_cohort: int = 3,
                 f"candidate ran as {backend_class(cand)!r}/"
                 f"{table_impl(cand)!r}/shards={n_shards(cand)}"
                 f"/instances={n_instances(cand)}"
+                f"/hosts={n_hosts(cand)}"
                 f"/express={express_path(cand)!r}"
                 f"/loop={express_loop(cand)!r}"
                 f"/host={host_path(cand)!r}"

@@ -63,6 +63,14 @@ TRIG_WIRE_FALLBACK = "wire_rung_fallback"
 # a cluster could serve every OFFER through the slow architecture with
 # healthy-looking aggregate counters and no flight-record evidence
 TRIG_EXPRESS_FALLBACK = "express_fallback"
+# the cluster fabric's failure detector changed a member's verdict
+# (ISSUE 19): suspect (beats stopped — possible partition), gray (beats
+# flowing but the serving-health word stalled — Huang HotOS'17), or
+# down (quorum of observers accused it). Suspicion transitions are the
+# earliest cluster-failure evidence; the ring around one shows whether
+# the beats died, the datagrams were rejected (bad sig / replay / skew
+# counters) or the member wedged while still answering
+TRIG_MEMBER_SUSPECT = "member_suspect"
 
 
 def default_trace_dir() -> str:

@@ -608,6 +608,37 @@ def f(some_list, q):
 """})
         assert run_on(tmp_path, {"single-writer"}) == []
 
+    def test_fabric_membership_mutator_outside_allowlist_flagged(
+            self, tmp_path):
+        # ISSUE 19: the failure-detector views are single-writer state;
+        # a rogue module watching/resetting slots desyncs verdicts from
+        # the coordinator's HA ladder
+        write_tree(tmp_path, {"bng_tpu/telemetry/rogue.py": """\
+def f(coord, iid, now):
+    coord.fabric_detector.watch(iid, now=now)
+    coord.fabric_detector.reset(iid, now=now)
+    coord.fabric_transport.reset_peer(iid)
+"""})
+        found = run_on(tmp_path, {"single-writer"})
+        assert codes_of(found) == {"BNG040"}
+        assert len(found) == 3
+
+    def test_fabric_mutators_from_coordinator_clean(self, tmp_path):
+        write_tree(tmp_path, {"bng_tpu/cluster/coordinator.py": """\
+def f(self, iid, now):
+    self.fabric_detector.watch(iid, now=now)
+    self.fabric_transport.reset_peer(iid)
+"""})
+        assert run_on(tmp_path, {"single-writer"}) == []
+
+    def test_generic_reset_receiver_not_flagged(self, tmp_path):
+        write_tree(tmp_path, {"bng_tpu/telemetry/fine.py": """\
+def f(histogram, sock):
+    histogram.counters.reset()   # not a fabric receiver
+    sock.reset_peer("x")         # bare name: no receiver chain match
+"""})
+        assert run_on(tmp_path, {"single-writer"}) == []
+
 
 # ---------------------------------------------------------------------------
 # fencing (BNG050)

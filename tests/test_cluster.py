@@ -657,13 +657,18 @@ class TestClusterMetrics:
         from bng_tpu.control.metrics import BNGMetrics
 
         m = BNGMetrics()
-        m.record_fleet_blocked(["ha", "pppoe"])
-        assert m.slowpath_fleet_blocked.value(blocker="ha") == 1
-        m.record_fleet_blocked(["pppoe"])
+        # the full blocker vocabulary after ISSUE 19 shrank it: radius
+        # and peer-pool left the list (fleet workers auth directly and
+        # the peer pool is parent-side), so a config reload from the
+        # old set to the new one must DROP the retired labels
+        m.record_fleet_blocked(["nexus", "radius", "peer-pool"])
+        assert m.slowpath_fleet_blocked.value(blocker="radius") == 1
+        m.record_fleet_blocked(["nexus", "pppoe", "sharded"])
         # the satellite fix: a blocker that disappeared must leave the
         # scrape, not freeze at 1
         assert {d["blocker"]
-                for d in m.slowpath_fleet_blocked.labeled()} == {"pppoe"}
+                for d in m.slowpath_fleet_blocked.labeled()} \
+            == {"nexus", "pppoe", "sharded"}
         m.record_fleet_blocked([])
         assert m.slowpath_fleet_blocked.labeled() == []
 
