@@ -148,15 +148,16 @@ PYTEST_FLAGS = -q --continue-on-collection-errors -p no:cacheprovider \
         verify-telemetry verify-static verify-sanitize verify-ops \
         verify-storm verify-perf verify-kernels verify-sharded \
         verify-express verify-hostpath verify-wire verify-cluster \
-        verify-edge verify-devloop verify-fabric
+        verify-edge verify-devloop verify-fabric verify-multibox
 
 verify: verify-static verify-storm verify-perf verify-kernels \
         verify-sharded verify-express verify-hostpath verify-wire \
-        verify-cluster verify-edge verify-devloop verify-fabric
+        verify-cluster verify-edge verify-devloop verify-fabric \
+        verify-multibox
 	set -o pipefail; rm -f /tmp/_t1.log; \
 	timeout -k 10 $(TIER1_TIMEOUT) env JAX_PLATFORMS=cpu \
 	$(PY) -m pytest tests/ $(PYTEST_FLAGS) \
-	-m 'not slow and not storm and not perf and not kernels and not sharded and not express and not hostpath and not wire and not cluster and not edge and not devloop and not fabric' \
+	-m 'not slow and not storm and not perf and not kernels and not sharded and not express and not hostpath and not wire and not cluster and not edge and not devloop and not fabric and not multibox' \
 	2>&1 | tee /tmp/_t1.log
 
 verify-sharded:
@@ -227,6 +228,13 @@ verify-fabric:
 	$(PY) -m pytest tests/test_fabric.py $(PYTEST_FLAGS) \
 	  -m 'fabric and not slow' \
 	&& echo "verify-fabric OK"
+
+verify-multibox:
+	set -o pipefail; \
+	timeout -k 10 60 env JAX_PLATFORMS=cpu \
+	$(PY) -m pytest tests/test_multibox.py $(PYTEST_FLAGS) \
+	  -m 'multibox and not slow' \
+	&& echo "verify-multibox OK"
 
 verify-kernels:
 	set -o pipefail; \

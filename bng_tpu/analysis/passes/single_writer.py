@@ -40,6 +40,7 @@ MUTATORS = {
     "set_route", "clear_route",
     "fill_slot", "adopt_cursors",
     "watch", "reset", "reset_peer",
+    "set_manifest", "accept_chunk",
 }
 
 # writer modules (path suffix -> why it is allowed to write)
@@ -87,6 +88,10 @@ ALLOWED_WRITERS = {
                                       "promote — a second writer "
                                       "desyncs verdicts from the HA "
                                       "ladder",
+    "bng_tpu/cluster/handoff/protocol.py":
+        "state-transfer authority (ISSUE 20): set_manifest/accept_chunk "
+        "advance the receiver's ACK cursor and chunk map — a second "
+        "writer could half-hydrate a member past the digest gate",
 }
 
 # receiver names that mark the call as a fast-path table mutation
@@ -96,6 +101,7 @@ TABLE_RECEIVERS = {
     "qos", "up", "down", "antispoof", "garden", "pppoe", "by_sid", "by_ip",
     "edge", "tap", "route", "ring", "devloop", "cursors",
     "fabric_detector", "fabric_transport",
+    "handoff", "receiver",
 }
 
 

@@ -1652,6 +1652,181 @@ def cluster_gray_member(seed: int) -> dict:
     return out_rep
 
 
+def cluster_host_loss(seed: int) -> dict:
+    """Multi-box host loss (ISSUE 20): two remote members `--join` a
+    coordinator over the SimTransport fabric, hydrate their carved
+    blocks through the chunked handoff stream, and serve steered DORAs
+    from their own stacks (missteers must be 0 — the placement law
+    re-checked on the remote box). Then the whole remote HOST vanishes
+    (every beta link cut at once — the box died, not a process): the
+    detector downs both members by accusation quorum, `_check_host_loss`
+    promotes their surviving-host HA halves AS A GROUP, the accounting
+    spool the lost box left behind replays exactly once, the flash
+    crowd's renewals ACK their ORIGINAL addresses, and the audit stays
+    clean. FATE+DESTINI one level up: the per-process kill lane can
+    never see a box disappearing with both of its HA halves' state."""
+    import tempfile
+
+    from bng_tpu.cluster import (ClusterCoordinator, MemberRuntime,
+                                 instance_for_mac)
+    from bng_tpu.cluster.fabric import SimTransport
+    from bng_tpu.control.radius import packet as rp
+    from bng_tpu.control.radius.accounting import AccountingManager
+    from bng_tpu.control.radius.client import (RadiusClient,
+                                               RadiusServerConfig)
+    from bng_tpu.control.radius.packet import RadiusPacket
+
+    n_macs = 32
+    clock = SimClock()
+    hub = SimTransport(clock, seed=seed)
+    coord = ClusterCoordinator(
+        clock=clock, sub_nbuckets=512, slice_size=64,
+        space_network=ip_to_u32("10.112.0.0"), space_prefix_len=16,
+        fabric_endpoint=hub.endpoint("coordinator"),
+        fabric_beat_interval_s=0.5, fabric_suspicion_threshold=3,
+        fabric_startup_grace_s=2.0,
+        ha_probe_interval_s=0.5, ha_failure_threshold=2,
+        ha_failover_delay_s=1.0)
+    # the founding carve declares the remote slots (blocks interleave
+    # on the host axis NOW; the boxes join into them)
+    coord.add_instances(["bng-a"], host="alpha",
+                        remotes={"bng-r1": "beta", "bng-r2": "beta"})
+    remote_ids = ("bng-r1", "bng-r2")
+    members = {iid: MemberRuntime(hub.endpoint(iid), iid, "beta",
+                                  clock=clock)
+               for iid in remote_ids}
+    # single-threaded determinism: the coordinator's reply wait chains
+    # the members' own ticks (the two "boxes" run in lockstep)
+    coord.remote_waiter = lambda: [m.tick(clock())
+                                   for m in members.values()]
+    join_ticks = 0
+    while not all(m.state == "serving" for m in members.values()) \
+            and join_ticks < 200:
+        clock.advance(0.25)
+        for m in members.values():
+            m.tick(clock())
+        coord.tick()
+        join_ticks += 1
+
+    macs = [_mac((seed % 89) * 100 + i) for i in range(n_macs)]
+    leased = dora_with_retries(coord, macs, clock)
+    ids = coord.member_ids()
+    remote_macs = [m for m in macs
+                   if instance_for_mac(m, ids) in remote_ids]
+    missteers = sum(m.missteers for m in members.values())
+    handoff_rx = sum(m.handoff.stats()["completed"]
+                     for m in members.values())
+
+    # the lost box's accounting story: its RADIUS lane was already dark,
+    # so session stops SPOOLED instead of sending — the spool is the
+    # state a dead host leaves behind for the survivor to replay
+    class _AcctServer:
+        def __init__(self):
+            self.stops = 0
+
+        def __call__(self, data, host, port, timeout):
+            req = RadiusPacket.decode(data)
+            if req.get_int(rp.ACCT_STATUS_TYPE) == rp.ACCT_STOP:
+                self.stops += 1
+            return RadiusPacket(rp.ACCOUNTING_RESPONSE, req.id).encode(
+                b"chaos-secret", request_auth=req.authenticator)
+
+    live = _AcctServer()
+    spool = tempfile.mktemp(prefix="bng-chaos-hostloss-", suffix=".spool")
+
+    def _client(transport):
+        return RadiusClient(
+            [RadiusServerConfig("10.0.0.5", secret=b"chaos-secret",
+                                timeout_s=0.05, retries=1)],
+            transport=transport, clock=clock)
+
+    lost_acct = AccountingManager(_client(lambda *a: None),
+                                  interim_interval_s=60,
+                                  spool_path=spool, clock=clock)
+    for i, m in enumerate(remote_macs[:4]):
+        sid = f"s-{m.hex()}"
+        lost_acct.start(sid, f"sub-{i}", leased[m])
+        lost_acct.update_counters(sid, 1000 + i, 2000 + i)
+        lost_acct.stop(sid)  # dark RADIUS: spools
+    # the dark transport spooled BOTH halves of each session's story
+    # (start + stop) — all 8 records must replay exactly once
+    spooled = len(lost_acct.pending)
+
+    replay_rounds: list = []
+
+    def _on_host_loss(host, _ids):
+        # the surviving host recovers the dead box's spool: exactly-once
+        # replay through a fresh manager on the SAME spool path
+        survivor = AccountingManager(_client(live), interim_interval_s=60,
+                                     spool_path=spool, clock=clock)
+        replay_rounds.append(survivor.retry_tick())
+        replay_rounds.append(survivor.retry_tick())
+
+    coord.on_host_loss = _on_host_loss
+
+    # the whole beta host vanishes: every link cut in the same instant
+    for iid in remote_ids:
+        hub.partition("coordinator", iid)
+    coord.remote_waiter = None  # nothing left to chain — the box is gone
+    loss_ticks = 0
+    while coord.host_losses == 0 and loss_ticks < 120:
+        clock.advance(0.5)
+        coord.tick()
+        loss_ticks += 1
+
+    roles = {iid: coord.members[iid].role for iid in remote_ids}
+    # flash crowd: the lost host's subscribers renew against the
+    # promoted surviving-host halves and must keep their addresses
+    out = coord.handle_batch(
+        [(k, _renew(m, leased[m], 0x70000 + k))
+         for k, m in enumerate(remote_macs)], now=clock())
+    sticky = sum(
+        1 for (_l, rep), m in zip(out, remote_macs)
+        if rep is not None and _reply(rep).msg_type == dhcp_codec.ACK
+        and _reply(rep).yiaddr == leased[m])
+    fresh = [_mac((seed % 89) * 100 + 20_000 + i) for i in range(12)]
+    fresh_leased = dora_with_retries(coord, fresh, clock)
+
+    audit = audit_invariants(bng_cluster=coord)
+    out_rep = {
+        "name": "cluster_host_loss", "seed": seed,
+        "join_ticks": join_ticks,
+        "handoff_completed": handoff_rx,
+        "leased": len(leased),
+        "remote_subs": len(remote_macs),
+        "missteers": missteers,
+        "host_losses": coord.host_losses,
+        "lost_hosts": sorted(coord._lost_hosts),
+        "loss_ticks": loss_ticks,
+        "roles": roles,
+        "failovers": coord.failovers,
+        "spooled": spooled,
+        "replay_rounds": replay_rounds,
+        "acct_stops": live.stops,
+        "sticky_acks": sticky,
+        "fresh_leased": len(fresh_leased),
+        "audit_ok": audit.ok,
+        "violations": audit.violations_by_kind(),
+    }
+    coord.close()
+    out_rep["ok"] = (
+        out_rep["handoff_completed"] == len(remote_ids)
+        and out_rep["leased"] == n_macs
+        and out_rep["remote_subs"] > 0
+        and missteers == 0
+        and out_rep["host_losses"] == 1
+        and out_rep["lost_hosts"] == ["beta"]
+        and roles == {"bng-r1": "promoted", "bng-r2": "promoted"}
+        and out_rep["failovers"] == len(remote_ids)
+        and spooled == 8
+        and replay_rounds == [8, 0]
+        and live.stops == 4
+        and sticky == out_rep["remote_subs"]
+        and out_rep["fresh_leased"] == len(fresh)
+        and audit.ok)
+    return out_rep
+
+
 SCENARIOS = {
     "dora_worker_crash": dora_worker_crash,
     "corrupt_restore_cold_start": corrupt_restore_cold_start,
@@ -1668,4 +1843,5 @@ SCENARIOS = {
     "devloop_storm": devloop_storm,
     "cluster_partial_partition": cluster_partial_partition,
     "cluster_gray_member": cluster_gray_member,
+    "cluster_host_loss": cluster_host_loss,
 }

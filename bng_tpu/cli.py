@@ -1044,20 +1044,22 @@ class BNGApp:
         # slices carved from the parent pools and relay table writes
         # back through the single-writer drain; non-DHCPv4 slow frames
         # (v6/SLAAC/PPPoE) stay on the parent demux via the fallback.
-        # Integrations that live on the parent's per-lease state (Nexus
-        # allocation, PPPoE) are not yet fleet-aware: with any of them
-        # configured the fleet is skipped so no integration silently
-        # degrades. Fleet-aware and OFF the blocker list: `ha` (worker
-        # lease events relay through the active's syncer push), `radius`
+        # Integrations that live on the parent's per-lease state
+        # (PPPoE) are not yet fleet-aware: with any of them configured
+        # the fleet is skipped so no integration silently degrades.
+        # Fleet-aware and OFF the blocker list: `ha` (worker lease
+        # events relay through the active's syncer push), `radius`
         # (per-worker RadiusClient on the MAC steering hash — ISSUE 19,
         # accounting start/stop riding the same lease-event relay, CoA
-        # routed to the owning shard), and `peer-pool` (parent-side
-        # only: it mounts on the cluster HTTP server and health-checks
-        # in tick — it never sits in the DHCP allocation path).
+        # routed to the owning shard), `peer-pool` (parent-side only:
+        # it mounts on the cluster HTTP server and health-checks in
+        # tick — it never sits in the DHCP allocation path), and
+        # `nexus` (ISSUE 20: each shard allocates against the shared
+        # store through its own HTTPAllocator + partition FSM — lease
+        # authority is per-MAC, and MAC steering makes that per-shard).
         self.fleet_blockers: list[str] = []
         if cfg.slowpath_workers > 1:
             blockers = [name for flag, name in (
-                (cfg.nexus_url, "nexus"),
                 (cfg.pppoe_enabled, "pppoe"),
                 (cfg.shards > 1, "sharded")) if flag]
             if blockers:
@@ -1125,6 +1127,14 @@ class BNGApp:
                     fspec.radius_servers = list(radius_server_cfgs)
                     fspec.radius_nas_id = cfg.node_id or "bng-tpu"
                     fspec.radius_nas_ip = ip_to_u32(cfg.server_ip)
+                if cfg.nexus_url:
+                    # per-worker Nexus allocators (ISSUE 20): lease
+                    # authority through the shared store, one client +
+                    # partition FSM per shard
+                    fspec.nexus_url = cfg.nexus_url
+                    fspec.nexus_node_id = cfg.node_id or "bng-tpu"
+                    if cfg.nexus_url.startswith("https"):
+                        fspec.nexus_tls = self._cluster_client_tls()
                 fleet = c["fleet"] = SlowPathFleet(
                     fspec,
                     n_workers=cfg.slowpath_workers, pools=pool_mgr,
@@ -2933,15 +2943,19 @@ def run_cluster(args) -> int:
         return 2
 
     # -- cluster join ------------------------------------------------
-    # announce this host into a running coordinator's carve over the
-    # fabric (ISSUE 19): one join datagram, then beats — the hub adds
-    # us as a remote member on the plan's host axis
+    # run this box as a FULL SERVING MEMBER of a remote coordinator's
+    # carve (ISSUE 20): announce with capped-backoff retries, hydrate
+    # the carved blocks from the coordinator's handoff stream, bring up
+    # a local fleet+engine stack, serve steered batches over the
+    # fabric, and ship lease/HA deltas back on every reply
     if args.join:
         import socket as _socket
 
         from bng_tpu.cluster.coordinator import DEFAULT_FABRIC_PSK
         from bng_tpu.cluster.fabric import UDPTransport
+        from bng_tpu.cluster.member import MemberRuntime
         from bng_tpu.control.deviceauth import PSKAuthenticator
+        from bng_tpu.control.metrics import BNGMetrics
 
         host_s, _, port_s = args.join.rpartition(":")
         try:
@@ -2954,25 +2968,44 @@ def run_cluster(args) -> int:
         node_id = args.node_id or f"bng-{hostname}"
         ep = UDPTransport(node_id, PSKAuthenticator(
             psk=args.fabric_psk or DEFAULT_FABRIC_PSK))
+        ep.add_peer("coordinator", hub)
+        member = MemberRuntime(
+            ep, node_id, hostname,
+            join_deadline_s=args.join_deadline,
+            log=lambda m: print(m, file=sys.stderr))
+        metrics = BNGMetrics()
+        print(f"cluster join: {node_id} (host {hostname}) -> "
+              f"{hub[0]}:{hub[1]}", file=sys.stderr)
+        last_state = member.state
+        ticks = 0
         try:
-            ep.add_peer("coordinator", hub)
-            ep.send("coordinator", "join",
-                    {"instance_id": node_id, "host": hostname})
-            print(f"cluster join: announced {node_id} (host {hostname}) "
-                  f"to {hub[0]}:{hub[1]}; beating", file=sys.stderr)
-            beats = 0
-            try:
-                while True:
-                    ep.send("coordinator", "beat",
-                            {"served": 0, "work": 0, "accuse": []})
-                    beats += 1
-                    if args.once and beats >= 3:
-                        return 0
-                    time.sleep(0.5)
-            except KeyboardInterrupt:
-                return 0
+            while True:
+                member.tick()
+                st = member.status()
+                metrics.record_member(st)
+                if member.state != last_state:
+                    print(f"cluster join: {last_state} -> "
+                          f"{member.state} (epoch {member.epoch}, "
+                          f"{member.join_retries} retries)",
+                          file=sys.stderr)
+                    last_state = member.state
+                if member.state == "gave_up":
+                    return 1
+                ticks += 1
+                if args.once and (member.state == "serving"
+                                  or ticks >= 3):
+                    print(json.dumps(st, indent=2, sort_keys=True,
+                                     default=str))
+                    return 0 if member.state == "serving" else 1
+                if args.status_file and ticks % 10 == 0:
+                    with open(args.status_file, "w") as f:
+                        f.write(json.dumps(st, indent=2, sort_keys=True,
+                                           default=str) + "\n")
+                time.sleep(0.05)
+        except KeyboardInterrupt:
+            return 0
         finally:
-            ep.close()
+            member.close()
 
     # -- cluster run -------------------------------------------------
     from bng_tpu.cluster import ClusterCoordinator
@@ -3011,8 +3044,17 @@ def run_cluster(args) -> int:
         print(f"cluster fabric: listening on {fa[0]}:{fa[1]}",
               file=sys.stderr)
     metrics = BNGMetrics()
+    expected_remotes: dict = {}
+    for spec_s in (args.expect_remote or ()):
+        iid, _, rhost = spec_s.partition("=")
+        if not iid:
+            print(f"cluster run: bad --expect-remote {spec_s!r} "
+                  f"(want ID=HOST)", file=sys.stderr)
+            return 2
+        expected_remotes[iid] = rhost or iid
     try:
-        coord.add_instances([f"bng-{i:02d}" for i in range(args.instances)])
+        coord.add_instances([f"bng-{i:02d}" for i in range(args.instances)],
+                            remotes=expected_remotes)
         out: dict = {}
         if args.subscribers:
             out["wave"] = _cluster_wave(coord, args.subscribers)
@@ -3039,17 +3081,24 @@ def run_cluster(args) -> int:
         # cadence App.tick gives a single instance) until interrupted
         print(f"cluster serving: {args.instances} instances "
               f"({args.mode}); ^C to stop", file=sys.stderr)
+        # with a fabric the tick must outpace the membership beats and
+        # the handoff retransmit timer; without one, 1 Hz (App.tick's
+        # cadence for a single instance) is plenty
+        tick_s = 0.1 if use_fabric else 1.0
         try:
+            last_status = 0.0
             while True:
-                time.sleep(1.0)
+                time.sleep(tick_s)
                 coord.tick()
-                status = coord.status()
-                metrics.record_cluster(status)
-                if args.status_file:
-                    with open(args.status_file, "w") as f:
-                        f.write(json.dumps(status, indent=2,
-                                           sort_keys=True, default=str)
-                                + "\n")
+                if time.time() - last_status >= 1.0:
+                    last_status = time.time()
+                    status = coord.status()
+                    metrics.record_cluster(status)
+                    if args.status_file:
+                        with open(args.status_file, "w") as f:
+                            f.write(json.dumps(status, indent=2,
+                                               sort_keys=True,
+                                               default=str) + "\n")
         except KeyboardInterrupt:
             pass
         return 0
@@ -3357,8 +3406,19 @@ def main(argv: list[str] | None = None) -> int:
                             "(process mode; port 0 = ephemeral)")
     clrun.add_argument("--join", default="",
                        help="HOST:PORT of a running coordinator's "
-                            "--listen: join its carve as a remote "
-                            "member and beat instead of serving locally")
+                            "--listen: join its carve as a full remote "
+                            "serving member — hydrate the carved blocks "
+                            "over the fabric handoff stream and serve "
+                            "them from this box")
+    clrun.add_argument("--join-deadline", type=float, default=60.0,
+                       help="give up the join (capped-backoff retries) "
+                            "after this many seconds (default 60)")
+    clrun.add_argument("--expect-remote", action="append", default=[],
+                       metavar="ID=HOST",
+                       help="declare a remote member slot in the "
+                            "founding carve (repeatable): blocks deal "
+                            "to it on the host axis now, and the slot "
+                            "comes alive when that box --join's")
     clrun.add_argument("--fabric-psk", default="",
                        help="pre-shared key authenticating fabric "
                             "datagrams (>=16 chars; default: the dev "

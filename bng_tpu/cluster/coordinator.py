@@ -39,10 +39,11 @@ from bng_tpu.control.ha import (ActiveSyncer, FailoverController,
                                 StandbySyncer)
 from bng_tpu.control.nexus import MemoryStore, TypedStore
 from bng_tpu.telemetry import spans as tele
-from bng_tpu.telemetry.recorder import TRIG_MEMBER_SUSPECT
+from bng_tpu.telemetry.recorder import TRIG_HOST_LOSS, TRIG_MEMBER_SUSPECT
 from bng_tpu.utils.net import ip_to_u32
 
 from .fabric import FailureDetector
+from .handoff import HandoffManager, build_handoff_checkpoint
 from .instance import InlineInstance, InstanceSpec, ProcessInstance
 from .plan import (ClusterPlan, InstancePlan, elect_carver, initial_plan,
                    instance_for_mac, replan)
@@ -78,6 +79,9 @@ class _Member:
         self.alive = True
         self.role = "active"  # active | promoted
         self.remote = False   # fabric-joined, served on another host
+        self.serving_remote = False  # joiner runs a full serving stack
+        self.hydrated_epoch = 0      # last plan epoch a handoff shipped
+        self.handoff_xid = ""        # in-flight carve transfer id
         self.host = ""
         self.store: InMemorySessionStore | None = None
         self.syncer: ActiveSyncer | None = None
@@ -149,8 +153,17 @@ class ClusterCoordinator:
         self.failovers = 0
         self.refused_removes = 0
         self.shed_frames = 0
+        self.host_losses = 0
         self.steered: dict[str, int] = {}
         self._hosts: dict[str, str] = {}
+        self._lost_hosts: set = set()
+        # host-loss hook (chaos + ops): called once per lost host with
+        # (host, [member_ids]) AFTER the group promotion — the seam the
+        # accounting-spool replay and alerting wire into
+        self.on_host_loss = None
+        # deterministic tests chain the remote members' own tick onto
+        # the front door's reply wait (single-threaded SimTransport)
+        self.remote_waiter = None
         self.fabric_events: list = []  # last 64 (peer, verdict) pairs
 
         # -- control fabric: the real-transport membership lane. The
@@ -162,6 +175,11 @@ class ClusterCoordinator:
         self.fabric_psk = fabric_psk or DEFAULT_FABRIC_PSK
         self.fabric_transport = fabric_endpoint
         self.fabric_detector: FailureDetector | None = None
+        self.handoff: HandoffManager | None = None
+        # real-transport mode waits on remote replies with a short
+        # sleep; an injected SimTransport endpoint is single-threaded
+        # and must never sleep (the test drives both sides itself)
+        self._fabric_real = fabric and fabric_endpoint is None
         if fabric and fabric_endpoint is None:
             from bng_tpu.control.deviceauth import PSKAuthenticator
 
@@ -170,6 +188,8 @@ class ClusterCoordinator:
                 "coordinator", PSKAuthenticator(psk=self.fabric_psk),
                 bind=fabric_bind, clock=self.clock)
         if self.fabric_transport is not None:
+            self.handoff = HandoffManager(self.fabric_transport,
+                                          clock=self.clock)
             self.fabric_detector = FailureDetector(
                 "coordinator", self.fabric_transport, clock=self.clock,
                 beat_interval_s=fabric_beat_interval_s,
@@ -187,23 +207,38 @@ class ClusterCoordinator:
         self._cancel_plan = self.store.watch(_PLAN_KEY, self._on_plan)
 
     # -- membership -------------------------------------------------------
-    def add_instances(self, instance_ids: list, host: str = "") -> None:
+    def add_instances(self, instance_ids: list, host: str = "",
+                      remotes: dict | None = None) -> None:
         """Register a founding (or joining) batch in one carve: blocks
         deal across the whole batch instead of the first registrant
         swallowing the space. `host` tags the batch's placement for the
-        plan's host axis (blocks interleave across hosts)."""
-        for iid in instance_ids:
+        plan's host axis (blocks interleave across hosts).
+
+        `remotes` ({instance_id: host}) declares EXPECTED remote slots
+        in the same carve: the founding deal interleaves their blocks
+        on the host axis now, and the slot comes alive when its box
+        `--join`s into it (ISSUE 20 multi-box deployment — the initial
+        carve deals every block, so a slot declared later could only
+        ever wait on the free list)."""
+        remotes = dict(remotes or {})
+        for iid in list(instance_ids) + sorted(remotes):
             if iid in self.members:
                 raise ValueError(f"instance {iid!r} already registered")
+        for iid in instance_ids:
             self.members[iid] = _Member(iid)
             self.members[iid].host = host
             self._hosts[iid] = host
+        for iid, rhost in sorted(remotes.items()):
+            m = self.members[iid] = _Member(iid)
+            m.remote = True
+            m.host = rhost
+            self._hosts[iid] = rhost
         # hold the carve until the whole batch registered: the founding
         # set must carve TOGETHER, or the first registrant's initial
         # plan swallows every block and the rest join empty-handed
         self._hold_recarve = True
         try:
-            for iid in instance_ids:
+            for iid in list(instance_ids) + sorted(remotes):
                 self.registry.put(iid, InstanceEntity(id=iid,
                                                       joined_at=self.clock()))
         finally:
@@ -218,17 +253,21 @@ class ClusterCoordinator:
         self.add_instances([instance_id], host=host)
 
     def add_remote_instance(self, instance_id: str, host: str,
-                            addr: tuple | None = None) -> None:
+                            addr: tuple | None = None,
+                            serving: bool = False) -> None:
         """A fabric-joined member served on another host: it takes part
         in the carve (its blocks interleave on the host axis) and the
-        failure detector watches its beats, but this coordinator builds
-        no local stack for it — frames steered its way are shed and
-        counted, because only the control plane spans hosts today (the
-        data path to a remote member is the ROADMAP's next rung)."""
+        failure detector watches its beats. With `serving=True` (the
+        ISSUE 20 `--join` runtime) the coordinator streams its carve
+        over the handoff lane and fronts it with a `RemoteInstance`
+        handle, so steered frames are SERVED across the fabric; an
+        announce-only joiner keeps the PR 19 shape — frames steered its
+        way are shed and counted."""
         if instance_id in self.members:
             raise ValueError(f"instance {instance_id!r} already registered")
         m = _Member(instance_id)
         m.remote = True
+        m.serving_remote = serving
         m.host = host
         self.members[instance_id] = m
         self._hosts[instance_id] = host
@@ -247,7 +286,12 @@ class ClusterCoordinator:
         m = self.members.get(instance_id)
         if m is None:
             raise KeyError(f"unknown instance {instance_id!r}")
-        if m.instance is not None and not force and m.instance.lease_count():
+        held = m.instance.lease_count() if m.instance is not None else 0
+        if not held and m.remote and m.store is not None:
+            # a remote member's authoritative books live off-box; the
+            # HA mirror on this host is the drain evidence we hold
+            held = len(m.store)
+        if m.instance is not None and not force and held:
             self.refused_removes += 1
             return False
         if m.instance is not None:
@@ -291,7 +335,15 @@ class ClusterCoordinator:
     def _apply_plan(self) -> None:
         for iid, iplan in self.plan.members.items():
             m = self.members.get(iid)
-            if m is None or m.remote or not iplan.blocks:
+            if m is None or not iplan.blocks:
+                continue
+            if m.remote:
+                # serving joiners hydrate over the handoff stream; a
+                # new epoch (join carve or replan block move) ships a
+                # fresh carve checkpoint
+                if (m.serving_remote and self.handoff is not None
+                        and m.hydrated_epoch < self.plan.epoch):
+                    self._start_handoff(m)
                 continue
             if m.instance is None:
                 m.spec = self._spec_for(iplan)
@@ -308,6 +360,56 @@ class ClusterCoordinator:
                 # member restarts on its next roll to pick them up
                 m.spec = self._spec_for(iplan)
                 m.instance.apply_plan(iplan)
+
+    def _start_handoff(self, m: _Member) -> None:
+        """Stream the member's carve to it as a verified checkpoint:
+        the plan document, its spec parameters, and any HA sessions
+        this host already mirrors for its slot (standby bootstrap /
+        replan move — empty at first join). The receiver hydrates all
+        of it or none of it."""
+        iplan = self.plan.members[m.id]
+        m.spec = self._spec_for(iplan)
+        sessions = []
+        if m.store is not None:
+            sessions = [{"session_id": s.session_id, "mac": s.mac,
+                         "ip": s.ip, "pool_id": s.pool_id,
+                         "username": s.username,
+                         "lease_expiry": s.lease_expiry,
+                         "qos_policy": s.qos_policy}
+                        for s in m.store.all()]
+        data = build_handoff_checkpoint(self.plan.epoch, {
+            "cluster_plan": self.plan.to_dict(),
+            "member": {
+                "instance_id": m.id,
+                "spec": {"server_mac": self.server_mac.hex(),
+                         "server_ip": self.server_ip,
+                         "n_workers": self.n_workers,
+                         "slice_size": self.slice_size,
+                         "inbox_capacity": self.inbox_capacity,
+                         "lease_time": self.lease_time,
+                         "sub_nbuckets": self.sub_nbuckets},
+                "sessions": sessions,
+            },
+        })
+        sender = self.handoff.send(m.id, data, kind="carve",
+                                   meta={"instance_id": m.id,
+                                         "epoch": self.plan.epoch})
+        m.handoff_xid = sender.xid
+        m.hydrated_epoch = self.plan.epoch
+
+    def _remote_pump(self) -> None:
+        """Drive the fabric while a RemoteInstance waits for replies:
+        drain the transport (the detector routes rbatch replies back
+        through `_on_fabric_message`), let an injected waiter advance
+        the far side (sim tests), breathe in real-UDP mode."""
+        if self.fabric_detector is not None:
+            self.fabric_detector.tick(self.clock())
+        if self.remote_waiter is not None:
+            self.remote_waiter()
+        elif self._fabric_real:
+            import time as _time
+
+            _time.sleep(0.002)
 
     def _spec_for(self, iplan: InstancePlan) -> InstanceSpec:
         spec = InstanceSpec.from_plan(
@@ -401,11 +503,21 @@ class ClusterCoordinator:
         m.alive = True
         m.role = "promoted"
         self.failovers += 1
-        if self.fabric_detector is not None:
+        if m.remote:
+            # the slot moved hosts: it now serves LOCALLY on the
+            # survivor, so the detector must stop expecting beats from
+            # the dead box (a reset would re-demote the promoted slot)
+            m.remote = False
+            m.serving_remote = False
+            m.handoff_xid = ""
+            if self.fabric_detector is not None:
+                self.fabric_detector.forget(m.id)
+        elif self.fabric_detector is not None:
             # the slot is a new process with fresh counters: wipe the
             # old view AND the transport's replay floor, or the new
             # child's seq=1 beats all read as replays of the dead one
             self.fabric_detector.reset(m.id, now=self.clock())
+        if self.fabric_detector is not None:
             reset_peer = getattr(self.fabric_transport, "reset_peer", None)
             if reset_peer is not None:
                 reset_peer(m.id)
@@ -428,12 +540,44 @@ class ClusterCoordinator:
 
     def _on_fabric_message(self, msg) -> None:
         """Non-beat fabric traffic. `join`: a member on another host
-        announces itself — it enters the carve as a remote member."""
+        announces itself — it enters the carve as a remote member (a
+        `serving` joiner additionally gets the handoff stream and a
+        RemoteInstance front). Handoff acks and remote-serving replies
+        route to their owners; a re-sent join (the member's backoff
+        retrying into an already-registered slot) is idempotent."""
         if msg.kind == "join":
             iid = str(msg.body.get("instance_id", ""))
-            if iid and iid not in self.members:
-                self.add_remote_instance(iid,
-                                         host=str(msg.body.get("host", "")))
+            if not iid:
+                return
+            m = self.members.get(iid)
+            if m is None:
+                self.add_remote_instance(
+                    iid, host=str(msg.body.get("host", "")),
+                    serving=bool(msg.body.get("serving", False)))
+                return
+            if not m.remote:
+                return  # a local member's id: not joinable from outside
+            # a pre-declared slot (co-carved at founding) comes alive —
+            # or a registered joiner's backoff re-sent the announce
+            if bool(msg.body.get("serving", False)):
+                m.serving_remote = True
+            if self.fabric_detector is not None \
+                    and iid not in self.fabric_detector.views:
+                self.fabric_detector.watch(iid, now=self.clock())
+            if (m.serving_remote and self.handoff is not None
+                    and self.plan is not None
+                    and iid in self.plan.members
+                    and self.plan.members[iid].blocks
+                    and m.hydrated_epoch < self.plan.epoch):
+                self._start_handoff(m)
+            return
+        if self.handoff is not None and self.handoff.handle(msg):
+            return
+        if msg.kind in ("rbatch_reply", "rexpire_reply"):
+            m = self.members.get(msg.src)
+            if m is not None and m.instance is not None \
+                    and hasattr(m.instance, "deliver"):
+                m.instance.deliver(msg.body)
 
     def tick(self, now: float | None = None) -> None:
         """Drive the fabric detector, standby reconnects, health probes
@@ -442,6 +586,10 @@ class ClusterCoordinator:
         now = now if now is not None else self.clock()
         if self.fabric_detector is not None:
             self.fabric_detector.tick(now)
+        if self.handoff is not None:
+            self.handoff.pump(now)
+            self._adopt_hydrated_remotes()
+        self._check_host_loss()
         for _iid, m in sorted(self.members.items()):
             if m.standby is not None:
                 m.standby.tick(now)
@@ -449,6 +597,57 @@ class ClusterCoordinator:
                 m.monitor.tick(now)
             if m.failover is not None:
                 m.failover.tick(now)
+
+    def _adopt_hydrated_remotes(self) -> None:
+        """A serving joiner whose carve handoff the receiver fully
+        acked becomes a steering target: front it with a
+        RemoteInstance and wire its HA pair on THIS host (the
+        surviving-host half that host-loss promotion hydrates from)."""
+        from .member import RemoteInstance
+
+        for iid, m in sorted(self.members.items()):
+            if not (m.remote and m.serving_remote and m.handoff_xid
+                    and m.instance is None):
+                continue
+            sender = self.handoff.senders.get((iid, m.handoff_xid))
+            if sender is None or not sender.done:
+                continue
+            m.instance = RemoteInstance(
+                self.fabric_transport, iid, m.spec, clock=self.clock,
+                pump=self._remote_pump)
+            m.alive = True
+            if self.ha:
+                self._wire_ha(m)
+
+    def _check_host_loss(self) -> None:
+        """The plan's host axis driving failure handling: when EVERY
+        fabric-watched remote member on a host is DOWN by accusation
+        quorum, the box is gone — promote the surviving-host HA halves
+        as a group (no per-member failover-delay stagger; their state
+        is already here)."""
+        if self.fabric_detector is None:
+            return
+        by_host: dict[str, list] = {}
+        for iid, m in sorted(self.members.items()):
+            if m.remote and m.host and iid in self.fabric_detector.views:
+                by_host.setdefault(m.host, []).append(m)
+        for host, group in sorted(by_host.items()):
+            if host in self._lost_hosts:
+                continue
+            if not all(self.fabric_detector.views[m.id].state == "down"
+                       for m in group):
+                continue
+            self._lost_hosts.add(host)
+            self.host_losses += 1
+            tele.trigger(TRIG_HOST_LOSS,
+                         f"host {host} lost: "
+                         f"{[m.id for m in group]} down by quorum")
+            for m in group:
+                m.alive = False
+                if m.standby is not None and m.spec is not None:
+                    self._promote(m.id)
+            if self.on_host_loss is not None:
+                self.on_host_loss(host, [m.id for m in group])
 
     # -- the front door ---------------------------------------------------
     def member_ids(self) -> tuple:
@@ -523,6 +722,7 @@ class ClusterCoordinator:
         for iid, m in sorted(self.members.items()):
             entry: dict = {"alive": m.alive, "role": m.role,
                            "pending": m.pending, "remote": m.remote,
+                           "serving_remote": m.serving_remote,
                            "host": m.host,
                            "steered": self.steered.get(iid, 0)}
             if m.instance is not None:
@@ -543,6 +743,8 @@ class ClusterCoordinator:
             "failovers": self.failovers,
             "refused_removes": self.refused_removes,
             "shed_frames": self.shed_frames,
+            "host_losses": self.host_losses,
+            "lost_hosts": sorted(self._lost_hosts),
         }
         if self.plan is not None:
             out["plan"] = {
@@ -557,6 +759,8 @@ class ClusterCoordinator:
         if self.fabric_detector is not None:
             out["fabric"] = self.fabric_detector.status()
             out["fabric"]["transport"] = dict(self.fabric_transport.stats)
+            if self.handoff is not None:
+                out["fabric"]["handoff"] = self.handoff.stats()
         return out
 
     def close(self) -> None:

@@ -152,6 +152,12 @@ class UDPTransport:
                 break
             msg = self._verify(raw)
             if msg is not None:
+                # learned peer addressing: the datagram passed the PSK
+                # signature + replay floor, so its source address is the
+                # authenticated peer's current binding — record it so a
+                # joiner we have never been told about (bng cluster run
+                # --join from another box) can be answered
+                self.peers[msg.src] = (_peer[0], int(_peer[1]))
                 out.append(msg)
         return out
 

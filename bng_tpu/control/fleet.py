@@ -127,6 +127,15 @@ class FleetSpec:
     radius_servers: list = field(default_factory=list)
     radius_nas_id: str = "bng-tpu"
     radius_nas_ip: int = 0
+    # central Nexus allocation (ISSUE 20): worker lease-authority routes
+    # through the shared store PER SHARD — each worker builds its own
+    # HTTPAllocator + ResilienceManager from these picklable fields
+    # (the radius_servers mold), so a configured nexus_url no longer
+    # force-disables the fleet. nexus_tls is a ztp_tls.TLSConfig
+    # (string/list dataclass — picklable) or None for plaintext.
+    nexus_url: str = ""
+    nexus_node_id: str = "bng-tpu"
+    nexus_tls: object = None
 
     @staticmethod
     def from_pool_manager(server_mac: bytes, server_ip: int,
@@ -322,6 +331,40 @@ def apply_table_events(events: list, table_sink, qos_hook=None,
 # the worker (runs in-child for process mode, in-parent for inline)
 # ---------------------------------------------------------------------------
 
+class _WorkerNexusAllocator:
+    """DHCPServer's int-contract adapter over a worker-local
+    HTTPAllocator (the cli `_NexusAlloc` twin, one per shard):
+    partitioned -> None so the local slice answers immediately instead
+    of eating a central-store timeout per DISCOVER."""
+
+    def __init__(self, allocator, resilience):
+        self.allocator = allocator
+        self.resilience = resilience
+        self.release_errors = 0
+
+    def allocate(self, owner: str):
+        if self.resilience.partitioned:
+            return None
+        try:
+            ip = self.allocator.allocate(owner)
+        except Exception:  # network lane: any failure = local fallback
+            return None
+        if not ip:
+            return None
+        from bng_tpu.utils.net import ip_to_u32
+
+        return ip_to_u32(ip)
+
+    def release(self, owner: str) -> None:
+        if self.resilience.partitioned:
+            return  # heal-time reconciliation covers it — no timeout
+            # per expired lease during an outage
+        try:
+            self.allocator.release(owner)
+        except Exception:  # heal-time reconciliation sweeps leaked IPs
+            self.release_errors += 1
+
+
 class FleetWorker:
     """One shard: demux + DHCP server + slice pools, shared-nothing."""
 
@@ -358,8 +401,31 @@ class FleetWorker:
                 nas_identifier=spec.radius_nas_id,
                 nas_ip=spec.radius_nas_ip, clock=self.clock)
             self._radius_degraded = DegradedRADIUSHandler()
+        # per-worker Nexus lane (ISSUE 20): the shard that owns the MAC
+        # allocates against the shared store under its own node id —
+        # no parent round-trip on the DORA path. While partitioned the
+        # adapter answers None and DHCP falls back to the local slice
+        # (the resilience FSM owns retry cadence, not a per-DISCOVER
+        # timeout).
+        self.nexus = None
+        self.nexus_resilience = None
+        allocator = None
+        if spec.nexus_url:
+            from bng_tpu.control.cluster_http import http_nexus_transport
+            from bng_tpu.control.nexus import HTTPAllocator
+            from bng_tpu.control.resilience import ResilienceManager
+
+            self.nexus = HTTPAllocator(
+                spec.nexus_url,
+                http_nexus_transport(spec.nexus_url, tls=spec.nexus_tls),
+                node_id=f"{spec.nexus_node_id}-w{worker_id}")
+            self.nexus_resilience = ResilienceManager(
+                nexus_healthy=self.nexus.health_check)
+            allocator = _WorkerNexusAllocator(self.nexus,
+                                              self.nexus_resilience)
         self.server = DHCPServer(
             server_mac=spec.server_mac, server_ip=spec.server_ip,
+            allocator=allocator,
             pool_manager=self.pools, fastpath_tables=self.tables,
             qos_hook=lambda ip, pol: self._events.append(("qos", ip, pol)),
             nat_hook=lambda ip, now: self._events.append(("nat", ip, now)),
@@ -476,6 +542,11 @@ class FleetWorker:
         "releases", "pending", "refill", "stats"}. One poison frame must
         not kill the worker or shift any other lane's result."""
         t0 = time.perf_counter()
+        if self.nexus_resilience is not None:
+            # drive the partition FSM here (the worker's only periodic
+            # entry point); check_interval_s gates the actual probes so
+            # this is a float compare per batch, not an HTTP call
+            self.nexus_resilience.tick(self.clock())
         results = []
         offers, acks, releases = [], [], []
         hist = self._lat_hist

@@ -499,6 +499,24 @@ class BNGMetrics:
         self.fabric_rx_rejected = r.counter(
             "bng_fabric_rx_rejected_total",
             "Fabric datagrams rejected on receive", ("reason",))
+        # multi-box deployment (ISSUE 20): join bootstrap, the handoff
+        # state-transfer lane, and host-loss group promotions
+        self.fabric_join_retries = r.counter(
+            "bng_fabric_join_retries_total",
+            "Join announces re-sent by the capped-backoff bootstrap "
+            "(first attempt not counted)")
+        self.handoff_chunks = r.counter(
+            "bng_handoff_chunks_total",
+            "State-transfer chunks by disposition (rx / corrupt / dup "
+            "/ orphan / tx / retx)", ("disposition",))
+        self.handoff_transfers = r.counter(
+            "bng_handoff_transfers_total",
+            "State transfers by outcome (completed / rejected / "
+            "resumed)", ("outcome",))
+        self.cluster_host_losses = r.counter(
+            "bng_cluster_host_losses_total",
+            "Whole hosts declared lost (every member DOWN by quorum; "
+            "surviving-host HA halves promoted as a group)")
         self.fabric_coa_relayed = r.counter(
             "bng_fabric_coa_relayed_total",
             "CoA/Disconnect requests relayed off the steered shard "
@@ -993,6 +1011,34 @@ class BNGMetrics:
             n = (fabric.get("transport") or {}).get(f"rx_{reason}")
             if n is not None:
                 self.fabric_rx_rejected.set_total(n, reason=reason)
+        if "handoff" in fabric:
+            self.collect_handoff(fabric["handoff"])
+
+    def collect_handoff(self, h: dict) -> None:
+        """HandoffManager.stats() -> bng_handoff_* (one node's view:
+        the coordinator counts tx/retx, a member counts rx/rejects —
+        both expose the same families)."""
+        for disp in ("rx", "corrupt", "dup", "orphan"):
+            self.handoff_chunks.set_total(
+                h.get(f"rx_{disp}" if disp != "rx" else "rx_chunks", 0),
+                disposition=disp)
+        self.handoff_chunks.set_total(h.get("tx_chunks", 0),
+                                      disposition="tx")
+        self.handoff_chunks.set_total(h.get("retx_chunks", 0),
+                                      disposition="retx")
+        self.handoff_transfers.set_total(h.get("completed", 0),
+                                         outcome="completed")
+        self.handoff_transfers.set_total(h.get("rejects", 0),
+                                         outcome="rejected")
+        self.handoff_transfers.set_total(h.get("resumes", 0),
+                                         outcome="resumed")
+
+    def record_member(self, status: dict) -> None:
+        """MemberRuntime.status() -> the joiner-side families: the
+        bootstrap retry counter and its handoff receive lane."""
+        self.fabric_join_retries.set_total(status.get("join_retries", 0))
+        if "handoff" in status:
+            self.collect_handoff(status["handoff"])
 
     def collect_checkpoint(self, checkpointer, now: float | None = None) -> None:
         """PeriodicCheckpointer.stats -> bng_ckpt_* gauges/counters (the
@@ -1100,6 +1146,7 @@ class BNGMetrics:
         self.cluster_shed.set_total(status.get("shed_frames", 0))
         self.cluster_refused_removes.set_total(
             status.get("refused_removes", 0))
+        self.cluster_host_losses.set_total(status.get("host_losses", 0))
         if "fabric" in status:
             self.collect_fabric(status["fabric"])
 

@@ -71,6 +71,12 @@ TRIG_EXPRESS_FALLBACK = "express_fallback"
 # the beats died, the datagrams were rejected (bad sig / replay / skew
 # counters) or the member wedged while still answering
 TRIG_MEMBER_SUSPECT = "member_suspect"
+# every fabric member on one host went DOWN by accusation quorum
+# (ISSUE 20): the box vanished with both of its HA halves' state, so
+# the surviving host's standbys promote as a group instead of waiting
+# out the per-member failover stagger. The ring around the trigger
+# shows the detection→promotion timeline PERF_NOTES §22 decomposes
+TRIG_HOST_LOSS = "host_loss"
 
 
 def default_trace_dir() -> str:

@@ -639,6 +639,30 @@ def f(histogram, sock):
 """})
         assert run_on(tmp_path, {"single-writer"}) == []
 
+    def test_handoff_cursor_mutator_outside_allowlist_flagged(
+            self, tmp_path):
+        # ISSUE 20: the handoff receiver's ACK cursor / chunk map is
+        # single-writer state — a rogue module feeding chunks or
+        # manifests past the manager could half-hydrate a member
+        # without the digest gate
+        write_tree(tmp_path, {"bng_tpu/telemetry/rogue.py": """\
+def f(member, src, body):
+    member.handoff.receiver.set_manifest(src, body)
+    member.handoff.receiver.accept_chunk(src, body)
+"""})
+        found = run_on(tmp_path, {"single-writer"})
+        assert codes_of(found) == {"BNG040"}
+        assert len(found) == 2
+
+    def test_handoff_mutators_from_protocol_clean(self, tmp_path):
+        write_tree(tmp_path,
+                   {"bng_tpu/cluster/handoff/protocol.py": """\
+def f(self, msg):
+    self.receiver.set_manifest(msg.src, msg.body)
+    self.receiver.accept_chunk(msg.src, msg.body)
+"""})
+        assert run_on(tmp_path, {"single-writer"}) == []
+
 
 # ---------------------------------------------------------------------------
 # fencing (BNG050)

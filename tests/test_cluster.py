@@ -657,18 +657,19 @@ class TestClusterMetrics:
         from bng_tpu.control.metrics import BNGMetrics
 
         m = BNGMetrics()
-        # the full blocker vocabulary after ISSUE 19 shrank it: radius
-        # and peer-pool left the list (fleet workers auth directly and
-        # the peer pool is parent-side), so a config reload from the
-        # old set to the new one must DROP the retired labels
+        # the full blocker vocabulary after ISSUE 20 shrank it again:
+        # nexus joined radius and peer-pool off the list (each shard
+        # allocates against the shared store through its own
+        # HTTPAllocator), so a config reload from the old set to the
+        # new one must DROP the retired labels
         m.record_fleet_blocked(["nexus", "radius", "peer-pool"])
         assert m.slowpath_fleet_blocked.value(blocker="radius") == 1
-        m.record_fleet_blocked(["nexus", "pppoe", "sharded"])
+        m.record_fleet_blocked(["pppoe", "sharded"])
         # the satellite fix: a blocker that disappeared must leave the
         # scrape, not freeze at 1
         assert {d["blocker"]
                 for d in m.slowpath_fleet_blocked.labeled()} \
-            == {"nexus", "pppoe", "sharded"}
+            == {"pppoe", "sharded"}
         m.record_fleet_blocked([])
         assert m.slowpath_fleet_blocked.labeled() == []
 

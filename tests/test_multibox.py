@@ -1,0 +1,843 @@
+"""Multi-box deployment (ISSUE 20): the fabric state-handoff protocol,
+remote serving members, and host-loss promotion.
+
+Covers the handoff corruption matrix (truncated / bit-flipped /
+replayed / out-of-order chunks -> reject-to-re-request, never partial
+acceptance), resume-from-ACK-cursor byte identity, the lossy
+SimTransport transfer loop, the full sim-mode join -> hydrate -> serve
+-> host-loss flow (missteers == 0, group promotion, sticky renewals,
+clean audit), the `--join` backoff/give-up discipline, the fleet's
+worker-local Nexus allocation lane, the member/handoff metrics
+families, and (slow tier) the two-process loopback e2e: a real
+`bng cluster run --join` subprocess pair SIGKILLed as a host group.
+
+`make verify-multibox` runs this file (`multibox` marker, <60s); the
+tier-1 Makefile line deselects the marker so the suite runs once. The
+subprocess e2e is additionally @slow."""
+
+import base64
+import hashlib
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+import zlib
+
+import pytest
+
+from bng_tpu.chaos.faults import SimClock
+from bng_tpu.chaos.invariants import audit_invariants
+from bng_tpu.chaos.scenarios import _mac, _renew, _reply, dora_with_retries
+from bng_tpu.cluster import (ClusterCoordinator, MemberRuntime,
+                             instance_for_mac)
+from bng_tpu.cluster.fabric import SimTransport
+from bng_tpu.cluster.handoff import (HandoffError, HandoffManager,
+                                     StateReceiver, StateSender,
+                                     build_handoff_checkpoint,
+                                     parse_handoff_checkpoint)
+from bng_tpu.cluster.handoff.protocol import (KIND_ACK, KIND_CHUNK,
+                                              KIND_MANIFEST)
+from bng_tpu.control import dhcp_codec
+from bng_tpu.utils.net import ip_to_u32, u32_to_ip
+
+pytestmark = pytest.mark.multibox
+
+SPACE = ip_to_u32("10.112.0.0")
+
+
+# ---------------------------------------------------------------------------
+# handoff wire helpers (direct receiver/sender drive, no transport loop)
+# ---------------------------------------------------------------------------
+
+class _Wire:
+    """Capture transport: records every (dst, kind, body) send."""
+
+    def __init__(self):
+        self.sent = []
+
+    def send(self, dst, kind, body):
+        self.sent.append((dst, kind, body))
+
+    def take(self):
+        out, self.sent = self.sent, []
+        return out
+
+    def acks(self):
+        return [b for _d, k, b in self.sent if k == KIND_ACK]
+
+
+def _payload(n=6000, seed=1):
+    return bytes((i * 131 + seed * 17) % 256 for i in range(n))
+
+
+def _manifest_body(data, xid="x-1", chunk_size=512, digest=None):
+    n = max(1, (len(data) + chunk_size - 1) // chunk_size)
+    return {"xid": xid, "kind": "carve", "total_len": len(data),
+            "n_chunks": n, "chunk_size": chunk_size,
+            "digest": digest or hashlib.sha256(data).hexdigest(),
+            "meta": {}}
+
+
+def _chunk_body(data, seq, xid="x-1", chunk_size=512, raw=None, crc=None):
+    """One chunk frame; `raw` overrides the payload while `crc` stays
+    the TRUE slice's CRC — the tamper hook for corruption tests."""
+    true = data[seq * chunk_size: (seq + 1) * chunk_size]
+    return {"xid": xid, "seq": seq,
+            "crc": (zlib.crc32(true) & 0xFFFFFFFF) if crc is None else crc,
+            "data": base64.b64encode(true if raw is None
+                                     else raw).decode("ascii")}
+
+
+def _recv(wire=None, verify=None):
+    got = {}
+    r = StateReceiver(wire if wire is not None else _Wire(),
+                      verify=verify,
+                      on_complete=lambda s, man, d: got.update(
+                          {"src": s, "man": man, "data": d}))
+    return r, got
+
+
+class TestHandoffCorruption:
+    """Every corruption is reject-to-re-request: the receiver drops the
+    bad frame, counts it, and re-acks its cursor — it never banks a
+    byte it cannot prove."""
+
+    def test_truncated_chunk_dropped_then_rerequested(self):
+        data = _payload()
+        wire = _Wire()
+        r, got = _recv(wire)
+        r.set_manifest("tx", _manifest_body(data))
+        r.accept_chunk("tx", _chunk_body(data, 0))
+        # chunk 1 truncated in flight: CRC can't match
+        r.accept_chunk("tx", _chunk_body(data, 1,
+                                         raw=data[512:1024 - 9]))
+        assert r.stats["rx_corrupt"] == 1
+        t = r.transfers[("tx", "x-1")]
+        assert 1 not in t.chunks and t.cursor == 1
+        assert wire.acks()[-1]["cursor"] == 1  # re-ack = re-request
+        for seq in range(1, t.n_chunks):
+            r.accept_chunk("tx", _chunk_body(data, seq))
+        assert got["data"] == data
+        assert r.stats["completed"] == 1 and r.stats["rejects"] == 0
+
+    def test_bitflipped_chunk_dropped(self):
+        data = _payload()
+        r, got = _recv()
+        r.set_manifest("tx", _manifest_body(data))
+        bad = bytearray(data[0:512])
+        bad[100] ^= 0x40
+        r.accept_chunk("tx", _chunk_body(data, 0, raw=bytes(bad)))
+        assert r.stats["rx_corrupt"] == 1
+        assert r.transfers[("tx", "x-1")].chunks == {}
+        for seq in range(r.transfers[("tx", "x-1")].n_chunks):
+            r.accept_chunk("tx", _chunk_body(data, seq))
+        assert got["data"] == data
+
+    def test_bad_base64_counts_corrupt(self):
+        data = _payload()
+        r, _ = _recv()
+        r.set_manifest("tx", _manifest_body(data))
+        body = _chunk_body(data, 0)
+        body["data"] = "!!not base64!!"
+        r.accept_chunk("tx", body)
+        assert r.stats["rx_corrupt"] == 1
+
+    def test_replayed_chunk_reacks_cursor(self):
+        # a replayed (duplicate) chunk means the sender lost an ack:
+        # the receiver must re-teach it the cursor, not bank it twice
+        data = _payload()
+        wire = _Wire()
+        r, got = _recv(wire)
+        r.set_manifest("tx", _manifest_body(data))
+        r.accept_chunk("tx", _chunk_body(data, 0))
+        before = len(wire.acks())
+        r.accept_chunk("tx", _chunk_body(data, 0))        # replay
+        assert r.stats["rx_dup"] == 1
+        assert len(wire.acks()) == before + 1
+        assert wire.acks()[-1]["cursor"] == 1
+        for seq in range(1, r.transfers[("tx", "x-1")].n_chunks):
+            r.accept_chunk("tx", _chunk_body(data, seq))
+        assert got["data"] == data
+        # replay AFTER completion is a dup too, not a new transfer
+        r.accept_chunk("tx", _chunk_body(data, 0))
+        assert r.stats["rx_dup"] == 2
+
+    def test_out_of_order_chunk_acks_the_gap_immediately(self):
+        data = _payload()
+        wire = _Wire()
+        r, got = _recv(wire)
+        r.set_manifest("tx", _manifest_body(data))
+        r.accept_chunk("tx", _chunk_body(data, 3))
+        ack = wire.acks()[-1]
+        assert ack["cursor"] == 0 and ack["need"] == [0, 1, 2]
+        for seq in (0, 1, 2):
+            r.accept_chunk("tx", _chunk_body(data, seq))
+        for seq in range(4, r.transfers[("tx", "x-1")].n_chunks):
+            r.accept_chunk("tx", _chunk_body(data, seq))
+        assert got["data"] == data and r.stats["completed"] == 1
+
+    def test_orphan_chunk_without_manifest(self):
+        r, _ = _recv()
+        r.accept_chunk("tx", _chunk_body(_payload(), 0))
+        assert r.stats["rx_orphan"] == 1
+
+    def test_out_of_range_seq_is_orphan(self):
+        data = _payload()
+        r, _ = _recv()
+        r.set_manifest("tx", _manifest_body(data))
+        r.accept_chunk("tx", _chunk_body(data, 0, crc=0, raw=b"z") | {
+            "seq": 999})
+        assert r.stats["rx_orphan"] == 1
+
+    def test_bad_geometry_manifest_dropped(self):
+        r, _ = _recv()
+        r.set_manifest("tx", {"xid": "x-1", "total_len": 10,
+                              "n_chunks": 0, "chunk_size": 0,
+                              "digest": "d", "meta": {}})
+        assert r.stats["rx_orphan"] == 1 and r.transfers == {}
+
+    def test_digest_mismatch_rejects_both_sides_to_zero(self):
+        # the assembled payload fails the manifest digest: the receiver
+        # wipes its chunks (cursor 0) and the reject ack resets the
+        # sender, which restarts the stream with a fresh manifest
+        data = _payload(2000)
+        wire = _Wire()
+        r, got = _recv(wire)
+        r.set_manifest("tx", _manifest_body(data, digest="0" * 64))
+        for seq in range(4):
+            r.accept_chunk("tx", _chunk_body(data, seq))
+        assert r.stats["rejects"] == 1 and "data" not in got
+        t = r.transfers[("tx", "x-1")]
+        assert t.chunks == {} and t.cursor == 0 and not t.complete
+        rej = wire.acks()[-1]
+        assert rej["reject"] and rej["cursor"] == 0
+        swire = _Wire()
+        s = StateSender(swire, "rx", "x-1", data, chunk_size=512,
+                        clock=lambda: 0.0)
+        s.on_ack({"xid": "x-1", "cursor": 2, "need": []})
+        s.pump(0.0)
+        assert s.acked == 2
+        s.on_ack(rej)
+        assert s.rejected == 1 and s.acked == 0 and s.sent_high == 0
+        assert s.stats["manifests_tx"] == 2  # restarted from zero
+
+    def test_checkpoint_gate_rejects_structurally_bad_payload(self):
+        # digest matches (the bytes arrived faithfully) but the payload
+        # is NOT a valid checkpoint: the hydration gate must refuse it
+        data = b"not a checkpoint at all" * 50
+        wire = _Wire()
+        got = {}
+        r = StateReceiver(wire, on_complete=lambda s, man, d: got.update(
+            {"data": d}))  # default verify = checkpoint gate
+        r.set_manifest("tx", _manifest_body(data))
+        for seq in range(r.transfers[("tx", "x-1")].n_chunks):
+            r.accept_chunk("tx", _chunk_body(data, seq))
+        assert r.stats["rejects"] == 1 and "data" not in got
+        assert "checkpoint gate" in wire.acks()[-1]["reason"]
+
+    def test_good_checkpoint_payload_passes_the_gate(self):
+        data = build_handoff_checkpoint(3, {"cluster_plan": {"epoch": 3}})
+        wire = _Wire()
+        got = {}
+        r = StateReceiver(wire, on_complete=lambda s, man, d: got.update(
+            {"data": d}))
+        r.set_manifest("tx", _manifest_body(data))
+        for seq in range(r.transfers[("tx", "x-1")].n_chunks):
+            r.accept_chunk("tx", _chunk_body(data, seq))
+        assert got["data"] == data
+        assert parse_handoff_checkpoint(got["data"]) == {
+            "cluster_plan": {"epoch": 3}}
+
+    def test_interrupted_transfer_resumes_from_ack_cursor(self):
+        # sender dies mid-stream and a NEW sender (same payload, same
+        # xid) re-manifests: the receiver keeps its banked chunks and
+        # acks the cursor — the resume — and the assembly is
+        # byte-identical to an uninterrupted transfer
+        data = _payload(5120)
+        wire = _Wire()
+        r, got = _recv(wire)
+        r.set_manifest("tx", _manifest_body(data))
+        for seq in range(5):
+            r.accept_chunk("tx", _chunk_body(data, seq))
+        assert r.transfers[("tx", "x-1")].cursor == 5
+        r.set_manifest("tx", _manifest_body(data))   # the restart
+        assert r.stats["resumes"] == 1
+        t = r.transfers[("tx", "x-1")]
+        assert len(t.chunks) == 5 and t.cursor == 5  # nothing lost
+        assert wire.acks()[-1]["cursor"] == 5        # sender skips 0-4
+        for seq in range(5, t.n_chunks):
+            r.accept_chunk("tx", _chunk_body(data, seq))
+        assert got["data"] == data and r.stats["completed"] == 1
+
+    def test_different_digest_restarts_clean(self):
+        data, data2 = _payload(2048, seed=1), _payload(2048, seed=2)
+        r, _ = _recv()
+        r.set_manifest("tx", _manifest_body(data))
+        r.accept_chunk("tx", _chunk_body(data, 0))
+        r.set_manifest("tx", _manifest_body(data2))  # new content
+        assert r.stats["resumes"] == 0
+        assert r.transfers[("tx", "x-1")].chunks == {}
+
+    def test_oversized_chunk_size_refused(self):
+        with pytest.raises(HandoffError):
+            StateSender(_Wire(), "rx", "x", b"abc", chunk_size=5121)
+        with pytest.raises(HandoffError):
+            StateSender(_Wire(), "rx", "x", b"abc", chunk_size=0)
+
+
+class TestHandoffOverSimFabric:
+    def test_lossy_transfer_completes_byte_identical(self):
+        """30% drop each way: the window/need/retransmit machinery must
+        converge and deliver the exact bytes."""
+        clock = SimClock()
+        hub = SimTransport(clock, seed=3)
+        a, b = hub.endpoint("a"), hub.endpoint("b")
+        hub.set_drop("a", "b", 0.3)
+        hub.set_drop("b", "a", 0.3)
+        got = {}
+        ma = HandoffManager(a, clock=clock, verify=None)
+        mb = HandoffManager(b, clock=clock, verify=None,
+                            on_complete=lambda s, man, d:
+                            got.setdefault("data", d))
+        data = _payload(23000, seed=5)
+        sender = ma.send("b", data, kind="carve", meta={"epoch": 9})
+        for _ in range(600):
+            if sender.done:
+                break
+            clock.advance(0.25)
+            for msg in a.poll():
+                ma.handle(msg)
+            for msg in b.poll():
+                mb.handle(msg)
+            ma.pump(clock())
+            mb.pump(clock())
+        assert sender.done
+        assert got["data"] == data
+        st = mb.receiver.stats
+        assert st["completed"] == 1 and st["rejects"] == 0
+        # the drop rate forced retransmits — the recovery lane really ran
+        assert sender.stats["retx_chunks"] > 0
+        ma.prune()
+        assert ma.senders == {}
+
+    def test_manager_stats_roll_up_both_halves(self):
+        clock = SimClock()
+        hub = SimTransport(clock, seed=1)
+        a, b = hub.endpoint("a"), hub.endpoint("b")
+        ma = HandoffManager(a, clock=clock, verify=None)
+        mb = HandoffManager(b, clock=clock, verify=None)
+        sender = ma.send("b", _payload(1000), kind="carve")
+        for _ in range(50):
+            if sender.done:
+                break
+            clock.advance(0.1)
+            for msg in a.poll():
+                ma.handle(msg)
+            for msg in b.poll():
+                mb.handle(msg)
+            ma.pump(clock())
+            mb.pump(clock())
+        assert ma.stats()["senders_done"] == 1
+        assert mb.stats()["rx_chunks"] >= 1
+        assert not ma.handle(type("M", (), {"kind": "beat", "src": "b",
+                                            "body": {}})())
+
+
+# ---------------------------------------------------------------------------
+# sim-mode multi-box flow: join -> hydrate -> serve -> host loss
+# ---------------------------------------------------------------------------
+
+def _sim_cluster(seed=0, remotes=("bng-r1", "bng-r2"), host="beta"):
+    clock = SimClock()
+    hub = SimTransport(clock, seed=seed)
+    coord = ClusterCoordinator(
+        clock=clock, sub_nbuckets=0, slice_size=64,
+        space_network=SPACE, space_prefix_len=16,
+        fabric_endpoint=hub.endpoint("coordinator"),
+        fabric_beat_interval_s=0.5, fabric_suspicion_threshold=3,
+        fabric_startup_grace_s=2.0,
+        ha_probe_interval_s=0.5, ha_failure_threshold=2,
+        ha_failover_delay_s=1.0)
+    coord.add_instances(["bng-a"], host="alpha",
+                        remotes={r: host for r in remotes})
+    members = {r: MemberRuntime(hub.endpoint(r), r, host, clock=clock)
+               for r in remotes}
+    coord.remote_waiter = lambda: [m.tick(clock())
+                                   for m in members.values()]
+    return clock, hub, coord, members
+
+
+def _spin_to_serving(clock, coord, members, max_ticks=200):
+    ticks = 0
+    while not all(m.state == "serving" for m in members.values()) \
+            and ticks < max_ticks:
+        clock.advance(0.25)
+        for m in members.values():
+            m.tick(clock())
+        coord.tick()
+        ticks += 1
+    return ticks
+
+
+class TestMultiboxSimFlow:
+    def test_join_hydrate_serve_then_host_loss_promotes_group(self):
+        clock, hub, coord, members = _sim_cluster(seed=4)
+        try:
+            _spin_to_serving(clock, coord, members)
+            assert all(m.state == "serving" for m in members.values())
+            # founding carve co-dealt the remote slots: everyone serves
+            st = coord.status()
+            assert st["members"]["bng-r1"]["serving_remote"]
+            assert st["members"]["bng-r2"]["serving_remote"]
+            assert coord.handoff.stats()["senders_done"] == 2
+
+            macs = [_mac(300 + i) for i in range(24)]
+            leased = dora_with_retries(coord, macs, clock)
+            assert len(leased) == 24
+            ids = coord.member_ids()
+            remote_macs = [m for m in macs
+                           if instance_for_mac(m, ids) != "bng-a"]
+            assert remote_macs  # the carve really steers off-box
+            # the member re-checks the placement law on every frame
+            assert sum(m.missteers for m in members.values()) == 0
+            assert all(m.batches_served > 0 for m in members.values())
+
+            # whole host gone: every beta link cut in one instant
+            hub.partition("coordinator", "bng-r1")
+            hub.partition("coordinator", "bng-r2")
+            coord.remote_waiter = None
+            ticks = 0
+            while coord.host_losses == 0 and ticks < 120:
+                clock.advance(0.5)
+                coord.tick()
+                ticks += 1
+            assert coord.host_losses == 1
+            assert coord._lost_hosts == {"beta"}
+            # the HA halves promoted AS A GROUP, not one-by-one races
+            assert coord.members["bng-r1"].role == "promoted"
+            assert coord.members["bng-r2"].role == "promoted"
+            assert not coord.members["bng-r1"].remote
+            assert coord.failovers == 2
+
+            # flash crowd: renewals must ACK the ORIGINAL addresses
+            out = coord.handle_batch(
+                [(k, _renew(m, leased[m], 0x9000 + k))
+                 for k, m in enumerate(remote_macs)], now=clock())
+            for (_l, rep), m in zip(out, remote_macs):
+                assert rep is not None
+                p = _reply(rep)
+                assert p.msg_type == dhcp_codec.ACK
+                assert p.yiaddr == leased[m]
+            audit = audit_invariants(bng_cluster=coord)
+            assert audit.ok, audit.violations_by_kind()
+        finally:
+            coord.close()
+            for m in members.values():
+                m.close()
+
+    def test_host_loss_fires_callback_once_with_member_ids(self):
+        clock, hub, coord, members = _sim_cluster(seed=2)
+        calls = []
+        coord.on_host_loss = lambda h, ids: calls.append((h, ids))
+        try:
+            _spin_to_serving(clock, coord, members)
+            hub.partition("coordinator", "bng-r1")
+            hub.partition("coordinator", "bng-r2")
+            coord.remote_waiter = None
+            for _ in range(120):
+                if coord.host_losses:
+                    break
+                clock.advance(0.5)
+                coord.tick()
+            assert calls == [("beta", ["bng-r1", "bng-r2"])]
+            # a lost host never re-triggers
+            for _ in range(10):
+                clock.advance(0.5)
+                coord.tick()
+            assert coord.host_losses == 1 and len(calls) == 1
+        finally:
+            coord.close()
+            for m in members.values():
+                m.close()
+
+    def test_single_member_down_is_failover_not_host_loss(self):
+        # one process dying on a two-member host is the ISSUE 19 lane:
+        # per-member failover, no host_loss trigger
+        clock, hub, coord, members = _sim_cluster(seed=6)
+        try:
+            _spin_to_serving(clock, coord, members)
+            hub.partition("coordinator", "bng-r1")
+            coord.remote_waiter = lambda: members["bng-r2"].tick(clock())
+            for _ in range(120):
+                clock.advance(0.5)
+                members["bng-r2"].tick(clock())
+                coord.tick()
+                if coord.members["bng-r1"].role == "promoted":
+                    break
+            assert coord.members["bng-r1"].role == "promoted"
+            assert coord.host_losses == 0
+            assert coord.members["bng-r2"].remote  # still serving remote
+        finally:
+            coord.close()
+            for m in members.values():
+                m.close()
+
+    def test_scenario_is_byte_deterministic(self):
+        from bng_tpu.chaos.runner import canonical_json
+        from bng_tpu.chaos.scenarios import cluster_host_loss
+        r1 = cluster_host_loss(11)
+        r2 = cluster_host_loss(11)
+        assert r1["ok"], r1
+        assert canonical_json(r1) == canonical_json(r2)
+
+
+class TestJoinBackoff:
+    def test_join_delay_is_deterministic_capped_and_jittered(self):
+        from bng_tpu.cluster.member import _join_delay
+        a = [_join_delay("bng-r1", k) for k in range(12)]
+        b = [_join_delay("bng-r1", k) for k in range(12)]
+        assert a == b                       # replayable under a seed
+        assert all(d <= 8.0 for d in a)     # capped
+        assert _join_delay("bng-r1", 3) != _join_delay("bng-r2", 3)
+        for k, d in enumerate(a):
+            raw = min(8.0, 0.5 * 2 ** k)
+            assert raw * 0.5 <= d <= raw    # jitter window [0.5, 1.0]
+
+    def test_unreachable_coordinator_gives_up_loudly(self):
+        clock = SimClock()
+        hub = SimTransport(clock, seed=0)
+        hub.endpoint("coordinator")  # exists but never answers
+        ep = hub.endpoint("bng-r9")
+        hub.partition("bng-r9", "coordinator")
+        lines = []
+        m = MemberRuntime(ep, "bng-r9", "gamma", clock=clock,
+                          join_deadline_s=6.0, log=lines.append)
+        try:
+            for _ in range(100):
+                clock.advance(0.25)
+                m.tick(clock())
+                if m.state == "gave_up":
+                    break
+            assert m.state == "gave_up"
+            assert m.join_retries >= 2      # capped backoff retried
+            assert any("GIVING UP" in ln for ln in lines)
+            # gave_up is terminal: no more join traffic
+            retries = m.join_retries
+            clock.advance(30.0)
+            m.tick(clock())
+            assert m.join_retries == retries
+        finally:
+            m.close()
+
+    def test_join_retries_ride_the_metrics_lane(self):
+        from bng_tpu.control.metrics import BNGMetrics
+        clock = SimClock()
+        hub = SimTransport(clock, seed=0)
+        hub.endpoint("coordinator")
+        ep = hub.endpoint("bng-r8")
+        hub.partition("bng-r8", "coordinator")
+        m = MemberRuntime(ep, "bng-r8", "gamma", clock=clock,
+                          join_deadline_s=20.0)
+        met = BNGMetrics()
+        try:
+            for _ in range(40):
+                clock.advance(0.5)
+                m.tick(clock())
+            met.record_member(m.status())
+            assert met.fabric_join_retries.value() == m.join_retries > 0
+        finally:
+            m.close()
+
+
+# ---------------------------------------------------------------------------
+# fleet workers allocate through Nexus (the cleared fleet blocker)
+# ---------------------------------------------------------------------------
+
+class TestFleetNexus:
+    def _nexus(self):
+        from bng_tpu.control.cluster_http import ClusterServer
+
+        class Backend:
+            def __init__(self):
+                self.ips = {}
+                self.next = 10
+
+            def allocate(self, subscriber_id, pool_hint):
+                if subscriber_id not in self.ips:
+                    self.ips[subscriber_id] = f"10.77.0.{self.next}"
+                    self.next += 1
+                return self.ips[subscriber_id]
+
+            def lookup(self, sid):
+                return self.ips.get(sid)
+
+            def lookup_by_ip(self, ip):
+                return None
+
+            def release(self, sid):
+                return self.ips.pop(sid, None) is not None
+
+            def pool_info(self):
+                return {"pools": []}
+
+        backend = Backend()
+        srv = ClusterServer().mount_allocator(backend).start()
+        return srv, backend
+
+    def test_worker_allocates_through_nexus(self):
+        """A FleetSpec with nexus_url builds a worker-local
+        HTTPAllocator: DORA addresses come from the central authority,
+        not the local slice (the ISSUE-20 fleet-blocker clearance)."""
+        from tests.test_fleet import (SERVER_IP, SERVER_MAC, dora,
+                                      mac_of, make_pools)
+
+        from bng_tpu.control.fleet import FleetSpec, SlowPathFleet
+        srv, backend = self._nexus()
+        pools = make_pools(network="10.77.0.0")
+        spec = FleetSpec.from_pool_manager(SERVER_MAC, SERVER_IP, pools)
+        spec.nexus_url = srv.url
+        spec.nexus_node_id = "mb-test"
+        fleet = SlowPathFleet(spec, 1, pools, mode="inline")
+        try:
+            macs = [mac_of(i) for i in range(4)]
+            leased = dora(fleet, macs)
+            assert backend.ips, "workers never called Nexus"
+            for m, ip in leased.items():
+                assert u32_to_ip(ip) == backend.ips[m.hex()]
+        finally:
+            fleet.close()
+            srv.close()
+
+    def test_nexus_down_falls_back_to_local_slice(self):
+        from tests.test_fleet import (SERVER_IP, SERVER_MAC, dora,
+                                      mac_of, make_pools)
+
+        from bng_tpu.control.fleet import FleetSpec, SlowPathFleet
+        pools = make_pools(network="10.77.0.0")
+        spec = FleetSpec.from_pool_manager(SERVER_MAC, SERVER_IP, pools)
+        # nothing listens here: every allocate raises inside the worker
+        # adapter and the local slice answers instead
+        spec.nexus_url = "http://127.0.0.1:9"
+        fleet = SlowPathFleet(spec, 1, pools, mode="inline")
+        try:
+            leased = dora(fleet, [mac_of(i) for i in range(4)])
+            assert len(leased) == 4
+        finally:
+            fleet.close()
+
+
+# ---------------------------------------------------------------------------
+# metrics: member + handoff + host-loss families
+# ---------------------------------------------------------------------------
+
+class TestMultiboxMetrics:
+    def test_record_member_routes_handoff_families(self):
+        from bng_tpu.control.metrics import BNGMetrics
+        m = BNGMetrics()
+        m.record_member({
+            "join_retries": 3,
+            "handoff": {"rx_chunks": 7, "rx_corrupt": 1, "rx_dup": 2,
+                        "rx_orphan": 4, "tx_chunks": 5, "retx_chunks": 1,
+                        "completed": 1, "rejects": 6, "resumes": 2}})
+        assert m.fabric_join_retries.value() == 3
+        assert m.handoff_chunks.value(disposition="rx") == 7
+        assert m.handoff_chunks.value(disposition="corrupt") == 1
+        assert m.handoff_chunks.value(disposition="dup") == 2
+        assert m.handoff_chunks.value(disposition="orphan") == 4
+        assert m.handoff_chunks.value(disposition="tx") == 5
+        assert m.handoff_chunks.value(disposition="retx") == 1
+        assert m.handoff_transfers.value(outcome="completed") == 1
+        assert m.handoff_transfers.value(outcome="rejected") == 6
+        assert m.handoff_transfers.value(outcome="resumed") == 2
+
+    def test_record_cluster_carries_host_losses_and_handoff(self):
+        from bng_tpu.control.metrics import BNGMetrics
+        m = BNGMetrics()
+        m.record_cluster({
+            "members": {}, "recarves": 0, "failovers": 2,
+            "shed_frames": 0, "refused_removes": 0, "host_losses": 1,
+            "fabric": {"beats_tx": 1, "beats_rx": 2, "peers": {},
+                       "verdicts": {}, "partitions": 0,
+                       "handoff": {"tx_chunks": 9, "completed": 2}}})
+        assert m.cluster_host_losses.value() == 1
+        assert m.handoff_chunks.value(disposition="tx") == 9
+        assert m.handoff_transfers.value(outcome="completed") == 2
+
+    def test_scrape_names_are_prometheus_conventional(self):
+        from bng_tpu.control.metrics import BNGMetrics
+        m = BNGMetrics()
+        m.record_member({"join_retries": 1,
+                         "handoff": {"rx_chunks": 1, "completed": 1}})
+        text = m.registry.expose()
+        assert "bng_fabric_join_retries_total" in text
+        assert "bng_handoff_chunks_total" in text
+        assert "bng_handoff_transfers_total" in text
+
+
+# ---------------------------------------------------------------------------
+# two-process loopback e2e (slow tier): real UDP, real SIGKILL
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+class TestTwoProcessLoopback:
+    def test_join_serve_sigkill_host_group(self, tmp_path):
+        """The acceptance flow end to end over 127.0.0.1: two real
+        `bng cluster run --join` subprocesses hydrate their carve over
+        the UDP handoff stream and serve steered DORAs (missteers 0),
+        then the whole "host" (both processes) is SIGKILLed — the
+        surviving side promotes the HA halves as a group, renewals ACK
+        the original addresses, the accounting spool replays exactly
+        once, and the cluster audit stays clean."""
+        from bng_tpu.control.radius import packet as rp
+        from bng_tpu.control.radius.accounting import AccountingManager
+        from bng_tpu.control.radius.client import (RadiusClient,
+                                                   RadiusServerConfig)
+        from bng_tpu.control.radius.packet import RadiusPacket
+
+        coord = ClusterCoordinator(
+            sub_nbuckets=0, slice_size=64,
+            space_network=SPACE, space_prefix_len=16,
+            fabric=True, fabric_bind=("127.0.0.1", 0),
+            fabric_beat_interval_s=0.2, fabric_suspicion_threshold=3,
+            fabric_startup_grace_s=2.0,
+            ha_probe_interval_s=0.2, ha_failure_threshold=2,
+            ha_failover_delay_s=0.5)
+        procs = []
+        logs = {}
+        try:
+            port = coord.fabric_transport.addr[1]
+            coord.add_instances(["bng-a"], host="alpha",
+                                remotes={"bng-r1": "beta",
+                                         "bng-r2": "beta"})
+            env = dict(os.environ, JAX_PLATFORMS="cpu")
+            for rid in ("bng-r1", "bng-r2"):
+                log = open(tmp_path / f"{rid}.log", "w")
+                logs[rid] = log
+                procs.append(subprocess.Popen(
+                    [sys.executable, "-m", "bng_tpu.cli", "cluster",
+                     "run", "--join", f"127.0.0.1:{port}",
+                     "--node-id", rid, "--join-deadline", "90",
+                     "--status-file", str(tmp_path / f"{rid}.json")],
+                    stdout=log, stderr=log, env=env))
+            deadline = time.time() + 90
+            while time.time() < deadline:
+                coord.tick()
+                st = coord.status()["members"]
+                if all(st[r].get("serving_remote")
+                       and coord.members[r].instance is not None
+                       for r in ("bng-r1", "bng-r2")):
+                    break
+                time.sleep(0.05)
+            assert coord.members["bng-r1"].instance is not None, \
+                "bng-r1 never hydrated"
+            assert coord.members["bng-r2"].instance is not None, \
+                "bng-r2 never hydrated"
+
+            macs = [_mac(700 + i) for i in range(24)]
+
+            class _WallClock:
+                """SimClock surface over wall time (dora_with_retries
+                advances between retry rounds)."""
+
+                def __call__(self):
+                    return time.time()
+
+                def advance(self, _dt):
+                    time.sleep(0.05)
+
+            leased = dora_with_retries(coord, macs, _WallClock(),
+                                       rounds=8)
+            assert len(leased) == 24
+            ids = coord.member_ids()
+            remote_macs = [m for m in macs
+                           if instance_for_mac(m, ids) != "bng-a"]
+            assert remote_macs
+
+            # the members' own view: serving, zero missteers
+            time.sleep(1.2)  # let a --status-file refresh land
+            coord.tick()
+            for rid in ("bng-r1", "bng-r2"):
+                mst = json.loads(
+                    (tmp_path / f"{rid}.json").read_text())
+                assert mst["state"] == "serving"
+                assert mst["missteers"] == 0
+                assert mst["handoff"]["completed"] >= 1
+
+            # the lost box's accounting spool (dark RADIUS: stops spool)
+            spool = str(tmp_path / "beta.spool")
+            clk = time.time
+            dead = AccountingManager(
+                RadiusClient([RadiusServerConfig(
+                    "10.0.0.5", secret=b"mb-secret", timeout_s=0.05,
+                    retries=1)], transport=lambda *a: None, clock=clk),
+                interim_interval_s=60, spool_path=spool, clock=clk)
+            for i, m in enumerate(remote_macs[:3]):
+                sid = f"s-{m.hex()}"
+                dead.start(sid, f"sub-{i}", leased[m])
+                dead.stop(sid)
+            spooled = len(dead.pending)
+            assert spooled == 6  # start + stop per session
+
+            stops = []
+
+            def live_transport(data, host, hport, timeout):
+                req = RadiusPacket.decode(data)
+                if req.get_int(rp.ACCT_STATUS_TYPE) == rp.ACCT_STOP:
+                    stops.append(req.id)
+                return RadiusPacket(rp.ACCOUNTING_RESPONSE,
+                                    req.id).encode(
+                    b"mb-secret", request_auth=req.authenticator)
+
+            replays = []
+
+            def on_loss(host, ids_):
+                survivor = AccountingManager(
+                    RadiusClient([RadiusServerConfig(
+                        "10.0.0.5", secret=b"mb-secret", timeout_s=0.5,
+                        retries=1)], transport=live_transport,
+                        clock=clk),
+                    interim_interval_s=60, spool_path=spool, clock=clk)
+                replays.append(survivor.retry_tick())
+                replays.append(survivor.retry_tick())
+
+            coord.on_host_loss = on_loss
+
+            # SIGKILL the whole host group — the box died mid-flight
+            for p in procs:
+                p.send_signal(signal.SIGKILL)
+            for p in procs:
+                p.wait(timeout=10)
+            deadline = time.time() + 60
+            while coord.host_losses == 0 and time.time() < deadline:
+                coord.tick()
+                time.sleep(0.05)
+            assert coord.host_losses == 1
+            assert coord.members["bng-r1"].role == "promoted"
+            assert coord.members["bng-r2"].role == "promoted"
+            assert replays == [spooled, 0]   # exactly-once replay
+            assert len(stops) == 3
+
+            # flash crowd: renewals ACK the ORIGINAL addresses from the
+            # promoted surviving-host halves
+            out = coord.handle_batch(
+                [(k, _renew(m, leased[m], 0xA000 + k))
+                 for k, m in enumerate(remote_macs)], now=time.time())
+            for (_l, rep), m in zip(out, remote_macs):
+                assert rep is not None
+                p = _reply(rep)
+                assert p.msg_type == dhcp_codec.ACK
+                assert p.yiaddr == leased[m]
+
+            audit = audit_invariants(bng_cluster=coord)
+            assert audit.ok, audit.violations_by_kind()
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+                    p.wait(timeout=10)
+            for log in logs.values():
+                log.close()
+            coord.close()
